@@ -1,0 +1,31 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+The environment's sitecustomize registers the `axon` TPU backend and imports
+jax at interpreter startup with JAX_PLATFORMS=axon — initializing it tries to
+claim the single real TPU chip, which would serialize/deadlock test runs.
+jax is therefore ALREADY imported when this conftest runs; env vars are too
+late, so force the CPU platform through jax.config and set the XLA host
+device count before the first backend client is created (SURVEY §4: XLA's
+CPU backend is the "fake TPU" for sharding tests; the driver validates the
+multi-chip path the same way via __graft_entry__.dryrun_multichip).
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """Fixed seeds per test — the reference's @with_seed decorator pattern."""
+    np.random.seed(0)
+    import tpu_mx as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,210 @@
+"""NDArray handle semantics tests (model: REF:tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+from tpu_mx.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3) and a.dtype == np.float32
+    assert_almost_equal(a, np.zeros((2, 3)))
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.0)
+    assert_almost_equal(c, np.full((2, 2), 7.0))
+    d = nd.arange(0, 10, 2)
+    assert_almost_equal(d, np.arange(0, 10, 2, dtype=np.float32))
+    e = nd.array([[1, 2], [3, 4]])
+    assert e.shape == (2, 2)
+
+
+def test_arithmetic_broadcast():
+    a = nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    b = nd.array(np.ones((1, 3), np.float32))
+    assert_almost_equal(a + b, a.asnumpy() + 1)
+    assert_almost_equal(a - 2.0, a.asnumpy() - 2)
+    assert_almost_equal(3.0 - a, 3 - a.asnumpy())
+    assert_almost_equal(a * a, a.asnumpy() ** 2)
+    assert_almost_equal(a / (a + 1), a.asnumpy() / (a.asnumpy() + 1))
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(-a, -a.asnumpy())
+
+
+def test_inplace_and_setitem():
+    a = nd.zeros((3, 3))
+    a[:] = 2.0
+    assert_almost_equal(a, np.full((3, 3), 2.0))
+    a += 1
+    assert_almost_equal(a, np.full((3, 3), 3.0))
+    a[1] = 9.0
+    assert a.asnumpy()[1, 0] == 9.0
+    a[0, 1] = -1.0
+    assert a.asnumpy()[0, 1] == -1.0
+    a[0:2, 0] = 5.0
+    assert a.asnumpy()[1, 0] == 5.0
+    ver = a._version
+    a *= 2
+    assert a._version > ver
+
+
+def test_indexing_slicing():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a[1], x[1])
+    assert_almost_equal(a[:, 1], x[:, 1])
+    assert_almost_equal(a[1, 2, 3], x[1, 2, 3])
+    assert_almost_equal(a[:, :, ::2], x[:, :, ::2])
+    idx = nd.array(np.array([0, 1]), dtype="int32")
+    assert_almost_equal(a[idx], x[[0, 1]])
+
+
+def test_reshape_transpose():
+    x = np.arange(12).reshape(3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.reshape(4, 3), x.reshape(4, 3))
+    assert_almost_equal(a.reshape((2, 6)), x.reshape(2, 6))
+    assert_almost_equal(nd.reshape(a, shape=(-1, 2)), x.reshape(-1, 2))
+    assert_almost_equal(nd.reshape(a, shape=(0, -1)), x.reshape(3, -1))
+    assert_almost_equal(a.T, x.T)
+    assert_almost_equal(a.transpose(), x.T)
+    assert_almost_equal(a.expand_dims(0), x[None])
+    assert_almost_equal(nd.flatten(nd.array(np.ones((2, 3, 4)))), np.ones((2, 12)))
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum())
+    assert_almost_equal(a.sum(axis=1), x.sum(1))
+    assert_almost_equal(nd.sum(a, axis=(0, 2)), x.sum((0, 2)))
+    assert_almost_equal(a.mean(axis=0, keepdims=True), x.mean(0, keepdims=True))
+    assert_almost_equal(a.max(axis=2), x.max(2))
+    assert_almost_equal(a.min(), x.min())
+    assert_almost_equal(nd.norm(a), np.sqrt((x ** 2).sum()))
+    assert int(a.argmax().asscalar()) == x.argmax()
+
+
+def test_dot_batchdot():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a @ b)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b.T), transpose_b=True), a @ b)
+    ba = np.random.rand(2, 3, 4).astype(np.float32)
+    bb = np.random.rand(2, 4, 5).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(ba), nd.array(bb)), ba @ bb)
+
+
+def test_concat_stack_split():
+    a = np.ones((2, 3), np.float32)
+    b = np.zeros((2, 3), np.float32)
+    assert_almost_equal(nd.concat(nd.array(a), nd.array(b), dim=1),
+                        np.concatenate([a, b], 1))
+    assert_almost_equal(nd.stack(nd.array(a), nd.array(b), axis=0), np.stack([a, b]))
+    parts = nd.split(nd.array(np.arange(8).reshape(2, 4).astype(np.float32)), 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (2, 2)
+
+
+def test_take_pick_onehot():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 5, 7])
+    assert_almost_equal(nd.take(nd.array(w), nd.array(idx, dtype="int32")), w[idx])
+    data = np.random.rand(3, 5).astype(np.float32)
+    picks = np.array([0, 2, 4])
+    assert_almost_equal(nd.pick(nd.array(data), nd.array(picks, dtype="int32"), axis=1),
+                        data[np.arange(3), picks])
+    oh = nd.one_hot(nd.array(np.array([0, 2]), dtype="int32"), 3)
+    assert_almost_equal(oh, np.eye(3, dtype=np.float32)[[0, 2]])
+
+
+def test_type_cast_and_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.astype("int32")
+    assert c.dtype == np.int32
+    assert a.context.kind in ("cpu", "tpu")
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context.kind == "cpu"
+
+
+def test_copy_copyto():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b[:] = 5
+    assert a.asnumpy()[0, 0] == 1.0
+    c = nd.zeros((2, 2))
+    a.copyto(c)
+    assert c.asnumpy()[0, 0] == 1.0
+
+
+def test_save_load(tmp_path):
+    a = nd.array(np.random.rand(3, 3).astype(np.float32))
+    b = nd.array(np.random.rand(2,).astype(np.float32))
+    f = str(tmp_path / "nds.npz")
+    nd.save(f, [a, b])
+    la, lb = nd.load(f)
+    assert_almost_equal(la, a)
+    assert_almost_equal(lb, b)
+    nd.save(f, {"x": a, "y": b})
+    d = nd.load(f)
+    assert_almost_equal(d["x"], a)
+
+
+def test_wait_and_scalar():
+    a = nd.ones((2,))
+    a.wait_to_read()
+    nd.waitall()
+    s = nd.array([3.5])
+    assert float(s.asscalar()) == 3.5
+    assert len(a) == 2
+    with pytest.raises(ValueError):
+        bool(nd.ones((2, 2)))
+
+
+def test_comparison_where_clip():
+    x = np.array([[1.0, -2.0], [3.0, 0.0]], np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a > 0, (x > 0).astype(np.float32))
+    assert_almost_equal(nd.where(a > 0, a, -a), np.abs(x))
+    assert_almost_equal(nd.clip(a, -1, 1), np.clip(x, -1, 1))
+
+
+def test_elementwise_math():
+    x = np.random.rand(4, 4).astype(np.float32) + 0.5
+    a = nd.array(x)
+    assert_almost_equal(nd.sqrt(a), np.sqrt(x))
+    assert_almost_equal(nd.exp(a), np.exp(x), rtol=1e-4)
+    assert_almost_equal(nd.log(a), np.log(x))
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-4)
+    assert_almost_equal(nd.tanh(a), np.tanh(x), rtol=1e-4)
+    assert_almost_equal(nd.relu(nd.array(x - 1)), np.maximum(x - 1, 0))
+    assert_almost_equal(nd.square(a), x ** 2)
+    assert_almost_equal(nd.abs(nd.array(-x)), x)
+    assert_almost_equal(nd.maximum(a, 1.0), np.maximum(x, 1.0))
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= a.asnumpy().min() and a.asnumpy().max() <= 1
+    mx.random.seed(42)
+    b = nd.random.uniform(0, 1, shape=(100,))
+    assert_almost_equal(a, b)  # deterministic under fixed seed
+    c = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(c.asnumpy().mean())) < 0.2
+    d = nd.random.randint(0, 10, shape=(50,))
+    assert d.asnumpy().min() >= 0 and d.asnumpy().max() < 10
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    a = nd.array(x)
+    v = nd.topk(a, k=2, ret_typ="value")
+    assert_almost_equal(v, np.sort(x, axis=1)[:, ::-1][:, :2])
+    s = nd.sort(a, axis=1)
+    assert_almost_equal(s, np.sort(x, 1))
+    i = nd.argsort(a, axis=1)
+    assert_almost_equal(i, np.argsort(x, 1).astype(np.float32))

@@ -1,0 +1,28 @@
+"""tpu_mx — a TPU-native deep learning framework with the capabilities of the
+reference (`anandj91/anand-mxnet`, an Apache MXNet 1.x fork), built on
+JAX/XLA/Pallas/pjit.  See SURVEY.md for the capability blueprint.
+
+Import surface mirrors the reference's `import mxnet as mx`:
+    mx.nd, mx.autograd, mx.gluon, mx.optimizer, mx.metric, mx.init,
+    mx.context / mx.cpu() / mx.gpu(i) / mx.tpu(i), mx.kvstore, mx.random,
+    mx.profiler, mx.io, mx.recordio, mx.test_utils, mx.runtime
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,
+                      num_tpus, tpu)
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import metric
+from . import gluon
+from . import kvstore
+from . import kvstore as kv
+from . import contrib
+from . import test_utils

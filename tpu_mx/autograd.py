@@ -1,0 +1,281 @@
+"""Imperative autograd: `record() / pause() / backward()` over a Python tape.
+
+TPU-native analog of the reference's autograd (REF:src/imperative/imperative.cc
+``Imperative::RecordOp/Backward``, REF:python/mxnet/autograd.py).  The reference
+records an NNVM tape of FGradient closures; here every recorded op stores the
+``jax.vjp`` pullback of its pure function.  ``backward()`` walks the tape in
+reverse creation order accumulating cotangents — the same semantics
+(grad_req write/add, head gradients, retain_graph) without a graph IR, because
+XLA is the graph layer underneath.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode",
+    "is_recording", "is_training", "mark_variables", "backward", "grad",
+    "Function", "get_symbol",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape = []
+
+
+_STATE = _State()
+
+
+class _TapeNode:
+    """One recorded op: pullback + input/output bookkeeping.
+
+    Outputs are held by strong reference: the cotangent accumulator keys on
+    id(), so an output collected mid-graph would let CPython reuse its id and
+    misroute cotangents — keeping outputs alive until the tape is dropped
+    makes id() keys sound (the reference ties graph lifetime to NDArray
+    refcounts the same way)."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "out_meta", "out_ids", "name")
+
+    def __init__(self, vjp_fn, inputs, outputs, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs                       # list[NDArray] (strong refs keep leaves alive)
+        self.outputs = list(outputs)
+        self.out_meta = [(o.shape, o.dtype) for o in outputs]
+        self.out_ids = [id(o) for o in outputs]
+        self.name = name
+
+
+# ----------------------------------------------------------------------------
+# recording scopes
+# ----------------------------------------------------------------------------
+class _RecordingScope:
+    def __init__(self, recording, training):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        self._old = (_STATE.recording, _STATE.training)
+        if self._rec and not _STATE.recording:
+            # entering the outermost record scope starts a fresh graph; a
+            # prior recorded-but-never-backwarded forward (e.g. an aborted
+            # step) is dropped here, bounding tape memory
+            _STATE.tape = []
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._old
+        return False
+
+
+def record(train_mode=True):
+    """``with autograd.record():`` — start taping ops (and set train mode)."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference: MXAutogradMarkVariables)."""
+    if not isinstance(variables, (list, tuple)):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+# ----------------------------------------------------------------------------
+# tape write path (called from ndarray._imperative_invoke)
+# ----------------------------------------------------------------------------
+def _needs_tape(arrays):
+    return _STATE.recording and any(
+        getattr(a, "_grad", None) is not None or getattr(a, "_tape_node", None) is not None
+        for a in arrays
+    )
+
+
+def _record_op(vjp_fn, inputs, outputs, name=""):
+    node = _TapeNode(vjp_fn, inputs, outputs, name)
+    for o in outputs:
+        o._tape_node = node
+    _STATE.tape.append(node)
+    return node
+
+
+# ----------------------------------------------------------------------------
+# backward
+# ----------------------------------------------------------------------------
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse-accumulate gradients from ``heads`` into every leaf with an
+    attached grad buffer.  Matches reference semantics: default head gradient
+    is ones; ``grad_req='add'`` accumulates across backward calls."""
+    from .ndarray import NDArray  # late import (cycle)
+
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    # cotangent accumulator keyed by output NDArray identity
+    cot = {}
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            g = jnp.ones(h.shape, h.dtype)
+        else:
+            g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        cot[id(h)] = cot[id(h)] + g if id(h) in cot else g
+
+    tape = _STATE.tape
+    leaf_grads = {}  # id(leaf NDArray) -> (leaf, accumulated grad)
+
+    for node in reversed(tape):
+        outs_ct = [cot.get(oid) for oid in node.out_ids]
+        if all(c is None for c in outs_ct):
+            continue
+        full_ct = tuple(
+            c if c is not None else jnp.zeros(shape, dtype)
+            for c, (shape, dtype) in zip(outs_ct, node.out_meta)
+        )
+        in_cts = node.vjp_fn(full_ct if len(full_ct) > 1 else full_ct[0])
+        for inp, ict in zip(node.inputs, in_cts):
+            if ict is None:
+                continue
+            if getattr(inp, "_grad", None) is not None:
+                key = id(inp)
+                if key in leaf_grads:
+                    leaf_grads[key] = (inp, leaf_grads[key][1] + ict)
+                else:
+                    leaf_grads[key] = (inp, ict)
+            if getattr(inp, "_tape_node", None) is not None:
+                key = id(inp)
+                cot[key] = cot[key] + ict if key in cot else ict
+
+    for leaf, g in leaf_grads.values():
+        g = g.astype(leaf.dtype)
+        if leaf._grad_req == "add":
+            leaf._grad._data = leaf._grad._data + g
+        elif leaf._grad_req != "null":
+            leaf._grad._data = g
+
+    if not retain_graph:
+        for node in tape:
+            node.vjp_fn = None
+        _STATE.tape = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional-style gradient (reference: mx.autograd.grad): returns grads of
+    ``heads`` w.r.t. ``variables`` without touching attached .grad buffers."""
+    from .ndarray import NDArray
+
+    single = not isinstance(variables, (list, tuple))
+    if single:
+        variables = [variables]
+    saved = [(v, getattr(v, "_grad", None), getattr(v, "_grad_req", "write")) for v in variables]
+    tape_backup = list(_STATE.tape)
+    try:
+        for v in variables:
+            v._grad = NDArray(jnp.zeros(v.shape, v.dtype))
+            v._grad_req = "write"
+        backward(heads, head_grads, retain_graph=True, train_mode=train_mode)
+        results = [v._grad for v in variables]
+    finally:
+        for (v, g, req) in saved:
+            v._grad, v._grad_req = g, req
+        if retain_graph:
+            _STATE.tape = tape_backup
+        else:
+            _STATE.tape = []
+    return results[0] if single else results
+
+
+def get_symbol(x):  # reference API parity: symbolic extraction is not applicable
+    raise NotImplementedError(
+        "get_symbol: the TPU-native stack has no NNVM symbol; use HybridBlock.export()"
+    )
+
+
+# ----------------------------------------------------------------------------
+# custom differentiable functions (reference: mx.autograd.Function)
+# ----------------------------------------------------------------------------
+class Function:
+    """User-defined op with custom forward/backward, reference-compatible:
+
+        class Sigmoid(Function):
+            def forward(self, x): ...  (NDArray math, saves with self.save_for_backward)
+            def backward(self, dy): ... (returns grads for each forward input)
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single_out = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single_out else list(outputs)
+
+        if _needs_tape(inputs):
+            fn = self
+
+            def vjp_fn(out_ct):
+                cts = (out_ct,) if single_out else tuple(out_ct)
+                with pause():
+                    in_grads = fn.backward(*[NDArray(c) for c in cts])
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return tuple(
+                    (g._data if isinstance(g, NDArray) else g) if g is not None else None
+                    for g in in_grads
+                )
+
+            _record_op(vjp_fn, list(inputs), outs, name=type(self).__name__)
+        return outs[0] if single_out else outs

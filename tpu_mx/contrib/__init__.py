@@ -1,0 +1,2 @@
+"""mx.contrib — auxiliary capabilities (REF:python/mxnet/contrib/)."""
+from . import compression

@@ -1,0 +1,49 @@
+"""2-bit gradient compression with error feedback
+(REF:src/kvstore/gradient_compression.{cc,cu,h}).
+
+The reference quantizes gradients to 2 bits around ±threshold before the PS
+push and keeps the quantization error as a residual added to the next
+gradient.  TPU-native form: the same quantize→dequantize+residual math as a
+pure jax function (jit-able, so it can also ride inside a compiled train step
+as a quantized-allreduce building block — SURVEY §2.3 stretch goal).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["GradientCompression", "quantize_2bit_core"]
+
+
+def quantize_2bit_core(grad, residual, threshold):
+    """Returns (dequantized_grad, new_residual): values snap to
+    {-threshold, 0, +threshold}; the rounding error feeds back."""
+    acc = grad + residual
+    q = jnp.where(acc >= threshold, threshold,
+                  jnp.where(acc <= -threshold, -threshold, 0.0)).astype(acc.dtype)
+    return q, acc - q
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):
+        if type != "2bit":
+            raise ValueError(f"unsupported compression type {type!r} (have: 2bit)")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals = {}
+
+    def compress_decompress(self, grad, key=None):
+        """Round-trip a gradient through the 2-bit wire format (what a worker
+        would push and then receive back aggregated)."""
+        raw = grad._data if isinstance(grad, NDArray) else grad
+        rkey = key if key is not None else id(grad)
+        residual = self._residuals.get(rkey)
+        if residual is None or residual.shape != raw.shape:
+            residual = jnp.zeros_like(raw)
+        q, new_residual = quantize_2bit_core(raw, residual, self.threshold)
+        self._residuals[rkey] = new_residual
+        return NDArray(q) if isinstance(grad, NDArray) else q
+
+    def get_params(self):
+        return {"type": self.type, "threshold": self.threshold}

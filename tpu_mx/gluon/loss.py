@@ -1,0 +1,292 @@
+"""Gluon losses (REF:python/mxnet/gluon/loss.py)."""
+from __future__ import annotations
+
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss", "KLDivLoss",
+           "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
+           "TripletLoss", "CTCLoss", "CosineEmbeddingLoss"]
+
+
+def _apply_weighting(F, loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(F, x, y):
+    return F.reshape(x, shape=y.shape)
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight, batch_axis, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(label - pred)
+        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
+        ndim = len(loss.shape)
+        return F.mean(loss, axis=tuple(i for i in range(ndim)
+                                       if i != self._batch_axis))
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(label - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        ndim = len(loss.shape)
+        return F.mean(loss, axis=tuple(i for i in range(ndim)
+                                       if i != self._batch_axis))
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """REF:gluon/loss.py:SoftmaxCrossEntropyLoss — fused log-softmax + pick."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -F.pick(pred, label, axis=self._axis)
+        else:
+            label = _reshape_like(F, label, pred)
+            loss = -F.sum(pred * label, axis=self._axis)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        ndim = len(loss.shape)
+        axes = tuple(i for i in range(ndim) if i != self._batch_axis)
+        return F.mean(loss, axis=axes) if axes else loss
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       pos_weight=None):
+        label = _reshape_like(F, label, pred)
+        if not self._from_sigmoid:
+            # log-sum-exp stable BCE-with-logits
+            loss = F.relu(pred) - pred * label + \
+                F.Activation(-F.abs(pred), act_type="softrelu")
+        else:
+            eps = 1e-12
+            loss = -(F.log(pred + eps) * label +
+                     F.log(1.0 - pred + eps) * (1.0 - label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        ndim = len(loss.shape)
+        return F.mean(loss, axis=tuple(i for i in range(ndim)
+                                       if i != self._batch_axis))
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        loss = label * (F.log(label + 1e-12) - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        ndim = len(loss.shape)
+        return F.mean(loss, axis=tuple(i for i in range(ndim)
+                                       if i != self._batch_axis))
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(label - pred)
+        loss = F.where(loss > self._rho,
+                       loss - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(loss))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        ndim = len(loss.shape)
+        return F.mean(loss, axis=tuple(i for i in range(ndim)
+                                       if i != self._batch_axis))
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.relu(self._margin - pred * label)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        ndim = len(loss.shape)
+        return F.mean(loss, axis=tuple(i for i in range(ndim)
+                                       if i != self._batch_axis))
+
+
+class SquaredHingeLoss(HingeLoss):
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(F.relu(self._margin - pred * label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        ndim = len(loss.shape)
+        return F.mean(loss, axis=tuple(i for i in range(ndim)
+                                       if i != self._batch_axis))
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = F.relu(pred) - pred * label + \
+            F.Activation(-F.abs(pred), act_type="softrelu")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        ndim = len(loss.shape)
+        return F.mean(loss, axis=tuple(i for i in range(ndim)
+                                       if i != self._batch_axis))
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(F, positive, pred)
+        negative = _reshape_like(F, negative, pred)
+        ndim = len(pred.shape)
+        axes = tuple(range(1, ndim))
+        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
+                     axis=axes) + self._margin
+        loss = F.relu(loss)
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        ndim = len(input1.shape)
+        axes = tuple(range(1, ndim))
+        num = F.sum(input1 * input2, axis=axes)
+        den = F.sqrt(F.sum(F.square(input1), axis=axes)) * \
+            F.sqrt(F.sum(F.square(input2), axis=axes))
+        cos = num / (den + 1e-12)
+        label = F.reshape(label, shape=cos.shape)
+        loss = F.where(label == 1, 1.0 - cos, F.relu(cos - self._margin))
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class CTCLoss(Loss):
+    """CTC (REF:gluon/loss.py:CTCLoss, warp-ctc kernel in the reference) via a
+    lax.scan dynamic program — XLA-compilable, O(T·2L)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        import jax
+        import jax.numpy as jnp
+        from ..ndarray import NDArray
+
+        def _raw(x):
+            return x._data if isinstance(x, NDArray) else (
+                None if x is None else jnp.asarray(x))
+
+        raw_label = _raw(label)
+        raw_pl = _raw(pred_lengths)
+        raw_ll = _raw(label_lengths)
+
+        def ctc(p, lab):
+            if self._layout == "NTC":
+                p = jnp.swapaxes(p, 0, 1)  # -> (T, N, C)
+            T, N, C = p.shape
+            logp = jax.nn.log_softmax(p, axis=-1)
+            L = lab.shape[1]
+            blank = 0
+            # extended label seq: blank, l1, blank, l2, ... blank  (2L+1)
+            ext = jnp.full((N, 2 * L + 1), blank, dtype=jnp.int32)
+            ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+            S = 2 * L + 1
+            neg_inf = -1e30
+            alpha0 = jnp.full((N, S), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
+
+            same_as_prev2 = jnp.concatenate(
+                [jnp.ones((N, 2), bool),
+                 ext[:, 2:] == ext[:, :-2]], axis=1)
+
+            def step(alpha, logp_t):
+                a = alpha
+                a1 = jnp.concatenate([jnp.full((N, 1), neg_inf), a[:, :-1]], 1)
+                a2 = jnp.concatenate([jnp.full((N, 2), neg_inf), a[:, :-2]], 1)
+                a2 = jnp.where(same_as_prev2, neg_inf, a2)
+                merged = jnp.logaddexp(jnp.logaddexp(a, a1), a2)
+                emit = jnp.take_along_axis(logp_t, ext, axis=1)
+                return merged + emit, merged + emit
+
+            _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+            alphas = jnp.concatenate([alpha0[None], alphas], 0)  # (T, N, S)
+            # per-sample end time: pred_lengths-1 (default T-1)
+            t_end = (raw_pl.astype(jnp.int32) - 1 if raw_pl is not None
+                     else jnp.full((N,), T - 1, jnp.int32))
+            alpha_end = jnp.take_along_axis(
+                alphas, t_end.reshape(1, N, 1), axis=0)[0]  # (N, S)
+            # per-sample final states: 2*label_len and 2*label_len-1
+            ll = (raw_ll.astype(jnp.int32) if raw_ll is not None
+                  else jnp.full((N,), L, jnp.int32))
+            s_last = 2 * ll          # index of trailing blank in ext
+            a_blank = jnp.take_along_axis(alpha_end, s_last[:, None], 1)[:, 0]
+            a_label = jnp.take_along_axis(
+                alpha_end, jnp.maximum(s_last - 1, 0)[:, None], 1)[:, 0]
+            return -jnp.logaddexp(a_blank, a_label)
+
+        if isinstance(pred, NDArray):
+            from ..ndarray.ops import _apply
+            return _apply(lambda p: ctc(p, raw_label), [pred], "CTCLoss")
+        return ctc(_raw(pred), raw_label)

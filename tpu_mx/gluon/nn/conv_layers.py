@@ -1,0 +1,196 @@
+"""Gluon conv/pool layers (REF:python/mxnet/gluon/nn/conv_layers.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import HybridBlock
+from .basic_layers import Activation
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+           "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
+           "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D"]
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    """Shared conv machinery; lowered to `lax.conv_general_dilated` via
+    nd.Convolution (REF:src/operator/nn/convolution.cc analog)."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 transpose=False, output_padding=0, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._strides = _tuple(strides, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._dilation = _tuple(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._transpose = transpose
+        self._output_padding = _tuple(output_padding, ndim)
+        wshape = ((in_channels, channels // groups) if transpose
+                  else (channels, in_channels // groups if in_channels else 0)) \
+            + kernel_size
+        self.weight = self.params.get("weight", shape=wshape, dtype=dtype,
+                                      init=weight_initializer,
+                                      allow_deferred_init=True)
+        if use_bias:
+            self.bias = self.params.get("bias", shape=(channels,), dtype=dtype,
+                                        init=bias_initializer,
+                                        allow_deferred_init=True)
+        else:
+            self.bias = None
+        self.act = Activation(activation) if activation else None
+
+    def infer_shape(self, x, *args):
+        c_in = x.shape[1]
+        if self._transpose:
+            self.weight.shape_hint((c_in, self._channels // self._groups)
+                                   + self._kernel)
+        else:
+            self.weight.shape_hint((self._channels, c_in // self._groups)
+                                   + self._kernel)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        if self._transpose:
+            out = F.Deconvolution(x, weight, bias, kernel=self._kernel,
+                                  stride=self._strides, dilate=self._dilation,
+                                  pad=self._padding, adj=self._output_padding,
+                                  num_filter=self._channels,
+                                  num_group=self._groups,
+                                  no_bias=bias is None)
+        else:
+            out = F.Convolution(x, weight, bias, kernel=self._kernel,
+                                stride=self._strides, dilate=self._dilation,
+                                pad=self._padding, num_filter=self._channels,
+                                num_group=self._groups, no_bias=bias is None)
+        return self.act(out) if self.act else out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._in_channels or None} -> "
+                f"{self._channels}, kernel_size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, global_pool, pool_type,
+                 layout, ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(**kwargs)
+        self._kernel = pool_size
+        self._stride = strides if strides is not None else pool_size
+        self._pad = padding
+        self._global = global_pool
+        self._type = pool_type
+        self._convention = "full" if ceil_mode else "valid"
+        self._count_include_pad = count_include_pad
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, kernel=self._kernel, pool_type=self._type,
+                         global_pool=self._global, stride=self._stride,
+                         pad=self._pad, pooling_convention=self._convention,
+                         count_include_pad=self._count_include_pad)
+
+    def __repr__(self):
+        if self._global:
+            return f"{type(self).__name__}"
+        return (f"{type(self).__name__}(size={self._kernel}, "
+                f"stride={self._stride}, padding={self._pad})")
+
+
+def _make_pool(name, ndim, ptype, global_pool):
+    if global_pool:
+        class GPool(_Pool):
+            def __init__(self, layout=None, **kwargs):
+                super().__init__((1,) * ndim, None, (0,) * ndim, True, ptype,
+                                 layout, **kwargs)
+        GPool.__name__ = GPool.__qualname__ = name
+        return GPool
+
+    class Pool(_Pool):
+        def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
+                     ceil_mode=False, **kwargs):
+            super().__init__(_tuple(pool_size, ndim),
+                             _tuple(strides, ndim) if strides is not None else None,
+                             _tuple(padding, ndim), False, ptype, layout,
+                             ceil_mode=ceil_mode, **kwargs)
+    Pool.__name__ = Pool.__qualname__ = name
+    return Pool
+
+
+MaxPool1D = _make_pool("MaxPool1D", 1, "max", False)
+MaxPool2D = _make_pool("MaxPool2D", 2, "max", False)
+MaxPool3D = _make_pool("MaxPool3D", 3, "max", False)
+AvgPool1D = _make_pool("AvgPool1D", 1, "avg", False)
+AvgPool2D = _make_pool("AvgPool2D", 2, "avg", False)
+AvgPool3D = _make_pool("AvgPool3D", 3, "avg", False)
+GlobalMaxPool1D = _make_pool("GlobalMaxPool1D", 1, "max", True)
+GlobalMaxPool2D = _make_pool("GlobalMaxPool2D", 2, "max", True)
+GlobalMaxPool3D = _make_pool("GlobalMaxPool3D", 3, "max", True)
+GlobalAvgPool1D = _make_pool("GlobalAvgPool1D", 1, "avg", True)
+GlobalAvgPool2D = _make_pool("GlobalAvgPool2D", 2, "avg", True)
+GlobalAvgPool3D = _make_pool("GlobalAvgPool3D", 3, "avg", True)

@@ -1,0 +1,301 @@
+"""Gluon Parameter / ParameterDict (REF:python/mxnet/gluon/parameter.py).
+
+Capabilities kept from the reference: deferred (shape-inferred) init,
+`grad_req` modes, per-device data access, `shared` params, constant params.
+TPU-native addition: a *substitution scope* — during a functional trace
+(`Block.apply`, the hybridize/jit path) `param.data()` yields the traced value
+injected by the caller instead of the stored buffer, which is what lets one
+imperative Gluon definition double as a pure jittable function of its pytree.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd, initializer as init_mod
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray, array
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class _Substitution(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_SUBST = _Substitution()
+
+
+@contextlib.contextmanager
+def param_substitution(mapping, updates=None):
+    """mapping: {param_name: raw jax value}; updates collects aux mutations."""
+    _SUBST.stack.append((mapping, updates if updates is not None else {}))
+    try:
+        yield _SUBST.stack[-1][1]
+    finally:
+        _SUBST.stack.pop()
+
+
+def _active_substitution():
+    return _SUBST.stack[-1] if _SUBST.stack else None
+
+
+class Parameter:
+    """A weight/aux tensor owned by Blocks."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._data = None          # NDArray
+        self._deferred_init_args = None
+
+    # -- init ----------------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data.drop_grad()
+            else:
+                self._data.attach_grad(req)
+
+    def _shape_incomplete(self):
+        return self.shape is None or any(s in (0, None, -1) for s in self.shape)
+
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        if self._shape_incomplete():
+            if not self.allow_deferred_init:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has unknown shape {self.shape}")
+            self._deferred_init_args = (init, ctx, default_init)
+            return
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        initializer = init or self.init or default_init or init_mod.Uniform(0.07)
+        if isinstance(initializer, str):
+            initializer = init_mod.registry.create(initializer)
+        data = initializer(self.name, self.shape, self.dtype)
+        self._data = array(data, ctx=ctx or current_context(), dtype=self.dtype)
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+        self._deferred_init_args = None
+
+    def _finish_deferred_init(self, shape):
+        self.shape = tuple(int(s) for s in shape)
+        if self._deferred_init_args is None:
+            self._deferred_init_args = (None, None, None)
+        self._finish_init(*self._deferred_init_args)
+
+    def shape_hint(self, shape):
+        """Fill in unknown dims (0/None) from an observed shape at first call."""
+        if self.shape is None:
+            self.shape = tuple(shape)
+            return
+        self.shape = tuple(o if (s in (0, None, -1)) else s
+                           for s, o in zip(self.shape, shape))
+
+    # -- access --------------------------------------------------------------
+    def data(self, ctx=None):
+        sub = _active_substitution()
+        if sub is not None and self.name in sub[0]:
+            return sub[0][self.name]  # traced value inside functional apply
+        if self._data is None:
+            if self._deferred_init_args is not None or self._shape_incomplete():
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} deferred-init pending; run a forward "
+                    "pass with real data first")
+            raise MXNetError(f"Parameter {self.name} not initialized")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    @property
+    def grad(self):
+        if self._data is None or self._data.grad is None:
+            raise MXNetError(f"Parameter {self.name} has no gradient buffer")
+        return self._data.grad
+
+    def list_grad(self):
+        return [self.grad]
+
+    def zero_grad(self):
+        if self._data is not None and self._data.grad is not None:
+            self._data.grad._rebind(jnp.zeros(self._data.shape, self._data.dtype))
+
+    def set_data(self, data):
+        if self.shape is not None and len(self.shape) == len(data.shape):
+            for want, got in zip(self.shape, data.shape):
+                if want not in (0, None, -1) and want != got:
+                    raise MXNetError(
+                        f"Parameter {self.name}: shape mismatch, declared "
+                        f"{self.shape} but got data of shape {tuple(data.shape)}")
+        elif self.shape is not None and any(s not in (0, None, -1)
+                                            for s in self.shape):
+            raise MXNetError(
+                f"Parameter {self.name}: rank mismatch, declared {self.shape} "
+                f"but got data of shape {tuple(data.shape)}")
+        if self._data is None:
+            self.shape = tuple(data.shape)
+            self._data = data if isinstance(data, NDArray) else array(data)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+        else:
+            self._data._rebind(
+                (data._data if isinstance(data, NDArray) else jnp.asarray(data))
+                .astype(self._data.dtype).reshape(self._data.shape))
+
+    def _register_mutation(self, new_value):
+        """Aux-state write (BatchNorm running stats): eager → in-place rebind;
+        inside a trace → recorded into the apply-scope updates dict."""
+        sub = _active_substitution()
+        if sub is not None:
+            sub[1][self.name] = new_value
+        else:
+            self._data._rebind(jnp.asarray(new_value).astype(self._data.dtype))
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            had_grad = self._data.grad is not None
+            self._data = NDArray(self._data._data.astype(dtype))
+            if had_grad:
+                self._data.attach_grad(self._grad_req)
+
+    def reset_ctx(self, ctx):
+        pass  # single logical device per process in the TPU stack; mesh handles spread
+
+    def var(self):
+        raise NotImplementedError("symbolic var() is not part of the TPU-native stack")
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        value = np.asarray(value)
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype), init=None, differentiable=False)
+        self._value = value
+
+    def _finish_init(self, init, ctx, default_init):
+        self._data = array(self._value, ctx=ctx or current_context())
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping with prefix (REF gluon.ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self.prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    def get(self, name, **kwargs):
+        full = self.prefix + name
+        if full in self._params:
+            return self._params[full]
+        if self._shared is not None and full in self._shared._params:
+            self._params[full] = self._shared._params[full]
+            return self._params[full]
+        p = Parameter(full, **kwargs)
+        self._params[full] = p
+        return p
+
+    def get_constant(self, name, value=None):
+        full = self.prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other):
+        for k, v in other.items():
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, fname, strip_prefix=""):
+        from ..ndarray import save as nd_save
+        payload = {}
+        for k, p in self._params.items():
+            if p._data is None:
+                continue
+            key = k[len(strip_prefix):] if k.startswith(strip_prefix) else k
+            payload[key] = p.data()
+        nd_save(fname, payload)
+
+    def load(self, fname, ctx=None, allow_missing=False, ignore_extra=False,
+             restore_prefix=""):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(fname)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for k, p in self._params.items():
+            if k in loaded:
+                p.set_data(loaded[k])
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {k} missing in file {fname}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(f"Extra parameters in file: {sorted(extra)}")
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __getitem__(self, k):
+        return self._params[k]
+
+    def __contains__(self, k):
+        return k in self._params
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __repr__(self):
+        lines = "\n".join(f"  {p!r}" for p in self._params.values())
+        return f"ParameterDict({self.prefix}\n{lines}\n)"

@@ -1,0 +1,115 @@
+"""Gluon Trainer (REF:python/mxnet/gluon/trainer.py).
+
+Owns the optimizer + kvstore; `step()` = allreduce_grads + update, exactly the
+reference's split.  On TPU the grad "allreduce" for the eager path is the
+kvstore facade (in-process sum / documented-sync dist); the *performance* path
+is `tpu_mx.parallel.compile_train_step`, where the same optimizer's functional
+core and the psum are fused into one XLA program (SURVEY §3.2 hot loop).
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..kvstore import create as kv_create
+from ..ndarray import NDArray
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, dict) or hasattr(params, "values"):
+            params = list(params.values())
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._all_params = list(params)
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt_mod.create(optimizer, **optimizer_params) \
+            if isinstance(optimizer, str) else optimizer
+        self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}
+        self._states = [None] * len(self._params)
+        self._states_inited = [False] * len(self._params)
+        self._kvstore = kv_create(kvstore) if isinstance(kvstore, str) and kvstore \
+            else kvstore
+        self._compression_params = compression_params
+        if compression_params and self._kvstore:
+            self._kvstore.set_gradient_compression(compression_params)
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._scale = 1.0
+
+    @property
+    def learning_rate(self):
+        if self._optimizer.lr_scheduler:
+            return self._optimizer.lr_scheduler(self._optimizer.num_update)
+        return self._optimizer.lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        if self._kvstore:
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    def _check_grads(self):
+        for p in self._params:
+            if p._data is None:
+                raise MXNetError(
+                    f"Parameter {p.name} is not initialized; call initialize() "
+                    "and run a forward pass before step()")
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """grad-rescale by 1/batch_size, allreduce, apply update."""
+        self._check_grads()
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            self._kvstore.push(i, p.grad, priority=-i)
+            self._kvstore.pull(i, p.grad, priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if not self._states_inited[i]:
+                self._states[i] = \
+                    self._optimizer.create_state_multi_precision(i, p.data())
+                self._states_inited[i] = True
+            self._states[i] = self._optimizer.update_multi_precision(
+                i, p.data(), p.grad, self._states[i])
+
+    def save_states(self, fname):
+        """Optimizer + update-count state (REF trainer.save_states)."""
+        import pickle
+        import numpy as np
+        import jax
+        payload = {
+            "states": jax.tree_util.tree_map(np.asarray, self._states),
+            "states_inited": self._states_inited,
+            "num_update": self._optimizer.num_update,
+            "index_update_count": self._optimizer._index_update_count,
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        import pickle
+        import jax.numpy as jnp
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        import jax
+        self._states = jax.tree_util.tree_map(jnp.asarray, payload["states"])
+        self._states_inited = payload["states_inited"]
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer._index_update_count = payload["index_update_count"]

@@ -1,0 +1,72 @@
+"""gluon.utils (REF:python/mxnet/gluon/utils.py): split_and_load,
+clip_global_norm, download stub."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ndarray import NDArray, array
+from ..ndarray import ops as F
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data size {size} not divisible by {num_slice} slices")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(F.slice_axis(data, axis=batch_axis, begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """DP batch sharding (reference's per-GPU split; on TPU the pjit path
+    shards via NamedSharding instead, but the API is kept for eager loops)."""
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays in place so the joint L2 norm <= max_norm (the LM-path
+    gradient clip, REF gluon/utils.py:clip_global_norm)."""
+    import jax.numpy as jnp
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                         for a in arrays))
+    norm = float(total)
+    if check_isfinite and not np.isfinite(norm):
+        import warnings
+        warnings.warn("nan or inf in clip_global_norm")
+    scale = max_norm / max(norm, max_norm)
+    if scale < 1.0:
+        for a in arrays:
+            a._rebind((a._data * scale).astype(a.dtype))
+    return norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise RuntimeError(
+        "download() requires network access, unavailable in this environment; "
+        "place files locally and pass their path instead")

@@ -1,0 +1,162 @@
+"""Weight initializers (REF:python/mxnet/initializer.py).
+
+String-registered like the reference (`init='xavier'`); produce numpy arrays
+so Parameter can place them on any context. Name-based aux handling matches
+the reference convention (running_mean→0, running_var→1, bias→0, gamma→1).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .base import Registry
+
+__all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
+           "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias", "registry"]
+
+registry = Registry("initializer")
+
+
+class Initializer:
+    """Base: dispatch on parameter-name convention, like the reference's
+    InitDesc-driven `__call__`."""
+
+    def __call__(self, name, shape, dtype="float32"):
+        if name.endswith("running_mean") or name.endswith("moving_mean"):
+            return np.zeros(shape, dtype)
+        if name.endswith("running_var") or name.endswith("moving_var"):
+            return np.ones(shape, dtype)
+        if name.endswith("gamma"):
+            return np.ones(shape, dtype)
+        if name.endswith("beta") or name.endswith("bias"):
+            return np.zeros(shape, dtype)
+        return self._init_weight(name, shape).astype(dtype)
+
+    def _init_weight(self, name, shape):
+        raise NotImplementedError
+
+
+@registry.register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        self.scale = scale
+
+    def _init_weight(self, name, shape):
+        return np.random.uniform(-self.scale, self.scale, size=shape)
+
+
+@registry.register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        self.sigma = sigma
+
+    def _init_weight(self, name, shape):
+        return np.random.normal(0, self.sigma, size=shape)
+
+
+@registry.register(aliases=("zeros",))
+class Zero(Initializer):
+    def _init_weight(self, name, shape):
+        return np.zeros(shape)
+
+
+@registry.register(aliases=("ones",))
+class One(Initializer):
+    def _init_weight(self, name, shape):
+        return np.ones(shape)
+
+
+@registry.register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _init_weight(self, name, shape):
+        return np.full(shape, self.value)
+
+
+def _fan(shape, factor_type):
+    hw = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * hw if len(shape) > 1 else shape[0]
+    fan_out = shape[0] * hw
+    if factor_type == "in":
+        return fan_in
+    if factor_type == "out":
+        return fan_out
+    return (fan_in + fan_out) / 2.0
+
+
+@registry.register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init_weight(self, name, shape):
+        factor = _fan(shape, self.factor_type)
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            return np.random.uniform(-scale, scale, size=shape)
+        return np.random.normal(0, scale, size=shape)
+
+
+@registry.register(name="msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        super().__init__("gaussian", factor_type, 2.0 / (1 + slope ** 2))
+
+
+@registry.register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, shape):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (rows, cols))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (rows, cols))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (rows, cols) else v
+        return (self.scale * q).reshape(shape)
+
+
+@registry.register
+class Bilinear(Initializer):
+    def _init_weight(self, name, shape):
+        weight = np.zeros(int(np.prod(shape)))
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return weight.reshape(shape)
+
+
+@registry.register(name="lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference: initializer.LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        self.forget_bias = forget_bias
+
+    def __call__(self, name, shape, dtype="float32"):
+        b = np.zeros(shape, dtype)
+        n = shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        return b
+
+    def _init_weight(self, name, shape):
+        return np.zeros(shape)
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return registry.create(name, **kwargs)

@@ -1,0 +1,155 @@
+"""KVStore: the data-parallel communication facade (REF:src/kvstore/**,
+REF:python/mxnet/kvstore.py).
+
+TPU-native mapping (SURVEY §2.3, §5.8): the reference's device ring/NCCL
+reduce and the ps-lite parameter server both become *XLA collectives compiled
+into the step function* — there is no server role on a TPU pod.  This module
+keeps the reference's push/pull API working:
+
+- `local` / `device`: in-process aggregation — push sums the per-device grad
+  list (the CommDevice/CommCPU analog), pull broadcasts;
+- `nccl`: alias of `device` (ICI collectives replace NCCL);
+- `dist_sync` / `dist_sync_device`: multi-host SPMD via `jax.distributed` —
+  rank = process_index, num_workers = process_count; the aggregation itself
+  rides the `psum` inside a pjit-ed train step (see tpu_mx.parallel);
+- `dist_async`: **semantic divergence documented** — XLA collectives are bulk
+  synchronous, so dist_async degrades to dist_sync semantics (SURVEY §7.3.3).
+
+Optimizer offload (`set_optimizer`, the PS server-side update) runs locally:
+with no server tier, `update_on_kvstore` simply applies the updater here.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError, get_env
+from .ndarray import NDArray
+from .optimizer import Updater, create as _create_optimizer
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    def __init__(self, kind="local"):
+        self.type = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+        self._is_dist = kind.startswith("dist")
+        if self._is_dist:
+            import jax
+            # multi-host boot: jax.distributed.initialize must have been called
+            # by the launcher (tpu_mx.tools.launch analog of tools/launch.py)
+            try:
+                self._rank = jax.process_index()
+                self._num_workers = jax.process_count()
+            except Exception:
+                self._rank, self._num_workers = 0, 1
+        else:
+            self._rank, self._num_workers = 0, 1
+
+    # -- identity -------------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    # -- core API -------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v[0].copy() if isinstance(v, list) else v.copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate gradients: sum over the per-device list (CommDevice
+        analog).  Under multi-host SPMD the cross-host sum happens inside the
+        jitted step via psum; this host-level sum covers the eager path."""
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vlist = v if isinstance(v, list) else [v]
+            agg = vlist[0]
+            for extra in vlist[1:]:
+                agg = agg + extra
+            if self._compression is not None:
+                agg = self._compression.compress_decompress(agg)
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            else:
+                self._store[f"_pending_{k}"] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            pending = self._store.pop(f"_pending_{k}", None)
+            src = self._store[k] if pending is None else pending
+            if self._updater is None and pending is not None:
+                self._store[k] = pending
+            olist = o if isinstance(o, list) else [o]
+            for dst in olist:
+                src.copyto(dst)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        self.pull(key, out, priority)  # sparse degenerate: dense on TPU
+
+    # -- optimizer offload ----------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Reference pickles the optimizer to PS servers; here the 'server' is
+        in-process (round-trip through pickle kept to preserve the contract
+        that the optimizer must be picklable)."""
+        self._optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._updater = Updater(self._optimizer)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        from .contrib.compression import GradientCompression
+        self._compression = GradientCompression(**compression_params)
+
+    # -- persistence (reference: save/load optimizer states on rank 0) --------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        with open(fname, "wb") as f:
+            pickle.dump(self._updater.get_states() if self._updater else {}, f)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            states = pickle.load(f)
+        if self._updater:
+            self._updater.set_states(states)
+
+    def barrier(self):
+        if self._is_dist:
+            import jax
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def _barrier(self):
+        self.barrier()
+
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            return list(key), list(value)
+        return [key], [value]
+
+    def __repr__(self):
+        return f"KVStore(type={self.type}, rank={self.rank}/{self.num_workers})"
+
+
+def create(name="local"):
+    """mx.kv.create — accepted types mirror the reference
+    (REF:include/mxnet/kvstore.h KVStore::Create)."""
+    valid = {"local", "local_allreduce_cpu", "local_allreduce_device", "device",
+             "nccl", "dist", "dist_sync", "dist_async", "dist_sync_device",
+             "dist_async_device", "dist_device_sync", "horovod", "p3"}
+    if name not in valid:
+        raise MXNetError(f"unknown KVStore type {name}")
+    return KVStore(name)

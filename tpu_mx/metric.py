@@ -1,0 +1,290 @@
+"""Evaluation metrics (REF:python/mxnet/metric.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .base import Registry
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE", "RMSE",
+           "CrossEntropy", "Perplexity", "Loss", "PearsonCorrelation",
+           "CompositeEvalMetric", "CustomMetric", "create", "np_fn"]
+
+registry = Registry("metric")
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name, value = [name], [value]
+        return list(zip(name, value))
+
+    @staticmethod
+    def _listify(labels, preds):
+        if isinstance(labels, (list, tuple)):
+            return list(labels), list(preds)
+        return [labels], [preds]
+
+
+@registry.register(name="acc", aliases=("accuracy",))
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred_np = _as_np(pred)
+            label_np = _as_np(label).astype(np.int64)
+            if pred_np.ndim > label_np.ndim:
+                pred_np = pred_np.argmax(axis=self.axis)
+            pred_np = pred_np.astype(np.int64)
+            self.sum_metric += (pred_np.flat == label_np.flat).sum()
+            self.num_inst += len(label_np.flat)
+
+
+@registry.register(name="top_k_accuracy", aliases=("topk",))
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred_np = _as_np(pred)
+            label_np = _as_np(label).astype(np.int64)
+            topk_idx = np.argsort(-pred_np, axis=-1)[..., :self.top_k]
+            hits = (topk_idx == label_np[..., None]).any(-1)
+            self.sum_metric += hits.sum()
+            self.num_inst += label_np.size
+
+
+@registry.register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.reset_stats()
+
+    def reset_stats(self):
+        self.tp = self.fp = self.fn = 0
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "tp"):
+            self.reset_stats()
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred_np = _as_np(pred)
+            label_np = _as_np(label).astype(np.int64).flatten()
+            if pred_np.ndim > 1 and pred_np.shape[-1] > 1:
+                pred_lab = pred_np.argmax(-1).flatten()
+            else:
+                pred_lab = (pred_np.flatten() > 0.5).astype(np.int64)
+            self.tp += int(((pred_lab == 1) & (label_np == 1)).sum())
+            self.fp += int(((pred_lab == 1) & (label_np == 0)).sum())
+            self.fn += int(((pred_lab == 0) & (label_np == 1)).sum())
+            prec = self.tp / max(self.tp + self.fp, 1)
+            rec = self.tp / max(self.tp + self.fn, 1)
+            f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+            self.sum_metric = f1
+            self.num_inst = 1
+
+
+@registry.register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            self.sum_metric += np.abs(_as_np(label) - _as_np(pred)).mean()
+            self.num_inst += 1
+
+
+@registry.register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            self.sum_metric += ((_as_np(label) - _as_np(pred)) ** 2).mean()
+            self.num_inst += 1
+
+
+@registry.register
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            self.sum_metric += math.sqrt(
+                ((_as_np(label) - _as_np(pred)) ** 2).mean())
+            self.num_inst += 1
+
+
+@registry.register(name="ce", aliases=("cross-entropy",))
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = _as_np(label).astype(np.int64).flatten()
+            pred_np = _as_np(pred).reshape(len(label_np), -1)
+            prob = pred_np[np.arange(len(label_np)), label_np]
+            self.sum_metric += (-np.log(prob + self.eps)).sum()
+            self.num_inst += len(label_np)
+
+
+@registry.register
+class Perplexity(CrossEntropy):
+    """The PTB metric (REF:python/mxnet/metric.py:Perplexity)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = _as_np(label).astype(np.int64).flatten()
+            pred_np = _as_np(pred).reshape(len(label_np), -1)
+            prob = pred_np[np.arange(len(label_np)), label_np]
+            if self.ignore_label is not None:
+                ignore = label_np == self.ignore_label
+                prob = prob[~ignore]
+            self.sum_metric += (-np.log(np.maximum(prob, self.eps))).sum()
+            self.num_inst += len(prob)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@registry.register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        for pred in preds:
+            loss = _as_np(pred)
+            self.sum_metric += loss.sum()
+            self.num_inst += loss.size
+
+
+@registry.register(name="pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            x = _as_np(label).flatten()
+            y = _as_np(pred).flatten()
+            self.sum_metric += float(np.corrcoef(x, y)[0, 1])
+            self.num_inst += 1
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) if isinstance(m, str) else m
+                        for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str) else metric)
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return (names, values)
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False, **kwargs):
+        super().__init__(name, **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            v = self._feval(_as_np(label), _as_np(pred))
+            if isinstance(v, tuple):
+                sm, ni = v
+                self.sum_metric += sm
+                self.num_inst += ni
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+def np_fn(numpy_feval, name=None, allow_extra_outputs=False):
+    return CustomMetric(numpy_feval, name or numpy_feval.__name__,
+                        allow_extra_outputs)
+
+
+np_metric = np_fn
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        return CompositeEvalMetric([create(m) for m in metric])
+    if callable(metric):
+        return CustomMetric(metric)
+    return registry.create(metric, *args, **kwargs)

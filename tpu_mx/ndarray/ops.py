@@ -1,0 +1,1169 @@
+"""Operator library: `mx.nd.*` over jax.numpy / lax, with tape recording.
+
+TPU-native analog of the reference operator library (REF:src/operator/** —
+mshadow/cuDNN/MKLDNN kernels registered via NNVM).  Design (SURVEY §7.1):
+every op has a *pure functional core* on raw `jax.Array`s, compiled by XLA
+(which supplies the fusion/memory-planning the reference got from NNVM passes
+and hand-written kernels).  The `_apply` wrapper gives the imperative face:
+it unwraps NDArray handles, records a `jax.vjp` pullback on the autograd tape
+when needed (the FGradient analog), and re-wraps outputs.  Called with raw
+arrays (inside a `hybridize()` trace) it is a zero-overhead passthrough, so
+one namespace serves both `F=mx.nd` and the traced path — the reference got
+the same duality from its nd/sym twin stubs.
+"""
+from __future__ import annotations
+
+import builtins
+import functools
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from .. import autograd
+from .ndarray import NDArray, array, concatenate, load, save, waitall
+from ..context import current_context
+
+_abs = builtins.abs
+_sum = builtins.sum
+_max = builtins.max
+_min = builtins.min
+
+
+# ----------------------------------------------------------------------------
+# imperative invoke (analog of REF:src/imperative/imperative.cc Imperative::Invoke)
+# ----------------------------------------------------------------------------
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _raw(a):
+    if isinstance(a, NDArray):
+        return a._data
+    if isinstance(a, (jax.Array, _np.ndarray)) or _is_traced(a):
+        return a
+    return a  # python scalar — kept as-is so jnp broadcasting rules apply
+
+
+def _apply(fn, args, name="op", nondiff=False):
+    """Dispatch one op: args = tensor positionals (NDArray | array | scalar)."""
+    datas = [_raw(a) for a in args]
+    if not any(isinstance(a, NDArray) for a in args):
+        return fn(*datas)  # functional mode (hybridize trace / internal reuse)
+
+    diff_idx = [
+        i for i, a in enumerate(args)
+        if isinstance(a, NDArray) and jnp.issubdtype(a.dtype, jnp.floating)
+    ]
+    diff_inputs = [args[i] for i in diff_idx]
+
+    if not nondiff and diff_idx and autograd._needs_tape(diff_inputs):
+        def closed(*diff_datas):
+            full = list(datas)
+            for i, d in zip(diff_idx, diff_datas):
+                full[i] = d
+            return fn(*full)
+
+        out_data, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
+        multi = isinstance(out_data, (tuple, list))
+        outs_raw = list(out_data) if multi else [out_data]
+        if all(jnp.issubdtype(o.dtype, jnp.floating) for o in outs_raw):
+            outs = [NDArray(o) for o in outs_raw]
+            autograd._record_op(vjp_fn, diff_inputs, outs, name=name)
+            return outs if multi else outs[0]
+        # non-float output: fall through unrecorded
+        out_data = tuple(outs_raw) if multi else outs_raw[0]
+    else:
+        out_data = fn(*datas)
+
+    if isinstance(out_data, (tuple, list)):
+        return [NDArray(o) for o in out_data]
+    return NDArray(out_data)
+
+
+def _index(a, key):
+    return _apply(lambda x: x[key], [a], name="index")
+
+
+# ----------------------------------------------------------------------------
+# creation ops
+# ----------------------------------------------------------------------------
+def _place(data, ctx):
+    return NDArray(data, ctx=ctx or current_context())
+
+
+def zeros(shape, ctx=None, dtype="float32", **kw):
+    return _place(jnp.zeros(shape, dtype=dtype), ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kw):
+    return _place(jnp.ones(shape, dtype=dtype), ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32", **kw):
+    return _place(jnp.full(shape, val, dtype=dtype), ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    a = jnp.arange(start, stop, step, dtype=dtype)
+    if repeat != 1:
+        a = jnp.repeat(a, repeat)
+    return _place(a, ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return _place(jnp.linspace(start, stop, num, endpoint=endpoint, dtype=dtype), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return _place(jnp.eye(N, M if M else N, k=k, dtype=dtype), ctx)
+
+
+def zeros_like(a, **kw):
+    return _apply(jnp.zeros_like, [a], "zeros_like", nondiff=True)
+
+
+def ones_like(a, **kw):
+    return _apply(jnp.ones_like, [a], "ones_like", nondiff=True)
+
+
+def full_like(a, fill_value, **kw):
+    return _apply(lambda x: jnp.full_like(x, fill_value), [a], "full_like", nondiff=True)
+
+
+# ----------------------------------------------------------------------------
+# unary elementwise
+# ----------------------------------------------------------------------------
+def _unary(jfn, name):
+    def op(data, out=None, **kw):
+        res = _apply(jfn, [data], name)
+        if out is not None:
+            out._rebind(res._data if isinstance(res, NDArray) else res)
+            return out
+        return res
+    op.__name__ = name
+    return op
+
+
+abs = _unary(jnp.abs, "abs")
+sign = _unary(jnp.sign, "sign")
+ceil = _unary(jnp.ceil, "ceil")
+floor = _unary(jnp.floor, "floor")
+trunc = _unary(jnp.trunc, "trunc")
+round = _unary(jnp.round, "round")
+rint = _unary(jnp.rint, "rint")
+fix = _unary(jnp.trunc, "fix")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(lambda x: lax.rsqrt(x), "rsqrt")
+cbrt = _unary(jnp.cbrt, "cbrt")
+rcbrt = _unary(lambda x: 1.0 / jnp.cbrt(x), "rcbrt")
+square = _unary(jnp.square, "square")
+reciprocal = _unary(lambda x: 1.0 / x, "reciprocal")
+negative = _unary(jnp.negative, "negative")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+arcsin = _unary(jnp.arcsin, "arcsin")
+arccos = _unary(jnp.arccos, "arccos")
+arctan = _unary(jnp.arctan, "arctan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+arcsinh = _unary(jnp.arcsinh, "arcsinh")
+arccosh = _unary(jnp.arccosh, "arccosh")
+arctanh = _unary(jnp.arctanh, "arctanh")
+degrees = _unary(jnp.degrees, "degrees")
+radians = _unary(jnp.radians, "radians")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+relu = _unary(jax.nn.relu, "relu")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+gammaln = _unary(jax.scipy.special.gammaln, "gammaln")
+gamma = _unary(lambda x: jnp.exp(jax.scipy.special.gammaln(x)), "gamma")
+logical_not = _unary(lambda x: (x == 0).astype(x.dtype), "logical_not")
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+
+
+def cast(data, dtype, **kw):
+    return _apply(lambda x: x.astype(dtype), [data], "cast")
+
+
+Cast = cast
+
+
+def amp_cast(data, dtype):
+    """AMP cast op (reference [ver>=1.5] REF:src/operator/tensor/amp_cast.cc)."""
+    return cast(data, dtype)
+
+
+def amp_multicast(*data, num_outputs=None):
+    widest = jnp.result_type(*[d.dtype for d in data])
+    return [cast(d, widest) for d in data]
+
+
+def BlockGrad(data, **kw):
+    return _apply(lax.stop_gradient, [data], "BlockGrad", nondiff=True)
+
+
+stop_gradient = BlockGrad
+
+
+def identity(data, **kw):
+    return _apply(lambda x: x, [data], "identity")
+
+
+def shape_array(data):
+    return _apply(lambda x: jnp.array(x.shape, dtype=jnp.int64), [data], "shape_array",
+                  nondiff=True)
+
+
+def size_array(data):
+    return _apply(lambda x: jnp.array([x.size], dtype=jnp.int64), [data], "size_array",
+                  nondiff=True)
+
+
+# ----------------------------------------------------------------------------
+# binary elementwise (+ broadcast_* aliases for reference API parity)
+# ----------------------------------------------------------------------------
+def _binary(jfn, name):
+    def op(lhs, rhs, out=None, **kw):
+        res = _apply(jfn, [lhs, rhs], name)
+        if out is not None:
+            out._rebind(res._data)
+            return out
+        return res
+    op.__name__ = name
+    return op
+
+
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+mod = _binary(jnp.mod, "mod")
+power = _binary(jnp.power, "power")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+hypot = _binary(jnp.hypot, "hypot")
+arctan2 = _binary(jnp.arctan2, "arctan2")
+equal = _binary(lambda a, b: (a == b).astype(jnp.result_type(a, b)), "equal")
+not_equal = _binary(lambda a, b: (a != b).astype(jnp.result_type(a, b)), "not_equal")
+greater = _binary(lambda a, b: (a > b).astype(jnp.result_type(a, b)), "greater")
+greater_equal = _binary(lambda a, b: (a >= b).astype(jnp.result_type(a, b)), "greater_equal")
+lesser = _binary(lambda a, b: (a < b).astype(jnp.result_type(a, b)), "lesser")
+lesser_equal = _binary(lambda a, b: (a <= b).astype(jnp.result_type(a, b)), "lesser_equal")
+logical_and = _binary(lambda a, b: ((a != 0) & (b != 0)).astype(jnp.result_type(a, b)), "logical_and")
+logical_or = _binary(lambda a, b: ((a != 0) | (b != 0)).astype(jnp.result_type(a, b)), "logical_or")
+logical_xor = _binary(lambda a, b: ((a != 0) ^ (b != 0)).astype(jnp.result_type(a, b)), "logical_xor")
+
+# the reference distinguishes elemwise_* (same-shape) from broadcast_* ops;
+# jnp broadcasts everywhere so these are exact aliases
+for _nm, _op in [
+    ("broadcast_add", add), ("broadcast_plus", add),
+    ("broadcast_sub", subtract), ("broadcast_minus", subtract),
+    ("broadcast_mul", multiply), ("broadcast_div", divide),
+    ("broadcast_mod", mod), ("broadcast_power", power),
+    ("broadcast_maximum", maximum), ("broadcast_minimum", minimum),
+    ("broadcast_hypot", hypot),
+    ("broadcast_equal", equal), ("broadcast_not_equal", not_equal),
+    ("broadcast_greater", greater), ("broadcast_greater_equal", greater_equal),
+    ("broadcast_lesser", lesser), ("broadcast_lesser_equal", lesser_equal),
+    ("broadcast_logical_and", logical_and), ("broadcast_logical_or", logical_or),
+    ("broadcast_logical_xor", logical_xor),
+    ("elemwise_add", add), ("elemwise_sub", subtract),
+    ("elemwise_mul", multiply), ("elemwise_div", divide),
+]:
+    globals()[_nm] = _op
+
+
+def add_n(*args, **kw):
+    return _apply(lambda *xs: functools.reduce(jnp.add, xs), list(args), "add_n")
+
+
+ElementWiseSum = add_n
+
+
+# ----------------------------------------------------------------------------
+# reductions
+# ----------------------------------------------------------------------------
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def _reduce(jfn, name):
+    def op(data, axis=None, keepdims=False, exclude=False, **kw):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            nd_ = data.ndim if hasattr(data, "ndim") else jnp.asarray(data).ndim
+            axset = {a % nd_ for a in (ax if isinstance(ax, tuple) else (ax,))}
+            ax = tuple(i for i in range(nd_) if i not in axset)
+        return _apply(lambda x: jfn(x, axis=ax, keepdims=keepdims), [data], name)
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum")
+mean = _reduce(jnp.mean, "mean")
+prod = _reduce(jnp.prod, "prod")
+max = _reduce(jnp.max, "max")
+min = _reduce(jnp.min, "min")
+nansum = _reduce(jnp.nansum, "nansum")
+nanprod = _reduce(jnp.nanprod, "nanprod")
+sum_axis = sum
+max_axis = max
+min_axis = min
+
+
+def argmax(data, axis=None, keepdims=False, **kw):
+    return _apply(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.float32),
+                  [data], "argmax", nondiff=True)
+
+
+def argmin(data, axis=None, keepdims=False, **kw):
+    return _apply(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32),
+                  [data], "argmin", nondiff=True)
+
+
+def norm(data, ord=2, axis=None, keepdims=False, **kw):
+    ax = _norm_axis(axis)
+
+    def f(x):
+        if ord == 1:
+            return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+    return _apply(f, [data], "norm")
+
+
+def cumsum(data, axis=None, dtype=None):
+    return _apply(lambda x: jnp.cumsum(x, axis=axis, dtype=dtype), [data], "cumsum")
+
+
+# ----------------------------------------------------------------------------
+# shape manipulation
+# ----------------------------------------------------------------------------
+def reshape(data, shape=None, reverse=False, **kw):
+    """MXNet reshape with special codes 0 (keep), -1 (infer), -2.. subset."""
+    target = tuple(shape)
+
+    def f(x):
+        out, src = [], list(x.shape)
+        i = 0
+        for s in target:
+            if s == 0:
+                out.append(src[i]); i += 1
+            elif s == -1:
+                out.append(-1); i += 1
+            elif s == -2:
+                out.extend(src[i:]); i = len(src)
+            elif s == -3:
+                out.append(src[i] * src[i + 1]); i += 2
+            elif s == -4:
+                continue  # handled by following explicit dims
+            else:
+                out.append(s); i += 1
+        return jnp.reshape(x, tuple(out))
+
+    return _apply(f, [data], "reshape")
+
+
+def reshape_like(lhs, rhs, **kw):
+    return _apply(lambda x, y: jnp.reshape(x, y.shape), [lhs, rhs], "reshape_like")
+
+
+def flatten(data, **kw):
+    return _apply(lambda x: jnp.reshape(x, (x.shape[0], -1)), [data], "flatten")
+
+
+Flatten = flatten
+
+
+def transpose(data, axes=None, **kw):
+    ax = tuple(axes) if axes else None
+    return _apply(lambda x: jnp.transpose(x, ax), [data], "transpose")
+
+
+def swapaxes(data, dim1=0, dim2=0, **kw):
+    return _apply(lambda x: jnp.swapaxes(x, dim1, dim2), [data], "swapaxes")
+
+
+SwapAxis = swapaxes
+
+
+def expand_dims(data, axis, **kw):
+    return _apply(lambda x: jnp.expand_dims(x, axis), [data], "expand_dims")
+
+
+def squeeze(data, axis=None, **kw):
+    return _apply(lambda x: jnp.squeeze(x, axis=axis), [data], "squeeze")
+
+
+def broadcast_to(data, shape, **kw):
+    tgt = tuple(shape)
+
+    def f(x):
+        # MXNet allows 0 meaning "keep this dim"
+        full = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(tgt))
+        return jnp.broadcast_to(x, full)
+
+    return _apply(f, [data], "broadcast_to")
+
+
+def broadcast_axis(data, axis=0, size=1, **kw):
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    sizes = size if isinstance(size, (list, tuple)) else (size,)
+
+    def f(x):
+        shp = list(x.shape)
+        for a, s in zip(axes, sizes):
+            shp[a] = s
+        return jnp.broadcast_to(x, tuple(shp))
+
+    return _apply(f, [data], "broadcast_axis")
+
+
+def broadcast_like(lhs, rhs, **kw):
+    return _apply(lambda x, y: jnp.broadcast_to(x, y.shape), [lhs, rhs], "broadcast_like")
+
+
+def flip(data, axis, **kw):
+    return _apply(lambda x: jnp.flip(x, axis=axis), [data], "flip")
+
+
+reverse = flip
+
+
+def tile(data, reps, **kw):
+    return _apply(lambda x: jnp.tile(x, reps), [data], "tile")
+
+
+def repeat(data, repeats, axis=None, **kw):
+    return _apply(lambda x: jnp.repeat(x, repeats, axis=axis), [data], "repeat")
+
+
+def pad(data, mode="constant", pad_width=None, constant_value=0, **kw):
+    """Reference pad op: pad_width is the flat (before,after) per-dim tuple."""
+    pw = list(zip(pad_width[::2], pad_width[1::2]))
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+
+    def f(x):
+        if jmode == "constant":
+            return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+        return jnp.pad(x, pw, mode=jmode)
+
+    return _apply(f, [data], "pad")
+
+
+Pad = pad
+
+
+def concat(*data, dim=1, **kw):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _apply(lambda *xs: jnp.concatenate(xs, axis=dim), list(data), "concat")
+
+
+Concat = concat
+
+
+def stack(*data, axis=0, **kw):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _apply(lambda *xs: jnp.stack(xs, axis=axis), list(data), "stack")
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False, **kw):
+    def f(x):
+        parts = jnp.split(x, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+
+    out = _apply(f, [data], "split")
+    return out
+
+
+SliceChannel = split
+
+
+def slice(data, begin, end, step=None, **kw):
+    def f(x):
+        idx = []
+        for i in range(len(begin)):
+            b = begin[i]
+            e = end[i] if end[i] is not None else x.shape[i]
+            s = (step[i] if step else None) or 1
+            idx.append(builtins.slice(b, e, s))
+        return x[tuple(idx)]
+
+    return _apply(f, [data], "slice")
+
+
+def slice_axis(data, axis, begin, end, **kw):
+    def f(x):
+        e = end if end is not None else x.shape[axis]
+        return lax.slice_in_dim(x, begin, e, axis=axis)
+
+    return _apply(f, [data], "slice_axis")
+
+
+def slice_like(data, shape_like, axes=None, **kw):
+    def f(x, y):
+        idx = [builtins.slice(None)] * x.ndim
+        dims = axes if axes is not None else range(y.ndim)
+        for a in dims:
+            idx[a] = builtins.slice(0, y.shape[a])
+        return x[tuple(idx)]
+
+    return _apply(f, [data, shape_like], "slice_like")
+
+
+def clip(data, a_min, a_max, **kw):
+    return _apply(lambda x: jnp.clip(x, a_min, a_max), [data], "clip")
+
+
+def where(condition, x, y, **kw):
+    return _apply(lambda c, a, b: jnp.where(c != 0, a, b), [condition, x, y], "where")
+
+
+# ----------------------------------------------------------------------------
+# indexing ops
+# ----------------------------------------------------------------------------
+def take(a, indices, axis=0, mode="clip", **kw):
+    jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return _apply(
+        lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis, mode=jmode),
+        [a, indices], "take")
+
+
+def pick(data, index, axis=-1, keepdims=False, **kw):
+    def f(x, i):
+        out = jnp.take_along_axis(
+            x, jnp.expand_dims(i.astype(jnp.int32), axis=axis), axis=axis)
+        return out if keepdims else jnp.squeeze(out, axis=axis)
+
+    return _apply(f, [data, index], "pick")
+
+
+def gather_nd(data, indices, **kw):
+    def f(x, i):
+        i = i.astype(jnp.int32)
+        return x[tuple(i[k] for k in range(i.shape[0]))]
+
+    return _apply(f, [data, indices], "gather_nd")
+
+
+def scatter_nd(data, indices, shape, **kw):
+    def f(d, i):
+        i = i.astype(jnp.int32)
+        out = jnp.zeros(tuple(shape), d.dtype)
+        return out.at[tuple(i[k] for k in range(i.shape[0]))].add(d)
+
+    return _apply(f, [data, indices], "scatter_nd")
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    def f(i):
+        oh = jax.nn.one_hot(i.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+        return oh * (on_value - off_value) + off_value
+
+    return _apply(f, [indices], "one_hot", nondiff=True)
+
+
+def Embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False, **kw):
+    """Embedding lookup (REF:src/operator/tensor/indexing_op.cc).  `sparse_grad`
+    (row_sparse in the reference) has no TPU analog; gradients are dense —
+    XLA turns the gather-vjp into an efficient scatter-add (SURVEY §7.3.4)."""
+    return _apply(lambda i, w: jnp.take(w, i.astype(jnp.int32), axis=0),
+                  [data, weight], "Embedding")
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False, value=0.0,
+                 axis=0, **kw):
+    if not use_sequence_length or sequence_length is None:
+        return identity(data)
+
+    def f(x, sl):
+        steps = jnp.arange(x.shape[axis])
+        mask = steps[:, None] < sl[None, :]  # (T, B)
+        if axis == 1:
+            mask = mask.T
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+        return jnp.where(mask, x, jnp.asarray(value, x.dtype))
+
+    return _apply(f, [data, sequence_length], "SequenceMask")
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    if not use_sequence_length or sequence_length is None:
+        return flip(data, axis=axis)
+
+    def f(x, sl):
+        T = x.shape[axis]
+        idx = jnp.arange(T)[:, None]  # (T,1)
+        rev = sl[None, :].astype(jnp.int32) - 1 - idx
+        gather_idx = jnp.where(idx < sl[None, :], rev, idx)  # (T,B)
+        return jnp.take_along_axis(
+            x, gather_idx.reshape(gather_idx.shape + (1,) * (x.ndim - 2)), axis=0)
+
+    return _apply(f, [data, sequence_length], "SequenceReverse")
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    def f(x, *sl):
+        if sl:
+            idx = sl[0].astype(jnp.int32) - 1
+        else:
+            idx = jnp.full((x.shape[1],), x.shape[axis] - 1, jnp.int32)
+        return jnp.take_along_axis(
+            x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0)[0]
+
+    args = [data] + ([sequence_length] if use_sequence_length else [])
+    return _apply(f, args, "SequenceLast")
+
+
+# ----------------------------------------------------------------------------
+# ordering
+# ----------------------------------------------------------------------------
+def sort(data, axis=-1, is_ascend=True, **kw):
+    def f(x):
+        s = jnp.sort(x, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+
+    return _apply(f, [data], "sort")
+
+
+def argsort(data, axis=-1, is_ascend=True, dtype="float32", **kw):
+    def f(x):
+        s = jnp.argsort(x, axis=axis)
+        if not is_ascend:
+            s = jnp.flip(s, axis=axis)
+        return s.astype(jnp.dtype(dtype))
+
+    return _apply(f, [data], "argsort", nondiff=True)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **kw):
+    def f(x):
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+        if is_ascend:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "indices":
+            return idx.astype(jnp.dtype(dtype))
+        if ret_typ == "both":
+            return (vals, idx.astype(jnp.dtype(dtype)))
+        if ret_typ == "mask":
+            m = jnp.zeros_like(xm, dtype=jnp.dtype(dtype))
+            m = m.at[..., :].set(0)
+            oh = jax.nn.one_hot(jnp.moveaxis(idx, axis, -1), x.shape[axis],
+                                dtype=jnp.dtype(dtype)).sum(-2)
+            return jnp.moveaxis(oh, -1, axis)
+        raise ValueError(ret_typ)
+
+    nondiff = ret_typ != "value"
+    return _apply(f, [data], "topk", nondiff=nondiff)
+
+
+# ----------------------------------------------------------------------------
+# linear algebra
+# ----------------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    """Reference `dot`: contracts last axis of lhs with first of rhs; the
+    transpose flags apply matrix-transpose semantics (2-D fast path hits the
+    MXU as a single matmul)."""
+
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        if a.ndim == 2 and b.ndim == 2:
+            return a @ b
+        return jnp.tensordot(a, b, axes=([-1], [0]))
+
+    return _apply(f, [lhs, rhs], "dot")
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    return _apply(f, [lhs, rhs], "batch_dot")
+
+
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kw):
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b)
+
+    return _apply(f, [A, B], "linalg_gemm2")
+
+
+def linalg_potrf(A, **kw):
+    return _apply(lambda a: jnp.linalg.cholesky(a), [A], "linalg_potrf")
+
+
+def linalg_syrk(A, transpose=False, alpha=1.0, **kw):
+    def f(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+    return _apply(f, [A], "linalg_syrk")
+
+
+# ----------------------------------------------------------------------------
+# neural-net ops (REF:src/operator/nn/**) — XLA-native forms
+# ----------------------------------------------------------------------------
+def _pair(v, n):
+    if v is None:
+        return (0,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    return t if len(t) == n else t + t[-1:] * (n - len(t))
+
+
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True, **kw):
+    """y = x·Wᵀ + b (REF:src/operator/nn/fully_connected.cc).  Contracted as a
+    single MXU matmul; `flatten` collapses trailing dims like the reference."""
+
+    def f(x, w, *b):
+        if flatten and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        y = jnp.matmul(x, w.T) if x.ndim <= 2 else jnp.einsum("...i,oi->...o", x, w)
+        if b:
+            y = y + b[0]
+        return y
+
+    args = [data, weight] + ([] if (no_bias or bias is None) else [bias])
+    return _apply(f, args, "FullyConnected")
+
+
+def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, **kw):
+    """N-D convolution (REF:src/operator/nn/convolution.cc; cuDNN path replaced
+    by `lax.conv_general_dilated`, which XLA tiles onto the MXU).  NCHW layout
+    API-side; XLA:TPU relayouts internally."""
+    nd_ = len(kernel)
+    strides = _pair(stride, nd_) if stride else (1,) * nd_
+    dilation = _pair(dilate, nd_) if dilate else (1,) * nd_
+    padding = [(p, p) for p in (_pair(pad, nd_) if pad else (0,) * nd_)]
+    spatial = "DHW"[-nd_:]
+    dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+
+    def f(x, w, *b):
+        y = lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=num_group,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        y = y.astype(x.dtype)
+        if b:
+            y = y + b[0].reshape((1, -1) + (1,) * nd_)
+        return y
+
+    args = [data, weight] + ([] if (no_bias or bias is None) else [bias])
+    return _apply(f, args, "Convolution")
+
+
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1, no_bias=True,
+                  **kw):
+    """Transposed conv (REF:src/operator/nn/deconvolution.cc).  `adj` (the
+    output_padding) extends the trailing pad so out = (i-1)*s - 2p + d*(k-1)
+    + 1 + adj, matching the reference's output-size formula."""
+    nd_ = len(kernel)
+    strides = _pair(stride, nd_) if stride else (1,) * nd_
+    dilation = _pair(dilate, nd_) if dilate else (1,) * nd_
+    padding = _pair(pad, nd_) if pad else (0,) * nd_
+    adjust = _pair(adj, nd_) if adj else (0,) * nd_
+    spatial = "DHW"[-nd_:]
+    dn = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
+
+    def f(x, w, *b):
+        pads = [(d * (k - 1) - p, d * (k - 1) - p + a)
+                for k, p, a, d in zip(kernel, padding, adjust, dilation)]
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1,) * nd_, padding=pads,
+            lhs_dilation=strides, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=num_group)
+        if b:
+            y = y + b[0].reshape((1, -1) + (1,) * nd_)
+        return y
+
+    args = [data, weight] + ([] if (no_bias or bias is None) else [bias])
+    return _apply(f, args, "Deconvolution")
+
+
+def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", count_include_pad=True, **kw):
+    """Max/avg/sum pooling via `lax.reduce_window`
+    (REF:src/operator/nn/pooling.cc)."""
+
+    def f(x):
+        nd_ = x.ndim - 2
+        if global_pool:
+            return x.mean(axis=tuple(range(2, x.ndim)), keepdims=True) \
+                if pool_type == "avg" else (
+                    x.max(axis=tuple(range(2, x.ndim)), keepdims=True)
+                    if pool_type == "max"
+                    else x.sum(axis=tuple(range(2, x.ndim)), keepdims=True))
+        k = _pair(kernel, nd_)
+        s = _pair(stride, nd_) if stride else k
+        p = _pair(pad, nd_) if pad else (0,) * nd_
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        padding = [(0, 0), (0, 0)] + [(pp, pp) for pp in p]
+        if pooling_convention == "full":
+            # ceil-mode: extend right/bottom padding so no element is dropped
+            padding = [(0, 0), (0, 0)] + [
+                (pp, pp + st - 1) for pp, st in zip(p, s)]
+        if pool_type == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, init, lax.max, window, strides, padding)
+        ssum = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return ssum
+        if count_include_pad:
+            return ssum / _np.prod(k)
+        ones_ = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones_, 0.0, lax.add, window, strides, padding)
+        return ssum / cnt
+
+    return _apply(f, [data], "Pooling")
+
+
+def Activation(data, act_type="relu", **kw):
+    fns = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+    }
+    return _apply(fns[act_type], [data], f"Activation[{act_type}]")
+
+
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+              upper_bound=0.334, **kw):
+    if act_type == "leaky":
+        return _apply(lambda x: jax.nn.leaky_relu(x, slope), [data], "LeakyReLU")
+    if act_type == "elu":
+        return _apply(lambda x: jax.nn.elu(x, slope), [data], "elu")
+    if act_type == "selu":
+        return _apply(jax.nn.selu, [data], "selu")
+    if act_type == "gelu":
+        return _apply(lambda x: jax.nn.gelu(x, approximate=False), [data], "gelu")
+    if act_type == "prelu":
+        return _apply(lambda x, g: jnp.where(x >= 0, x, g * x), [data, gamma], "prelu")
+    raise ValueError(act_type)
+
+
+def gelu(data, **kw):
+    return _apply(lambda x: jax.nn.gelu(x, approximate=False), [data], "gelu")
+
+
+def gelu_tanh(data, **kw):
+    return _apply(lambda x: jax.nn.gelu(x, approximate=True), [data], "gelu_tanh")
+
+
+def softmax(data, axis=-1, temperature=None, length=None, **kw):
+    def f(x, *ln):
+        z = x / temperature if temperature else x
+        if ln:
+            steps = jnp.arange(x.shape[axis])
+            shape = [1] * x.ndim
+            shape[axis] = x.shape[axis]
+            mask = steps.reshape(shape) < ln[0].reshape(
+                ln[0].shape + (1,) * (x.ndim - ln[0].ndim))
+            z = jnp.where(mask, z, -jnp.inf)
+        return jax.nn.softmax(z, axis=axis)
+
+    args = [data] + ([length] if length is not None else [])
+    return _apply(f, args, "softmax")
+
+
+def log_softmax(data, axis=-1, temperature=None, **kw):
+    def f(x):
+        z = x / temperature if temperature else x
+        return jax.nn.log_softmax(z, axis=axis)
+
+    return _apply(f, [data], "log_softmax")
+
+
+def softmin(data, axis=-1, **kw):
+    return _apply(lambda x: jax.nn.softmax(-x, axis=axis), [data], "softmin")
+
+
+def softmax_cross_entropy(data, label, **kw):
+    def f(x, y):
+        logp = jax.nn.log_softmax(x, axis=-1)
+        oh = jax.nn.one_hot(y.astype(jnp.int32), x.shape[-1], dtype=x.dtype)
+        return -jnp.sum(oh * logp)
+
+    return _apply(f, [data, label], "softmax_cross_entropy")
+
+
+def SoftmaxActivation(data, mode="instance", **kw):
+    axis = 1 if mode == "channel" else -1
+    return softmax(data, axis=axis)
+
+
+def LayerNorm(data, gamma=None, beta=None, axis=-1, eps=1e-5, **kw):
+    """REF:src/operator/nn/layer_norm.cc — fp32 statistics for bf16 inputs."""
+
+    def f(x, g, b):
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(axis=axis, keepdims=True)
+        var = jnp.square(xf - mu).mean(axis=axis, keepdims=True)
+        y = (xf - mu) * lax.rsqrt(var + eps)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        return (y * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+
+    return _apply(f, [data, gamma, beta], "LayerNorm")
+
+
+def RMSNorm(data, gamma=None, axis=-1, eps=1e-6, **kw):
+    def f(x, g):
+        xf = x.astype(jnp.float32)
+        ms = jnp.square(xf).mean(axis=axis, keepdims=True)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        return (xf * lax.rsqrt(ms + eps) * g.reshape(shape)).astype(x.dtype)
+
+    return _apply(f, [data, gamma], "RMSNorm")
+
+
+def InstanceNorm(data, gamma, beta, eps=1e-3, **kw):
+    def f(x, g, b):
+        ax = tuple(range(2, x.ndim))
+        mu = x.mean(axis=ax, keepdims=True)
+        var = jnp.square(x - mu).mean(axis=ax, keepdims=True)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return (x - mu) * lax.rsqrt(var + eps) * g.reshape(shape) + b.reshape(shape)
+
+    return _apply(f, [data, gamma, beta], "InstanceNorm")
+
+
+def L2Normalization(data, eps=1e-10, mode="instance", **kw):
+    def f(x):
+        if mode == "channel":
+            ax = (1,)
+        elif mode == "spatial":
+            ax = tuple(range(2, x.ndim))
+        else:
+            ax = tuple(range(1, x.ndim))
+        nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True) + eps)
+        return x / nrm
+
+    return _apply(f, [data], "L2Normalization")
+
+
+def batch_norm_core(x, gamma, beta, moving_mean, moving_var, eps, use_batch_stats,
+                    axis=1, fix_gamma=False):
+    """Pure BN forward; returns (out, batch_mean, batch_var).  Gluon's
+    BatchNorm layer owns the running-stat update (the reference did it via
+    FMutateInputs on aux states — here state flows functionally, SURVEY §7.1)."""
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if use_batch_stats:
+        red = tuple(i for i in range(x.ndim) if i != axis)
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(axis=red)
+        var = jnp.square(xf - mu.reshape(shape)).mean(axis=red)
+    else:
+        mu, var = moving_mean, moving_var
+    y = (x.astype(jnp.float32) - mu.reshape(shape)) * lax.rsqrt(
+        var.reshape(shape) + eps)
+    y = y * g.reshape(shape) + beta.reshape(shape)
+    return y.astype(x.dtype), mu, var
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
+              fix_gamma=True, use_global_stats=False, axis=1, **kw):
+    """Op-level BatchNorm (inference-style unless recording; Gluon layer drives
+    the training path with running-stat updates)."""
+    training = autograd.is_training() and not use_global_stats
+
+    def f(x, g, b, mm, mv):
+        y, _, _ = batch_norm_core(x, g, b, mm, mv, eps, training, axis, fix_gamma)
+        return y
+
+    return _apply(f, [data, gamma, beta, moving_mean, moving_var], "BatchNorm")
+
+
+def Dropout(data, p=0.5, mode="training", axes=None, **kw):
+    """REF:src/operator/nn/dropout.cc — inverted dropout; key from the RNG
+    stream (traced key inside hybridize, eager split otherwise)."""
+    if not (autograd.is_training() or mode == "always") or p <= 0:
+        return identity(data)
+    from .. import random as _random
+    key = _random.take_key()
+
+    def f(x):
+        shape = x.shape
+        if axes:
+            shape = tuple(1 if i in axes else s for i, s in enumerate(x.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype)).astype(x.dtype)
+
+    return _apply(f, [data], "Dropout")
+
+
+# ----------------------------------------------------------------------------
+# optimizer update ops (REF:src/operator/optimizer_op.cc fused updates).
+# Pure cores used by both the imperative optimizer and jitted train steps.
+# ----------------------------------------------------------------------------
+def sgd_update_core(weight, grad, lr, wd, rescale_grad=1.0, clip_gradient=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+def sgd_mom_update_core(weight, grad, mom, lr, momentum, wd, rescale_grad=1.0,
+                        clip_gradient=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+def adam_update_core(weight, grad, mean, var, lr, beta1, beta2, epsilon, wd, t,
+                     rescale_grad=1.0, clip_gradient=None, lazy_update=False):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    return weight - lr * mhat / (jnp.sqrt(vhat) + epsilon), m, v
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1,
+               out=None, **kw):
+    cg = clip_gradient if clip_gradient and clip_gradient > 0 else None
+    res = _apply(lambda w, g: sgd_update_core(w, g, lr, wd, rescale_grad, cg),
+                 [weight, grad], "sgd_update", nondiff=True)
+    if out is not None:
+        out._rebind(res._data)
+        return out
+    return res
+
+
+# ----------------------------------------------------------------------------
+# random samplers (REF:src/operator/random/**) — see tpu_mx.random for state
+# ----------------------------------------------------------------------------
+def _rand(shape, sampler, dtype, ctx):
+    from .. import random as _random
+    key = _random.take_key()
+    data = sampler(key, tuple(shape) if shape else ())
+    return _place(data.astype(dtype), ctx)
+
+
+def random_uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None, **kw):
+    return _rand(shape, lambda k, s: jax.random.uniform(k, s, minval=low, maxval=high),
+                 dtype, ctx)
+
+
+def random_normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None, **kw):
+    return _rand(shape, lambda k, s: loc + scale * jax.random.normal(k, s), dtype, ctx)
+
+
+def random_randint(low, high, shape=(1,), dtype="int32", ctx=None, **kw):
+    return _rand(shape, lambda k, s: jax.random.randint(k, s, low, high), dtype, ctx)
+
+
+def random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None, **kw):
+    return _rand(shape, lambda k, s: jax.random.gamma(k, alpha, s) * beta, dtype, ctx)
+
+
+def random_exponential(scale=1.0, shape=(1,), dtype="float32", ctx=None, **kw):
+    return _rand(shape, lambda k, s: jax.random.exponential(k, s) * scale, dtype, ctx)
+
+
+def random_poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, **kw):
+    return _rand(shape, lambda k, s: jax.random.poisson(k, lam, s), dtype, ctx)
+
+
+def random_bernoulli(prob=0.5, shape=(1,), dtype="float32", ctx=None, **kw):
+    return _rand(shape, lambda k, s: jax.random.bernoulli(k, prob, s), dtype, ctx)
+
+
+def sample_multinomial(data, shape=1, get_prob=False, dtype="int32", **kw):
+    from .. import random as _random
+    key = _random.take_key()
+    n = shape if isinstance(shape, int) else int(_np.prod(shape))
+
+    def f(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        return jax.random.categorical(key, logits, axis=-1,
+                                      shape=(n,) + p.shape[:-1]).astype(jnp.dtype(dtype))
+
+    res = _apply(lambda p: jnp.moveaxis(f(p), 0, -1).squeeze(-1) if n == 1
+                 else jnp.moveaxis(f(p), 0, -1), [data], "sample_multinomial",
+                 nondiff=True)
+    return res
+
+
+def shuffle(data, **kw):
+    from .. import random as _random
+    key = _random.take_key()
+    return _apply(lambda x: jax.random.permutation(key, x, axis=0), [data], "shuffle",
+                  nondiff=True)
+
+
+# namespace-style aliases matching mx.nd.random.* / mx.random.*
+class _RandomNS:
+    uniform = staticmethod(random_uniform)
+    normal = staticmethod(random_normal)
+    randint = staticmethod(random_randint)
+    gamma = staticmethod(random_gamma)
+    exponential = staticmethod(random_exponential)
+    poisson = staticmethod(random_poisson)
+    bernoulli = staticmethod(random_bernoulli)
+    multinomial = staticmethod(sample_multinomial)
+    shuffle = staticmethod(shuffle)
+
+
+random = _RandomNS()
+uniform = random_uniform
+normal = random_normal
+randn = lambda *shape, **kw: random_normal(shape=shape, **kw)

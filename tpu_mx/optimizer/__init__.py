@@ -1,0 +1,5 @@
+"""mx.optimizer — optimizers + LR schedulers."""
+from .optimizer import *  # noqa: F401,F403
+from . import lr_scheduler
+from .lr_scheduler import (CosineScheduler, FactorScheduler, LRScheduler,
+                           MultiFactorScheduler, PolyScheduler)

@@ -1,0 +1,61 @@
+"""RNG state: the `mx.random.seed()` layer over JAX's splittable PRNG.
+
+The reference keeps per-device sampler states inside the ResourceManager
+(REF:src/resource.cc kRandom).  Here a process-global key is split per draw in
+eager mode; inside a `hybridize()` trace the active `KeyHolder` (installed by
+Block.apply) supplies *traced* subkeys so compiled graphs stay pure and
+reproducible — keys become explicit step-function inputs, the XLA-correct way.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = ["seed", "take_key", "KeyHolder", "key_scope"]
+
+
+class _GlobalRNG(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+
+
+_GLOBAL = _GlobalRNG()
+_HOLDER = threading.local()
+
+
+class KeyHolder:
+    """Mutable holder threading one traced key through a functional forward."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def take(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    """Route `take_key()` to splits of `key` (used during functional apply)."""
+    holder = KeyHolder(key)
+    prev = getattr(_HOLDER, "holder", None)
+    _HOLDER.holder = holder
+    try:
+        yield holder
+    finally:
+        _HOLDER.holder = prev
+
+
+def take_key():
+    holder = getattr(_HOLDER, "holder", None)
+    if holder is not None:
+        return holder.take()
+    _GLOBAL.key, sub = jax.random.split(_GLOBAL.key)
+    return sub
+
+
+def seed(seed_state, ctx="all"):
+    """mx.random.seed (REF:python/mxnet/random.py)."""
+    _GLOBAL.key = jax.random.PRNGKey(int(seed_state))

@@ -1,0 +1,139 @@
+"""Test kit: the de-facto test framework of the reference
+(REF:python/mxnet/test_utils.py), ported in spirit (SURVEY §4):
+
+- `assert_almost_equal` with per-dtype default tolerances,
+- `check_numeric_gradient` — finite differences vs the autograd tape
+  (the FGradient oracle),
+- `check_consistency` — run the same function on several contexts/dtypes and
+  compare outputs & gradients (the cross-backend oracle; here TPU-vs-CPU),
+- `default_context` override hook enabling the reference's context-override
+  test-reuse pattern (tests/gpu re-running unittest files on another device).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import autograd, context
+from .ndarray import NDArray, array
+
+_DEFAULT_CTX = [None]
+
+_DTYPE_TOL = {
+    np.dtype(np.float16): (1e-2, 1e-2),
+    np.dtype(np.float32): (1e-4, 1e-5),
+    np.dtype(np.float64): (1e-6, 1e-8),
+}
+
+
+def default_context():
+    return _DEFAULT_CTX[0] or context.current_context()
+
+
+def set_default_context(ctx):
+    _DEFAULT_CTX[0] = ctx
+
+
+def _as_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def default_tols(*arrays):
+    rtol, atol = 1e-5, 1e-7
+    for a in arrays:
+        dt = np.dtype(_as_np(a).dtype)
+        if dt in _DTYPE_TOL:
+            r, at = _DTYPE_TOL[dt]
+            rtol, atol = max(rtol, r), max(atol, at)
+        elif str(dt) == "bfloat16":
+            rtol, atol = max(rtol, 1e-2), max(atol, 1e-2)
+    return rtol, atol
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a_np, b_np = _as_np(a).astype(np.float64), _as_np(b).astype(np.float64)
+    if rtol is None or atol is None:
+        r, at = default_tols(a, b)
+        rtol = rtol if rtol is not None else r
+        atol = atol if atol is not None else at
+    np.testing.assert_allclose(a_np, b_np, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} vs {names[1]}")
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    try:
+        assert_almost_equal(a, b, rtol, atol)
+        return True
+    except AssertionError:
+        return False
+
+
+def same(a, b):
+    return np.array_equal(_as_np(a), _as_np(b))
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None, low=-1.0, high=1.0):
+    data = np.random.uniform(low, high, size=shape).astype(dtype)
+    return array(data, ctx=ctx or default_context())
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite-difference check of tape gradients, like the reference's
+    check_numeric_gradient (REF:python/mxnet/test_utils.py).
+
+    fn: callable(list[NDArray]) -> scalar-reducible NDArray.
+    inputs: list of numpy arrays (float64 recommended upstream; float32 here).
+    """
+    nds = [array(x.astype(np.float32)) for x in inputs]
+    for a in nds:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(nds)
+        loss = out.sum()
+    loss.backward()
+    analytic = [a.grad.asnumpy().copy() for a in nds]
+
+    for idx, x in enumerate(inputs):
+        numeric = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            lp = float(fn([array(v.astype(np.float32)) for v in inputs]).sum().asscalar())
+            flat[j] = orig - eps
+            lm = float(fn([array(v.astype(np.float32)) for v in inputs]).sum().asscalar())
+            flat[j] = orig
+            num_flat[j] = (lp - lm) / (2 * eps)
+        np.testing.assert_allclose(analytic[idx], numeric, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch for input {idx}")
+
+
+def check_consistency(fn, inputs, ctx_list=None, grad=True, rtol=None, atol=None):
+    """Cross-backend oracle: run fn on each ctx, compare outputs and input
+    gradients against the first ctx (reference: check_consistency running a
+    symbol on [cpu, gpu, fp16-gpu] — here e.g. [cpu(0), tpu(0)])."""
+    if ctx_list is None:
+        ctx_list = [context.cpu(0)]
+        if context.num_tpus():
+            ctx_list.append(context.tpu(0))
+    results = []
+    for ctx in ctx_list:
+        nds = [array(x, ctx=ctx) for x in inputs]
+        if grad:
+            for a in nds:
+                a.attach_grad()
+            with autograd.record():
+                out = fn(nds)
+                loss = out.sum()
+            loss.backward()
+            results.append((out.asnumpy(), [a.grad.asnumpy() for a in nds]))
+        else:
+            results.append((fn(nds).asnumpy(), []))
+    ref_out, ref_grads = results[0]
+    for (out, grads), ctx in zip(results[1:], ctx_list[1:]):
+        assert_almost_equal(out, ref_out, rtol, atol, names=(str(ctx), str(ctx_list[0])))
+        for g, rg in zip(grads, ref_grads):
+            assert_almost_equal(g, rg, rtol, atol, names=(str(ctx), str(ctx_list[0])))
+    return results
