@@ -1,0 +1,72 @@
+"""Benchmark: ResNet-50 training throughput, single chip (BASELINE headline).
+
+Runs the full compiled train step (fwd+bwd+SGD update in one XLA program,
+bf16 compute / f32 master state) and prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+vs_baseline is against the A100 ballpark in BASELINE.md (~2800 img/s AMP).
+
+Env: BENCH_SMOKE=1 shrinks shapes for a CPU smoke run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+
+    import tpu_mx as mx
+    from tpu_mx import gluon, nd
+    from tpu_mx.gluon.model_zoo import vision
+    from tpu_mx.parallel import CompiledTrainStep
+
+    if smoke:
+        batch, size, warmup, iters = 8, 64, 1, 3
+        net = vision.resnet18_v1(classes=100)
+    else:
+        batch, size, warmup, iters = 128, 224, 3, 10
+        net = vision.resnet50_v1(classes=1000)
+
+    net.initialize(init="xavier")
+    x = nd.array(np.random.rand(batch, 3, size, size).astype(np.float32))
+    _ = net(x)  # finalize deferred shapes
+    net.cast("bfloat16")
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              wd=1e-4, multi_precision=True)
+    step = CompiledTrainStep(net, loss_fn, opt, mesh=None)
+
+    data = nd.cast(
+        nd.array(np.random.rand(batch, 3, size, size).astype(np.float32)),
+        "bfloat16")
+    label = nd.array(np.random.randint(0, 100 if smoke else 1000, (batch,)),
+                     dtype="float32")
+
+    for _ in range(warmup):
+        step.step(data, label).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step.step(data, label)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip"
+        if not smoke else "resnet18_smoke_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / 2800.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,231 @@
+"""Gluon block/layer tests (model: REF:tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import autograd, gluon, nd
+from tpu_mx.gluon import nn
+from tpu_mx.test_utils import assert_almost_equal
+
+
+def test_dense_forward_deferred_init():
+    net = nn.Dense(4, use_bias=True)
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3).astype(np.float32))
+    y = net(x)
+    assert y.shape == (2, 4)
+    assert net.weight.shape == (4, 3)
+    manual = x.asnumpy() @ net.weight.data().asnumpy().T + \
+        net.bias.data().asnumpy()
+    assert_almost_equal(y, manual, rtol=1e-5)
+
+
+def test_dense_flatten():
+    net = nn.Dense(5, flatten=True)
+    net.initialize()
+    y = net(nd.ones((2, 3, 4)))
+    assert y.shape == (2, 5)
+    net2 = nn.Dense(5, flatten=False)
+    net2.initialize()
+    assert net2(nd.ones((2, 3, 4))).shape == (2, 3, 5)
+
+
+def test_uninitialized_raises():
+    net = nn.Dense(4)
+    with pytest.raises(mx.MXNetError):
+        net(nd.ones((2, 3)))
+
+
+def test_conv_layers():
+    net = nn.Conv2D(8, kernel_size=3, strides=2, padding=1)
+    net.initialize()
+    y = net(nd.ones((2, 3, 16, 16)))
+    assert y.shape == (2, 8, 8, 8)
+    assert net.weight.shape == (8, 3, 3, 3)
+    net1d = nn.Conv1D(4, kernel_size=3)
+    net1d.initialize()
+    assert net1d(nd.ones((2, 3, 10))).shape == (2, 4, 8)
+
+
+def test_pool_layers():
+    assert nn.MaxPool2D(2)(nd.ones((1, 2, 8, 8))).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(2)(nd.ones((1, 2, 8, 8))).shape == (1, 2, 4, 4)
+    assert nn.GlobalAvgPool2D()(nd.ones((1, 2, 8, 8))).shape == (1, 2, 1, 1)
+    # avg pooling value correctness
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = nn.AvgPool2D(2)(x)
+    assert_almost_equal(y, np.array([[[[2.5, 4.5], [10.5, 12.5]]]]))
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.array(np.random.rand(4, 3, 5, 5).astype(np.float32) * 10)
+    with autograd.record():
+        y = bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)  # stats updated in training
+    y_inf = bn(x)  # inference path uses running stats
+    assert y_inf.shape == x.shape
+
+
+def test_dropout_train_vs_eval():
+    do = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    y_eval = do(x)
+    assert_almost_equal(y_eval, x.asnumpy())  # identity at inference
+    with autograd.record():
+        y_train = do(x)
+    frac_zero = float((y_train.asnumpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = nd.array(np.array([1, 3, 5]), dtype="int32")
+    y = emb(idx)
+    assert y.shape == (3, 4)
+    assert_almost_equal(y, emb.weight.data().asnumpy()[[1, 3, 5]])
+
+
+def test_sequential_and_getitem():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    y = net(nd.ones((2, 3)))
+    assert y.shape == (2, 4)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = nd.array(np.random.rand(4, 10).astype(np.float32))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    assert_almost_equal(y_eager, y_hybrid, rtol=1e-5)
+
+
+def test_hybrid_training_matches_eager():
+    def build():
+        np.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(4))
+        net.initialize()
+        return net
+
+    x = nd.array(np.random.rand(4, 6).astype(np.float32))
+    lbl = nd.array(np.array([0, 1, 2, 3]), dtype="float32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    grads = []
+    for hybrid in (False, True):
+        net = build()
+        if hybrid:
+            net.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        with autograd.record():
+            loss = loss_fn(net(x), lbl).mean()
+        loss.backward()
+        g = {k: p.grad.asnumpy().copy()
+             for k, p in net.collect_params().items()}
+        grads.append(g)
+    for (k1, g1), (k2, g2) in zip(sorted(grads[0].items()),
+                                  sorted(grads[1].items())):
+        assert_almost_equal(g1, g2, rtol=1e-4, atol=1e-5)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4))
+    net.initialize()
+    net(nd.ones((1, 3)))
+    f = str(tmp_path / "p.npz")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8), nn.Dense(4))
+    net2.load_parameters(f)
+    assert_almost_equal(net2(nd.ones((1, 3))), net(nd.ones((1, 3))))
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(2, use_bias=False)
+    net.initialize(init="ones")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(batch_size=1)
+    # dL/dW = x broadcast to (2,2) of ones; W_new = 1 - 1*1 = 0
+    assert_almost_equal(net.weight.data(), np.zeros((2, 2)))
+
+
+def test_losses_values():
+    l2 = gluon.loss.L2Loss()
+    pred = nd.array([[1.0, 2.0]])
+    label = nd.array([[0.0, 0.0]])
+    assert_almost_equal(l2(pred, label), np.array([1.25]))  # mean(sq)/2
+    l1 = gluon.loss.L1Loss()
+    assert_almost_equal(l1(pred, label), np.array([1.5]))
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    logits = nd.array([[10.0, 0.0], [0.0, 10.0]])
+    labels = nd.array([0, 1], dtype="float32")
+    assert float(sce(logits, labels).mean().asscalar()) < 0.01
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    p = nd.array([[100.0], [-100.0]])
+    t = nd.array([[1.0], [0.0]])
+    assert float(bce(p, t).mean().asscalar()) < 1e-5
+
+
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.const = self.params.get_constant("const",
+                                                  np.array([2.0, 3.0]))
+
+        def hybrid_forward(self, F, x, const):
+            return x * const
+
+    net = Net()
+    net.initialize()
+    y = net(nd.ones((2,)))
+    assert_almost_equal(y, np.array([2.0, 3.0]))
+
+
+def test_grad_req_null_excluded():
+    net = nn.Dense(2)
+    net.initialize()
+    net.weight.grad_req = "null"
+    net(nd.ones((1, 2)))
+    tr = gluon.Trainer(net.collect_params(), "sgd")
+    assert len(tr._params) == 1  # only bias
+
+
+def test_model_zoo_lenet():
+    from tpu_mx.models import lenet
+    net = lenet()
+    net.initialize()
+    y = net(nd.ones((2, 1, 28, 28)))
+    assert y.shape == (2, 10)
+
+
+def test_clip_global_norm():
+    arrays = [nd.array([3.0]), nd.array([4.0])]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert abs(norm - 5.0) < 1e-5
+    total = np.sqrt(sum(float((a.asnumpy() ** 2).sum()) for a in arrays))
+    assert abs(total - 1.0) < 1e-4
+
+
+def test_split_and_load():
+    data = nd.arange(0, 8).reshape(8, 1)
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(0)])
+    assert len(parts) == 2 and parts[0].shape == (4, 1)

@@ -1,0 +1,138 @@
+"""RNN layer/cell tests (model: REF:tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import autograd, nd
+from tpu_mx.gluon import rnn
+from tpu_mx.test_utils import assert_almost_equal
+
+
+def test_lstm_shapes():
+    layer = rnn.LSTM(16, num_layers=2)
+    layer.initialize()
+    x = nd.array(np.random.rand(5, 3, 8).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(batch_size=3)
+    out, st = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert st[0].shape == (2, 3, 16) and st[1].shape == (2, 3, 16)
+
+
+def test_lstm_ntc_layout():
+    layer = rnn.LSTM(8, layout="NTC")
+    layer.initialize()
+    out = layer(nd.array(np.random.rand(3, 5, 4).astype(np.float32)))
+    assert out.shape == (3, 5, 8)
+
+
+def test_bidirectional():
+    layer = rnn.GRU(8, bidirectional=True)
+    layer.initialize()
+    out = layer(nd.array(np.random.rand(5, 2, 4).astype(np.float32)))
+    assert out.shape == (5, 2, 16)
+
+
+def test_rnn_gradients_flow():
+    layer = rnn.LSTM(8, num_layers=1)
+    layer.initialize()
+    x = nd.array(np.random.rand(4, 2, 4).astype(np.float32))
+    with autograd.record():
+        loss = (layer(x) ** 2).sum()
+    loss.backward()
+    for p in layer.collect_params().values():
+        assert float(np.abs(p.grad.asnumpy()).sum()) > 0
+
+
+def test_lstm_vs_manual_numpy():
+    """Fused scan LSTM against a manual numpy step loop with the same params."""
+    H, C = 3, 2
+    layer = rnn.LSTM(H, input_size=C)
+    layer.initialize()
+    x_np = np.random.rand(4, 1, C).astype(np.float32)
+    out = layer(nd.array(x_np)).asnumpy()
+
+    params = {k.split("_", 1)[1] if False else k: v.data().asnumpy()
+              for k, v in layer.collect_params().items()}
+    wi = [v for k, v in params.items() if "i2h_weight" in k][0]
+    wh = [v for k, v in params.items() if "h2h_weight" in k][0]
+    bi = [v for k, v in params.items() if "i2h_bias" in k][0]
+    bh = [v for k, v in params.items() if "h2h_bias" in k][0]
+
+    def sigmoid(z):
+        return 1 / (1 + np.exp(-z))
+
+    h = np.zeros((1, H), np.float32)
+    c = np.zeros((1, H), np.float32)
+    outs = []
+    for t in range(4):
+        gates = x_np[t] @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+        h = sigmoid(o) * np.tanh(c)
+        outs.append(h.copy())
+    manual = np.stack(outs)
+    assert_almost_equal(out, manual, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_vs_manual_numpy():
+    H, C = 3, 2
+    layer = rnn.GRU(H, input_size=C)
+    layer.initialize()
+    x_np = np.random.rand(3, 1, C).astype(np.float32)
+    out = layer(nd.array(x_np)).asnumpy()
+
+    params = {k: v.data().asnumpy()
+              for k, v in layer.collect_params().items()}
+    wi = [v for k, v in params.items() if "i2h_weight" in k][0]
+    wh = [v for k, v in params.items() if "h2h_weight" in k][0]
+    bi = [v for k, v in params.items() if "i2h_bias" in k][0]
+    bh = [v for k, v in params.items() if "h2h_bias" in k][0]
+
+    def sigmoid(z):
+        return 1 / (1 + np.exp(-z))
+
+    h = np.zeros((1, H), np.float32)
+    outs = []
+    for t in range(3):
+        i_all = x_np[t] @ wi.T + bi
+        h_all = h @ wh.T
+        i_r, i_z, i_n = np.split(i_all, 3, -1)
+        h_r, h_z, h_n = np.split(h_all + bh, 3, -1)
+        r = sigmoid(i_r + h_r)
+        z = sigmoid(i_z + h_z)
+        n = np.tanh(i_n + r * (h @ wh[2*H:].T + bh[2*H:]))
+        h = (1 - z) * n + z * h
+        outs.append(h.copy())
+    manual = np.stack(outs)
+    assert_almost_equal(out, manual, rtol=1e-4, atol=1e-5)
+
+
+def test_cells_and_unroll():
+    cell = rnn.LSTMCell(6)
+    cell.initialize()
+    x = nd.array(np.random.rand(2, 5, 4).astype(np.float32))
+    outs, states = cell.unroll(5, x, layout="NTC")
+    assert outs.shape == (2, 5, 6)
+    assert len(states) == 2
+
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.GRUCell(4))
+    stack.add(rnn.GRUCell(4))
+    stack.initialize()
+    out, st = stack(nd.ones((2, 3)), stack.begin_state(2))
+    assert out.shape == (2, 4) and len(st) == 2
+
+
+def test_lstm_lm_model():
+    from tpu_mx.models import RNNModel
+    lm = RNNModel(vocab_size=30, num_embed=8, num_hidden=8, num_layers=1)
+    lm.initialize()
+    x = nd.array(np.random.randint(0, 30, (6, 2)), dtype="int32")
+    logits = lm(x)
+    assert logits.shape == (6, 2, 30)
+    # with explicit state (TBPTT pattern)
+    st = lm.begin_state(batch_size=2)
+    logits, st2 = lm(x, st)
+    assert logits.shape == (6, 2, 30)

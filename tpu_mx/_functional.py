@@ -1,0 +1,35 @@
+"""Functional-trace scope shared between the op layer and gluon.
+
+When a HybridBlock's forward is being traced as a pure function (hybridize /
+CompiledTrainStep), ops must stay in raw-jax land: no NDArray wrapping, no
+tape recording, creation ops return raw arrays.  gluon.parameter's
+substitution scope pushes here; ndarray.ops checks here.  Lives in its own
+module so ops.py doesn't import gluon.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class _Stack(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_STACK = _Stack()
+
+
+def push(entry):
+    _STACK.stack.append(entry)
+
+
+def pop():
+    return _STACK.stack.pop()
+
+
+def active():
+    return bool(_STACK.stack)
+
+
+def top():
+    return _STACK.stack[-1] if _STACK.stack else None

@@ -5,5 +5,7 @@ from .block import Block, HybridBlock, SymbolBlock
 from . import nn
 from . import loss
 from .trainer import Trainer
+from . import rnn
+from . import model_zoo
 from . import utils
 from .utils import split_and_load

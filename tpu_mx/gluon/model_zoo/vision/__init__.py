@@ -1,0 +1,32 @@
+"""Model zoo vision models + get_model registry
+(REF:python/mxnet/gluon/model_zoo/vision/__init__.py)."""
+# module refs first: the star imports below rebind e.g. `alexnet` to the
+# factory function, shadowing the submodule attribute on this package
+from . import alexnet as _alexnet
+from . import densenet as _densenet
+from . import mobilenet as _mobilenet
+from . import resnet as _resnet
+from . import squeezenet as _squeezenet
+from . import vgg as _vgg
+
+from .resnet import *  # noqa: F401,F403
+from .alexnet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+
+_models = {}
+for _mod in (_resnet, _alexnet, _vgg, _mobilenet, _squeezenet, _densenet):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower():
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            f"model {name!r} not in model zoo; available: {sorted(_models)}")
+    return _models[name](**kwargs)

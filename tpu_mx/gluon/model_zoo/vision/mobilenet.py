@@ -1,0 +1,105 @@
+"""MobileNet v1/v2 (REF:model_zoo/vision/mobilenet.py) — depthwise convs via
+`groups=channels` (lax.conv feature_group_count)."""
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
+           "mobilenet_v2_0_5"]
+
+
+def _add_conv(out, channels, kernel=1, stride=1, pad=0, num_group=1,
+              active=True, relu6=False):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+                      use_bias=False))
+    out.add(nn.BatchNorm())
+    if active:
+        out.add(nn.HybridLambda(
+            lambda F, x: F.clip(x, 0, 6) if relu6 else F.relu(x)))
+
+
+class LinearBottleneck(HybridBlock):
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        self.out = nn.HybridSequential()
+        if t != 1:
+            _add_conv(self.out, in_channels * t, relu6=True)
+        _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
+                  num_group=in_channels * t, relu6=True)
+        _add_conv(self.out, channels, active=False)
+
+    def hybrid_forward(self, F, x):
+        out = self.out(x)
+        return x + out if self.use_shortcut else out
+
+
+class MobileNet(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), 3, 2, 1)
+        dw_channels = [int(x * multiplier) for x in
+                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+        channels = [int(x * multiplier) for x in
+                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+        strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
+        for dwc, c, s in zip(dw_channels, channels, strides):
+            _add_conv(self.features, dwc, 3, s, 1, num_group=dwc)
+            _add_conv(self.features, c)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), 3, 2, 1, relu6=True)
+        in_c = [int(multiplier * x) for x in
+                [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
+                + [160] * 3]
+        channels = [int(multiplier * x) for x in
+                    [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
+                    + [160] * 3 + [320]]
+        ts = [1] + [6] * 16
+        strides = [1, 2, 1, 2, 1, 1, 2, 1, 1, 1, 1, 1, 1, 2, 1, 1, 1]
+        for ic, c, t, s in zip(in_c, channels, ts, strides):
+            self.features.add(LinearBottleneck(ic, c, t, s))
+        last = int(1280 * multiplier) if multiplier > 1.0 else 1280
+        _add_conv(self.features, last, relu6=True)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, 1, use_bias=False))
+        self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def mobilenet1_0(**kw):
+    return MobileNet(1.0, **kw)
+
+
+def mobilenet0_75(**kw):
+    return MobileNet(0.75, **kw)
+
+
+def mobilenet0_5(**kw):
+    return MobileNet(0.5, **kw)
+
+
+def mobilenet0_25(**kw):
+    return MobileNet(0.25, **kw)
+
+
+def mobilenet_v2_1_0(**kw):
+    return MobileNetV2(1.0, **kw)
+
+
+def mobilenet_v2_0_5(**kw):
+    return MobileNetV2(0.5, **kw)

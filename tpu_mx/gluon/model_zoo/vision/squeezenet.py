@@ -1,0 +1,72 @@
+"""SqueezeNet 1.0/1.1 (REF:model_zoo/vision/squeezenet.py)."""
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(squeeze_channels, 1, activation="relu"))
+
+    class _Expand(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.e1 = nn.Conv2D(expand1x1_channels, 1, activation="relu")
+            self.e3 = nn.Conv2D(expand3x3_channels, 3, padding=1,
+                                activation="relu")
+
+        def hybrid_forward(self, F, x):
+            return F.concat(self.e1(x), self.e3(x), dim=1)
+
+    out.add(_Expand())
+    return out
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        assert version in ("1.0", "1.1")
+        self.features = nn.HybridSequential()
+        if version == "1.0":
+            self.features.add(nn.Conv2D(96, 7, 2, activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(64, 256, 256))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(64, 256, 256))
+        else:
+            self.features.add(nn.Conv2D(64, 3, 2, activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(64, 256, 256))
+            self.features.add(_make_fire(64, 256, 256))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, 1, activation="relu"))
+        self.output.add(nn.GlobalAvgPool2D())
+        self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet("1.1", **kw)

@@ -10,7 +10,6 @@ imperative Gluon definition double as a pure jittable function of its pytree.
 from __future__ import annotations
 
 import contextlib
-import threading
 
 import jax.numpy as jnp
 import numpy as np
@@ -27,26 +26,24 @@ class DeferredInitializationError(MXNetError):
     pass
 
 
-class _Substitution(threading.local):
-    def __init__(self):
-        self.stack = []
-
-
-_SUBST = _Substitution()
+from .. import _functional
 
 
 @contextlib.contextmanager
 def param_substitution(mapping, updates=None):
-    """mapping: {param_name: raw jax value}; updates collects aux mutations."""
-    _SUBST.stack.append((mapping, updates if updates is not None else {}))
+    """mapping: {param_name: raw jax value}; updates collects aux mutations.
+    Pushing this scope also switches the op layer into raw-jax mode
+    (see tpu_mx._functional)."""
+    entry = (mapping, updates if updates is not None else {})
+    _functional.push(entry)
     try:
-        yield _SUBST.stack[-1][1]
+        yield entry[1]
     finally:
-        _SUBST.stack.pop()
+        _functional.pop()
 
 
 def _active_substitution():
-    return _SUBST.stack[-1] if _SUBST.stack else None
+    return _functional.top()
 
 
 class Parameter:

@@ -1,0 +1,282 @@
+"""Unfused RNN cells (REF:python/mxnet/gluon/rnn/rnn_cell.py).
+
+Single-step cells with the reference's API (begin_state, unroll, __call__).
+The fused multi-step path is rnn_layer.py over `lax.scan`; these cells exist
+for custom per-step control flow, mirroring the reference's split between
+rnn_cell (unfused) and the cuDNN-backed rnn_layer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import HybridBlock
+from ... import initializer as init_mod
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ...ndarray import ops as F
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            states.append(F.zeros(shape, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Static unroll (reference's symbolic unroll; here the per-step python
+        loop is traced once under hybridize so XLA still sees one graph)."""
+        from ...ndarray import ops as F
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            steps = list(inputs)
+            batch = steps[0].shape[0]
+        else:
+            batch = inputs.shape[layout.find("N")]
+            steps = F.split(inputs, length, axis=axis, squeeze_axis=True)
+            if length == 1:
+                steps = [steps] if not isinstance(steps, list) else steps
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            out, states = self(steps[t], states)
+            outputs.append(out)
+        if merge_outputs or merge_outputs is None:
+            outputs = F.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape_hint((self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    """Gate order i,f,g,o matching the reference's fused RNN op layout."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape_hint((4 * self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        gates = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                                 num_hidden=4 * self._hidden_size) + \
+            F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                             num_hidden=4 * self._hidden_size)
+        parts = F.split(gates, 4, axis=-1)
+        i = F.sigmoid(parts[0])
+        f = F.sigmoid(parts[1])
+        g = F.tanh(parts[2])
+        o = F.sigmoid(parts[3])
+        c = f * states[1] + i * g
+        h = o * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    """Gate order r,z,n (reset/update/new) matching the reference."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(3 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(3 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(3 * hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(3 * hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape_hint((3 * self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i_r, i_z, i_n = F.split(i2h, 3, axis=-1)
+        h_r, h_z, h_n = F.split(h2h, 3, axis=-1)
+        r = F.sigmoid(i_r + h_r)
+        z = F.sigmoid(i_z + h_z)
+        n = F.tanh(i_n + r * h_n)
+        h = (1 - z) * n + z * states[0]
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return sum([c.state_info(batch_size)
+                    for c in self._children.values()], [])
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return sum([c.begin_state(batch_size, **kwargs)
+                    for c in self._children.values()], [])
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, new_s = cell(inputs, states[p:p + n])
+            next_states.extend(new_s)
+            p += n
+        return inputs, next_states
+
+    def hybrid_forward(self, F, inputs, states):
+        return self.forward(inputs, states)
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate)
+        return inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def hybrid_forward(self, F, inputs, states):
+        out, new_states = self.base_cell(inputs, states)
+        if self._zs > 0:
+            new_states = [
+                F.where(F.random.uniform(shape=ns.shape) < self._zs, s, ns)
+                if hasattr(ns, "shape") else ns
+                for s, ns in zip(states, new_states)]
+        if self._zo > 0:
+            prev = self._prev_output
+            if prev is None:
+                prev = F.zeros_like(out)
+            out = F.where(F.random.uniform(shape=out.shape) < self._zo,
+                          prev, out)
+            self._prev_output = out
+        return out, new_states
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def hybrid_forward(self, F, inputs, states):
+        out, new_states = self.base_cell(inputs, states)
+        return out + inputs, new_states

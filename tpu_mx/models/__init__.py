@@ -1,0 +1,6 @@
+"""tpu_mx.models — reference workload models (SURVEY §2.4 capability
+checklist): LeNet (MNIST), model-zoo ResNets, PTB LSTM LM, BERT, SSD."""
+from .lenet import lenet
+from .lstm_lm import RNNModel
+from .bert import (BERTEncoder, BERTModel, bert_base_config,
+                   bert_data_specs, bert_sharding_rules)

@@ -1,0 +1,175 @@
+"""BERT for pretraining — the flagship transformer (BASELINE config 3).
+
+The reference ecosystem's BERT lives in GluonNLP but exercises only in-repo
+capabilities (SURVEY §2.4): Gluon blocks, LayerNorm/gelu/Embedding/batch_dot
+ops, LAMB, KVStore DP.  This implementation is TPU-first:
+
+- bfloat16-friendly compute (LayerNorm stats in fp32, MXU matmuls in bf16),
+- Megatron-style tensor-parallel sharding rules (qkv/FFN-in column-sharded on
+  `tp`, output projections row-sharded, activations propagate via GSPMD),
+- sequence axis ready for ring attention over `sp`
+  (tpu_mx.parallel.ring_attention) — long-context path the reference lacked,
+- the whole train step compiles into one XLA program via
+  parallel.CompiledTrainStep.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray import ops
+from ..parallel import P, attention as _attention
+
+__all__ = ["BERTModel", "BERTEncoder", "TransformerLayer", "bert_base_config",
+           "bert_sharding_rules", "bert_data_specs"]
+
+
+def bert_base_config(vocab_size=30522, max_len=512):
+    return dict(num_layers=12, units=768, hidden_size=3072, num_heads=12,
+                vocab_size=vocab_size, max_length=max_len, dropout=0.1)
+
+
+def bert_sharding_rules():
+    """Megatron TP layout (regex → PartitionSpec on (out, in) weights):
+    column-parallel for qkv & FFN-in, row-parallel for the output mats."""
+    return [
+        (r"qkv_weight$", P("tp", None)),
+        (r"qkv_bias$", P("tp")),
+        (r"attnout_weight$", P(None, "tp")),
+        (r"ffn1_weight$", P("tp", None)),
+        (r"ffn1_bias$", P("tp")),
+        (r"ffn2_weight$", P(None, "tp")),
+        (r"word_embed_weight$", P(None, None)),
+        # everything else (embeddings, LN, heads) replicated
+    ]
+
+
+def bert_data_specs():
+    """(tokens, token_types, labels) enter sharded batch×sequence."""
+    return (P("dp", "sp"), P("dp", "sp"), P("dp", "sp"))
+
+
+class SelfAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._heads = num_heads
+        self._mesh = mesh
+        self.qkv_weight = self.params.get("qkv_weight",
+                                          shape=(3 * units, units))
+        self.qkv_bias = self.params.get("qkv_bias", shape=(3 * units,))
+        self.attnout_weight = self.params.get("attnout_weight",
+                                              shape=(units, units))
+        self.attnout_bias = self.params.get("attnout_bias", shape=(units,))
+
+    def hybrid_forward(self, F, x, qkv_weight, qkv_bias, attnout_weight,
+                       attnout_bias):
+        B, T, U = x.shape
+        H, D = self._heads, U // self._heads
+        qkv = F.FullyConnected(x, qkv_weight, qkv_bias,
+                               num_hidden=3 * U, flatten=False)  # (B,T,3U)
+        qkv = F.reshape(qkv, shape=(B, T, 3, H, D))
+        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))             # (3,B,H,T,D)
+        q = F.squeeze(F.slice_axis(qkv, axis=0, begin=0, end=1), axis=0)
+        k = F.squeeze(F.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
+        v = F.squeeze(F.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
+        mesh = self._mesh
+        out = ops._apply(
+            lambda qq, kk, vv: _attention(qq, kk, vv, mesh=mesh, causal=False),
+            [q, k, v], "RingAttention")                           # (B,H,T,D)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, T, U))
+        return F.FullyConnected(out, attnout_weight, attnout_bias,
+                                num_hidden=U, flatten=False)
+
+
+class TransformerLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, mesh=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.attention = SelfAttention(units, num_heads, dropout, mesh=mesh)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.ffn1_weight = self.params.get("ffn1_weight",
+                                           shape=(hidden_size, units))
+        self.ffn1_bias = self.params.get("ffn1_bias", shape=(hidden_size,))
+        self.ffn2_weight = self.params.get("ffn2_weight",
+                                           shape=(units, hidden_size))
+        self.ffn2_bias = self.params.get("ffn2_bias", shape=(units,))
+        self._hidden = hidden_size
+        self._units = units
+
+    def hybrid_forward(self, F, x, ffn1_weight, ffn1_bias, ffn2_weight,
+                       ffn2_bias):
+        att = self.attention(x)
+        if self.dropout:
+            att = self.dropout(att)
+        x = self.ln1(x + att)
+        h = F.FullyConnected(x, ffn1_weight, ffn1_bias,
+                             num_hidden=self._hidden, flatten=False)
+        h = F.gelu(h)
+        h = F.FullyConnected(h, ffn2_weight, ffn2_bias,
+                             num_hidden=self._units, flatten=False)
+        if self.dropout:
+            h = self.dropout(h)
+        return self.ln2(x + h)
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, vocab_size,
+                 max_length, dropout=0.0, mesh=None, dtype="float32",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.word_embed_weight = self.params.get(
+            "word_embed_weight", shape=(vocab_size, units), dtype=dtype)
+        self.pos_embed_weight = self.params.get(
+            "pos_embed_weight", shape=(max_length, units), dtype=dtype)
+        self.type_embed_weight = self.params.get(
+            "type_embed_weight", shape=(2, units), dtype=dtype)
+        self.ln = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(TransformerLayer(units, hidden_size, num_heads,
+                                             dropout, mesh=mesh))
+
+    def hybrid_forward(self, F, tokens, token_types, word_embed_weight,
+                       pos_embed_weight, type_embed_weight):
+        T = tokens.shape[1]
+        x = F.Embedding(tokens, word_embed_weight)
+        x = x + F.Embedding(token_types, type_embed_weight)
+        pos = F.slice_axis(pos_embed_weight, axis=0, begin=0, end=T)
+        x = x + F.expand_dims(pos, axis=0)
+        x = self.ln(x)
+        if self.dropout:
+            x = self.dropout(x)
+        for layer in self.layers._children.values():
+            x = layer(x)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Encoder + tied-embedding MLM head (pretraining objective)."""
+
+    def __init__(self, config=None, mesh=None, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        cfg = config or bert_base_config()
+        self._cfg = cfg
+        self.encoder = BERTEncoder(mesh=mesh, dtype=dtype, **cfg)
+        units = cfg["units"]
+        self.mlm_dense = nn.Dense(units, flatten=False, in_units=units)
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.mlm_bias = self.params.get("mlm_bias",
+                                        shape=(cfg["vocab_size"],))
+
+    def hybrid_forward(self, F, tokens, token_types, mlm_bias):
+        x = self.encoder(tokens, token_types)
+        h = F.gelu(self.mlm_dense(x))
+        h = self.mlm_ln(h)
+        # tied decoder: logits = h · E^T  (one MXU matmul over vocab)
+        embed = self.encoder.word_embed_weight.data()
+        logits = F.dot(h, embed, transpose_b=True) + mlm_bias
+        return logits

@@ -1,0 +1,49 @@
+"""PTB word-level LSTM language model (BASELINE config 2;
+REF:example/gluon/word_language_model/model.py shape: embed → multi-layer
+LSTM → tied/untied decoder, trained with truncated BPTT)."""
+from ..gluon import nn, rnn
+from ..gluon.block import HybridBlock
+
+__all__ = ["RNNModel"]
+
+
+class RNNModel(HybridBlock):
+    def __init__(self, mode="lstm", vocab_size=10000, num_embed=200,
+                 num_hidden=200, num_layers=2, dropout=0.5, tie_weights=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.drop = nn.Dropout(dropout)
+        self.encoder = nn.Embedding(vocab_size, num_embed)
+        if mode == "lstm":
+            self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                input_size=num_embed)
+        elif mode == "gru":
+            self.rnn = rnn.GRU(num_hidden, num_layers, dropout=dropout,
+                               input_size=num_embed)
+        else:
+            self.rnn = rnn.RNN(num_hidden, num_layers, dropout=dropout,
+                               input_size=num_embed,
+                               activation="relu" if mode == "rnn_relu"
+                               else "tanh")
+        if tie_weights:
+            assert num_embed == num_hidden, "tied weights need equal dims"
+            self.decoder = nn.Dense(vocab_size, flatten=False,
+                                    params=self.encoder.params)
+        else:
+            self.decoder = nn.Dense(vocab_size, flatten=False,
+                                    in_units=num_hidden)
+        self._num_hidden = num_hidden
+
+    def begin_state(self, batch_size=0):
+        return self.rnn.begin_state(batch_size)
+
+    def hybrid_forward(self, F, inputs, state=None):
+        """inputs: (T, N) int tokens; returns (T, N, V) logits (+ state)."""
+        emb = self.drop(self.encoder(inputs))
+        if state is None:
+            output = self.rnn(emb)
+            output = self.drop(output)
+            return self.decoder(output)
+        output, state = self.rnn(emb, state)
+        output = self.drop(output)
+        return self.decoder(output), state

@@ -23,6 +23,7 @@ import numpy as _np
 from jax import lax
 
 from .. import autograd
+from .. import _functional
 from .ndarray import NDArray, array, concatenate, load, save, waitall
 from ..context import current_context
 
@@ -50,8 +51,11 @@ def _raw(a):
 def _apply(fn, args, name="op", nondiff=False):
     """Dispatch one op: args = tensor positionals (NDArray | array | scalar)."""
     datas = [_raw(a) for a in args]
-    if not any(isinstance(a, NDArray) for a in args):
-        return fn(*datas)  # functional mode (hybridize trace / internal reuse)
+    if _functional.active() or not any(isinstance(a, NDArray) for a in args):
+        # functional mode: inside a hybridize/apply trace (even if an NDArray
+        # leaked in via a creation op), or a pure-array call — no wrapping,
+        # no tape
+        return fn(*datas)
 
     diff_idx = [
         i for i, a in enumerate(args)
@@ -91,6 +95,8 @@ def _index(a, key):
 # creation ops
 # ----------------------------------------------------------------------------
 def _place(data, ctx):
+    if _functional.active():
+        return data  # raw inside a functional trace
     return NDArray(data, ctx=ctx or current_context())
 
 
@@ -784,12 +790,13 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
 
     def f(x, w, *b):
+        # NOTE: no preferred_element_type — jax 0.9's conv transpose rule
+        # emits mismatched-dtype convs under grad with it; XLA:TPU already
+        # accumulates bf16 convs in f32 on the MXU
         y = lax.conv_general_dilated(
             x, w, window_strides=strides, padding=padding,
             rhs_dilation=dilation, dimension_numbers=dn,
-            feature_group_count=num_group,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-        y = y.astype(x.dtype)
+            feature_group_count=num_group)
         if b:
             y = y + b[0].reshape((1, -1) + (1,) * nd_)
         return y

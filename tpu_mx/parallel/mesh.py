@@ -1,0 +1,70 @@
+"""Device mesh utilities (SURVEY §5.8: the TPU-native replacement for the
+reference's KVStore comm topology — ps-lite trees/rings become a
+`jax.sharding.Mesh` whose collectives XLA compiles over ICI/DCN).
+
+Axis conventions used across the framework:
+  dp   — data parallel (batch sharding; grad psum)
+  fsdp — parameter-sharded data parallel (reduce_scatter/all_gather)
+  tp   — tensor/model parallel (Megatron-style sharded matmuls)
+  sp   — sequence/context parallel (ring attention over ICI)
+  pp   — pipeline stages (reserved; not yet wired)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "Mesh", "NamedSharding", "P", "local_mesh",
+           "hybrid_mesh"]
+
+
+def make_mesh(axis_sizes=None, devices=None):
+    """Build a Mesh from {axis: size}. Sizes of -1 are inferred to fill the
+    device count (at most one -1)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = {"dp": n}
+    names = list(axis_sizes)
+    sizes = list(axis_sizes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {n}")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def local_mesh(**axis_sizes):
+    return make_mesh(axis_sizes or None)
+
+
+def hybrid_mesh(ici_axes, dcn_axes=None):
+    """Multi-slice (ICI within slice, DCN across): reference's single-machine
+    vs cross-machine KVStore split.
+
+    create_hybrid_device_mesh takes per-axis (ici, dcn) factor lists of EQUAL
+    length and returns a device array of ndim == len(axes) (elementwise
+    product), so both dicts must name the same axes; an axis absent from
+    dcn_axes gets dcn factor 1.  hybrid_mesh({'dp': 8, 'tp': 4},
+    {'dp': 2}) = 2 slices of 8×4, dp spanning DCN."""
+    if not dcn_axes:
+        return make_mesh(ici_axes)
+    from jax.experimental import mesh_utils
+    unknown = set(dcn_axes) - set(ici_axes)
+    if unknown:
+        raise ValueError(f"dcn axes {sorted(unknown)} not present in ici_axes")
+    names = list(ici_axes)
+    ici_shape = [ici_axes[n] for n in names]
+    dcn_shape = [dcn_axes.get(n, 1) for n in names]
+    dev = mesh_utils.create_hybrid_device_mesh(ici_shape, dcn_shape)
+    return Mesh(dev, axis_names=tuple(names))

@@ -1,0 +1,139 @@
+"""Ring attention: sequence/context parallelism over the ICI ring
+(SURVEY §5.7 — a NEW capability, absent in the reference, whose max sequence
+length was bounded by one device's memory).
+
+Design: the sequence axis is sharded over mesh axis `sp`.  Each device holds a
+(T/n)-length Q block and streams K/V blocks around the ring with
+`lax.ppermute`, accumulating flash-attention style online-softmax statistics
+(running max m, denominator l, numerator o) so the full T×T attention is
+computed in n steps with O(T/n) memory per device and compute/communication
+overlap on ICI.  Causal masking uses the rotating K-block index.
+
+The same blockwise kernel with n=1 is the local attention path, so models can
+call `attention()` unconditionally and get ring behavior exactly when the
+mesh has an `sp` axis.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "attention", "local_flash_attention"]
+
+
+def _block_attn(q, k, v, bias=None, mask=None, scale=1.0):
+    """One q-block × k-block attention: returns (scores-exp sum stats).
+    q: (B, H, Tq, D), k/v: (B, H, Tk, D)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                                   # (B,H,Tq)
+    # guard fully-masked rows: exp(-inf - -inf) -> use max(m, finite floor)
+    m_safe = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m_safe[..., None])                        # (B,H,Tq,Tk)
+    l = jnp.sum(p, axis=-1)                                   # (B,H,Tq)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)                   # (B,H,Tq,D)
+    return m_safe, l, o
+
+
+def _merge(m1, l1, o1, m2, l2, o2):
+    """Merge two online-softmax partial results."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    return m, l, o
+
+
+def _ring_body(q, k, v, axis_name, causal, scale):
+    """Runs inside shard_map: q/k/v are LOCAL blocks (B, H, Tb, D)."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, H, Tb, D = q.shape
+    neg = jnp.full((B, H, Tb), -1e30, q.dtype)
+    zero_l = jnp.zeros((B, H, Tb), q.dtype)
+    zero_o = jnp.zeros_like(q)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        m, l, o, k_cur, v_cur = carry
+        k_idx = (my_idx - i) % n  # whose K block we currently hold
+        if causal:
+            # global positions: q row r -> my_idx*Tb + r; k col c -> k_idx*Tb + c
+            qpos = my_idx * Tb + jnp.arange(Tb)
+            kpos = k_idx * Tb + jnp.arange(Tb)
+            mask = qpos[:, None] >= kpos[None, :]
+            mask = mask[None, None]
+        else:
+            mask = None
+        bm, bl, bo = _block_attn(q, k_cur, v_cur, mask=mask, scale=scale)
+        m, l, o = _merge(m, l, o, bm, bl, bo)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    (m, l, o, _, _), _ = lax.scan(
+        step, (neg, zero_l, zero_o, k, v), jnp.arange(n))
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                   q_spec=None):
+    """Sequence-parallel attention.  q/k/v: GLOBAL (B, H, T, D) arrays whose
+    T axis is sharded over `axis_name`.  Returns attention output with the
+    same sharding.  `q_spec` overrides the default
+    P('dp', 'tp', axis_name, None) layout (axes absent from the mesh are
+    dropped automatically)."""
+    from jax.experimental.shard_map import shard_map
+
+    def present(ax):
+        return ax in mesh.axis_names
+
+    spec = q_spec or P("dp" if present("dp") else None,
+                       "tp" if present("tp") else None,
+                       axis_name if present(axis_name) else None,
+                       None)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    if not present(axis_name):
+        # no sequence axis: plain (flash-style blockwise on one device)
+        mask = None
+        if causal:
+            t = q.shape[2]
+            mask = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])[None, None]
+        m, l, o = _block_attn(q, k, v, mask=mask, scale=scale)
+        return o / jnp.maximum(l, 1e-30)[..., None]
+
+    fn = shard_map(
+        functools.partial(_ring_body, axis_name=axis_name, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)
+
+
+def local_flash_attention(q, k, v, causal=False):
+    """Single-device attention with the same numerics as the ring kernel."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    mask = None
+    if causal:
+        t = q.shape[2]
+        mask = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])[None, None]
+    m, l, o = _block_attn(q, k, v, mask=mask, scale=scale)
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def attention(q, k, v, mesh=None, causal=False):
+    """Dispatch: ring attention when a mesh with an `sp` axis is active,
+    local flash otherwise."""
+    if mesh is not None and "sp" in mesh.axis_names and \
+            mesh.shape["sp"] > 1:
+        return ring_attention(q, k, v, mesh, causal=causal)
+    return local_flash_attention(q, k, v, causal=causal)

@@ -1,0 +1,225 @@
+"""Compiled SPMD train step — the performance path (SURVEY §3.2's hot loop,
+fused into ONE XLA program).
+
+The reference's step is: CachedOp forward → autograd backward → KVStore
+push/pull (NCCL/PS) → fused optimizer kernels, four engine-scheduled phases.
+Here the entire step — forward, backward, gradient reduction (psum inserted
+by XLA from the shardings), optimizer update, BN-stat update — is a single
+jitted function with donated buffers, so weights never leave device and XLA
+overlaps the collectives with the backward pass (the same overlap the
+reference engineered via per-parameter engine ordering).
+
+Sharding: parameters get PartitionSpecs from regex rules (default replicated
+= pure DP; rules give Megatron-style TP or fsdp), batch enters sharded over
+`dp` (and `sp` for sequence-parallel models).  Works mesh-less too (single
+device jit).
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ndarray import NDArray
+
+__all__ = ["CompiledTrainStep", "sharding_for", "apply_rules"]
+
+
+def apply_rules(name, shape, rules, mesh):
+    """First matching (regex → PartitionSpec) rule wins; axes not in the mesh
+    are dropped from the spec; default replicated."""
+    if rules:
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                cleaned = tuple(
+                    (ax if (ax is not None and ax in mesh.axis_names) else None)
+                    for ax in spec) if mesh is not None else ()
+                # drop trailing Nones beyond rank
+                cleaned = cleaned[:len(shape)]
+                return P(*cleaned)
+    return P()
+
+
+def sharding_for(mesh, spec):
+    return NamedSharding(mesh, spec) if mesh is not None else None
+
+
+class CompiledTrainStep:
+    """One-program train step over an (optional) mesh.
+
+    net        — an initialized HybridBlock (run one forward first)
+    loss_fn    — gluon Loss block (operates on raw arrays through F ops)
+    optimizer  — tpu_mx optimizer (its pure update_core is traced in)
+    mesh       — jax.sharding.Mesh or None
+    rules      — [(regex, PartitionSpec)] parameter sharding rules
+    data_specs — PartitionSpecs for the batch inputs (default P('dp') on axis0)
+    """
+
+    def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
+                 data_specs=None, donate=True, extra_fwd_args=0):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        params = {k: p for k, p in net.collect_params().items()
+                  if p._data is not None}
+        if not params:
+            raise ValueError("net has no initialized parameters; run one "
+                             "forward pass before compiling the step")
+        self._params = params
+        self._diff_keys = [
+            k for k, p in params.items()
+            if p.grad_req != "null" and jnp.issubdtype(p.data().dtype,
+                                                       jnp.floating)]
+        self._lr_mults = {k: params[k].lr_mult for k in self._diff_keys}
+        self._wd_mults = {k: params[k].wd_mult for k in self._diff_keys}
+        self.values = {k: p.data()._data for k, p in params.items()}
+        # mixed precision: f32 master copies for low-precision diff params
+        # (the reference's mp_* kernel family; optimizer.multi_precision)
+        self._mp_keys = set()
+        if getattr(optimizer, "multi_precision", False):
+            self._mp_keys = {
+                k for k in self._diff_keys
+                if self.values[k].dtype in (jnp.float16, jnp.bfloat16)}
+        self.masters = {k: self.values[k].astype(jnp.float32)
+                        for k in self._mp_keys}
+        self.opt_states = {
+            k: optimizer.create_state(
+                i, NDArray(self.masters[k]) if k in self._mp_keys
+                else params[k].data())
+            for i, k in enumerate(self._diff_keys)}
+        self._t = 0
+        self._specs = {k: apply_rules(k, v.shape, rules, mesh)
+                       for k, v in self.values.items()}
+        self._data_specs = data_specs
+        self._donate = donate
+        self._jitted = None
+
+    # -- sharding helpers -----------------------------------------------------
+    def _value_shardings(self):
+        return {k: sharding_for(self.mesh, self._specs[k])
+                for k in self.values}
+
+    def _state_shardings(self):
+        return {
+            k: jax.tree_util.tree_map(
+                lambda _: sharding_for(self.mesh, self._specs[k]),
+                self.opt_states[k])
+            for k in self._diff_keys}
+
+    def place(self):
+        """Device_put params/opt state onto their mesh shardings."""
+        if self.mesh is None:
+            return
+        vs = self._value_shardings()
+        self.values = {k: jax.device_put(v, vs[k])
+                       for k, v in self.values.items()}
+        self.masters = {k: jax.device_put(v, vs[k])
+                        for k, v in self.masters.items()}
+        ss = self._state_shardings()
+        self.opt_states = {k: jax.device_put(s, ss[k])
+                           for k, s in self.opt_states.items()}
+
+    # -- the compiled program -------------------------------------------------
+    def _build(self, n_batch_args):
+        net, loss_fn, opt = self.net, self.loss_fn, self.optimizer
+        diff_keys = list(self._diff_keys)
+        lr_mults, wd_mults = self._lr_mults, self._wd_mults
+        base_wd = opt.wd
+
+        mp_keys = set(self._mp_keys)
+
+        def fn(values, masters, opt_states, t, lr, key, *batch):
+            data_args, label = batch[:-1], batch[-1]
+            diff_vals = {k: values[k] for k in diff_keys}
+            const_vals = {k: v for k, v in values.items()
+                          if k not in set(diff_keys)}
+
+            def lfn(dv):
+                pm = dict(const_vals)
+                pm.update(dv)
+                out, updates = net._functional_call(pm, key, True, data_args)
+                if isinstance(out, (tuple, list)):
+                    out = out[0]
+                l = loss_fn(out, label)
+                return jnp.mean(l), updates
+
+            (loss, updates), grads = jax.value_and_grad(
+                lfn, has_aux=True)(diff_vals)
+            new_vals = dict(values)
+            new_masters = {}
+            new_states = {}
+            for k in diff_keys:
+                if k in mp_keys:
+                    # update in f32 master space; forward weight is a cast
+                    w, s = opt.update_core(
+                        masters[k], grads[k].astype(jnp.float32),
+                        opt_states[k], lr * lr_mults[k],
+                        base_wd * wd_mults[k], t)
+                    new_masters[k] = w
+                    new_vals[k] = w.astype(values[k].dtype)
+                else:
+                    w, s = opt.update_core(values[k], grads[k], opt_states[k],
+                                           lr * lr_mults[k],
+                                           base_wd * wd_mults[k], t)
+                    new_vals[k] = w.astype(values[k].dtype)
+                new_states[k] = s
+            for k, v in updates.items():
+                if k in new_vals:
+                    new_vals[k] = v.astype(new_vals[k].dtype)
+            return new_vals, new_masters, new_states, loss
+
+        if self.mesh is None:
+            self._jitted = jax.jit(
+                fn, donate_argnums=(0, 1, 2) if self._donate else ())
+            return
+        repl = sharding_for(self.mesh, P())
+        dspecs = self._data_specs or tuple(P("dp") for _ in range(n_batch_args))
+        batch_sh = tuple(sharding_for(self.mesh, s) for s in dspecs)
+        master_sh = {k: sharding_for(self.mesh, self._specs[k])
+                     for k in self._mp_keys}
+        in_sh = (self._value_shardings(), master_sh, self._state_shardings(),
+                 repl, repl, repl) + batch_sh
+        out_sh = (self._value_shardings(), master_sh, self._state_shardings(),
+                  repl)
+        self._jitted = jax.jit(
+            fn, in_shardings=in_sh, out_shardings=out_sh,
+            donate_argnums=(0, 1, 2) if self._donate else ())
+
+    def step(self, *batch, lr=None):
+        """Run one step; batch = (*data_args, label) as NDArray/array."""
+        from .. import random as _random
+        raw = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b)
+                    for b in batch)
+        if self._jitted is None:
+            self._build(len(raw))
+            self.place()
+        self._t += 1
+        if lr is None:
+            sched = self.optimizer.lr_scheduler
+            lr = sched(self._t) if sched else self.optimizer.lr
+        key = _random.take_key()
+        self.values, self.masters, self.opt_states, loss = self._jitted(
+            self.values, self.masters, self.opt_states,
+            jnp.asarray(self._t, jnp.float32), jnp.asarray(lr, jnp.float32),
+            key, *raw)
+        return NDArray(loss)
+
+    def sync_to_net(self):
+        """Write device weights back into the Gluon parameters (for eval,
+        checkpointing through net.save_parameters, etc.)."""
+        for k, p in self._params.items():
+            p._data._rebind(self.values[k])
+
+    def state_dict(self):
+        return {"values": self.values, "masters": self.masters,
+                "opt_states": self.opt_states, "t": self._t}
+
+    def load_state_dict(self, sd):
+        self.values = sd["values"]
+        self.masters = sd.get("masters", {})
+        self.opt_states = sd["opt_states"]
+        self._t = sd["t"]
