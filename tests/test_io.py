@@ -1,0 +1,216 @@
+"""mx.io / mx.recordio tests — mirrors the reference's test_io.py /
+test_recordio.py coverage (REF:tests/python/unittest/)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import io as mio
+from tpu_mx import recordio
+
+
+# ---------------------------------------------------------------- recordio --
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"", b"x" * 1001, os.urandom(4096)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_recordio_magic_layout(tmp_path):
+    """First 4 bytes must be the dmlc magic so reference tools accept it."""
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"abc")
+    w.close()
+    raw = open(path, "rb").read()
+    magic, lrec = struct.unpack("<II", raw[:8])
+    assert magic == 0xCED7230A
+    assert lrec & ((1 << 29) - 1) == 3
+    assert len(raw) == 12  # 8 header + 3 data + 1 pad
+
+
+def test_indexed_recordio(tmp_path):
+    rec, idx = str(tmp_path / "t.rec"), str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(20):
+        w.write_idx(i, f"record-{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.keys == list(range(20))
+    for i in (7, 0, 19, 3):
+        assert r.read_idx(i) == f"record-{i}".encode()
+    r.close()
+
+
+def test_pack_unpack_scalar_and_vector_label():
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(h, b"payload")
+    h2, data = recordio.unpack(s)
+    assert data == b"payload" and h2.label == 3.0 and h2.id == 42
+
+    lab = np.array([1.0, 2.0, 3.5], np.float32)
+    s = recordio.pack(recordio.IRHeader(0, lab, 7, 0), b"img")
+    h3, data = recordio.unpack(s)
+    assert data == b"img"
+    np.testing.assert_allclose(h3.label, lab)
+
+
+def test_pack_img_roundtrip():
+    img = (np.random.rand(32, 24, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".png")
+    h, img2 = recordio.unpack_img(s)
+    np.testing.assert_array_equal(img, img2)  # png is lossless
+
+
+# -------------------------------------------------------------- NDArrayIter --
+def _collect(it):
+    it.reset()
+    return list(it)
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(60, dtype=np.float32).reshape(20, 3)
+    label = np.arange(20, dtype=np.float32)
+    it = mio.NDArrayIter(data, label, batch_size=6, last_batch_handle="pad")
+    batches = _collect(it)
+    assert len(batches) == 4  # ceil(20/6)
+    assert batches[-1].pad == 4
+    first = batches[0].data[0].asnumpy()
+    np.testing.assert_allclose(first, data[:6])
+
+
+def test_ndarrayiter_discard_and_shuffle():
+    data = np.arange(20, dtype=np.float32).reshape(20, 1)
+    it = mio.NDArrayIter(data, None, batch_size=6,
+                         last_batch_handle="discard", shuffle=True)
+    batches = _collect(it)
+    assert len(batches) == 3
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in batches])
+    assert len(set(seen.tolist())) == 18  # no duplicates within epoch
+
+
+def test_ndarrayiter_roll_over():
+    data = np.arange(20, dtype=np.float32).reshape(20, 1)
+    it = mio.NDArrayIter(data, None, batch_size=6,
+                         last_batch_handle="roll_over")
+    ep1 = _collect(it)
+    assert len(ep1) == 3  # 18 served, 2-sample tail deferred
+    seen1 = np.concatenate([b.data[0].asnumpy().ravel() for b in ep1])
+    assert len(np.unique(seen1)) == 18  # no duplication inside the epoch
+    it.reset()
+    ep2 = list(it)
+    # tail (2) + fresh 20 = 22 -> 3 full batches, new tail of 4 deferred
+    assert len(ep2) == 3
+    head = ep2[0].data[0].asnumpy().ravel()
+    np.testing.assert_allclose(head[:2], [18.0, 19.0])  # carried tail leads
+
+
+def test_ndarrayiter_seed_reproducible():
+    data = np.arange(20, dtype=np.float32).reshape(20, 1)
+    a = mio.NDArrayIter(data, None, batch_size=5, shuffle=True, seed=7)
+    b = mio.NDArrayIter(data, None, batch_size=5, shuffle=True, seed=7)
+    for ba, bb in zip(_collect(a), _collect(b)):
+        np.testing.assert_array_equal(ba.data[0].asnumpy(),
+                                      bb.data[0].asnumpy())
+
+
+def test_prefetching_iter_exhausted_no_hang():
+    it = mio.PrefetchingIter(
+        mio.NDArrayIter(np.zeros((10, 2), np.float32), batch_size=5))
+    assert len(list(it)) == 2
+    with pytest.raises(StopIteration):  # must not deadlock
+        next(it)
+
+
+def test_ndarrayiter_provide():
+    it = mio.NDArrayIter({"a": np.zeros((10, 4), np.float32)},
+                         {"lab": np.zeros((10,), np.float32)}, batch_size=5)
+    d, = it.provide_data
+    assert d.name == "a" and d.shape == (5, 4)
+    l, = it.provide_label
+    assert l.name == "lab"
+
+
+def test_resize_iter():
+    it = mio.NDArrayIter(np.zeros((20, 2), np.float32), batch_size=5)
+    rit = mio.ResizeIter(it, 7)  # epoch forced to 7 batches, wraps around
+    assert len(_collect(rit)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+    base = mio.NDArrayIter(data, np.zeros(20, np.float32), batch_size=5)
+    pit = mio.PrefetchingIter(base)
+    batches = list(pit)
+    assert len(batches) == 4
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    pit.reset()
+    assert len(list(pit)) == 4
+
+
+# --------------------------------------------------------------- CSV/MNIST --
+def test_csviter(tmp_path):
+    data = np.random.rand(17, 6).astype(np.float32)
+    labels = np.arange(17, dtype=np.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels, delimiter=",")
+    it = mio.CSVIter(data_csv=dpath, data_shape=(6,), label_csv=lpath,
+                     batch_size=5)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:5], rtol=1e-5)
+
+
+def _write_idx_ubyte(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x800 | arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_mnistiter(tmp_path):
+    imgs = (np.random.rand(50, 28, 28) * 255).astype(np.uint8)
+    labels = np.random.randint(0, 10, 50).astype(np.uint8)
+    ipath, lpath = str(tmp_path / "img"), str(tmp_path / "lab")
+    _write_idx_ubyte(ipath, imgs)
+    _write_idx_ubyte(lpath, labels)
+    it = mio.MNISTIter(image=ipath, label=lpath, batch_size=10, shuffle=False)
+    b = next(iter(it))
+    assert b.data[0].shape == (10, 1, 28, 28)
+    np.testing.assert_allclose(b.data[0].asnumpy()[0, 0],
+                               imgs[0].astype(np.float32) / 255.0)
+    flat = mio.MNISTIter(image=ipath, label=lpath, batch_size=10, flat=True)
+    assert next(iter(flat)).data[0].shape == (10, 784)
+
+
+# --------------------------------------------------------- ImageRecordIter --
+def test_image_record_iter(tmp_path):
+    rec, idx = str(tmp_path / "im.rec"), str(tmp_path / "im.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(12):
+        img = (rng.rand(40, 40, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    w.close()
+    it = mio.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                             data_shape=(3, 32, 32), batch_size=4,
+                             shuffle=True, rand_crop=True, rand_mirror=True,
+                             preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(labels.tolist()) <= {0.0, 1.0, 2.0}

@@ -25,4 +25,6 @@ from . import gluon
 from . import kvstore
 from . import kvstore as kv
 from . import contrib
+from . import recordio
+from . import io
 from . import test_utils

@@ -1,0 +1,526 @@
+"""The ``mx.io`` data-iterator surface.
+
+Capability map to the reference:
+  * ``DataIter``/``DataBatch``/``DataDesc`` protocol — REF:python/mxnet/io/io.py
+  * ``NDArrayIter`` (pad/discard/roll_over)       — REF:python/mxnet/io/io.py
+  * ``MNISTIter``, ``CSVIter``                      — REF:src/io/iter_mnist.cc,
+    REF:src/io/iter_csv.cc (C++ iters exposed through MXDataIter)
+  * ``ImageRecordIter``                             — REF:src/io/iter_image_recordio_2.cc
+    (multithreaded JPEG decode + augment + batch; here: a thread pool decoding
+    into pinned host staging, with the native C++ chunk reader used when built)
+  * ``PrefetchingIter``                             — REF:src/io/iter_prefetcher.h
+    (double-buffering on a background thread so host decode overlaps device step)
+
+TPU-first notes: iterators produce host numpy batches; transfer happens once
+per batch via ``nd.array`` (→ ``jax.device_put``), and ``PrefetchingIter``
+keeps the next batch decoding while the current one trains — the same
+pipeline shape the reference builds with dmlc::ThreadedIter.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..base import MXNetError, check
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Shape/type descriptor for one input (REF io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, tuple(shape))
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if not layout else layout.find("N")
+
+
+class DataBatch:
+    """One batch: lists of data/label arrays plus padding bookkeeping."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label if label is not None else []
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator protocol (reset / next / iter_next / getdata / getlabel /
+    getpad), identical surface to the reference's DataIter."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+    @property
+    def provide_data(self):
+        raise NotImplementedError
+
+    @property
+    def provide_label(self):
+        return []
+
+
+def _as_list_of_pairs(data, default_name):
+    """Normalize data=dict|list|array → [(name, ndarray)] (init_data in REF)."""
+    if data is None:
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [(default_name, data)]
+    elif isinstance(data, (list, tuple)):
+        data = [(f"{default_name}_{i}" if i else default_name, d)
+                for i, d in enumerate(data)]
+    elif isinstance(data, dict):
+        data = sorted(data.items())
+    out = []
+    for k, v in data:
+        arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+        out.append((k, arr))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Batches over in-memory arrays with ``pad``/``discard``/``roll_over``
+    last-batch handling and optional shuffling (REF io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label", seed=None):
+        super().__init__(batch_size)
+        self.data = _as_list_of_pairs(data, data_name)
+        self.label = _as_list_of_pairs(label, label_name)
+        check(self.data, "NDArrayIter needs at least one data array")
+        self.num_data = self.data[0][1].shape[0]
+        for k, v in self.data + self.label:
+            check(v.shape[0] == self.num_data,
+                  f"array {k} first dim {v.shape[0]} != {self.num_data}")
+        check(last_batch_handle in ("pad", "discard", "roll_over"),
+              f"bad last_batch_handle {last_batch_handle}")
+        check(self.num_data >= batch_size,
+              "batch_size larger than dataset")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self._rng = np.random.RandomState(seed) if seed is not None \
+            else np.random
+        self._leftover = None  # roll_over: tail carried into the next epoch
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        epoch = np.arange(self.num_data)
+        if self.shuffle:
+            self._rng.shuffle(epoch)
+        if self.last_batch_handle == "roll_over" and self._leftover is not None:
+            # last epoch's tail leads this epoch (reference roll_over contract)
+            epoch = np.concatenate([self._leftover, epoch])
+            self._leftover = None
+        self.idx = epoch
+        self.cursor = 0
+        self._sel = None
+        self._pad = 0
+
+    def iter_next(self):
+        n = len(self.idx)
+        remaining = n - self.cursor
+        if remaining <= 0:
+            return False
+        if remaining >= self.batch_size:
+            self._sel = self.idx[self.cursor:self.cursor + self.batch_size]
+            self._pad = 0
+            self.cursor += self.batch_size
+            return True
+        # short tail
+        if self.last_batch_handle == "discard":
+            self.cursor = n
+            return False
+        if self.last_batch_handle == "roll_over":
+            self._leftover = self.idx[self.cursor:]
+            self.cursor = n
+            return False
+        # pad: wrap to the epoch head, report the overlap via getpad()
+        self._pad = self.batch_size - remaining
+        self._sel = np.concatenate(
+            [self.idx[self.cursor:], self.idx[:self._pad]])
+        self.cursor = n
+        return True
+
+    def _take(self, arrs):
+        return [nd.array(v[self._sel]) for _, v in arrs]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        return self._pad
+
+
+class ResizeIter(DataIter):
+    """Caps/extends an iterator to exactly ``size`` batches per epoch
+    (REF io.py ResizeIter — used to equalize epoch lengths)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Runs the wrapped iterator(s) on a background thread with a bounded
+    queue — REF:src/io/iter_prefetcher.h's double buffering, host-side."""
+
+    def __init__(self, iters, depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.depth = depth
+        self._queue = None
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._exhausted = False
+
+        def worker():
+            try:
+                while not self._stop.is_set():
+                    batches = []
+                    for it in self.iters:
+                        batches.append(it.next())
+                    self._queue.put(batches)
+            except StopIteration:
+                self._queue.put(None)
+            except Exception as e:  # surface errors on the consumer side
+                self._queue.put(e)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._start()
+
+    def iter_next(self):
+        if self._exhausted:  # worker exited; a blocking get() would hang
+            return False
+        item = self._queue.get()
+        if item is None:
+            self._exhausted = True
+            return False
+        if isinstance(item, Exception):
+            self._exhausted = True
+            raise item
+        self._batches = item
+        return True
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        b = self._batches[0]
+        if len(self._batches) > 1:
+            return DataBatch(
+                sum([x.data for x in self._batches], []),
+                sum([x.label for x in self._batches], []),
+                pad=b.pad, index=b.index)
+        return b
+
+    def getdata(self):
+        return sum([x.data for x in self._batches], [])
+
+    def getlabel(self):
+        return sum([x.label for x in self._batches], [])
+
+    def getpad(self):
+        return self._batches[0].pad
+
+
+def _read_idx_ubyte(path):
+    """Read an MNIST idx-ubyte file (REF:src/io/iter_mnist.cc ReadInt loop)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-ubyte reader (REF:src/io/iter_mnist.cc).  Produces
+    ``(N,1,28,28)`` float32 in [0,1] (or flat ``(N,784)``)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=True, seed=0, **kwargs):
+        imgs = _read_idx_ubyte(image).astype(np.float32) / 255.0
+        labels = _read_idx_ubyte(label).astype(np.float32)
+        imgs = imgs.reshape(len(imgs), -1) if flat else imgs[:, None, :, :]
+        super().__init__(imgs, labels, batch_size=batch_size, shuffle=shuffle,
+                         last_batch_handle="discard", data_name="data",
+                         label_name="softmax_label", seed=seed)
+
+
+class CSVIter(NDArrayIter):
+    """CSV reader (REF:src/io/iter_csv.cc): ``data_csv`` (+``label_csv``)
+    reshaped to ``data_shape`` rows."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=128, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+        else:
+            label = np.zeros((len(data), 1), dtype=np.float32)
+        super().__init__(data, label, batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch else "discard")
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image pipeline (REF:src/io/iter_image_recordio_2.cc):
+    threaded JPEG decode + augmentation + NCHW batching, prefetched.
+
+    Augmentations follow REF:src/io/image_aug_default.cc's core set:
+    ``resize`` (shorter side), ``rand_crop``, ``rand_mirror``, center crop to
+    ``data_shape``, mean/std normalization.  Decode fan-out uses a thread pool
+    (``preprocess_threads``); when the native ``libtpumx_io`` extension is
+    built it supplies the chunked record reader.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
+                 label_width=1, shuffle=False, rand_crop=False,
+                 rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
+                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 preprocess_threads=4, prefetch_buffer=4, round_batch=True,
+                 seed=0, **kwargs):
+        super().__init__(batch_size)
+        import cv2  # decode backend, as in the reference (OpenCV)
+        self._cv2 = cv2
+        self.data_shape = tuple(data_shape)
+        check(len(self.data_shape) == 3, "data_shape must be (C,H,W)")
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+        self.rng = np.random.RandomState(seed)
+        self.round_batch = round_batch
+
+        from ..recordio import MXRecordIO, MXIndexedRecordIO, unpack
+        self._unpack = unpack
+        if path_imgidx and os.path.isfile(path_imgidx):
+            self._rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self._order = list(self._rec.keys)
+        else:
+            # no index: scan once to record offsets, enabling shuffle anyway
+            self._rec = MXRecordIO(path_imgrec, "r")
+            self._offsets = []
+            while True:
+                pos = self._rec.tell()
+                if self._rec.read() is None:
+                    break
+                self._offsets.append(pos)
+            self._order = list(range(len(self._offsets)))
+        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self._prefetch = prefetch_buffer
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self.label_width == 1 else (
+            self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shp)]
+
+    def reset(self):
+        if self.shuffle:
+            self.rng.shuffle(self._order)
+        self._cursor = 0
+        self._pending = []
+
+    def _read_raw(self, key):
+        from ..recordio import MXIndexedRecordIO
+        if isinstance(self._rec, MXIndexedRecordIO):
+            return self._rec.read_idx(key)
+        self._rec.record.seek(self._offsets[key])
+        return self._rec.read()
+
+    def _decode_one(self, raw, aug):
+        # `aug` = (crop_frac_y, crop_frac_x, mirror) drawn on the MAIN thread:
+        # np.random.RandomState is not thread-safe, so pool workers must not
+        # touch self.rng (and per-batch draws keep seeded runs reproducible
+        # regardless of worker scheduling).
+        cv2 = self._cv2
+        fy, fx, mirror = aug
+        header, img_bytes = self._unpack(raw)
+        img = cv2.imdecode(np.frombuffer(img_bytes, np.uint8), cv2.IMREAD_COLOR)
+        check(img is not None, "image decode failed")
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            short = min(img.shape[:2])
+            scale = self.resize / short
+            img = cv2.resize(img, (max(w, int(round(img.shape[1] * scale))),
+                                   max(h, int(round(img.shape[0] * scale)))))
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            img = cv2.resize(img, (max(w, iw), max(h, ih)))
+            ih, iw = img.shape[:2]
+        if self.rand_crop:
+            y = int(fy * (ih - h + 1))
+            x = int(fx * (iw - w + 1))
+        else:
+            y, x = (ih - h) // 2, (iw - w) // 2
+        img = img[y:y + h, x:x + w]
+        if mirror:
+            img = img[:, ::-1]
+        img = (img.astype(np.float32) - self.mean) / self.std
+        label = header.label if self.label_width > 1 else float(
+            np.asarray(header.label).ravel()[0])
+        return img.transpose(2, 0, 1), label
+
+    def iter_next(self):
+        n = len(self._order)
+        if self._cursor >= n:
+            return False
+        idxs = [self._order[(self._cursor + i) % n]
+                for i in range(self.batch_size)]
+        self._pad = max(0, self._cursor + self.batch_size - n)
+        if self._pad and not self.round_batch:
+            return False
+        self._cursor += self.batch_size
+        raws = [self._read_raw(i) for i in idxs]  # sequential file reads
+        augs = [(self.rng.rand(), self.rng.rand(),
+                 self.rand_mirror and self.rng.rand() < 0.5)
+                for _ in idxs]
+        decoded = list(self._pool.map(self._decode_one, raws, augs))
+        self._data = np.stack([d for d, _ in decoded])
+        labels = [l for _, l in decoded]
+        self._label = np.asarray(labels, dtype=np.float32)
+        return True
+
+    def getdata(self):
+        return [nd.array(self._data)]
+
+    def getlabel(self):
+        return [nd.array(self._label)]
+
+    def getpad(self):
+        return self._pad
