@@ -1,0 +1,191 @@
+"""mx.symbol tests — composition, inference, executor, serialization.
+
+Models the reference's tests/python/unittest/test_symbol.py and
+test_executor.py coverage.
+"""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def test_compose_and_listing():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.list_auxiliary_states() == []
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(8, 20), softmax_label=(8,))
+    assert arg_shapes == [(8, 20), (16, 20), (16,), (4, 16), (4,), (8,)]
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    d = mx.sym.var("data")
+    c = mx.sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv0")
+    b = mx.sym.BatchNorm(c, name="bn0")
+    f = mx.sym.FullyConnected(mx.sym.flatten(b), num_hidden=10, name="fc")
+    assert b.list_auxiliary_states() == ["bn0_moving_mean", "bn0_moving_var"]
+    arg_shapes, out_shapes, aux_shapes = f.infer_shape(data=(4, 3, 28, 28))
+    assert arg_shapes[1] == (8, 3, 3, 3)
+    assert aux_shapes == [(8,), (8,)]
+    assert out_shapes == [(4, 10)]
+
+
+def test_variable_shape_attr():
+    d = mx.sym.var("data", shape=(2, 5))
+    y = mx.sym.FullyConnected(d, num_hidden=3, name="fc")
+    arg_shapes, out_shapes, _ = y.infer_shape()
+    assert out_shapes == [(2, 3)]
+
+
+def test_executor_forward_backward():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(8, 20), softmax_label=(8,))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = rng.randn(8, 20).astype("float32")
+    ex.arg_dict["fc1_weight"][:] = rng.randn(16, 20).astype("float32") * 0.1
+    ex.arg_dict["fc2_weight"][:] = rng.randn(4, 16).astype("float32") * 0.1
+    ex.arg_dict["softmax_label"][:] = rng.randint(0, 4, (8,)).astype("float32")
+    (y,) = ex.forward(is_train=True)
+    np.testing.assert_allclose(y.asnumpy().sum(axis=1), np.ones(8), rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["fc1_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+    # softmax output head: data grad == (p - onehot)/1
+    p = y.asnumpy()
+    lbl = ex.arg_dict["softmax_label"].asnumpy().astype(int)
+    oh = np.eye(4)[lbl]
+    gd = ex.grad_dict["data"].asnumpy()
+    assert gd.shape == (8, 20)
+    # fc2 bias grad equals column sums of (p - onehot)
+    np.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                               (p - oh).sum(axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_grad_req_add_and_null():
+    out = _mlp()
+    req = {n: "write" for n in out.list_arguments()}
+    req["data"] = "null"
+    req["fc1_weight"] = "add"
+    ex = out.simple_bind(mx.cpu(), grad_req=req, data=(8, 20),
+                         softmax_label=(8,))
+    rng = np.random.RandomState(1)
+    ex.arg_dict["data"][:] = rng.randn(8, 20).astype("float32")
+    ex.arg_dict["fc1_weight"][:] = rng.randn(16, 20).astype("float32") * 0.1
+    ex.arg_dict["fc2_weight"][:] = rng.randn(4, 16).astype("float32") * 0.1
+    ex.forward(is_train=True)
+    ex.backward()
+    g1 = ex.grad_dict["fc1_weight"].asnumpy().copy()
+    ex.forward(is_train=True)
+    ex.backward()
+    g2 = ex.grad_dict["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-4, atol=1e-6)
+    assert "data" not in ex.grad_dict
+
+
+def test_operator_overloading():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = (a + b) * 2.0 - a / 2.0
+    ex = c.simple_bind(mx.cpu(), a=(3,), b=(3,))
+    ex.arg_dict["a"][:] = np.array([1.0, 2.0, 3.0], "float32")
+    ex.arg_dict["b"][:] = np.array([4.0, 5.0, 6.0], "float32")
+    (y,) = ex.forward()
+    np.testing.assert_allclose(y.asnumpy(), [9.5, 13.0, 16.5], rtol=1e-6)
+
+
+def test_group_and_getitem():
+    a = mx.sym.var("a")
+    s1 = a * 2.0
+    s2 = a + 1.0
+    g = mx.sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.simple_bind(mx.cpu(), a=(2,))
+    ex.arg_dict["a"][:] = np.array([1.0, 2.0], "float32")
+    y1, y2 = ex.forward()
+    np.testing.assert_allclose(y1.asnumpy(), [2.0, 4.0])
+    np.testing.assert_allclose(y2.asnumpy(), [2.0, 3.0])
+
+
+def test_multi_output_split():
+    a = mx.sym.var("a")
+    parts = mx.sym.split(a, num_outputs=2, axis=1, name="sp")
+    assert len(parts.list_outputs()) == 2
+    right = parts[1]
+    ex = right.simple_bind(mx.cpu(), a=(2, 4))
+    ex.arg_dict["a"][:] = np.arange(8).reshape(2, 4).astype("float32")
+    (y,) = ex.forward()
+    np.testing.assert_allclose(y.asnumpy(), [[2, 3], [6, 7]])
+
+
+def test_json_roundtrip(tmp_path):
+    out = _mlp()
+    path = str(tmp_path / "sym.json")
+    out.save(path)
+    loaded = mx.sym.load(path)
+    assert loaded.list_arguments() == out.list_arguments()
+    assert loaded.list_outputs() == out.list_outputs()
+    ex = loaded.simple_bind(mx.cpu(), data=(4, 20), softmax_label=(4,))
+    (y,) = ex.forward()
+    assert y.shape == (4, 4)
+
+
+def test_eval():
+    a = mx.sym.var("a")
+    y = a * 3.0
+    (out,) = y.eval(a=mx.nd.array([1.0, 2.0]))
+    np.testing.assert_allclose(out.asnumpy(), [3.0, 6.0])
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1 = internals["fc1"]
+    ex = fc1.simple_bind(mx.cpu(), data=(2, 20))
+    (y,) = ex.forward()
+    assert y.shape == (2, 16)
+
+
+def test_attrs():
+    a = mx.sym.var("a", shape=(2, 2))
+    y = mx.sym.FullyConnected(a, num_hidden=2, name="fc",
+                              attr={"__ctx_group__": "dev1"})
+    assert y.attr("__ctx_group__") == "dev1"
+    assert "fc" in y.attr_dict()
+
+
+def test_regression_outputs():
+    d = mx.sym.var("data")
+    l = mx.sym.var("label")
+    out = mx.sym.LinearRegressionOutput(d, l, name="lro")
+    ex = out.simple_bind(mx.cpu(), data=(4, 3), label=(4, 3))
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 3).astype("float32")
+    t = rng.randn(4, 3).astype("float32")
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["label"][:] = t
+    (y,) = ex.forward(is_train=True)
+    np.testing.assert_allclose(y.asnumpy(), x, rtol=1e-6)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), (x - t) / 4,
+                               rtol=1e-5, atol=1e-6)

@@ -15,6 +15,8 @@ from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,
                       num_tpus, tpu)
 from . import ndarray
 from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
 from . import autograd
 from . import random
 from . import initializer

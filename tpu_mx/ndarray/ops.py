@@ -1157,6 +1157,110 @@ def shuffle(data, **kw):
                   nondiff=True)
 
 
+# ---------------------------------------------------------------------------
+# legacy output heads (REF:src/operator/softmax_output.cc,
+# REF:src/operator/regression_output-inl.h, REF:src/operator/make_loss.cc).
+# These are loss layers: forward is the prediction, backward *injects* the
+# loss gradient regardless of the incoming head gradient — realized here with
+# `jax.custom_vjp` so the same semantics hold under the symbolic executor.
+# ---------------------------------------------------------------------------
+
+def _output_head(fwd_fn, grad_fn, name):
+    @jax.custom_vjp
+    def head(x, y):
+        return fwd_fn(x, y)
+
+    def head_fwd(x, y):
+        out = fwd_fn(x, y)
+        return out, (out, x, y)
+
+    def head_bwd(res, g):
+        out, x, y = res
+        del g  # loss layer: incoming head grad ignored (reference semantics)
+        ylike = jnp.zeros_like(y) if isinstance(y, jnp.ndarray) else 0.0
+        return grad_fn(out, x, y), ylike
+
+    head.defvjp(head_fwd, head_bwd)
+    head.__name__ = name
+    return head
+
+
+def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1.0,
+                  multi_output=False, use_ignore=False, preserve_shape=False,
+                  normalization="null", out_grad=False, smooth_alpha=0.0, **kw):
+    """Softmax forward + injected cross-entropy gradient
+    (REF:src/operator/softmax_output.cc)."""
+    axis = 1 if multi_output else -1
+
+    def fwd(x, y):
+        return jax.nn.softmax(x, axis=axis)
+
+    def grad(p, x, y):
+        n_class = x.shape[axis]
+        yi = y.astype(jnp.int32)
+        oh = jax.nn.one_hot(yi, n_class, axis=axis, dtype=x.dtype)
+        if smooth_alpha:
+            oh = oh * (1.0 - smooth_alpha) + smooth_alpha / n_class
+        g = p - oh
+        if use_ignore:
+            valid = (y != ignore_label).astype(x.dtype)
+            g = g * jnp.expand_dims(valid, axis if axis != -1 else x.ndim - 1)
+        if normalization == "batch":
+            g = g / x.shape[0]
+        elif normalization == "valid":
+            if use_ignore:
+                cnt = jnp.maximum(jnp.sum(y != ignore_label), 1).astype(x.dtype)
+            else:
+                cnt = jnp.asarray(float(_np.prod(y.shape)), x.dtype)
+            g = g / cnt
+        return g * grad_scale
+
+    return _apply(_output_head(fwd, grad, "SoftmaxOutput"), [data, label],
+                  "SoftmaxOutput")
+
+
+def _regression_head(link, residual, name):
+    def make(data, label, grad_scale=1.0, **kw):
+        def fwd(x, y):
+            return link(x)
+
+        def grad(out, x, y):
+            yb = y.reshape(out.shape)
+            return residual(out, yb) * (grad_scale / out.shape[0])
+
+        return _apply(_output_head(fwd, grad, name), [data, label], name)
+
+    make.__name__ = name
+    return make
+
+
+LinearRegressionOutput = _regression_head(
+    lambda x: x, lambda o, y: o - y, "LinearRegressionOutput")
+MAERegressionOutput = _regression_head(
+    lambda x: x, lambda o, y: jnp.sign(o - y), "MAERegressionOutput")
+LogisticRegressionOutput = _regression_head(
+    jax.nn.sigmoid, lambda o, y: o - y, "LogisticRegressionOutput")
+
+
+def MakeLoss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null", **kw):
+    """REF:src/operator/make_loss.cc — treat `data` as a loss value; backward
+    injects `grad_scale` (normalized) into it."""
+
+    def fwd(x, y):
+        return x
+
+    def grad(out, x, y):
+        g = jnp.full_like(x, grad_scale)
+        if normalization == "batch":
+            g = g / x.shape[0]
+        elif normalization == "valid":
+            cnt = jnp.maximum(jnp.sum(x > valid_thresh), 1).astype(x.dtype)
+            g = g / cnt
+        return g
+
+    return _apply(_output_head(fwd, grad, "MakeLoss"), [data, 0.0], "MakeLoss")
+
+
 # namespace-style aliases matching mx.nd.random.* / mx.random.*
 class _RandomNS:
     uniform = staticmethod(random_uniform)
