@@ -26,6 +26,9 @@ from . import metric
 from . import gluon
 from . import kvstore
 from . import kvstore as kv
+from . import module
+from . import model
+from . import callback
 from . import contrib
 from . import recordio
 from . import io

@@ -1,0 +1,37 @@
+"""mx.model — checkpoint helpers (REF:python/mxnet/model.py).
+
+The reference pairs `<prefix>-symbol.json` with `<prefix>-NNNN.params`
+(dmlc-stream serialized NDArrays, keys prefixed ``arg:``/``aux:``); the same
+file layout is kept here over the framework's own NDArray save format so
+Module/Gluon checkpoints round-trip byte-compatibly within this framework.
+"""
+from __future__ import annotations
+
+from .ndarray import ndarray as _nd
+from .symbol import Symbol, load as _sym_load
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+from .module.module import BatchEndParam  # re-export (reference parity)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    _nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    symbol = _sym_load(f"{prefix}-symbol.json")
+    loaded = _nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        kind, name = k.split(":", 1)
+        if kind == "arg":
+            arg_params[name] = v
+        elif kind == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

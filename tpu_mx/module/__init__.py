@@ -1,0 +1,4 @@
+"""mx.module — legacy symbolic training API (REF:python/mxnet/module/)."""
+from .module import BaseModule, BucketingModule, Module
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

@@ -1,0 +1,561 @@
+"""mx.module — legacy symbolic training API (REF:python/mxnet/module/).
+
+Parity surface: `Module` (bind/init_params/init_optimizer/forward/backward/
+update/fit/score/predict, checkpointing), `BucketingModule` (the symbolic
+PTB path, REF:python/mxnet/module/bucketing_module.py).
+
+TPU-native design: the reference's `DataParallelExecutorGroup`
+(REF:python/mxnet/module/executor_group.py) sliced the batch across a ctx
+list, ran one GraphExecutor per GPU and reduced grads through KVStore.  Here
+a *single* jitted executor runs SPMD: when `context` is a device list, the
+module builds a 1-axis `jax.sharding.Mesh`, shards the batch over it and
+replicates parameters — XLA inserts the gradient `psum` that KVStore used to
+do.  Variable last-batch sizes simply retrace the jit (no `reshape` pass)."""
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import initializer as _init_mod
+from .. import metric as _metric_mod
+from .. import optimizer as _opt_mod
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..io.io import DataBatch, DataDesc
+from ..ndarray import NDArray, array
+from ..ndarray import ndarray as _nd_mod
+from ..symbol import Symbol
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]
+
+
+def _as_descs(shapes):
+    out = []
+    for s in shapes or []:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], tuple(s[1])
+            out.append(DataDesc(name, shape))
+    return out
+
+
+def _metric(m):
+    if isinstance(m, _metric_mod.EvalMetric):
+        return m
+    return _metric_mod.create(m)
+
+
+class BaseModule:
+    """Shared high-level loop: fit / score / predict / forward_backward."""
+
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # subclasses implement: bind, init_params, init_optimizer, forward,
+    # backward, update, get_outputs, get_params, update_metric
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True,
+              epoch=0, batch_end_callback=None):
+        eval_metric = _metric(eval_metric)
+        eval_metric.reset()
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            if batch.pad:
+                # strip wrapped-around pad rows so metrics see true samples
+                outs = [NDArray(o._data[:o.shape[0] - batch.pad])
+                        for o in self.get_outputs()]
+                labels = [NDArray((l._data if isinstance(l, NDArray)
+                                   else jnp.asarray(l))
+                                  [:len(l) - batch.pad])
+                          for l in batch.label]
+                eval_metric.update(labels, outs)
+            else:
+                self.update_metric(eval_metric, batch.label)
+            if batch_end_callback is not None:
+                for cb in _as_list(batch_end_callback):
+                    cb(BatchEndParam(epoch, nbatch, eval_metric, locals()))
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True):
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = [o.copy() for o in self.get_outputs()]
+            if batch.pad:
+                outs = [NDArray(o._data[:o.shape[0] - batch.pad])
+                        for o in outs]
+            outputs.append(outs)
+        if not merge_batches:
+            return outputs
+        n_out = len(outputs[0]) if outputs else 0
+        merged = [_nd_mod.concatenate([b[i] for b in outputs], axis=0)
+                  for i in range(n_out)]
+        return merged[0] if n_out == 1 else merged
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        """The reference's canonical training loop
+        (REF:python/mxnet/module/base_module.py fit)."""
+        assert num_epoch is not None, "num_epoch must be specified"
+        initializer = initializer or _init_mod.Uniform(0.01)
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        eval_metric = _metric(eval_metric)
+        validation_metric = (_metric(validation_metric)
+                             if validation_metric is not None else eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(BatchEndParam(epoch, nbatch, eval_metric, locals()))
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+            if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 epoch=epoch,
+                                 batch_end_callback=eval_batch_end_callback)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+
+    def install_monitor(self, monitor):
+        pass
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric, locals_=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals_
+
+
+class Module(BaseModule):
+    """Single-symbol module (REF:python/mxnet/module/module.py)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger)
+        if not isinstance(symbol, Symbol):
+            raise MXNetError("Module requires a Symbol")
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        ctxs = context if context is not None else [current_context()]
+        self._contexts = list(ctxs) if isinstance(ctxs, (list, tuple)) else [ctxs]
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater_states = {}
+        self._data_shapes = None
+        self._label_shapes = None
+        self._mesh = None
+        if len(self._contexts) > 1:
+            devs = np.array([c.jax_device() for c in self._contexts])
+            self._mesh = Mesh(devs, ("dp",))
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return list(self._data_names)
+
+    @property
+    def label_names(self):
+        return list(self._label_names)
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        if not self.binded:
+            raise MXNetError("module not bound")
+        shapes = {d.name: d.shape for d in
+                  (self._data_shapes or []) + (self._label_shapes or [])}
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self.output_names, out_shapes))
+
+    # -- bind ---------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = _as_descs(data_shapes)
+        self._label_shapes = _as_descs(label_shapes)
+        shapes = {d.name: d.shape
+                  for d in self._data_shapes + self._label_shapes}
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+        if shared_module is not None and shared_module._exec is not None:
+            # parameter sharing (BucketingModule): reuse the same NDArray
+            # handles so in-place updates are visible to every bucket
+            ex = self._symbol.simple_bind(self._contexts[0], grad_req=req,
+                                          **shapes)
+            for n in self._param_names:
+                if n in shared_module._exec.arg_dict:
+                    ex.arg_dict[n] = shared_module._exec.arg_dict[n]
+            for n in self._aux_names:
+                if n in shared_module._exec.aux_dict:
+                    ex.aux_dict[n] = shared_module._exec.aux_dict[n]
+            self._exec = ex
+        else:
+            self._exec = self._symbol.simple_bind(self._contexts[0],
+                                                  grad_req=req, **shapes)
+        self.binded = True
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        initializer = initializer or _init_mod.Uniform(0.01)
+        preloaded = getattr(self, "_preloaded", None)
+        if preloaded is not None and arg_params is None:
+            arg_params, aux_params = preloaded
+        for n in self._param_names:
+            arr = self._exec.arg_dict[n]
+            if arg_params and n in arg_params:
+                self._set_param(self._exec.arg_dict, n, arg_params[n])
+            else:
+                if arg_params is not None and not allow_missing:
+                    raise MXNetError(f"missing parameter '{n}' "
+                                     "(pass allow_missing=True to initialize)")
+                self._set_param(self._exec.arg_dict, n,
+                                initializer(n, arr.shape))
+        for n in self._aux_names:
+            if aux_params and n in aux_params:
+                self._set_param(self._exec.aux_dict, n, aux_params[n])
+            else:
+                if aux_params is not None and not allow_missing:
+                    raise MXNetError(f"missing aux state '{n}' "
+                                     "(pass allow_missing=True to initialize)")
+                self._set_param(self._exec.aux_dict, n,
+                                initializer(n, self._exec.aux_dict[n].shape))
+        self.params_initialized = True
+
+    def _set_param(self, d, name, value):
+        data = value._data if isinstance(value, NDArray) else jnp.asarray(value)
+        data = data.astype(d[name].dtype) if name in d else data
+        if self._mesh is not None:
+            data = jax.device_put(data, NamedSharding(self._mesh, P()))
+        # rebind in place so shared handles (bucketing) see the update
+        if name in d:
+            d[name]._rebind(data)
+        else:
+            d[name] = NDArray(data)
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    # -- optimizer ----------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, _opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            self._optimizer = _opt_mod.create(optimizer,
+                                              **dict(optimizer_params))
+        self._updater_states = {}
+        for i, n in enumerate(self._param_names):
+            w = self._exec.arg_dict[n]
+            self._updater_states[n] = \
+                self._optimizer.create_state_multi_precision(i, w)
+        preload = getattr(self, "_preload_opt", None)
+        if preload is not None:
+            self.load_optimizer_states(preload)
+            self._preload_opt = None
+        self.optimizer_initialized = True
+
+    # -- step ---------------------------------------------------------------
+    def _shard(self, data, spec):
+        if self._mesh is None:
+            return data
+        return jax.device_put(data, NamedSharding(self._mesh, spec))
+
+    def forward(self, data_batch, is_train=None):
+        if not self.binded or not self.params_initialized:
+            raise MXNetError("bind and init_params before forward")
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            raw = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+            feeds[name] = self._shard(raw, P("dp"))
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                raw = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+                feeds[name] = self._shard(raw, P("dp"))
+        self._exec.forward(is_train=is_train,
+                           **{k: NDArray(v) for k, v in feeds.items()})
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        if not self.optimizer_initialized:
+            raise MXNetError("init_optimizer before update")
+        for i, n in enumerate(self._param_names):
+            g = self._exec.grad_dict.get(n)
+            if g is None:
+                continue
+            w = self._exec.arg_dict[n]
+            self._updater_states[n] = self._optimizer.update_multi_precision(
+                i, w, g, self._updater_states[n])
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- checkpoint ---------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg, aux)
+        mod._preload_opt = (f"{prefix}-{epoch:04d}.states"
+                            if load_optimizer_states else None)
+        return mod
+
+    def save_optimizer_states(self, fname):
+        import pickle
+        states = {n: jax.tree.map(
+            lambda x: np.asarray(x) if hasattr(x, "dtype") else x, s)
+            for n, s in self._updater_states.items()}
+        with open(fname, "wb") as f:
+            pickle.dump(states, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle
+        with open(fname, "rb") as f:
+            states = pickle.load(f)
+        self._updater_states = {
+            n: jax.tree.map(
+                lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, s)
+            for n, s in states.items()}
+
+
+class BucketingModule(BaseModule):
+    """Per-bucket executors sharing parameters — the symbolic variable-length
+    path (REF:python/mxnet/module/bucketing_module.py).  Each bucket's jit
+    cache is its own XLA program; parameters are the *same* NDArray handles,
+    so the in-place optimizer updates are seen by every bucket."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, fixed_param_names=None, state_names=None):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      logger=self.logger, context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        if self.binded and not force_rebind:
+            return
+        self._buckets = {}   # stale buckets alias old parameter handles
+        mod = self._gen_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, for_training, inputs_need_grad)
+        self._buckets[self._default_bucket_key] = mod
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """`data_shapes`/`label_shapes` may be bare shape tuples — they are
+        paired with the NEW bucket's own data/label names from sym_gen."""
+        if bucket_key not in self._buckets:
+            default = self._buckets[self._default_bucket_key]
+            mod = self._gen_module(bucket_key)
+            if data_shapes and not isinstance(data_shapes[0], (DataDesc,)) \
+                    and not isinstance(data_shapes[0][0], str):
+                data_shapes = list(zip(mod.data_names, data_shapes))
+                if label_shapes:
+                    label_shapes = list(zip(mod.label_names, label_shapes))
+            mod.bind(data_shapes, label_shapes, self.for_training,
+                     self.inputs_need_grad, shared_module=default)
+            mod.params_initialized = default.params_initialized
+            mod._optimizer = default._optimizer
+            mod._updater_states = default._updater_states
+            mod.optimizer_initialized = default.optimizer_initialized
+            self._buckets[bucket_key] = mod
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, **kwargs):
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._curr_module.init_optimizer(**kwargs)
+        # share optimizer across buckets
+        for mod in self._buckets.values():
+            mod._optimizer = self._curr_module._optimizer
+            mod._updater_states = self._curr_module._updater_states
+            mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", self._default_bucket_key)
+        if key != self._curr_bucket_key:
+            # pass bare shapes; switch_bucket pairs them with the new
+            # bucket's own input names from sym_gen
+            data_shapes = [tuple(d.shape) for d in data_batch.data]
+            label_shapes = ([tuple(d.shape) for d in data_batch.label]
+                            if data_batch.label else None)
+            self.switch_bucket(key, data_shapes, label_shapes)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        # all buckets hold the same _updater_states dict object; Module.update
+        # mutates it in place, so no re-sharing is needed here
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs()
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, **kwargs):
+        self._curr_module.set_params(arg_params, aux_params, **kwargs)
+        self.params_initialized = True
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._curr_module.save_checkpoint(prefix, epoch,
+                                          save_optimizer_states)

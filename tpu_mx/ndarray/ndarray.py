@@ -508,13 +508,18 @@ def save(fname, data):
         meta = "dict"
     else:
         raise TypeError("save: need NDArray, list or dict of NDArray")
-    np.savez(fname, __layout__=np.array(meta), **payload)
+    # write through a file object so the exact filename is kept (np.savez
+    # appends .npz to bare paths, breaking `<prefix>-NNNN.params` parity)
+    with open(fname, "wb") as f:
+        np.savez(f, __layout__=np.array(meta), **payload)
 
 
 def load(fname):
     """Load what `save` wrote (mx.nd.load)."""
-    with np.load(fname if str(fname).endswith(".npz") else fname + ".npz",
-                 allow_pickle=False) as z:
+    import os
+    if not os.path.exists(fname) and os.path.exists(str(fname) + ".npz"):
+        fname = str(fname) + ".npz"   # files written by older revisions
+    with np.load(fname, allow_pickle=False) as z:
         layout = str(z["__layout__"]) if "__layout__" in z else "dict"
         items = {k: NDArray(jnp.asarray(v)) for k, v in z.items() if k != "__layout__"}
     if layout == "list":
