@@ -1,0 +1,181 @@
+"""Detection contrib ops vs plain-numpy oracles (reference test pattern:
+tests/python/unittest/test_contrib_operator.py)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+
+
+def np_iou(a, b):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    aa = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    ab = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    union = aa[:, None] + ab[None, :] - inter
+    return np.where(union > 0, inter / union, 0)
+
+
+def test_box_iou():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(6, 2, 2), axis=2).reshape(6, 4)[:, [0, 2, 1, 3]]
+    b = np.sort(rng.rand(4, 2, 2), axis=2).reshape(4, 4)[:, [0, 2, 1, 3]]
+    got = mx.nd.contrib.box_iou(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got, np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior():
+    x = mx.nd.zeros((1, 3, 4, 6))
+    anchors = mx.nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25),
+                                          ratios=(1, 2)).asnumpy()
+    # K = S + R - 1 = 3 anchors per cell
+    assert anchors.shape == (1, 4 * 6 * 3, 4)
+    # first cell center = (0.5/6, 0.5/4); first anchor size .5 ratio 1
+    cx, cy = 0.5 / 6, 0.5 / 4
+    np.testing.assert_allclose(anchors[0, 0],
+                               [cx - 0.25, cy - 0.25, cx + 0.25, cy + 0.25],
+                               rtol=1e-5, atol=1e-6)
+    # ratio-2 anchor: w = s*sqrt(2), h = s/sqrt(2)
+    w, h = 0.5 * np.sqrt(2), 0.5 / np.sqrt(2)
+    np.testing.assert_allclose(anchors[0, 2],
+                               [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                               rtol=1e-5, atol=1e-6)
+    clipped = mx.nd.contrib.MultiBoxPrior(x, sizes=(0.9,), clip=True).asnumpy()
+    assert clipped.min() >= 0 and clipped.max() <= 1
+
+
+def test_box_nms():
+    boxes = np.array([
+        [0, 0.9, 0.1, 0.1, 0.5, 0.5],
+        [0, 0.8, 0.12, 0.12, 0.52, 0.52],   # overlaps first -> suppressed
+        [0, 0.7, 0.6, 0.6, 0.9, 0.9],       # far -> kept
+        [1, 0.6, 0.1, 0.1, 0.5, 0.5],       # other class -> kept
+        [0, 0.0, 0, 0, 0, 0],               # below valid_thresh
+    ], dtype="float32")
+    out = mx.nd.contrib.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                                valid_thresh=0.01, id_index=0,
+                                coord_start=2, score_index=1).asnumpy()
+    kept_scores = sorted(out[out[:, 1] > 0][:, 1].tolist())
+    np.testing.assert_allclose(kept_scores, [0.6, 0.7, 0.9], rtol=1e-6)
+    # force_suppress removes the class distinction
+    out2 = mx.nd.contrib.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                                 valid_thresh=0.01, id_index=0,
+                                 coord_start=2, score_index=1,
+                                 force_suppress=True).asnumpy()
+    kept2 = sorted(out2[out2[:, 1] > 0][:, 1].tolist())
+    np.testing.assert_allclose(kept2, [0.7, 0.9], rtol=1e-6)
+
+
+def test_box_nms_topk_bounds_output():
+    rng = np.random.RandomState(1)
+    # 6 far-apart valid boxes, no overlaps
+    boxes = np.zeros((6, 6), "float32")
+    for i in range(6):
+        boxes[i] = [0, 0.9 - 0.1 * i, 0.15 * i, 0.0, 0.15 * i + 0.1, 0.1]
+    out = mx.nd.contrib.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                                valid_thresh=0.01, id_index=0, coord_start=2,
+                                score_index=1, topk=2).asnumpy()
+    assert (out[:, 1] > 0).sum() == 2       # only top-2 survive
+
+
+def test_box_nms_format_conversion():
+    # center-format input, corner output
+    row = np.array([[0, 0.9, 0.5, 0.5, 0.2, 0.2]], "float32")
+    out = mx.nd.contrib.box_nms(mx.nd.array(row), valid_thresh=0.01,
+                                id_index=0, coord_start=2, score_index=1,
+                                in_format="center",
+                                out_format="corner").asnumpy()
+    np.testing.assert_allclose(out[0, 2:], [0.4, 0.4, 0.6, 0.6], atol=1e-6)
+    # corner input, center output
+    row2 = np.array([[0, 0.9, 0.4, 0.4, 0.6, 0.6]], "float32")
+    out2 = mx.nd.contrib.box_nms(mx.nd.array(row2), valid_thresh=0.01,
+                                 id_index=0, coord_start=2, score_index=1,
+                                 in_format="corner",
+                                 out_format="center").asnumpy()
+    np.testing.assert_allclose(out2[0, 2:], [0.5, 0.5, 0.2, 0.2], atol=1e-6)
+
+
+def test_multibox_target():
+    anchors = np.array([[0.1, 0.1, 0.3, 0.3],
+                        [0.5, 0.5, 0.9, 0.9],
+                        [0.0, 0.0, 0.05, 0.05]], "float32")[None]
+    # one gt matching anchor 0 well, padded row
+    label = np.array([[[1, 0.1, 0.1, 0.3, 0.3],
+                       [-1, -1, -1, -1, -1]]], "float32")
+    cls_pred = np.zeros((1, 3, 3), "float32")
+    loc_t, loc_m, cls_t = mx.sym.contrib.MultiBoxTarget(
+        mx.sym.var("anc"), mx.sym.var("lab"), mx.sym.var("pred")
+    ).eval(anc=mx.nd.array(anchors), lab=mx.nd.array(label),
+           pred=mx.nd.array(cls_pred)) if False else \
+        mx.nd.contrib.MultiBoxTarget(mx.nd.array(anchors),
+                                     mx.nd.array(label),
+                                     mx.nd.array(cls_pred))
+    cls_t = cls_t.asnumpy()
+    loc_m = loc_m.asnumpy()
+    loc_t = loc_t.asnumpy()
+    assert cls_t.shape == (1, 3)
+    assert cls_t[0, 0] == 2.0          # class 1 -> target 1+1
+    assert cls_t[0, 1] == 0.0          # background
+    assert loc_m.shape == (1, 12)
+    np.testing.assert_allclose(loc_m[0, :4], 1.0)   # anchor 0 matched
+    np.testing.assert_allclose(loc_m[0, 4:], 0.0)
+    # perfect overlap -> zero offsets
+    np.testing.assert_allclose(loc_t[0, :4], 0.0, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    rng = np.random.RandomState(0)
+    A = 20
+    anchors = np.sort(rng.rand(A, 2, 2), axis=1).transpose(0, 2, 1)\
+        .reshape(A, 4)[None].astype("float32")
+    anchors = np.concatenate([np.array([[[0.1, 0.1, 0.4, 0.4]]],
+                                       "float32"), anchors], axis=1)
+    label = np.array([[[0, 0.1, 0.1, 0.4, 0.4]]], "float32")
+    cls_pred = rng.rand(1, 2, A + 1).astype("float32")
+    _, _, cls_t = mx.nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    cls_t = cls_t.asnumpy()[0]
+    n_pos = (cls_t > 0).sum()
+    n_neg = (cls_t == 0).sum()
+    n_ign = (cls_t == -1).sum()
+    assert n_pos >= 1
+    assert n_neg <= 3 * n_pos
+    assert n_ign > 0
+
+
+def test_multibox_detection_roundtrip():
+    """Encode with MultiBoxTarget then decode with MultiBoxDetection: the
+    decoded box must reproduce the ground truth."""
+    anchors = np.array([[0.15, 0.15, 0.35, 0.45],
+                        [0.5, 0.5, 0.9, 0.9]], "float32")[None]
+    gt = np.array([[[0, 0.1, 0.2, 0.4, 0.4]]], "float32")
+    cls_pred = np.zeros((1, 2, 2), "float32")
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(gt), mx.nd.array(cls_pred),
+        overlap_threshold=0.3)
+    assert cls_t.asnumpy()[0, 0] == 1.0
+    # build cls_prob consistent with the match
+    cls_prob = np.array([[[0.1, 0.9], [0.9, 0.1]]], "float32")  # (B,C+1,A)
+    out = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(cls_prob), loc_t, mx.nd.array(anchors),
+        threshold=0.5, clip=False).asnumpy()
+    det = out[0, 0]
+    assert det[0] == 0.0               # class id 0
+    np.testing.assert_allclose(det[1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(det[2:], [0.1, 0.2, 0.4, 0.4], atol=1e-5)
+
+
+def test_multibox_symbolic():
+    anc = mx.sym.var("anchor")
+    lab = mx.sym.var("label")
+    pred = mx.sym.var("cls_pred")
+    tgt = mx.sym.contrib.MultiBoxTarget(anc, lab, pred, name="target")
+    assert len(tgt.list_outputs()) == 3
+    ex = tgt.simple_bind(mx.cpu(), anchor=(1, 3, 4), label=(1, 2, 5),
+                         cls_pred=(1, 3, 3))
+    ex.arg_dict["label"][:] = -np.ones((1, 2, 5), "float32")
+    outs = ex.forward()
+    assert outs[2].shape == (1, 3)

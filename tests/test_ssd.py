@@ -1,0 +1,96 @@
+"""SSD model path: forward shapes, target generation, one training step,
+detection inference (BASELINE config 4 slice)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import autograd, gluon
+from tpu_mx.models import SSD, SSDTrainingTargets
+
+
+def _tiny_ssd(num_classes=3):
+    # 2 scales, small backbone -> fast CPU test
+    return SSD(num_classes, sizes=[(0.2, 0.27), (0.4, 0.49)],
+               ratios=[(1, 2, 0.5)] * 2, base_filters=(8, 16),
+               scale_filters=16)
+
+
+def test_ssd_forward_shapes():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.zeros((2, 3, 64, 64))
+    anchors, cls_preds, box_preds = net(x)
+    # backbone: 2 pools -> 16x16; scale1 -> 8x8; K=4 anchors/cell
+    A = 16 * 16 * 4 + 8 * 8 * 4
+    assert anchors.shape == (1, A, 4)
+    assert cls_preds.shape == (2, A, 4)
+    assert box_preds.shape == (2, A * 4)
+
+
+def test_ssd_train_step():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    targets = SSDTrainingTargets()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    box_loss = gluon.loss.HuberLoss()
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randn(2, 3, 64, 64).astype("float32"))
+    labels = mx.nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.4, 0.4], [1, 0.5, 0.5, 0.9, 0.9]],
+         [[2, 0.2, 0.3, 0.6, 0.7], [-1, -1, -1, -1, -1]]], "float32"))
+    losses = []
+    for _ in range(3):
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            with autograd.pause():
+                loc_t, loc_m, cls_t = targets(anchors, labels, cls_preds)
+            l_cls = cls_loss(cls_preds, cls_t)
+            l_box = box_loss(box_preds * loc_m, loc_t * loc_m)
+            l = l_cls + l_box
+        l.backward()
+        trainer.step(2)
+        losses.append(float(l.mean().asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ssd_detect():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.zeros((1, 3, 64, 64))
+    det = net.detect(x, threshold=0.0)
+    A = 16 * 16 * 4 + 8 * 8 * 4
+    assert det.shape == (1, A, 6)
+    d = det.asnumpy()
+    kept = d[0][d[0, :, 0] >= 0]
+    assert kept.shape[0] >= 1           # something survives NMS
+    # scores in [0,1], class ids within range
+    assert ((kept[:, 1] >= 0) & (kept[:, 1] <= 1)).all()
+    assert kept[:, 0].max() < 3
+
+
+def test_ssd_hybridize_consistency():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(1)
+                    .randn(1, 3, 64, 64).astype("float32"))
+    a1, c1, b1 = net(x)
+    net.hybridize()
+    a2, c2, b2 = net(x)
+    np.testing.assert_allclose(a1.asnumpy(), a2.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(c1.asnumpy(), c2.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(b1.asnumpy(), b2.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_ssd_512_config():
+    net = mx.models.ssd_512(num_classes=20)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.zeros((1, 3, 128, 128))   # reduced res for test speed
+    anchors, cls_preds, box_preds = net(x)
+    assert anchors.shape[1] == cls_preds.shape[1]
+    assert cls_preds.shape[2] == 21
+    assert box_preds.shape[1] == anchors.shape[1] * 4

@@ -32,4 +32,5 @@ from . import callback
 from . import contrib
 from . import recordio
 from . import io
+from . import image
 from . import test_utils

@@ -1,0 +1,5 @@
+"""mx.image — image IO/augment/iterators (REF:python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from .detection import (CreateDetAugmenter, DetAugmenter, DetBorrowAug,
+                        DetHorizontalFlipAug, DetForceResizeAug,
+                        DetRandomCropAug, ImageDetIter)

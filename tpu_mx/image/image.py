@@ -1,0 +1,411 @@
+"""mx.image — image IO, augmenters, ImageIter (REF:python/mxnet/image/image.py).
+
+TPU-native design: the reference decodes/augments with OpenCV into NCHW
+float batches on the CPU, then copies to device.  Here decode is PIL (no
+OpenCV in the image), augment is pure numpy on the host — augmentation
+stays off the TPU on purpose: the chip's MXU time is for the model, and
+host-side numpy keeps the input pipeline overlappable with device compute
+(the iterator returns host arrays; `device_put` happens at the training
+step, double-buffered by JAX's async dispatch)."""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io.io import DataBatch, DataDesc, DataIter
+from ..ndarray import NDArray, array
+from .. import recordio as _recordio
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize", "HorizontalFlipAug",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "ColorJitterAug",
+           "CreateAugmenter", "Augmenter", "ImageIter"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("mx.image requires Pillow in this build") from e
+
+
+# --------------------------------------------------------------------------
+# IO — numpy HWC uint8/float arrays in, NDArray out (reference convention)
+# --------------------------------------------------------------------------
+
+def imdecode(buf, to_rgb=True, flag=1, **kw):
+    """Decode an encoded image buffer -> NDArray HWC (RGB order like the
+    reference's default to_rgb=1)."""
+    Image = _pil()
+    img = Image.open(_io.BytesIO(buf if isinstance(buf, (bytes, bytearray))
+                                 else bytes(buf)))
+    if flag == 0:
+        img = img.convert("L")
+        arr = np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = np.asarray(img)
+        if not to_rgb:
+            arr = arr[:, :, ::-1]
+    return array(arr.astype(np.uint8), dtype="uint8")
+
+
+def imread(filename, to_rgb=True, flag=1, **kw):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), to_rgb=to_rgb, flag=flag)
+
+
+def imresize(src, w, h, interp=1):
+    Image = _pil()
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    squeeze = arr.shape[-1] == 1
+    img = Image.fromarray(arr.squeeze(-1).astype(np.uint8) if squeeze
+                          else arr.astype(np.uint8))
+    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                3: Image.LANCZOS}.get(interp, Image.BILINEAR)
+    out = np.asarray(img.resize((int(w), int(h)), resample))
+    if squeeze:
+        out = out[:, :, None]
+    return array(out.astype(np.uint8), dtype="uint8")
+
+
+def resize_short(src, size, interp=1):
+    h, w = (src.shape[0], src.shape[1])
+    if h > w:
+        new_w, new_h = size, int(size * h / w)
+    else:
+        new_w, new_h = int(size * w / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != tuple(size):
+        return imresize(array(out, dtype="uint8"), size[0], size[1], interp)
+    return array(out, dtype="uint8")
+
+
+def random_crop(src, size, interp=1):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=1):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    arr = (src.asnumpy() if isinstance(src, NDArray)
+           else np.asarray(src)).astype(np.float32)
+    arr = arr - np.asarray(mean, np.float32)
+    if std is not None:
+        arr = arr / np.asarray(std, np.float32)
+    return array(arr)
+
+
+# --------------------------------------------------------------------------
+# augmenters (host-side numpy; reference: image.py Augmenter family)
+# --------------------------------------------------------------------------
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = src.asnumpy() if isinstance(src, NDArray) else src
+            return array(np.ascontiguousarray(arr[:, ::-1]), dtype="uint8")
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        return array(arr.astype(self.typ))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        arr = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        return array(arr * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        arr = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        gray = (arr[..., :3] * self._coef).sum(axis=-1).mean()
+        return array(arr * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = ContrastJitterAug._coef
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        arr = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        gray = (arr[..., :3] * self._coef).sum(axis=-1, keepdims=True)
+        return array(arr * alpha + gray * (1 - alpha))
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self._augs = []
+        if brightness:
+            self._augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self._augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self._augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        augs = list(self._augs)
+        _pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """REF:python/mxnet/image/image.py CreateAugmenter — same flag set."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(
+            mean if mean is not None else np.zeros(3, np.float32), std))
+    return auglist
+
+
+# --------------------------------------------------------------------------
+# ImageIter — RecordIO (.rec) or .lst/root file lists -> NCHW batches
+# (REF:python/mxnet/image/image.py ImageIter; the C++ twin is
+#  REF:src/io/iter_image_recordio_2.cc)
+# --------------------------------------------------------------------------
+
+class ImageIter(DataIter):
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        assert len(data_shape) == 3, "data_shape must be (C, H, W)"
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.dtype = dtype
+        self._data_name = data_name
+        self._label_name = label_name
+        self.auglist = (aug_list if aug_list is not None
+                        else CreateAugmenter(data_shape))
+        self._record = None
+        self.seq = []
+        self.imglist = {}
+        if path_imgrec:
+            self._record = _recordio.MXIndexedRecordIO(
+                path_imgrec[:-4] + ".idx" if path_imgrec.endswith(".rec")
+                else path_imgrec + ".idx", path_imgrec, "r")
+            self.seq = list(self._record.keys)
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        idx = int(parts[0])
+                        label = np.array([float(v) for v in parts[1:-1]],
+                                         np.float32)
+                        self.imglist[idx] = (label, parts[-1])
+            else:
+                for i, (label, fname) in enumerate(imglist):
+                    self.imglist[i] = (np.array(np.atleast_1d(label),
+                                               np.float32), fname)
+            self.path_root = path_root
+            self.seq = list(self.imglist.keys())
+        else:
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist or "
+                             "imglist")
+        if last_batch_handle not in ("pad", "discard"):
+            raise MXNetError("ImageIter supports last_batch_handle 'pad' or "
+                             f"'discard', got {last_batch_handle!r}")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+        self.cursor = 0
+
+    def _read_sample(self, idx, want_img=True):
+        if self._record is not None:
+            header, img_bytes = _recordio.unpack(self._record.read_idx(idx))
+            label = np.atleast_1d(np.asarray(header.label, np.float32))
+            img = imdecode(img_bytes) if want_img else None
+        else:
+            label, fname = self.imglist[idx]
+            img = (imread(os.path.join(self.path_root, fname))
+                   if want_img else None)
+        return label, img
+
+    def _augment(self, img):
+        for aug in self.auglist:
+            img = aug(img)
+        return img
+
+    def next(self):
+        if self.cursor >= len(self.seq):
+            raise StopIteration
+        n = self.batch_size
+        C, H, W = self.data_shape
+        data = np.zeros((n, C, H, W), self.dtype)
+        lw = self.label_width
+        label = np.zeros((n,) if lw == 1 else (n, lw), np.float32)
+        pad = 0
+        for i in range(n):
+            if self.cursor >= len(self.seq):
+                if self.last_batch_handle == "discard":
+                    raise StopIteration
+                # wrap-around padding, reference semantics
+                src = self.seq[pad % len(self.seq)]
+                pad += 1
+            else:
+                src = self.seq[self.cursor]
+                self.cursor += 1
+            lab, img = self._read_sample(src)
+            img = self._augment(img)
+            arr = (img.asnumpy() if isinstance(img, NDArray)
+                   else np.asarray(img)).astype(self.dtype)
+            data[i] = arr.transpose(2, 0, 1)
+            label[i] = lab if lw > 1 else lab[0]
+        return DataBatch([array(data)], [array(label)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -4,3 +4,4 @@ from .lenet import lenet
 from .lstm_lm import RNNModel
 from .bert import (BERTEncoder, BERTModel, bert_base_config,
                    bert_data_specs, bert_sharding_rules)
+from .ssd import SSD, SSDTrainingTargets, ssd_300, ssd_512

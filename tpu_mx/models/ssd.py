@@ -1,0 +1,144 @@
+"""SSD object detector (BASELINE config 4; REF:example/ssd/symbol/symbol_builder.py,
+REF:src/operator/contrib/multibox_*.cc for the op semantics).
+
+TPU-native design: the whole forward — backbone, multi-scale heads and
+anchor generation — is one HybridBlock, so `hybridize()` compiles it to a
+single XLA program with static shapes; anchors are constants folded at
+trace time.  Training targets come from `mx.nd.contrib.MultiBoxTarget`
+(vectorized matching), inference runs `MultiBoxDetection` (fixed-size
+padded NMS) — both jit-compatible, no dynamic shapes anywhere
+(SURVEY §7.3 hard-part 2)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..gluon import HybridBlock, nn
+from ..ndarray import NDArray
+from ..ndarray import contrib as _contrib
+from ..ndarray import ops as F
+
+__all__ = ["SSD", "ssd_512", "ssd_300", "SSDTrainingTargets"]
+
+
+def _body_block(filters):
+    """VGG-ish downsampling block: 2×(conv-bn-relu) + pool/2."""
+    blk = nn.HybridSequential()
+    for _ in range(2):
+        blk.add(nn.Conv2D(filters, kernel_size=3, padding=1),
+                nn.BatchNorm(), nn.Activation("relu"))
+    blk.add(nn.MaxPool2D(2, 2))
+    return blk
+
+
+def _scale_block(filters):
+    """Extra-scale block: 1×1 reduce + 3×3/s2 (REF:example/ssd
+    multi_layer_feature extra layers).  Stride-2 conv with padding keeps
+    1×1 maps at 1×1 instead of pooling to zero."""
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(filters // 2, kernel_size=1),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(filters, kernel_size=3, strides=2, padding=1),
+            nn.BatchNorm(), nn.Activation("relu"))
+    return blk
+
+
+class SSD(HybridBlock):
+    """Multi-scale single-shot detector.
+
+    forward(x) -> (anchors (1, A, 4), cls_preds (B, A, num_classes+1),
+                   box_preds (B, A*4))
+    """
+
+    def __init__(self, num_classes, sizes, ratios, base_filters=(16, 32, 64),
+                 scale_filters=128, num_scales=None, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.sizes = [tuple(s) for s in sizes]
+        self.ratios = [tuple(r) for r in ratios]
+        n = num_scales or len(self.sizes)
+        assert len(self.sizes) == len(self.ratios) == n
+        self._num_anchors = [len(s) + len(r) - 1
+                             for s, r in zip(self.sizes, self.ratios)]
+        self.backbone = nn.HybridSequential()
+        for f in base_filters:
+            self.backbone.add(_body_block(f))
+        self.scale_blocks = []
+        self.cls_heads = []
+        self.box_heads = []
+        for i in range(n):
+            if i > 0:
+                blk = _scale_block(scale_filters)
+                self.scale_blocks.append(blk)
+                setattr(self, f"scale_{i}", blk)
+            ch = nn.Conv2D(self._num_anchors[i] * (num_classes + 1),
+                           kernel_size=3, padding=1)
+            bh = nn.Conv2D(self._num_anchors[i] * 4, kernel_size=3, padding=1)
+            self.cls_heads.append(ch)
+            self.box_heads.append(bh)
+            setattr(self, f"cls_head_{i}", ch)
+            setattr(self, f"box_head_{i}", bh)
+
+    def forward(self, x):
+        feats = self.backbone(x)
+        anchors, cls_preds, box_preds = [], [], []
+        for i in range(len(self.sizes)):
+            if i > 0:
+                feats = self.scale_blocks[i - 1](feats)
+            anchors.append(_contrib.MultiBoxPrior(
+                feats, sizes=self.sizes[i], ratios=self.ratios[i]))
+            c = self.cls_heads[i](feats)          # (B, K*(C+1), H, W)
+            cls_preds.append(F.reshape(
+                F.transpose(c, axes=(0, 2, 3, 1)),
+                shape=(0, -1, self.num_classes + 1)))
+            b = self.box_heads[i](feats)          # (B, K*4, H, W)
+            box_preds.append(F.reshape(
+                F.transpose(b, axes=(0, 2, 3, 1)), shape=(0, -1)))
+        anchors = F.concat(*anchors, dim=1)       # (1, A, 4)
+        cls_preds = F.concat(*cls_preds, dim=1)   # (B, A, C+1)
+        box_preds = F.concat(*box_preds, dim=1)   # (B, A*4)
+        return anchors, cls_preds, box_preds
+
+    def detect(self, x, threshold=0.01, nms_threshold=0.45, nms_topk=400,
+               force_suppress=False):
+        """Inference: decode + NMS -> (B, A, 6) padded detections."""
+        anchors, cls_preds, box_preds = self(x)
+        cls_prob = F.softmax(cls_preds, axis=-1)          # (B, A, C+1)
+        cls_prob = F.transpose(cls_prob, axes=(0, 2, 1))  # (B, C+1, A)
+        return _contrib.MultiBoxDetection(
+            cls_prob, box_preds, anchors, threshold=threshold,
+            nms_threshold=nms_threshold, nms_topk=nms_topk,
+            force_suppress=force_suppress)
+
+
+class SSDTrainingTargets:
+    """Target generator: wraps MultiBoxTarget with the SSD loss convention
+    (REF:example/ssd/train/metric.py pattern: CE on cls, smooth-L1 on loc)."""
+
+    def __init__(self, overlap_threshold=0.5, negative_mining_ratio=3.0,
+                 negative_mining_thresh=0.5):
+        self.kw = dict(overlap_threshold=overlap_threshold,
+                       negative_mining_ratio=negative_mining_ratio,
+                       negative_mining_thresh=negative_mining_thresh)
+
+    def __call__(self, anchors, labels, cls_preds):
+        # cls_preds (B, A, C+1) -> (B, C+1, A) for mining
+        pred_t = F.transpose(cls_preds, axes=(0, 2, 1))
+        return _contrib.MultiBoxTarget(anchors, labels, pred_t, **self.kw)
+
+
+def ssd_512(num_classes=20, **kwargs):
+    """SSD-512 anchor configuration (REF:example/ssd/symbol/symbol_factory.py
+    get_config('vgg16_reduced', 512)) over the compact backbone."""
+    sizes = [(0.07, 0.1025), (0.15, 0.2121), (0.3, 0.3674), (0.45, 0.5196),
+             (0.6, 0.6708), (0.75, 0.8216), (0.9, 0.9721)]
+    ratios = [(1, 2, 0.5)] * 2 + [(1, 2, 0.5, 3, 1.0 / 3)] * 3 + \
+        [(1, 2, 0.5)] * 2
+    return SSD(num_classes, sizes, ratios, **kwargs)
+
+
+def ssd_300(num_classes=20, **kwargs):
+    sizes = [(0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+             (0.71, 0.79), (0.88, 0.961)]
+    ratios = [(1, 2, 0.5)] * 2 + [(1, 2, 0.5, 3, 1.0 / 3)] * 3 + \
+        [(1, 2, 0.5)]
+    return SSD(num_classes, sizes, ratios, **kwargs)

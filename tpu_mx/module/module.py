@@ -355,6 +355,13 @@ class Module(BaseModule):
         else:
             self._optimizer = _opt_mod.create(optimizer,
                                               **dict(optimizer_params))
+        # name-keyed lr_mult/wd_mult need the index→name map (reference
+        # Module passes param_idx2name into the optimizer)
+        idx2name = dict(enumerate(self._param_names))
+        if getattr(self._optimizer, "idx2name", None):
+            self._optimizer.idx2name.update(idx2name)
+        else:
+            self._optimizer.idx2name = idx2name
         self._updater_states = {}
         for i, n in enumerate(self._param_names):
             w = self._exec.arg_dict[n]
@@ -503,6 +510,13 @@ class BucketingModule(BaseModule):
                     label_shapes = list(zip(mod.label_names, label_shapes))
             mod.bind(data_shapes, label_shapes, self.for_training,
                      self.inputs_need_grad, shared_module=default)
+            extra = [n for n in mod._param_names
+                     if n not in default._exec.arg_dict]
+            if extra:
+                raise MXNetError(
+                    f"bucket {bucket_key!r} introduces parameters {extra} "
+                    "absent from the default bucket — all parameters must "
+                    "exist in the default bucket's symbol for sharing")
             mod.params_initialized = default.params_initialized
             mod._optimizer = default._optimizer
             mod._updater_states = default._updater_states

@@ -1,0 +1,311 @@
+"""mx.nd.contrib — detection ops (REF:src/operator/contrib/multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc, bounding_box.cc).
+
+TPU-native design: the reference's CUDA kernels produce *fixed-size padded*
+outputs already (invalid entries are -1), which is exactly XLA's static-shape
+model — so every op here is a pure fixed-shape function: IoU matching and
+target encoding are vectorized (`vmap` over batch), greedy NMS is a
+`lax.fori_loop` over score-sorted candidates (sequential dependence is
+inherent to greedy NMS; each step is O(A) vector work on-chip).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import _apply
+
+__all__ = ["box_iou", "box_nms", "MultiBoxPrior", "MultiBoxTarget",
+           "MultiBoxDetection"]
+
+
+# --------------------------------------------------------------------------
+# geometry helpers (corner format: x1 y1 x2 y2)
+# --------------------------------------------------------------------------
+
+def _iou_corner(a, b):
+    """a: (..., A, 4), b: (..., M, 4) -> (..., A, M)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)          # (A,1)
+    bx1, by1, bx2, by2 = [x.squeeze(-1) for x in jnp.split(b, 4, axis=-1)]
+    ix1 = jnp.maximum(ax1, bx1[..., None, :])
+    iy1 = jnp.maximum(ay1, by1[..., None, :])
+    ix2 = jnp.minimum(ax2, bx2[..., None, :])
+    iy2 = jnp.minimum(ay2, by2[..., None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+    area_b = jnp.maximum(bx2 - bx1, 0) * jnp.maximum(by2 - by1, 0)
+    union = area_a + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_to_corner(x):
+    cx, cy, w, h = jnp.split(x, 4, axis=-1)
+    return jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                           axis=-1)
+
+
+def _corner_to_center(x):
+    x1, y1, x2, y2 = jnp.split(x, 4, axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                           axis=-1)
+
+
+def box_iou(lhs, rhs, format="corner", **kw):
+    """Pairwise IoU (REF:src/operator/contrib/bounding_box.cc box_iou)."""
+
+    def f(a, b):
+        if format == "center":
+            a, b = _center_to_corner(a), _center_to_corner(b)
+        return _iou_corner(a, b)
+
+    return _apply(f, [lhs, rhs], "box_iou", nondiff=True)
+
+
+# --------------------------------------------------------------------------
+# greedy NMS core: returns keep mask over entries ordered as given
+# --------------------------------------------------------------------------
+
+def _nms_keep(boxes, scores, ids, valid, thresh, topk, force_suppress):
+    """boxes (A,4) already score-sorted desc; sequential greedy suppression.
+    `topk` bounds the candidate set (reference semantics: everything beyond
+    the top-k scores is discarded outright)."""
+    A = boxes.shape[0]
+    ar = jnp.arange(A)
+    n_iter = A if topk < 0 else min(int(topk), A)
+    if topk >= 0:
+        valid = valid & (ar < topk)
+    iou = _iou_corner(boxes, boxes)                       # (A, A)
+    same = jnp.ones((A, A), bool) if force_suppress else \
+        (ids[:, None] == ids[None, :])
+
+    def body(i, keep):
+        sup = (iou[i] > thresh) & same[i] & (ar > i) & keep[i] & valid[i]
+        return keep & ~sup
+
+    keep = jax.lax.fori_loop(0, n_iter, body, valid)
+    return keep
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner",
+            **kw):
+    """REF:src/operator/contrib/bounding_box.cc BoxNMS.  Output keeps the
+    score-sorted order; suppressed/invalid rows are all -1 (fixed shape)."""
+
+    def f(x):
+        shape = x.shape
+        flat = x.reshape((-1,) + shape[-2:]) if x.ndim > 2 else x[None]
+
+        def one(batch):
+            scores = batch[:, score_index]
+            boxes = jax.lax.dynamic_slice_in_dim(batch, coord_start, 4, axis=1)
+            if in_format == "center":
+                boxes = _center_to_corner(boxes)
+            if id_index >= 0:
+                ids = batch[:, id_index]
+            else:
+                ids = jnp.zeros_like(scores)
+            valid = scores > valid_thresh
+            if id_index >= 0 and background_id >= 0:
+                valid &= ids != background_id
+            order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+            b_s, s_s, i_s, v_s = (boxes[order], scores[order], ids[order],
+                                  valid[order])
+            keep = _nms_keep(b_s, s_s, i_s, v_s, overlap_thresh, topk,
+                             force_suppress)
+            out_rows = batch[order]
+            # b_s is always corner-format working coords; rewrite the coord
+            # columns in the requested out_format regardless of in_format
+            coords = _corner_to_center(b_s) if out_format == "center" else b_s
+            out_rows = jax.lax.dynamic_update_slice_in_dim(
+                out_rows, coords, coord_start, axis=1)
+            return jnp.where(keep[:, None], out_rows, -jnp.ones_like(out_rows))
+
+        out = jax.vmap(one)(flat)
+        return out.reshape(shape)
+
+    return _apply(f, [data], "box_nms", nondiff=True)
+
+
+# --------------------------------------------------------------------------
+# MultiBoxPrior
+# --------------------------------------------------------------------------
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """Anchor generation (REF:src/operator/contrib/multibox_prior.cc).
+    data (N,C,H,W) -> (1, H*W*(S+R-1), 4) normalized corner boxes."""
+    sizes = tuple(float(s) for s in _tuple(sizes))
+    ratios = tuple(float(r) for r in _tuple(ratios))
+    steps = tuple(float(s) for s in _tuple(steps))
+    offsets = tuple(float(o) for o in _tuple(offsets))
+
+    def f(x):
+        H, W = x.shape[-2], x.shape[-1]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / H
+        step_x = steps[1] if steps[1] > 0 else 1.0 / W
+        cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+        cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")      # (H, W)
+        # anchor set per cell: (s_k, r_0) for all k, then (s_0, r_k) k>=1
+        whs = [(s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0]))
+               for s in sizes]
+        whs += [(sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r))
+                for r in ratios[1:]]
+        wh = jnp.asarray(whs, jnp.float32)                  # (K, 2)
+        K = wh.shape[0]
+        centers = jnp.stack([cxg, cyg], axis=-1)[:, :, None, :]   # (H,W,1,2)
+        half = wh[None, None, :, :] / 2                      # (1,1,K,2)
+        lo = centers - half
+        hi = centers + half
+        anchors = jnp.concatenate([lo, hi], axis=-1).reshape(H * W * K, 4)
+        if clip:
+            anchors = jnp.clip(anchors, 0.0, 1.0)
+        return anchors[None]
+
+    return _apply(f, [data], "MultiBoxPrior", nondiff=True)
+
+
+def _tuple(v):
+    if isinstance(v, (int, float)):
+        return (v,)
+    if isinstance(v, str):
+        return tuple(float(t) for t in
+                     v.strip("()[] ").replace(",", " ").split())
+    return tuple(v)
+
+
+# --------------------------------------------------------------------------
+# MultiBoxTarget
+# --------------------------------------------------------------------------
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """Anchor matching + target encoding
+    (REF:src/operator/contrib/multibox_target.cc).
+
+    anchor (1,A,4) corner; label (B,M,5) rows [cls,x1,y1,x2,y2], pad=-1;
+    cls_pred (B,C+1,A) (class scores, used for hard negative mining).
+    Returns [loc_target (B,A*4), loc_mask (B,A*4), cls_target (B,A)].
+    Matching is argmax-threshold plus per-gt forced best-anchor (bipartite
+    approximated by scatter; ties resolved by later gt index, deterministic).
+    """
+    variances = tuple(float(v) for v in _tuple(variances))
+
+    def f(anc, lab, pred):
+        A = anc.shape[1]
+        anc2 = anc.reshape(A, 4)
+        anc_c = _corner_to_center(anc2)                    # (A,4) cx cy w h
+
+        def one(lab_b, pred_b):
+            M = lab_b.shape[0]
+            gt_cls = lab_b[:, 0]
+            gt_box = lab_b[:, 1:5]
+            valid_gt = gt_cls >= 0                          # (M,)
+            iou = _iou_corner(anc2, gt_box)                 # (A, M)
+            iou = jnp.where(valid_gt[None, :], iou, 0.0)
+            best_gt = jnp.argmax(iou, axis=1)               # (A,)
+            best_iou = jnp.max(iou, axis=1)
+            matched = best_iou >= overlap_threshold
+            # forced bipartite-ish: each valid gt claims its best anchor
+            best_anchor_per_gt = jnp.argmax(iou, axis=0)    # (M,)
+            gt_has_overlap = jnp.max(iou, axis=0) > 1e-12
+            force = valid_gt & gt_has_overlap
+            matched = matched.at[best_anchor_per_gt].set(
+                jnp.where(force, True, matched[best_anchor_per_gt]))
+            best_gt = best_gt.at[best_anchor_per_gt].set(
+                jnp.where(force, jnp.arange(M), best_gt[best_anchor_per_gt]))
+            # classification targets: matched -> cls+1, else background 0
+            cls_t = jnp.where(matched, gt_cls[best_gt] + 1.0, 0.0)
+            if negative_mining_ratio > 0:
+                # hardness = max non-background class score
+                hard = jnp.max(pred_b[1:], axis=0)          # (A,)
+                is_neg = (~matched) & (best_iou < negative_mining_thresh)
+                num_pos = jnp.sum(matched)
+                num_neg = jnp.maximum(
+                    num_pos * negative_mining_ratio,
+                    float(minimum_negative_samples))
+                neg_rank = jnp.argsort(
+                    jnp.argsort(-jnp.where(is_neg, hard, -jnp.inf)))
+                selected_neg = is_neg & (neg_rank < num_neg)
+                cls_t = jnp.where(matched, cls_t,
+                                  jnp.where(selected_neg, 0.0,
+                                            float(ignore_label)))
+            # location targets (center offsets / variances)
+            g = _corner_to_center(gt_box)[best_gt]          # (A,4)
+            eps = 1e-12
+            tx = (g[:, 0] - anc_c[:, 0]) / jnp.maximum(anc_c[:, 2], eps) / variances[0]
+            ty = (g[:, 1] - anc_c[:, 1]) / jnp.maximum(anc_c[:, 3], eps) / variances[1]
+            tw = jnp.log(jnp.maximum(g[:, 2], eps) /
+                         jnp.maximum(anc_c[:, 2], eps)) / variances[2]
+            th = jnp.log(jnp.maximum(g[:, 3], eps) /
+                         jnp.maximum(anc_c[:, 3], eps)) / variances[3]
+            loc_t = jnp.stack([tx, ty, tw, th], axis=1)     # (A,4)
+            loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+            loc_m = jnp.where(matched[:, None],
+                              jnp.ones_like(loc_t), jnp.zeros_like(loc_t))
+            return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+        loc_t, loc_m, cls_t = jax.vmap(one)(lab, pred)
+        return loc_t, loc_m, cls_t
+
+    return _apply(f, [anchor, label, cls_pred], "MultiBoxTarget",
+                  nondiff=True)
+
+
+# --------------------------------------------------------------------------
+# MultiBoxDetection
+# --------------------------------------------------------------------------
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      background_id=0, nms_threshold=0.5,
+                      force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kw):
+    """Decode + confidence filter + per-class NMS
+    (REF:src/operator/contrib/multibox_detection.cc).
+    cls_prob (B,C+1,A), loc_pred (B,A*4), anchor (1,A,4) ->
+    (B, A, 6) rows [class_id, score, x1, y1, x2, y2], invalid = -1."""
+    variances = tuple(float(v) for v in _tuple(variances))
+
+    def f(prob, loc, anc):
+        A = anc.shape[1]
+        anc_c = _corner_to_center(anc.reshape(A, 4))
+
+        def one(prob_b, loc_b):
+            # class selection (excluding background row `background_id`)
+            C1 = prob_b.shape[0]
+            mask = jnp.arange(C1)[:, None] != background_id
+            scores_nb = jnp.where(mask, prob_b, -jnp.inf)
+            best_cls = jnp.argmax(scores_nb, axis=0)        # (A,)
+            score = jnp.max(scores_nb, axis=0)
+            cls_id = jnp.where(best_cls > background_id, best_cls - 1,
+                               best_cls).astype(jnp.float32)
+            valid = score > threshold
+            # decode
+            l = loc_b.reshape(A, 4)
+            cx = l[:, 0] * variances[0] * anc_c[:, 2] + anc_c[:, 0]
+            cy = l[:, 1] * variances[1] * anc_c[:, 3] + anc_c[:, 1]
+            w = jnp.exp(l[:, 2] * variances[2]) * anc_c[:, 2]
+            h = jnp.exp(l[:, 3] * variances[3]) * anc_c[:, 3]
+            boxes = _center_to_corner(jnp.stack([cx, cy, w, h], axis=1))
+            if clip:
+                boxes = jnp.clip(boxes, 0.0, 1.0)
+            order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))
+            b_s, s_s, c_s, v_s = (boxes[order], score[order], cls_id[order],
+                                  valid[order])
+            keep = _nms_keep(b_s, s_s, c_s, v_s, nms_threshold, nms_topk,
+                             force_suppress)
+            rows = jnp.concatenate(
+                [c_s[:, None], s_s[:, None], b_s], axis=1)  # (A,6)
+            return jnp.where(keep[:, None], rows, -jnp.ones_like(rows))
+
+        return jax.vmap(one)(prob, loc)
+
+    return _apply(f, [cls_prob, loc_pred, anchor], "MultiBoxDetection",
+                  nondiff=True)
