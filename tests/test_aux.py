@@ -1,0 +1,129 @@
+"""Aux subsystems: profiler, monitor, runtime features, engine API
+(reference test analog: tests/python/unittest/test_profiler.py,
+test_engine.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+
+
+def test_profiler_scope_and_dumps(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.set_config(filename=fname, profile_all=True)
+    mx.profiler.set_state("run")
+    with mx.profiler.scope("matmul_region"):
+        a = nd.array(np.random.rand(32, 32).astype(np.float32))
+        b = nd.dot(a, a)
+        b.wait_to_read()
+    task = mx.profiler.Task("mytask")
+    task.start()
+    task.stop()
+    c = mx.profiler.Counter("imgs", value=0)
+    c.increment(5)
+    mx.profiler.Marker("tick").mark()
+    mx.profiler.set_state("stop")
+    assert os.path.exists(fname)
+    table = mx.profiler.dumps()
+    assert "matmul_region" in table
+    assert "mytask" in table
+
+
+def test_profiler_pause_resume(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "p.json"))
+    mx.profiler.set_state("run")
+    mx.profiler.pause()
+    with mx.profiler.scope("hidden"):
+        pass
+    mx.profiler.resume()
+    with mx.profiler.scope("visible"):
+        pass
+    mx.profiler.set_state("stop")
+    table = mx.profiler.dumps(reset=True)
+    assert "visible" in table and "hidden" not in table
+
+
+def test_monitor_records_stats():
+    from tpu_mx import gluon
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=2, pattern=".*")
+    mon.install(net)
+    x = nd.array(np.random.rand(2, 16).astype(np.float32))
+    seen = []
+    for _ in range(4):
+        mon.tic()
+        net(x)
+        seen.append(mon.toc())
+    # interval=2: batches 0 and 2 record, 1 and 3 do not
+    assert len(seen[0]) > 0 and len(seen[2]) > 0
+    assert seen[1] == [] and seen[3] == []
+    step, name, stat = seen[0][0]
+    assert isinstance(stat, float) and np.isfinite(stat)
+
+
+def test_monitor_pattern_filter():
+    from tpu_mx import gluon
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=1, pattern="nomatch_.*")
+    mon.install(net)
+    mon.tic()
+    net(nd.array(np.random.rand(2, 8).astype(np.float32)))
+    assert mon.toc() == []
+
+
+def test_runtime_feature_list():
+    feats = mx.runtime.feature_list()
+    assert feats
+    names = {f.name for f in feats}
+    assert {"JAX", "CPU", "PROFILER"} <= names
+    features = mx.runtime.Features()
+    assert features.is_enabled("JAX")
+
+
+def test_engine_api():
+    assert mx.engine.engine_type() == "JaxAsyncDispatch"
+    prev = mx.engine.set_bulk_size(32)
+    assert mx.engine.set_bulk_size(prev) == 32
+    with mx.engine.bulk(64):
+        a = nd.array(np.ones((4, 4), np.float32))
+        b = a * 2
+    mx.engine.wait_for_all()
+    np.testing.assert_allclose(b.asnumpy(), 2.0)
+
+
+def test_monitor_uninstall():
+    from tpu_mx import gluon
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install(net)
+    mon.install(net)  # double install -> duplicated hooks until uninstall
+    mon.uninstall()
+    mon.tic()
+    net(nd.array(np.random.rand(2, 8).astype(np.float32)))
+    assert mon.toc() == []
+
+
+def test_profiler_new_session_clears_events(tmp_path):
+    f1, f2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    import json
+    mx.profiler.set_config(filename=f1)
+    mx.profiler.set_state("run")
+    with mx.profiler.scope("first"):
+        pass
+    mx.profiler.set_state("stop")
+    mx.profiler.set_config(filename=f2)
+    mx.profiler.set_state("run")
+    with mx.profiler.scope("second"):
+        pass
+    mx.profiler.set_state("stop")
+    names = {e["name"] for e in json.load(open(f2))["traceEvents"]}
+    assert "second" in names and "first" not in names

@@ -34,3 +34,7 @@ from . import recordio
 from . import io
 from . import image
 from . import test_utils
+from . import profiler
+from . import monitor
+from . import runtime
+from . import engine

@@ -1,0 +1,56 @@
+"""Engine control API (REF:python/mxnet/engine.py, REF:include/mxnet/engine.h).
+
+The reference's dependency engine schedules every NDArray mutation on
+per-device thread pools; Python exposes ``bulk`` (op bulking) and engine
+type inspection.  TPU-natively the "engine" is JAX's async dispatch plus
+XLA program order: ops issue immediately and execute in stream order, and
+``jit`` regions are the bulked segments.  This module keeps the control
+surface: ``bulk`` is honored as a hint (ops inside are already batched by
+dispatch), and the wait functions map to ``block_until_ready``.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["bulk", "set_bulk_size", "wait_for_all", "engine_type"]
+
+try:
+    _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
+except ValueError:
+    _bulk_size = 15
+
+
+def engine_type():
+    """Name of the active scheduler.  The reference returns one of
+    NaiveEngine/ThreadedEngine/ThreadedEnginePerDevice; here scheduling is
+    JAX's asynchronous dispatch."""
+    return "JaxAsyncDispatch"
+
+
+def set_bulk_size(size):
+    """Set the bulking hint; returns the previous value.  Kept for API
+    compatibility — XLA fusion under ``jit`` supersedes engine-level
+    bulking (REF:src/imperative/cached_op.cc bulking)."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scope within which ops may be bulked (no-op semantically: JAX's
+    dispatch already pipelines; use ``hybridize()``/``jit`` for true
+    single-program execution)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def wait_for_all():
+    """Block until all issued computation has finished
+    (Engine::WaitForAll)."""
+    from .ndarray import waitall
+    waitall()

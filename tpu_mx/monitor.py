@@ -1,0 +1,92 @@
+"""Monitor: per-layer output statistics for debugging
+(REF:python/mxnet/monitor.py).
+
+The reference installs a stat callback on every executor output whose name
+matches a pattern.  Here the equivalent hooks are Gluon forward hooks: pass
+a ``Block`` to :meth:`Monitor.install`, and every ``interval``-th forward
+pass records ``stat_func`` of each matching child's outputs.  Works on
+un-hybridized blocks (hybridized graphs are a single XLA program — use
+``mx.profiler`` for those).
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(arr):
+    return float(np.abs(arr).mean())
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.re = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue = []  # (step, name, stat)
+        self._handles = []
+
+    # -- installation ------------------------------------------------------
+    def install(self, block, root_name=None):
+        """Register forward hooks on ``block`` and all named children."""
+        def make_hook(name):
+            def hook(blk, inputs, output):
+                if not self.activated:
+                    return
+                outs = output if isinstance(output, (list, tuple)) else (output,)
+                for i, o in enumerate(outs):
+                    if isinstance(o, NDArray):
+                        key = name if len(outs) == 1 else f"{name}_output{i}"
+                        if self.re.match(key):
+                            self.queue.append(
+                                (self.step, key, self.stat_func(o.asnumpy())))
+            return hook
+
+        for name, child in self._walk(block, root_name or type(block).__name__.lower()):
+            hook = child.register_forward_hook(make_hook(name))
+            self._handles.append((child, hook))
+        return self
+
+    def uninstall(self):
+        """Remove every hook this monitor registered."""
+        for child, hook in self._handles:
+            hooks = child.__dict__.get("_fwd_hooks")
+            if hooks and hook in hooks:
+                hooks.remove(hook)
+        self._handles = []
+
+    def _walk(self, block, prefix):
+        yield prefix, block
+        children = getattr(block, "_children", {})
+        items = children.items() if isinstance(children, dict) else enumerate(children)
+        for key, child in items:
+            yield from self._walk(child, f"{prefix}.{key}")
+
+    # -- per-batch protocol (same as reference) ----------------------------
+    def tic(self):
+        """Start collecting for this batch if it is an interval batch."""
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting and return list of (step, name, stat)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = sorted(self.queue, key=lambda t: t[1]) if self.sort else list(self.queue)
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            print("Batch: %7d %30s %s" % (step, name, stat))

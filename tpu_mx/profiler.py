@@ -1,0 +1,230 @@
+"""Profiler API (REF:python/mxnet/profiler.py, REF:src/profiler/profiler.cc).
+
+The reference brackets every engine op with timestamps and emits a
+chrome://tracing JSON plus per-op aggregate statistics.  TPU-natively the
+heavy lifting is ``jax.profiler`` (XLA traces viewable in Perfetto /
+TensorBoard); this module keeps the reference-shaped API on top of it and
+adds a host-side scope recorder so ``dumps()`` can print an aggregate
+per-scope table like the reference's ``aggregate_stats.cc``.
+
+Usage (same shape as the reference):
+    mx.profiler.set_config(filename='profile.json', profile_all=True)
+    mx.profiler.set_state('run')
+    ... work ...
+    mx.profiler.set_state('stop')
+    print(mx.profiler.dumps())
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
+           "scope", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_state = {
+    "filename": "profile.json",
+    "trace_dir": None,       # jax.profiler trace directory (derived from filename)
+    "running": False,
+    "paused": False,
+    "jax_trace": False,      # whether a jax.profiler trace is active
+    "profile_all": False,
+}
+_lock = threading.Lock()
+# scope name -> [count, total_seconds, min_seconds, max_seconds]
+_agg: dict[str, list] = {}
+# chrome-trace events recorded host-side (scopes, markers, counters)
+_events: list[dict] = []
+_pid = os.getpid()
+
+
+def set_config(filename="profile.json", profile_all=False, profile_symbolic=True,
+               profile_imperative=True, profile_memory=False, profile_api=False,
+               aggregate_stats=True, **kwargs):
+    """Configure the profiler.  Mode kwargs mirror the reference; all op
+    execution on TPU is captured uniformly by the XLA trace, so the
+    symbolic/imperative/memory/api switches only gate host-side recording."""
+    _state["filename"] = filename
+    _state["profile_all"] = profile_all
+    base, _ = os.path.splitext(filename)
+    _state["trace_dir"] = base + "_xla_trace"
+
+
+def set_state(state="stop"):
+    """'run' starts profiling (including a jax.profiler/XLA device trace when
+    possible); 'stop' ends it and writes the chrome-trace JSON."""
+    if state == "run":
+        if _state["running"]:
+            return
+        with _lock:
+            _events.clear()
+            _agg.clear()
+        _state["running"], _state["paused"] = True, False
+        try:
+            import jax
+            jax.profiler.start_trace(_state["trace_dir"] or "profile_xla_trace")
+            _state["jax_trace"] = True
+        except Exception:
+            _state["jax_trace"] = False
+    elif state == "stop":
+        if not _state["running"]:
+            return
+        _state["running"] = False
+        if _state["jax_trace"]:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _state["jax_trace"] = False
+        dump()
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def pause():
+    """Suspend host-side recording without ending the session."""
+    _state["paused"] = True
+
+
+def resume():
+    _state["paused"] = False
+
+
+def _recording():
+    return _state["running"] and not _state["paused"]
+
+
+def _record_scope(name, t0, t1, category="scope"):
+    with _lock:
+        st = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+        dt = t1 - t0
+        st[0] += 1
+        st[1] += dt
+        st[2] = min(st[2], dt)
+        st[3] = max(st[3], dt)
+        _events.append({"name": name, "cat": category, "ph": "X",
+                        "ts": t0 * 1e6, "dur": dt * 1e6,
+                        "pid": _pid, "tid": threading.get_ident()})
+
+
+class scope:
+    """Context manager: times a named region, forwards it to the XLA trace as
+    a ``jax.profiler.TraceAnnotation``, and feeds the aggregate table."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ann = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        if _recording():
+            try:
+                import jax
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        if _recording():
+            _record_scope(self.name, self._t0, t1)
+        return False
+
+
+class Task:
+    """Named task object (reference: profiler::ProfileTask)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None and _recording():
+            _record_scope(self.name, self._t0, time.perf_counter(), "task")
+        self._t0 = None
+
+
+class Frame(Task):
+    """Named frame (reference: profiler::ProfileFrame)."""
+
+
+class Event(Task):
+    """Named event (reference: profiler::ProfileEvent)."""
+
+
+class Counter:
+    """Named monotonic counter emitted into the chrome trace
+    (reference: profiler::ProfileCounter)."""
+
+    def __init__(self, name, domain=None, value=0):
+        self.name = name
+        self.value = value
+        self._emit()
+
+    def _emit(self):
+        if _recording():
+            with _lock:
+                _events.append({"name": self.name, "ph": "C",
+                                "ts": time.perf_counter() * 1e6, "pid": _pid,
+                                "args": {self.name: self.value}})
+
+    def set_value(self, value):
+        self.value = value
+        self._emit()
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+class Marker:
+    """Instant event (reference: profiler::ProfileMarker)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _recording():
+            with _lock:
+                _events.append({"name": self.name, "ph": "i",
+                                "ts": time.perf_counter() * 1e6, "pid": _pid,
+                                "tid": threading.get_ident(),
+                                "s": {"process": "p", "thread": "t",
+                                      "global": "g"}.get(scope, "p")})
+
+
+def dump(finished=True):
+    """Write recorded host-side events as chrome://tracing JSON to the
+    configured filename.  The XLA device trace lives separately under
+    ``<filename-stem>_xla_trace/`` (view with Perfetto/TensorBoard)."""
+    with _lock:
+        events = list(_events)
+    with open(_state["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+def dumps(reset=False):
+    """Return the aggregate per-scope statistics table as a string
+    (reference: MXAggregateProfileStatsPrint)."""
+    with _lock:
+        rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+        if reset:
+            _agg.clear()
+    lines = ["%-40s %8s %12s %12s %12s %12s" %
+             ("Name", "Calls", "Total(ms)", "Mean(ms)", "Min(ms)", "Max(ms)")]
+    for name, (n, tot, mn, mx) in rows:
+        lines.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f" %
+                     (name, n, tot * 1e3, tot / n * 1e3, mn * 1e3, mx * 1e3))
+    return "\n".join(lines)

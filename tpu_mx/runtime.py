@@ -1,0 +1,87 @@
+"""Runtime feature detection (REF:src/libinfo.cc, REF:python/mxnet/runtime.py).
+
+The reference exposes its build-time feature matrix (CUDA? CUDNN? MKLDNN?
+DIST_KVSTORE? ...) via ``mx.runtime.feature_list()``.  Here features are
+determined live from the JAX installation: backend platforms, device counts,
+and which optional subsystems of this framework are importable.
+"""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = bool(enabled)
+
+    def __repr__(self):
+        return "[%s: %s]" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _probe():
+    feats = {"JAX": False, "TPU": False, "GPU": False, "CPU": True,
+             "PALLAS": False, "X64": False, "DIST_KVSTORE": False}
+    try:
+        import jax
+        feats["JAX"] = True
+    except Exception:
+        jax = None
+    if jax is not None:
+        try:
+            platform = jax.default_backend()
+            feats["TPU"] = platform == "tpu"
+            feats["GPU"] = platform in ("gpu", "cuda", "rocm")
+        except Exception:
+            pass
+        try:
+            feats["PALLAS"] = bool(__import__("jax.experimental.pallas",
+                                              fromlist=["pallas"]))
+        except Exception:
+            pass
+        try:
+            feats["X64"] = bool(jax.config.read("jax_enable_x64"))
+        except Exception:
+            pass
+        try:
+            import jax.distributed  # noqa: F401
+            feats["DIST_KVSTORE"] = True
+        except Exception:
+            pass
+    for mod, name in [("cv2", "OPENCV"),
+                      ("PIL", "PIL"),            # image decode path
+                      ("orbax.checkpoint", "ORBAX")]:
+        try:
+            __import__(mod)
+            feats[name] = True
+        except Exception:
+            feats[name] = False
+    # native C++ components of this framework (RecordIO fast path)
+    try:
+        from .lib import recordio_cpp  # noqa: F401
+        feats["CPP_RECORDIO"] = True
+    except Exception:
+        feats["CPP_RECORDIO"] = False
+    feats["BF16"] = feats["JAX"]
+    feats["INT8_QUANTIZATION"] = True
+    feats["PROFILER"] = True
+    return feats
+
+
+class Features(dict):
+    """dict of name -> Feature, like the reference's LibInfo wrapper."""
+
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _probe().items()})
+
+    def is_enabled(self, name):
+        feat = self.get(name.upper())
+        return bool(feat and feat.enabled)
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(map(str, self.values()))
+
+
+def feature_list():
+    """Check the library for compile-time/runtime features it supports."""
+    return list(Features().values())
