@@ -1,0 +1,157 @@
+"""AMP tests (reference analog: tests/python/gpu/test_contrib_amp.py)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd, gluon, autograd
+from tpu_mx.contrib import amp
+
+
+@pytest.fixture
+def amp_session():
+    amp.init(target_dtype="bfloat16")
+    yield
+    from tpu_mx.contrib.amp.amp import _deinit
+    _deinit()
+
+
+def test_amp_casts_matmul_to_bf16(amp_session):
+    a = nd.array(np.random.rand(8, 8).astype(np.float32))
+    out = nd.dot(a, a)
+    assert out.dtype == "bfloat16"
+    # fp32 ops force float32 even on bf16 inputs
+    s = nd.softmax(out, axis=-1)
+    assert s.dtype == "float32"
+
+
+def test_amp_widest_cast(amp_session):
+    a = nd.array(np.random.rand(4, 4).astype(np.float32))
+    b = a.astype("bfloat16")
+    out = nd.concat(a, b, dim=0) if hasattr(nd, "concat") else nd.stack(a, b)
+    assert out.dtype == "float32"
+
+
+def test_loss_scaler_dynamics():
+    s = amp.LossScaler(init_scale=16.0, scale_factor=2.0, scale_window=2,
+                       target_dtype="float16")
+    s.update_scale(overflow=True)
+    assert s.loss_scale == 8.0
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 16.0
+    b = amp.LossScaler(target_dtype="bfloat16")
+    assert b.loss_scale == 1.0
+    b.update_scale(True)
+    assert b.loss_scale == 1.0
+
+
+def test_amp_training_loop(amp_session):
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    amp.init_trainer(trainer)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    X = np.random.RandomState(0).rand(32, 8).astype(np.float32)
+    Y = (X.sum(axis=1) > 4).astype(np.int32)
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            out = net(nd.array(X))
+            loss = loss_fn(out, nd.array(Y))
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        trainer.step(32)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_overflow_skips_step():
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    x = nd.array(np.random.rand(4, 4).astype(np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    from tpu_mx.contrib.amp import amp as amp_mod
+    amp_mod._amp_state["target_dtype"] = "float16"
+    try:
+        amp.init_trainer(trainer)
+    finally:
+        amp_mod._amp_state["target_dtype"] = None
+    scaler = trainer._amp_loss_scaler
+    scale0 = scaler.loss_scale
+    w0 = net.weight.data().asnumpy().copy()
+    # poison a gradient with inf -> step must be skipped, scale halved
+    g = net.weight.grad
+    g._rebind(g._data.at[0, 0].set(np.inf))
+    with pytest.warns(UserWarning, match="overflow"):
+        trainer.step(4)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+    assert scaler.loss_scale == scale0 / 2
+
+
+def test_convert_model_keeps_norms_fp32():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8))
+    net.add(gluon.nn.BatchNorm())
+    net.initialize()
+    net(nd.array(np.random.rand(2, 4).astype(np.float32)))
+    amp.convert_model(net, target_dtype="bfloat16")
+    assert net[0].weight.data().dtype == "bfloat16"
+    assert net[1].gamma.data().dtype == "float32"
+
+
+def test_convert_model_preserves_norm_values():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8))
+    net.add(gluon.nn.BatchNorm())
+    net.initialize()
+    net(nd.array(np.random.rand(2, 4).astype(np.float32)))
+    # give gamma values that do not survive a bf16 roundtrip
+    gamma0 = np.full(8, 1.0009765625, np.float32)  # 1 + 2**-10
+    net[1].gamma.set_data(nd.array(gamma0))
+    amp.convert_model(net, target_dtype="bfloat16")
+    np.testing.assert_array_equal(net[1].gamma.data().asnumpy(), gamma0)
+
+
+def test_convert_model_excluded_sym_names():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8))
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.array(np.random.rand(2, 4).astype(np.float32)))
+    amp.convert_model(net, target_dtype="bfloat16", excluded_sym_names=["1"])
+    assert net[0].weight.data().dtype == "bfloat16"
+    assert net[1].weight.data().dtype == "float32"
+
+
+def test_conditional_fp32_ops(amp_session):
+    from tpu_mx.contrib.amp.amp import _deinit
+    _deinit()
+    amp.init(target_dtype="bfloat16",
+             conditional_fp32_ops=[("Activation", "act_type", ["softsign"])])
+    x = nd.array(np.random.rand(4, 4).astype(np.bfloat16)) \
+        if hasattr(np, "bfloat16") else \
+        nd.array(np.random.rand(4, 4).astype(np.float32)).astype("bfloat16")
+    out = nd.Activation(x, act_type="softsign")
+    assert out.dtype == "float32"
+    out2 = nd.Activation(x, act_type="relu")
+    assert out2.dtype == "bfloat16"
+
+
+def test_hook_handle_detach():
+    from tpu_mx.gluon.block import HookHandle
+    calls = []
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    h = net.register_forward_hook(lambda blk, ins, out: calls.append(1))
+    assert isinstance(h, HookHandle)
+    net(nd.array(np.random.rand(2, 3).astype(np.float32)))
+    h.remove()
+    net(nd.array(np.random.rand(2, 3).astype(np.float32)))
+    assert len(calls) == 1

@@ -1,2 +1,3 @@
 """mx.contrib — auxiliary capabilities (REF:python/mxnet/contrib/)."""
 from . import compression
+from . import amp

@@ -1,0 +1,4 @@
+"""AMP: automatic mixed precision (REF:python/mxnet/contrib/amp/)."""
+from . import lists
+from .amp import (LossScaler, convert_model, init, init_trainer, scale_loss,
+                  unscale)

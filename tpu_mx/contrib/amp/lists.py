@@ -1,0 +1,46 @@
+"""AMP cast lists (REF:python/mxnet/contrib/amp/lists/symbol_fp16.py).
+
+Ops routed to the low-precision dtype are the MXU-bound ones (matmul/conv
+families — bf16 is the TPU-native precision for the systolic array); ops
+kept in float32 are the numerically sensitive reductions/exponentials.
+Everything not listed runs in whatever dtype its inputs already have
+(XLA's type promotion plays the reference's "widest type cast" role).
+"""
+
+# run in the AMP target dtype (bfloat16 by default): MXU-heavy ops
+TARGET_DTYPE_OPS = [
+    "FullyConnected",
+    "Convolution",
+    "Deconvolution",
+    "dot",
+    "batch_dot",
+]
+
+# always promoted to float32: loss / normalization / exponential families
+FP32_OPS = [
+    "softmax",
+    "log_softmax",
+    "softmax_cross_entropy",
+    "SoftmaxActivation",
+    "SoftmaxOutput",
+    "norm",
+    "L2Normalization",
+    "LayerNorm",
+    "InstanceNorm",
+    "RMSNorm",
+    "BatchNorm",
+    "exp",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "expm1",
+]
+
+# ops whose float inputs are cast to the *widest* float dtype present
+WIDEST_TYPE_CASTS = [
+    "add_n",
+    "concat",
+    "stack",
+    "where",
+]

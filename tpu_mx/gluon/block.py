@@ -54,6 +54,28 @@ class _BlockScope:
         return False
 
 
+class HookHandle:
+    """Removable handle for a registered hook (reference: gluon.utils
+    HookHandle)."""
+
+    def __init__(self, hooks_list, hook):
+        self._hooks_list = hooks_list
+        self._hook = hook
+
+    def detach(self):
+        if self._hooks_list is not None and self._hook in self._hooks_list:
+            self._hooks_list.remove(self._hook)
+        self._hooks_list = None
+
+    remove = detach
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()
+
+
 class Block:
     """Define-by-run module. Subclasses implement `forward(self, *args)`."""
 
@@ -106,12 +128,12 @@ class Block:
     def register_forward_hook(self, hook):
         hooks = self.__dict__.setdefault("_fwd_hooks", [])
         hooks.append(hook)
-        return hook
+        return HookHandle(hooks, hook)
 
     def register_forward_pre_hook(self, hook):
         hooks = self.__dict__.setdefault("_fwd_pre_hooks", [])
         hooks.append(hook)
-        return hook
+        return HookHandle(hooks, hook)
 
     def apply_fn(self, fn):
         """Reference Block.apply: run fn on self and all children."""

@@ -51,16 +51,13 @@ class Monitor:
             return hook
 
         for name, child in self._walk(block, root_name or type(block).__name__.lower()):
-            hook = child.register_forward_hook(make_hook(name))
-            self._handles.append((child, hook))
+            self._handles.append(child.register_forward_hook(make_hook(name)))
         return self
 
     def uninstall(self):
         """Remove every hook this monitor registered."""
-        for child, hook in self._handles:
-            hooks = child.__dict__.get("_fwd_hooks")
-            if hooks and hook in hooks:
-                hooks.remove(hook)
+        for handle in self._handles:
+            handle.detach()
         self._handles = []
 
     def _walk(self, block, prefix):
