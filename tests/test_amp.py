@@ -155,3 +155,37 @@ def test_hook_handle_detach():
     h.remove()
     net(nd.array(np.random.rand(2, 3).astype(np.float32)))
     assert len(calls) == 1
+
+
+def test_amp_kwarg_call_is_cast(amp_session):
+    x = nd.array(np.random.rand(4, 4).astype(np.float32)).astype("bfloat16")
+    out = nd.softmax(data=x, axis=-1)
+    assert out.dtype == "float32"
+
+
+def test_conditional_fp32_positional(amp_session):
+    from tpu_mx.contrib.amp.amp import _deinit
+    _deinit()
+    amp.init(target_dtype="bfloat16",
+             conditional_fp32_ops=[("Activation", "act_type", ["softsign"])])
+    x = nd.array(np.random.rand(4, 4).astype(np.float32)).astype("bfloat16")
+    out = nd.Activation(x, "softsign")
+    assert out.dtype == "float32"
+
+
+def test_amp_reinit_warns(amp_session):
+    with pytest.warns(UserWarning, match="already ran"):
+        amp.init(target_dtype="float16")
+
+
+def test_convert_model_excluded_container():
+    net = gluon.nn.Sequential()
+    sub = gluon.nn.Sequential()
+    sub.add(gluon.nn.Dense(8))
+    net.add(sub)
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.array(np.random.rand(2, 4).astype(np.float32)))
+    amp.convert_model(net, target_dtype="bfloat16", excluded_sym_names=["0"])
+    assert net[0][0].weight.data().dtype == "float32"
+    assert net[1].weight.data().dtype == "bfloat16"

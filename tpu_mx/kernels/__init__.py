@@ -1,0 +1,6 @@
+"""Pallas TPU kernels: the hand-tuned hot ops of the framework
+(the analog of the reference's cuDNN/hand-CUDA kernels under
+REF:src/operator/ — here written against the MXU/VMEM model)."""
+from . import flash_attention
+from .flash_attention import flash_attention as flash_attention_fn
+from .flash_attention import mha_flash_attention

@@ -1,0 +1,352 @@
+"""Flash attention as a Pallas TPU kernel.
+
+TPU-native replacement for the reference's attention compute (the reference
+has no fused attention at all — MXNet 1.x predates it; BERT-era GluonNLP
+composed it from batch_dot + softmax, materializing the full T×T score
+matrix).  This kernel computes attention blockwise with online softmax:
+O(T) memory per core instead of O(T²), MXU-shaped (Bq×D)·(D×Bk) matmuls,
+fp32 accumulation regardless of input dtype.
+
+Layout: q/k/v are (BH, T, D) — batch*heads collapsed.  Grid is
+(BH, T/Bq, T/Bk) with the K dimension innermost; VMEM scratch carries the
+running (m, l, acc) statistics across K steps, and the output block is
+written on the last K step (the standard sequential-grid accumulation
+pattern).  The backward pass is two more Pallas kernels (dq and dk/dv),
+using the saved logsumexp — the flash attention recompute trick.
+
+Falls back to interpret mode off-TPU so tests run anywhere.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "mha_flash_attention"]
+
+NEG_INF = -1e30
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+# ----------------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # skip fully-masked blocks (strictly above the diagonal)
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                      # (Bq, D)
+        k = k_ref[0].astype(jnp.float32)                      # (Bk, D)
+        v = v_ref[0].astype(jnp.float32)                      # (Bk, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_scr[:, 0]                                  # (Bq,)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])                       # (Bq, Bk)
+        l_cur = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l_safe))[:, None].astype(
+            jnp.float32)
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    grid = (bh, _cdiv(t, block_q), _cdiv(tk, block_k))
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            # lse rides as (BH, T, 1): TPU block rules need the last two
+            # block dims divisible by (8, 128) or equal to the array dims
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ----------------------------------------------------------------------------
+# backward: dq kernel (grid k-innermost, accumulate dq over k blocks)
+# ----------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]                                 # (Bq,)
+        delta = delta_ref[0][:, 0]                             # (Bq,)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                          # (Bq, Bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+# ----------------------------------------------------------------------------
+# backward: dk/dv kernel (grid q-innermost, accumulate dk,dv over q blocks)
+# ----------------------------------------------------------------------------
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                          # (Bq, Bk)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    bq = min(block_q, t)
+    bk = min(block_k, tk)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[..., None]                        # (BH, T, 1)
+
+    qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM)
+    rowq = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+                        memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(bh, _cdiv(t, bq), _cdiv(tk, bk)),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: swap grid so q is innermost; index maps take (b, kblk, qblk)
+    qspec2 = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kspec2 = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                          memory_space=pltpu.VMEM)
+    rowq2 = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(bh, _cdiv(tk, bk), _cdiv(t, bq)),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------------
+# public entry
+# ----------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+    return _bwd(scale, causal, block_q, block_k, res, do)
+
+
+_flash_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, scale=None, causal=False,
+                    block_q=None, block_k=None):
+    """softmax(q·kᵀ·scale [+causal mask])·v, blockwise.  q/k/v: (BH, T, D).
+    scale defaults to 1/sqrt(D); blocks default to the tuned sizes.  T (for
+    both q and k/v) must tile exactly by the chosen blocks — partial K
+    blocks would feed padded garbage into the softmax."""
+    t, tk = q.shape[1], k.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    block_q = block_q or _pick_block(t, 512)
+    block_k = block_k or _pick_block(tk, 1024)
+    if t % min(block_q, t) or tk % min(block_k, tk):
+        raise ValueError(
+            f"flash_attention: seq lens (q={t}, kv={tk}) must be divisible "
+            f"by the block sizes ({block_q}, {block_k}); gate callers with "
+            "kernels.flash_attention.supported()")
+    return _flash_core(q, k, v, scale, causal, block_q, block_k)
+
+
+def _pick_block(t, prefer):
+    """Largest power-of-two block ≤ prefer that divides t, so blocks tile T
+    exactly — partial K blocks would feed garbage columns into the softmax.
+    t ≤ the smallest candidate is returned as-is (single block)."""
+    if t <= 128:
+        return t
+    for b in (prefer, 1024, 512, 256, 128):
+        if b <= prefer and t % b == 0:
+            return b
+    return t  # no aligned divisor: single block covering T (caller gates)
+
+
+def mha_flash_attention(q, k, v, causal=False, block_q=None, block_k=None):
+    """Multi-head wrapper: q/k/v are (B, H, T, D); collapses batch*heads,
+    runs the Pallas kernel, restores the layout.  Default blocks tuned on
+    v5e-class hardware: large K blocks amortize the scratch carry."""
+    b, h, t, d = q.shape
+    fold = lambda x: x.reshape(b * h, x.shape[2], d)
+    out = flash_attention(fold(q), fold(k), fold(v), None, causal,
+                          block_q, block_k)
+    return out.reshape(b, h, t, d)
+
+
+def supported(q_shape, dtype, kv_len=None):
+    """Whether the Pallas path handles this problem: head dim a multiple of
+    the VPU lane half-count (dense MXU tiles) and BOTH sequence lengths
+    multiples of the smallest block so K blocks tile exactly."""
+    d = q_shape[-1]
+    t = q_shape[-2]
+    kv_len = t if kv_len is None else kv_len
+    return d % 64 == 0 and t % 128 == 0 and kv_len % 128 == 0 and \
+        jnp.dtype(dtype).name in ("float32", "bfloat16")

@@ -120,7 +120,14 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
 
 
 def local_flash_attention(q, k, v, causal=False):
-    """Single-device attention with the same numerics as the ring kernel."""
+    """Single-device attention with the same numerics as the ring kernel.
+    On TPU with tile-friendly shapes this runs the Pallas flash kernel
+    (tpu_mx.kernels.flash_attention: blockwise online softmax, O(T) memory);
+    otherwise the XLA dense path."""
+    from ..kernels import flash_attention as fa
+    if jax.default_backend() == "tpu" and \
+            fa.supported(q.shape, q.dtype, kv_len=k.shape[2]):
+        return fa.mha_flash_attention(q, k, v, causal=causal)
     scale = 1.0 / math.sqrt(q.shape[-1])
     mask = None
     if causal:
