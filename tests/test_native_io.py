@@ -1,0 +1,206 @@
+"""Native C++ data pipeline tests (reference analog: the C++ iterator tests
+plus tests/python/unittest/test_io.py).  Oracle: the Python ImageRecordIter
+decode path (same libjpeg family underneath)."""
+import os
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import recordio
+
+cv2 = pytest.importorskip("cv2")
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    """24 small JPEG records, labels = index, various sizes."""
+    d = tmp_path_factory.mktemp("rec")
+    path = str(d / "data.rec")
+    rng = np.random.RandomState(0)
+    rec = recordio.MXRecordIO(path, "w")
+    imgs = []
+    for i in range(24):
+        h, w = rng.randint(40, 90), rng.randint(40, 90)
+        img = rng.randint(0, 255, (h, w, 3), np.uint8)
+        header = recordio.IRHeader(0, float(i), i, 0)
+        rec.write(recordio.pack_img(header, img, quality=95))
+        imgs.append(img)
+    rec.close()
+    return path, imgs
+
+
+def _pipe(path, **kw):
+    from tpu_mx.lib.recordio_cpp import NativeImagePipe
+    args = dict(batch_size=8, data_shape=(3, 32, 32), preprocess_threads=3,
+                prefetch_buffer=3)
+    args.update(kw)
+    return NativeImagePipe(path, **args)
+
+
+def test_native_builds_and_counts(rec_file):
+    path, imgs = rec_file
+    p = _pipe(path)
+    assert len(p) == 24
+    p.close()
+
+
+def test_native_batches_and_labels(rec_file):
+    path, _ = rec_file
+    p = _pipe(path)
+    seen_labels = []
+    batches = 0
+    while True:
+        out = p.next_batch()
+        if out is None:
+            break
+        data, label = out
+        assert data.shape == (8, 3, 32, 32)
+        assert data.dtype == np.float32
+        assert np.isfinite(data).all()
+        seen_labels.extend(label.tolist())
+        batches += 1
+    assert batches == 3
+    assert sorted(int(l) for l in seen_labels) == list(range(24))
+    p.close()
+
+
+def test_native_epoch_reset_and_shuffle(rec_file):
+    path, _ = rec_file
+    p = _pipe(path, shuffle=True, seed=7)
+    def epoch_labels():
+        out, labels = p.next_batch(), []
+        while out is not None:
+            labels.extend(out[1].tolist())
+            out = p.next_batch()
+        return labels
+    e1 = epoch_labels()
+    p.reset()
+    e2 = epoch_labels()
+    assert sorted(e1) == sorted(e2) == list(map(float, range(24)))
+    assert e1 != e2  # reshuffled across epochs
+    p.close()
+
+
+def test_native_matches_python_decode(rec_file):
+    """Center-crop, no resize: native output must closely match the Python
+    cv2 pipeline (both are libjpeg decodes; only rounding may differ)."""
+    path, _ = rec_file
+    from tpu_mx.io import ImageRecordIter
+    py_iter = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                              batch_size=8, shuffle=False,
+                              preprocess_threads=2, use_native=False)
+    p = _pipe(path)
+    nb = py_iter.next()
+    py_data = nb.data[0].asnumpy()
+    nat_data, nat_label = p.next_batch()
+    assert nat_data.shape == py_data.shape
+    # same labels, same order
+    np.testing.assert_array_equal(nat_label,
+                                  nb.label[0].asnumpy().astype(np.float32))
+    diff = np.abs(nat_data - py_data)
+    assert np.mean(diff) < 2.0 and np.median(diff) < 1.5, \
+        f"decode divergence: mean {diff.mean()}, max {diff.max()}"
+    p.close()
+
+
+def test_native_mean_std_normalization(rec_file):
+    path, _ = rec_file
+    p0 = _pipe(path)
+    p1 = _pipe(path, mean=(10.0, 20.0, 30.0), std=(2.0, 4.0, 8.0))
+    d0, _ = p0.next_batch()
+    d1, _ = p1.next_batch()
+    for c, (m, s) in enumerate([(10, 2), (20, 4), (30, 8)]):
+        np.testing.assert_allclose(d1[:, c], (d0[:, c] - m) / s,
+                                   rtol=1e-5, atol=1e-5)
+    p0.close()
+    p1.close()
+
+
+def test_native_deterministic_augment(rec_file):
+    path, _ = rec_file
+    a = _pipe(path, rand_crop=True, rand_mirror=True, seed=42,
+              data_shape=(3, 24, 24))
+    b = _pipe(path, rand_crop=True, rand_mirror=True, seed=42,
+              data_shape=(3, 24, 24))
+    da, la = a.next_batch()
+    db, lb = b.next_batch()
+    np.testing.assert_array_equal(da, db)
+    np.testing.assert_array_equal(la, lb)
+    a.close()
+    b.close()
+
+
+def test_native_bad_file(tmp_path):
+    bad = tmp_path / "bad.rec"
+    bad.write_bytes(b"not a recordio file at all")
+    from tpu_mx.lib.recordio_cpp import NativeImagePipe
+    with pytest.raises(IOError):
+        NativeImagePipe(str(bad), batch_size=2, data_shape=(3, 8, 8))
+
+
+def test_runtime_feature_flag():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("CPP_RECORDIO")
+
+
+def test_image_record_iter_native_default(rec_file):
+    """ImageRecordIter picks the native pipeline automatically and yields
+    the same epoch as the Python path."""
+    path, _ = rec_file
+    from tpu_mx.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                         batch_size=8)
+    assert it._native is not None
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (8, 3, 32, 32)
+        labels.extend(batch.label[0].asnumpy().tolist())
+        assert batch.pad == 0  # 24 % 8 == 0
+    assert sorted(int(l) for l in labels) == list(range(24))
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter_native_pad(rec_file):
+    path, _ = rec_file
+    from tpu_mx.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                         batch_size=10)
+    pads = [b.pad for b in it]
+    assert pads == [0, 0, 6]  # 24 records, batch 10 -> last pad 6
+
+
+def test_native_reset_recovers_from_bad_record(tmp_path):
+    """A corrupt record fails the epoch; reset() must un-poison the pipe."""
+    import struct
+    path = str(tmp_path / "mixed.rec")
+    rng = np.random.RandomState(0)
+    rec = recordio.MXRecordIO(path, "w")
+    img = rng.randint(0, 255, (40, 40, 3), np.uint8)
+    rec.write(recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img))
+    # corrupt record: valid header, garbage jpeg payload
+    rec.write(struct.pack("<IfQQ", 0, 2.0, 1, 0) + b"\x00" * 64)
+    rec.close()
+    p = _pipe(path, batch_size=2, data_shape=(3, 16, 16),
+              preprocess_threads=1)
+    with pytest.raises(IOError):
+        p.next_batch()
+    p.reset()
+    with pytest.raises(IOError):  # same data still fails, but freshly
+        p.next_batch()
+    p.close()
+
+
+def test_use_native_true_raises_on_png(tmp_path):
+    path = str(tmp_path / "png.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    img = np.zeros((20, 20, 3), np.uint8)
+    rec.write(recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), img,
+                                img_fmt=".png"))
+    rec.close()
+    from tpu_mx.io import ImageRecordIter
+    from tpu_mx.base import MXNetError
+    with pytest.raises(MXNetError, match="use_native"):
+        ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                        batch_size=1, use_native=True)

@@ -404,10 +404,8 @@ class ImageRecordIter(DataIter):
                  rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  preprocess_threads=4, prefetch_buffer=4, round_batch=True,
-                 seed=0, **kwargs):
+                 seed=0, use_native=None, **kwargs):
         super().__init__(batch_size)
-        import cv2  # decode backend, as in the reference (OpenCV)
-        self._cv2 = cv2
         self.data_shape = tuple(data_shape)
         check(len(self.data_shape) == 3, "data_shape must be (C,H,W)")
         self.label_width = label_width
@@ -420,6 +418,43 @@ class ImageRecordIter(DataIter):
         self.rng = np.random.RandomState(seed)
         self.round_batch = round_batch
 
+        # native C++ pipeline (native/tpumx_io.cpp): threaded decode+augment
+        # in one shared library — the hot path for training (SURVEY §3.5).
+        # Python/cv2 path remains for PNG records and round_batch=False.
+        self._native = None
+        native_ok = (round_batch and self.data_shape[0] == 3 and
+                     self._first_record_is_jpeg(path_imgrec))
+        if use_native and not native_ok:
+            raise MXNetError(
+                "use_native=True requires JPEG records, round_batch=True and "
+                "3-channel data_shape")
+        if use_native is not False and native_ok:
+            try:
+                from ..lib.recordio_cpp import NativeImagePipe
+                self._native = NativeImagePipe(
+                    path_imgrec, batch_size=batch_size,
+                    data_shape=self.data_shape, resize=resize,
+                    rand_crop=rand_crop, rand_mirror=rand_mirror,
+                    mean=self.mean, std=self.std,
+                    preprocess_threads=preprocess_threads,
+                    prefetch_buffer=prefetch_buffer, shuffle=shuffle,
+                    seed=seed, label_width=label_width)
+            except Exception as e:
+                if use_native:
+                    raise
+                import warnings
+                warnings.warn(f"native io unavailable ({e}); "
+                              "using the Python pipeline")
+        if self._native is not None:
+            n = len(self._native)
+            self._nat_batches = (n + batch_size - 1) // batch_size
+            self._nat_pad = self._nat_batches * batch_size - n
+            self._nat_seen = 0
+            self._pad = 0
+            return
+
+        import cv2  # decode backend, as in the reference (OpenCV)
+        self._cv2 = cv2
         from ..recordio import MXRecordIO, MXIndexedRecordIO, unpack
         self._unpack = unpack
         if path_imgidx and os.path.isfile(path_imgidx):
@@ -449,7 +484,28 @@ class ImageRecordIter(DataIter):
             self.batch_size, self.label_width)
         return [DataDesc("softmax_label", shp)]
 
+    @staticmethod
+    def _first_record_is_jpeg(path):
+        """The native pipeline decodes JPEG only; peek the first record's
+        payload magic (after the IRHeader + any extra labels)."""
+        try:
+            from ..recordio import MXRecordIO, unpack
+            r = MXRecordIO(path, "r")
+            raw = r.read()
+            r.close()
+            if raw is None:
+                return False
+            _, payload = unpack(raw)
+            return bytes(payload[:2]) == b"\xff\xd8"
+        except Exception:
+            return False
+
     def reset(self):
+        if self._native is not None:
+            self._native.reset()
+            self._nat_seen = 0
+            self._pad = 0
+            return
         if self.shuffle:
             self.rng.shuffle(self._order)
         self._cursor = 0
@@ -497,6 +553,15 @@ class ImageRecordIter(DataIter):
         return img.transpose(2, 0, 1), label
 
     def iter_next(self):
+        if self._native is not None:
+            out = self._native.next_batch()
+            if out is None:
+                return False
+            self._data, self._label = out
+            self._nat_seen += 1
+            self._pad = self._nat_pad if self._nat_seen == self._nat_batches \
+                else 0
+            return True
         n = len(self._order)
         if self._cursor >= n:
             return False

@@ -1,0 +1,47 @@
+"""Native components of the framework (C++, built lazily with g++).
+
+The reference ships its data pipeline and runtime as C++
+(REF:src/io/**, REF:src/engine/**); here the compute/scheduling side is
+XLA's job, but the host-side input pipeline is genuinely CPU-bound
+(SURVEY §7.3 hard-part 5), so it is native too: ``native/tpumx_io.cpp``
+is compiled on first use into ``libtpumx_io.so`` next to this package.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+
+_LIB_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_LIB_DIR, os.pardir, os.pardir, "native", "tpumx_io.cpp")
+_SO = os.path.join(_LIB_DIR, "libtpumx_io.so")
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def ensure_built():
+    """Compile the native library if missing or stale; returns the .so path."""
+    src = os.path.abspath(_SRC)
+    if not os.path.isfile(src):
+        raise NativeBuildError(f"native source not found: {src}")
+    if os.path.isfile(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return _SO
+    # build to a per-pid temp path then rename: atomic for concurrent
+    # data-parallel processes racing to build on one machine
+    tmp = f"{_SO}.build.{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-funroll-loops", "-std=c++17",
+           "-shared", "-fPIC", src, "-o", tmp, "-ljpeg", "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True,
+                       timeout=300)
+        os.replace(tmp, _SO)
+    except FileNotFoundError as e:
+        raise NativeBuildError(f"g++ not available: {e}") from e
+    except subprocess.CalledProcessError as e:
+        raise NativeBuildError(
+            f"native build failed:\n{e.stderr[-4000:]}") from e
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return _SO

@@ -1,0 +1,106 @@
+"""ctypes binding for the native data pipeline (native/tpumx_io.cpp).
+
+The analog of the reference's Python→C crossing for its iterators
+(REF:src/c_api — MXDataIterNext etc.), done with ctypes because pybind11
+is not in the image.  All blocking calls release the GIL (ctypes does this
+for foreign calls), so the C++ worker threads overlap with Python.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import ensure_built
+
+__all__ = ["NativeImagePipe"]
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        path = ensure_built()
+        lib = ctypes.CDLL(path)
+        lib.tmx_pipe_create.restype = ctypes.c_void_p
+        lib.tmx_pipe_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.tmx_pipe_next.restype = ctypes.c_int
+        lib.tmx_pipe_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float)]
+        lib.tmx_pipe_size.restype = ctypes.c_longlong
+        lib.tmx_pipe_size.argtypes = [ctypes.c_void_p]
+        lib.tmx_pipe_reset.restype = None
+        lib.tmx_pipe_reset.argtypes = [ctypes.c_void_p]
+        lib.tmx_pipe_error.restype = ctypes.c_char_p
+        lib.tmx_pipe_error.argtypes = [ctypes.c_void_p]
+        lib.tmx_pipe_destroy.restype = None
+        lib.tmx_pipe_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class NativeImagePipe:
+    """Threaded RecordIO→JPEG→augment→NCHW pipeline running in C++."""
+
+    def __init__(self, path_imgrec, batch_size, data_shape, resize=-1,
+                 rand_crop=False, rand_mirror=False, mean=(0.0, 0.0, 0.0),
+                 std=(1.0, 1.0, 1.0), preprocess_threads=4,
+                 prefetch_buffer=4, shuffle=False, seed=0, label_width=1):
+        lib = _load()
+        c, h, w = data_shape
+        mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
+        std_arr = (ctypes.c_float * 3)(*[float(s) for s in std])
+        err = ctypes.create_string_buffer(1024)
+        self._h = lib.tmx_pipe_create(
+            path_imgrec.encode(), batch_size, c, h, w,
+            int(resize), int(bool(rand_crop)), int(bool(rand_mirror)),
+            mean_arr, std_arr, int(preprocess_threads), int(prefetch_buffer),
+            int(bool(shuffle)), int(seed), int(label_width), err, len(err))
+        if not self._h:
+            raise IOError("NativeImagePipe: %s" %
+                          err.value.decode(errors="replace"))
+        self._lib = lib
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+    def __len__(self):
+        return int(self._lib.tmx_pipe_size(self._h))
+
+    def next_batch(self):
+        """Returns (data, label) fresh arrays, or None at epoch end.  The
+        C++ side fills the arrays directly — one copy total."""
+        data = np.empty((self.batch_size,) + self.data_shape, np.float32)
+        label = np.empty((self.batch_size, self.label_width), np.float32)
+        n = self._lib.tmx_pipe_next(
+            self._h,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n < 0:
+            raise IOError("NativeImagePipe: %s" %
+                          self._lib.tmx_pipe_error(self._h).decode(
+                              errors="replace"))
+        if n == 0:
+            return None
+        return data, label[:, 0] if self.label_width == 1 else label
+
+    def reset(self):
+        self._lib.tmx_pipe_reset(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.tmx_pipe_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
