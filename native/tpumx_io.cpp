@@ -91,7 +91,10 @@ struct RecFile {
           return false;
         }
         cur.parts.emplace_back(payload_at, len);
-        cur.total += len;
+        // parts of a split record are rejoined WITH the magic word between
+        // them (the writer split exactly at payload-embedded magics —
+        // recordio.py MXRecordIO.read does _MAGIC_BYTES.join(parts))
+        cur.total += 4 + len;
         if (cflag == 3) {
           records.push_back(std::move(cur));
           in_split = false;
@@ -108,7 +111,13 @@ struct RecFile {
     out->resize(e.total);
     uint8_t* dst = out->data();
     std::lock_guard<std::mutex> lk(io_mu);
+    bool first = true;
     for (const auto& p : e.parts) {
+      if (!first) {
+        memcpy(dst, &kMagic, 4);  // re-insert the split delimiter
+        dst += 4;
+      }
+      first = false;
       if (fseek(fp, static_cast<long>(p.first), SEEK_SET) != 0) return false;
       if (fread(dst, 1, p.second, fp) != p.second) return false;
       dst += p.second;

@@ -1,0 +1,89 @@
+"""Custom operator tests (reference analog:
+tests/python/unittest/test_operator.py::test_custom_op)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd, autograd
+from tpu_mx.base import MXNetError
+
+
+@mx.operator.register("sq")
+class SquareProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class Square(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0],
+                            2.0 * in_data[0] * out_grad[0])
+        return Square()
+
+
+def test_custom_forward():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = nd.Custom(x, op_type="sq")
+    np.testing.assert_array_equal(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_custom_backward():
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sq")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * xv, rtol=1e-6)
+
+
+def test_custom_composes_with_builtin_ops():
+    xv = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x * 2.0, op_type="sq")  # (2x)^2 = 4x^2
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 8 * xv, rtol=1e-5)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(MXNetError, match="not registered"):
+        nd.Custom(nd.array(np.ones(3)), op_type="nope")
+
+
+def test_custom_multi_output():
+    @mx.operator.register("split2")
+    class Split2Prop(mx.operator.CustomOpProp):
+        def list_outputs(self):
+            return ["a", "b"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0], in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Split2(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 1.0)
+                    self.assign(out_data[1], req[1], in_data[0] * 3.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                out_grad[0] + 3.0 * out_grad[1])
+            return Split2()
+
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.Custom(x, op_type="split2")
+        loss = (a + b).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.full((2, 2), 4.0), rtol=1e-6)

@@ -204,3 +204,68 @@ def test_use_native_true_raises_on_png(tmp_path):
     with pytest.raises(MXNetError, match="use_native"):
         ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
                         batch_size=1, use_native=True)
+
+
+def test_native_split_record_roundtrip(tmp_path):
+    """Records whose payload embeds the RecordIO magic are split by the
+    dmlc writer; the native scanner must rejoin them with the magic bytes
+    (recordio.py MXRecordIO.read does _MAGIC_BYTES.join)."""
+    import struct
+    path = str(tmp_path / "split.rec")
+    magic = struct.pack("<I", 0xCED7230A)
+    img = np.random.RandomState(3).randint(0, 255, (40, 40, 3), np.uint8)
+    payload = recordio.pack_img(recordio.IRHeader(0, 7.0, 0, 0), img)
+    # hand-write a dmlc-style split record: parts joined by magic
+    cut = len(payload) // 2
+    parts = [payload[:cut], payload[cut:]]
+    joined = (magic + b"".join(parts[0:1]) + magic + parts[1])
+    with open(path, "wb") as f:
+        def emit(cflag, data):
+            lrec = (cflag << 29) | len(data)
+            f.write(magic + struct.pack("<I", lrec) + data)
+            f.write(b"\x00" * ((4 - len(data) % 4) % 4))
+        emit(1, parts[0])
+        emit(3, parts[1])
+    # python reader oracle
+    r = recordio.MXRecordIO(path, "r")
+    raw = r.read()
+    r.close()
+    assert raw == parts[0] + magic + parts[1]
+    # the native pipe must decode it identically IF the rejoined payload is
+    # a valid record; here the magic falls inside the jpeg stream, so just
+    # check the pipe parses the file into exactly one record
+    from tpu_mx.lib.recordio_cpp import NativeImagePipe
+    p = NativeImagePipe(path, batch_size=1, data_shape=(3, 16, 16),
+                        preprocess_threads=1)
+    assert len(p) == 1
+    p.close()
+
+
+def test_sparse_dot_transpose_b():
+    from tpu_mx.ndarray import sparse
+    from tpu_mx import nd
+    dense = np.zeros((2, 3), np.float32)
+    dense[0, 1], dense[1, 2] = 2.0, 3.0
+    csr = sparse.csr_matrix(dense)
+    rhs = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+    out = sparse.dot(csr, nd.array(rhs), transpose_b=True)
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs.T, rtol=1e-5)
+    # dense · csr with transpose_a
+    lhs = np.random.RandomState(1).rand(2, 5).astype(np.float32)
+    out2 = sparse.dot(nd.array(lhs), csr, transpose_a=True)
+    np.testing.assert_allclose(out2.asnumpy(), lhs.T @ dense, rtol=1e-5)
+
+
+def test_libsvm_sparse_labels(tmp_path):
+    d = tmp_path / "d.libsvm"
+    l = tmp_path / "l.libsvm"
+    d.write_text("0 0:1.0\n0 1:2.0\n")
+    l.write_text("0 0:1.0 2:5.0\n0 1:3.0\n")
+    from tpu_mx.io import LibSVMIter
+    it = LibSVMIter(data_libsvm=str(d), data_shape=(3,), batch_size=2,
+                    label_libsvm=str(l), label_shape=(3,))
+    assert it.getpad() == 0  # before first batch: must not crash
+    b = next(iter(it))
+    np.testing.assert_array_equal(
+        b.label[0].asnumpy(),
+        np.array([[1.0, 0.0, 5.0], [0.0, 3.0, 0.0]], np.float32))

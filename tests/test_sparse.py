@@ -1,0 +1,124 @@
+"""Sparse NDArray tests (reference analog:
+tests/python/unittest/test_sparse_ndarray.py, test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+from tpu_mx.ndarray import sparse
+
+
+def dense_csr_pair(m=6, n=5, density=0.4, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.rand(m, n).astype(np.float32)
+    dense[rng.rand(m, n) > density] = 0.0
+    return dense, sparse.csr_matrix(dense)
+
+
+def test_csr_roundtrip():
+    dense, csr = dense_csr_pair()
+    assert csr.stype == "csr"
+    assert csr.shape == dense.shape
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+    # 3-tuple construction matches scipy-style layout
+    csr2 = sparse.csr_matrix((csr.data, csr.indices, csr.indptr),
+                             shape=dense.shape)
+    np.testing.assert_array_equal(csr2.asnumpy(), dense)
+
+
+def test_csr_nnz_and_slice():
+    dense, csr = dense_csr_pair()
+    assert csr.nnz == int((dense != 0).sum())
+    sl = csr.slice(1, 4)
+    np.testing.assert_array_equal(sl.asnumpy(), dense[1:4])
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((8, 3), np.float32)
+    dense[[1, 4, 6]] = np.random.RandomState(0).rand(3, 3)
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert list(rsp.indices.asnumpy()) == [1, 4, 6]
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+    rsp2 = sparse.row_sparse_array((rsp.data, rsp.indices), shape=(8, 3))
+    np.testing.assert_array_equal(rsp2.asnumpy(), dense)
+
+
+def test_dot_csr_dense():
+    dense, csr = dense_csr_pair()
+    rhs = nd.array(np.random.RandomState(1).rand(5, 4).astype(np.float32))
+    out = sparse.dot(csr, rhs)
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dot_csr_transpose():
+    dense, csr = dense_csr_pair()
+    rhs = nd.array(np.random.RandomState(2).rand(6, 4).astype(np.float32))
+    out = sparse.dot(csr, rhs, transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), dense.T @ rhs.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tostype_and_cast_storage():
+    dense, _ = dense_csr_pair()
+    a = nd.array(dense)
+    assert a.stype == "default"
+    csr = a.tostype("csr")
+    assert csr.stype == "csr"
+    back = csr.tostype("default")
+    np.testing.assert_array_equal(back.asnumpy(), dense)
+    rsp = sparse.cast_storage(a, "row_sparse")
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+
+
+def test_retain():
+    dense = np.zeros((10, 2), np.float32)
+    dense[[2, 5, 7]] = 1.0
+    rsp = sparse.row_sparse_array(dense)
+    kept = sparse.retain(rsp, nd.array(np.array([5, 7], np.int32)))
+    expect = np.zeros_like(dense)
+    expect[[5, 7]] = 1.0
+    np.testing.assert_array_equal(kept.asnumpy(), expect)
+
+
+def test_rowsparse_add_accumulates_duplicates():
+    a = sparse.row_sparse_array((np.ones((2, 3), np.float32),
+                                 np.array([1, 2])), shape=(5, 3))
+    b = sparse.row_sparse_array((np.ones((2, 3), np.float32),
+                                 np.array([2, 4])), shape=(5, 3))
+    s = sparse.elemwise_add(a, b)
+    expect = np.zeros((5, 3), np.float32)
+    expect[[1, 4]] = 1.0
+    expect[2] = 2.0
+    np.testing.assert_array_equal(s.asnumpy(), expect)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("csr", (4, 6))
+    assert z.stype == "csr" and z.nnz == 0
+    np.testing.assert_array_equal(z.asnumpy(), np.zeros((4, 6)))
+    zr = sparse.zeros("row_sparse", (4, 6))
+    np.testing.assert_array_equal(zr.asnumpy(), np.zeros((4, 6)))
+
+
+def test_dense_ops_reject_sparse():
+    _, csr = dense_csr_pair()
+    with pytest.raises(Exception):
+        nd.dot(csr, csr)  # dense namespace must not silently densify
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 4:0.5\n0 0:2.0\n")
+    from tpu_mx.io import LibSVMIter
+    it = LibSVMIter(data_libsvm=str(p), data_shape=(5,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr"
+    expect = np.zeros((2, 5), np.float32)
+    expect[0, 0], expect[0, 3] = 1.5, 2.0
+    expect[1, 1] = 1.0
+    np.testing.assert_array_equal(b0.data[0].asnumpy(), expect)
+    np.testing.assert_array_equal(b0.label[0].asnumpy(), [1.0, 0.0])

@@ -38,3 +38,4 @@ from . import profiler
 from . import monitor
 from . import runtime
 from . import engine
+from . import operator

@@ -1,7 +1,9 @@
 """Data iterators — the ``mx.io`` surface (REF:python/mxnet/io/io.py +
 the C++ iterators of REF:src/io/).  See ``tpu_mx/io/io.py``."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, MNISTIter, CSVIter, ImageRecordIter)
+                 PrefetchingIter, MNISTIter, CSVIter, ImageRecordIter,
+                 LibSVMIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter"]
+           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
+           "LibSVMIter"]

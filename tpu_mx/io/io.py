@@ -388,6 +388,106 @@ class CSVIter(NDArrayIter):
                          last_batch_handle="pad" if round_batch else "discard")
 
 
+class LibSVMIter(DataIter):
+    """LibSVM text reader (REF:src/io/iter_libsvm.cc): lines of
+    ``label idx:val idx:val ...`` batched as CSR matrices; labels may
+    themselves be sparse (``label_libsvm``)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=(1,), round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._num_features = int(np.prod(data_shape))
+        self._label_shape = tuple(label_shape)
+        label_dim = int(np.prod(self._label_shape))
+        self._rows, scalars = self._parse(data_libsvm)
+        if label_libsvm:
+            lab_rows, _ = self._parse(label_libsvm)
+            if len(lab_rows) != len(self._rows):
+                raise MXNetError("label_libsvm row count != data rows")
+            self._labels = []
+            for r in lab_rows:
+                vec = np.zeros(label_dim, np.float32)
+                for k, v in r:
+                    vec[k] = v
+                self._labels.append(vec)
+        elif label_dim > 1:
+            self._labels = []
+            for s in scalars:
+                vec = np.zeros(label_dim, np.float32)
+                vec[0] = s
+                self._labels.append(vec)
+        else:
+            self._labels = scalars
+        self.round_batch = round_batch
+        self.reset()
+
+    @staticmethod
+    def _parse(path):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                rows.append([(int(k), float(v)) for k, v in
+                             (p.split(":") for p in parts[1:])])
+        return rows, labels
+
+    def reset(self):
+        self._cursor = 0
+        self._pad = 0
+
+    def iter_next(self):
+        n = len(self._rows)
+        if self._cursor >= n:
+            return False
+        end = self._cursor + self.batch_size
+        self._pad = max(0, end - n)
+        if self._pad and not self.round_batch:
+            return False
+        sel = [(self._cursor + i) % n for i in range(self.batch_size)]
+        self._cursor = min(end, n)
+        data, indices, indptr = [], [], [0]
+        labels = []
+        for i in sel:
+            for k, v in self._rows[i]:
+                indices.append(k)
+                data.append(v)
+            indptr.append(len(data))
+            labels.append(self._labels[i])
+        from ..ndarray import sparse as _sparse
+        self._data_batch = _sparse.csr_matrix(
+            (np.asarray(data, np.float32), np.asarray(indices, np.int32),
+             np.asarray(indptr, np.int32)),
+            shape=(self.batch_size, self._num_features))
+        lab = np.asarray(labels, np.float32)
+        if lab.ndim > 1:
+            lab = lab.reshape((self.batch_size,) + self._label_shape)
+        self._label_batch = nd.array(lab)
+        return True
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._num_features))]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if int(np.prod(self._label_shape)) == 1 \
+            else (self.batch_size,) + self._label_shape
+        return [DataDesc("softmax_label", shp)]
+
+    def getdata(self):
+        return [self._data_batch]
+
+    def getlabel(self):
+        return [self._label_batch]
+
+    def getpad(self):
+        return self._pad
+
+
 class ImageRecordIter(DataIter):
     """RecordIO image pipeline (REF:src/io/iter_image_recordio_2.cc):
     threaded JPEG decode + augmentation + NCHW batching, prefetched.
@@ -485,18 +585,26 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shp)]
 
     @staticmethod
-    def _first_record_is_jpeg(path):
-        """The native pipeline decodes JPEG only; peek the first record's
-        payload magic (after the IRHeader + any extra labels)."""
+    def _first_record_is_jpeg(path, sample=8):
+        """The native pipeline decodes JPEG only; peek the payload magic of
+        the first few records (a mixed-format file beyond the sample still
+        fails mid-epoch — use use_native=False for those)."""
         try:
             from ..recordio import MXRecordIO, unpack
             r = MXRecordIO(path, "r")
-            raw = r.read()
-            r.close()
-            if raw is None:
-                return False
-            _, payload = unpack(raw)
-            return bytes(payload[:2]) == b"\xff\xd8"
+            seen = 0
+            try:
+                for _ in range(sample):
+                    raw = r.read()
+                    if raw is None:
+                        break
+                    _, payload = unpack(raw)
+                    if bytes(payload[:2]) != b"\xff\xd8":
+                        return False
+                    seen += 1
+            finally:
+                r.close()
+            return seen > 0
         except Exception:
             return False
 

@@ -21,9 +21,14 @@ class NativeBuildError(RuntimeError):
 
 
 def ensure_built():
-    """Compile the native library if missing or stale; returns the .so path."""
+    """Compile the native library if missing or stale; returns the .so path.
+    The .so is never shipped (built with -march=native for THIS machine);
+    an installed layout without the C++ source uses whatever .so is
+    present."""
     src = os.path.abspath(_SRC)
     if not os.path.isfile(src):
+        if os.path.isfile(_SO):
+            return _SO
         raise NativeBuildError(f"native source not found: {src}")
     if os.path.isfile(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
         return _SO

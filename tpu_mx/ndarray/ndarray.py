@@ -100,6 +100,13 @@ class NDArray:
     def stype(self):
         return "default"
 
+    def tostype(self, stype):
+        """Convert to another storage type (csr / row_sparse / default)."""
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+        return _sparse.cast_storage(self, stype)
+
     @property
     def grad(self):
         return self._grad

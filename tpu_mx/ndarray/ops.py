@@ -1278,3 +1278,13 @@ random = _RandomNS()
 uniform = random_uniform
 normal = random_normal
 randn = lambda *shape, **kw: random_normal(shape=shape, **kw)
+
+
+def Custom(*args, op_type=None, **op_params):
+    """User-registered custom op (REF:src/operator/custom/custom.cc);
+    register with @mx.operator.register(name), invoke as
+    nd.Custom(x, ..., op_type=name, **params)."""
+    from .. import operator as _op_mod
+    if op_type is None:
+        raise ValueError("Custom requires op_type=")
+    return _op_mod._invoke_custom(args, op_type, **op_params)

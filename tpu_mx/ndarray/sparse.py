@@ -1,0 +1,303 @@
+"""Sparse NDArray: `row_sparse` and `csr` storage types
+(REF:python/mxnet/ndarray/sparse.py, REF:include/mxnet/ndarray.h storage
+types, REF:src/operator/tensor/dot.cc sparse kernels).
+
+TPU divergence note (SURVEY §7.3 hard-part 4): TPUs have no sparse memory
+format — XLA computes on dense tiles.  Storage here is genuinely compact
+(index + value arrays on device), and the compute kernels are expressed as
+gather + segment-sum, which XLA lowers to TPU-efficient embedding-style
+ops.  `row_sparse` exists chiefly as the gradient type of Embedding-like
+lookups (the reference's main use), `csr` for sample-feature matrices
+(LibSVM-style input).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ndarray import NDArray
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "dot", "retain",
+           "cast_storage", "elemwise_add", "tostype"]
+
+
+class BaseSparseNDArray:
+    """Common surface of the compressed formats.  Deliberately NOT an
+    NDArray subclass: dense ops must not silently consume compressed
+    handles (the reference raises the same way via storage-type dispatch)."""
+
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return str(self._values.dtype)
+
+    @property
+    def context(self):
+        return NDArray(self._values).context
+
+    ctx = context
+
+    def asnumpy(self):
+        return np.asarray(self.todense()._data)
+
+    def astype(self, dtype):
+        out = self.copy()
+        out._values = out._values.astype(dtype)
+        return out
+
+    def wait_to_read(self):
+        self._values.block_until_ready()
+        return self
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__, self._shape,
+                                  self.context)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (2D).  data/indices/indptr layout is
+    bit-compatible with the reference's csr storage."""
+
+    def __init__(self, data, indices, indptr, shape):
+        self._values = jnp.asarray(data)
+        self._indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._indptr = jnp.asarray(indptr, dtype=jnp.int32)
+        self._shape = tuple(shape)
+        if len(self._shape) != 2:
+            raise ValueError("csr storage is 2-D only")
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return NDArray(self._values)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr)
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def copy(self):
+        return CSRNDArray(self._values, self._indices, self._indptr,
+                          self._shape)
+
+    def _row_ids(self):
+        """nnz-length row id per stored element, from indptr: TPU-friendly
+        (one searchsorted, no host loop)."""
+        nnz = self._values.shape[0]
+        return jnp.searchsorted(self._indptr[1:], jnp.arange(nnz),
+                                side="right").astype(jnp.int32)
+
+    def todense(self):
+        rows = self._row_ids()
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        return NDArray(dense.at[rows, self._indices].add(self._values))
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(f"cannot convert csr to {stype}")
+
+    def slice(self, start, stop):
+        """Row slice (the reference supports csr row slicing)."""
+        start, stop = int(start), int(stop)
+        ptr = self._indptr[start:stop + 1]
+        lo, hi = int(ptr[0]), int(ptr[-1])
+        return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],
+                          ptr - ptr[0], (stop - start, self._shape[1]))
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim sparse tensor: (indices, values) where values[i] is the
+    full row `indices[i]`.  The gradient type of embedding lookups."""
+
+    def __init__(self, data, indices, shape):
+        self._values = jnp.asarray(data)
+        self._indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._shape = tuple(shape)
+        if self._values.shape[0] != self._indices.shape[0]:
+            raise ValueError("row_sparse: len(data) != len(indices)")
+        if self._values.shape[1:] != self._shape[1:]:
+            raise ValueError("row_sparse: row shape mismatch")
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return NDArray(self._values)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    def copy(self):
+        return RowSparseNDArray(self._values, self._indices, self._shape)
+
+    def todense(self):
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        # .add (not .set): duplicate indices accumulate, matching the
+        # reference's reduce-on-conversion semantics for unmerged grads
+        return NDArray(dense.at[self._indices].add(self._values))
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError(f"cannot convert row_sparse to {stype}")
+
+
+# ----------------------------------------------------------------------------
+# constructors (REF sparse.py csr_matrix / row_sparse_array)
+# ----------------------------------------------------------------------------
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """``csr_matrix((data, indices, indptr), shape)`` or from a dense
+    array/NDArray."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = (_unwrap(a) for a in arg1)
+        if dtype is not None:
+            data = data.astype(dtype)
+        if shape is None:
+            raise ValueError("shape is required for the 3-tuple form")
+        return CSRNDArray(data, indices, indptr, shape)
+    dense = np.asarray(_unwrap(arg1))
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    if dense.ndim != 2:
+        raise ValueError("csr_matrix: dense input must be 2-D")
+    mask = dense != 0
+    indptr = np.concatenate([[0], np.cumsum(mask.sum(axis=1))]).astype(np.int32)
+    indices = np.nonzero(mask)[1].astype(np.int32)
+    data = dense[mask]
+    return CSRNDArray(data, indices, indptr, dense.shape)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """``row_sparse_array((data, indices), shape)`` or from dense."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = (_unwrap(a) for a in arg1)
+        if dtype is not None:
+            data = data.astype(dtype)
+        if shape is None:
+            raise ValueError("shape is required for the 2-tuple form")
+        return RowSparseNDArray(data, indices, shape)
+    dense = np.asarray(_unwrap(arg1))
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    nz_rows = np.nonzero(np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+    return RowSparseNDArray(dense[nz_rows], nz_rows.astype(np.int32),
+                            dense.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
+                                jnp.zeros((0,), jnp.int32), shape)
+    return NDArray(jnp.zeros(shape, dtype))
+
+
+# ----------------------------------------------------------------------------
+# ops
+# ----------------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot.  csr·dense (fwd) and csrᵀ·dense are the two
+    kernels the reference optimizes (REF:src/operator/tensor/dot-inl.h);
+    both lower to gather + segment_sum on TPU."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        rows = lhs._row_ids()
+        vals = lhs._values
+        cols = lhs._indices
+        rhs_mat = rhs._data.T if transpose_b else rhs._data
+        if not transpose_a:
+            contrib = vals[:, None] * rhs_mat[cols]              # (nnz, N)
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=lhs._shape[0])
+            return NDArray(out)
+        # csrᵀ · dense: scatter by column id
+        contrib = vals[:, None] * rhs_mat[rows]
+        out = jax.ops.segment_sum(contrib, cols,
+                                  num_segments=lhs._shape[1])
+        return NDArray(out)
+    if isinstance(lhs, NDArray) and isinstance(rhs, CSRNDArray):
+        # dense · csr = (csrᵀ · denseᵀ)ᵀ
+        lhs_mat = lhs._data.T if transpose_a else lhs._data
+        return NDArray(dot(rhs, NDArray(lhs_mat.T),
+                           transpose_a=not transpose_b)._data.T)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        from . import ops
+        return ops.dot(lhs, rhs, transpose_a=transpose_a,
+                       transpose_b=transpose_b)
+    raise TypeError(f"sparse.dot: unsupported operands "
+                    f"({type(lhs).__name__}, {type(rhs).__name__})")
+
+
+def retain(rsp, indices):
+    """Keep only the listed rows of a row_sparse array
+    (REF sparse_retain op — used by the sparse optimizer path)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    want = _unwrap(indices).astype(jnp.int32)
+    # membership mask over stored rows (static shapes: O(k·m) compare)
+    keep = (rsp._indices[:, None] == want[None, :]).any(axis=1)
+    kept_idx = np.nonzero(np.asarray(keep))[0]
+    return RowSparseNDArray(rsp._values[kept_idx], rsp._indices[kept_idx],
+                            rsp._shape)
+
+
+def cast_storage(arr, stype):
+    """Dense ⇄ sparse conversion (REF cast_storage op)."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    if stype == "csr":
+        return csr_matrix(arr)
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    raise ValueError(f"unknown storage type {stype}")
+
+
+def elemwise_add(a, b):
+    """row_sparse + row_sparse → row_sparse (gradient accumulation)."""
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        if a._shape != b._shape:
+            raise ValueError("shape mismatch")
+        idx = jnp.concatenate([a._indices, b._indices])
+        vals = jnp.concatenate([a._values, b._values])
+        return RowSparseNDArray(vals, idx, a._shape)
+    return cast_storage(a, "default") + cast_storage(b, "default")
+
+
+def tostype(arr, stype):
+    return cast_storage(arr, stype)

@@ -1,0 +1,140 @@
+"""Custom operators: user-defined ops with Python forward/backward
+(REF:src/operator/custom/custom.cc, REF:python/mxnet/operator.py).
+
+The reference integrates Python CustomOps into its engine via registered
+callbacks; here the imperative tape plays the engine's role, so a custom
+op is a tape node whose pullback calls the user's ``backward``.  The same
+three-class shape is kept — ``CustomOp`` (kernels), ``CustomOpProp``
+(shape/type inference + op metadata), ``register`` — and invocation via
+``mx.nd.Custom(*args, op_type=name)``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_registry = {}
+
+
+class CustomOp:
+    """Base class: override ``forward`` and ``backward``.  Use
+    ``self.assign(dst, req, src)`` to honor the write/add/null grad_req
+    protocol like the reference."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Metadata provider: shapes/dtypes/arg names + op factory."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Class decorator: ``@mx.operator.register("my_op")`` on a
+    CustomOpProp subclass."""
+    def wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _registry[reg_name] = prop_cls
+        return prop_cls
+    return wrap
+
+
+def get_all_registered():
+    return dict(_registry)
+
+
+def _invoke_custom(args, op_type, **op_params):
+    """Imperative entry used by mx.nd.Custom — builds the op, runs forward,
+    and records a tape node whose pullback runs the user's backward."""
+    from . import autograd
+    from .ndarray import NDArray
+    from .context import current_context
+    import jax.numpy as jnp
+
+    if op_type not in _registry:
+        raise MXNetError(
+            f"custom op {op_type!r} is not registered "
+            f"(known: {sorted(_registry)})")
+    prop = _registry[op_type](**op_params)
+
+    in_shapes = [tuple(a.shape) for a in args]
+    in_types = [a.dtype for a in args]
+    _, out_shapes, aux_shapes = prop.infer_shape(list(in_shapes))
+    _, out_types, _ = prop.infer_type(list(in_types))
+    op = prop.create_operator(current_context(), in_shapes, in_types)
+
+    in_data = list(args)
+    out_data = [NDArray(jnp.zeros(s, t))
+                for s, t in zip(out_shapes, out_types)]
+    aux = [NDArray(jnp.zeros(s, "float32")) for s in aux_shapes]
+
+    with autograd.pause():
+        op.forward(is_train=autograd.is_recording(),
+                   req=["write"] * len(out_data),
+                   in_data=in_data, out_data=out_data, aux=aux)
+
+    if autograd._needs_tape(in_data):
+        single_out = len(out_data) == 1
+
+        def vjp_fn(out_ct):
+            cts = (out_ct,) if single_out else tuple(out_ct)
+            in_grad = [NDArray(jnp.zeros(s, t))
+                       for s, t in zip(in_shapes, in_types)]
+            with autograd.pause():
+                op.backward(req=["write"] * len(in_grad),
+                            out_grad=[NDArray(c) for c in cts],
+                            in_data=in_data, out_data=out_data,
+                            in_grad=in_grad, aux=aux)
+            return tuple(g._data for g in in_grad)
+
+        autograd._record_op(vjp_fn, list(in_data), list(out_data),
+                            name=f"Custom[{op_type}]")
+
+    return out_data[0] if len(out_data) == 1 else out_data
