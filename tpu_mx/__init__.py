@@ -9,6 +9,12 @@ Import surface mirrors the reference's `import mxnet as mx`:
 """
 __version__ = "0.1.0"
 
+# Multi-process boot must precede any JAX computation, so it happens at
+# import time from the launcher's env protocol — the analog of the
+# reference's LibraryInitializer reading DMLC_ROLE (REF:src/initialize.cc).
+from .base import dist_boot as _dist_boot
+_dist_boot()
+
 from . import base
 from .base import MXNetError
 from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,

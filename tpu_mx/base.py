@@ -12,7 +12,8 @@ import logging
 import numbers
 import os
 
-__all__ = ["Registry", "MXNetError", "check", "get_env", "string_types", "numeric_types"]
+__all__ = ["Registry", "MXNetError", "check", "get_env", "dist_boot",
+           "string_types", "numeric_types"]
 
 logging.basicConfig(level=os.environ.get("TPU_MX_LOG_LEVEL", "INFO"))
 logger = logging.getLogger("tpu_mx")
@@ -80,3 +81,24 @@ class Registry:
 
     def keys(self):
         return sorted(self._entries)
+
+
+def dist_boot():
+    """Join the multi-process collective group from the launcher env
+    (tools/launch.py: TPUMX_COORDINATOR / TPUMX_NUM_PROC / TPUMX_PROC_ID —
+    the DMLC_PS_ROOT_URI analog).  Must run before any JAX computation.
+    Returns True iff this process is part of a formed group."""
+    import os
+    coord = os.environ.get("TPUMX_COORDINATOR")
+    if not coord:
+        return False
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["TPUMX_NUM_PROC"]),
+            process_id=int(os.environ["TPUMX_PROC_ID"]))
+        return True
+    except RuntimeError:
+        # already initialized (import-time boot) — verify membership
+        return jax.process_count() == int(os.environ["TPUMX_NUM_PROC"])

@@ -4,6 +4,7 @@
 # factory function, shadowing the submodule attribute on this package
 from . import alexnet as _alexnet
 from . import densenet as _densenet
+from . import inception as _inception
 from . import mobilenet as _mobilenet
 from . import resnet as _resnet
 from . import squeezenet as _squeezenet
@@ -15,9 +16,11 @@ from .vgg import *  # noqa: F401,F403
 from .mobilenet import *  # noqa: F401,F403
 from .squeezenet import *  # noqa: F401,F403
 from .densenet import *  # noqa: F401,F403
+from .inception import *  # noqa: F401,F403
 
 _models = {}
-for _mod in (_resnet, _alexnet, _vgg, _mobilenet, _squeezenet, _densenet):
+for _mod in (_resnet, _alexnet, _vgg, _mobilenet, _squeezenet, _densenet,
+             _inception):
     for _name in _mod.__all__:
         _obj = getattr(_mod, _name)
         if callable(_obj) and _name[0].islower():

@@ -1,0 +1,133 @@
+"""Inception-v3 (REF:model_zoo/vision/inception.py — Szegedy et al. 2015,
+"Rethinking the Inception Architecture for Computer Vision").  299×299
+input; the four mixed-block families (A/B/C/D/E) mirror the reference's
+channel plan exactly."""
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv(channels, kernel, stride=1, padding=0):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(channels, kernel, stride, padding, use_bias=False))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class _Branches(HybridBlock):
+    """Parallel branches concatenated on the channel axis."""
+
+    def __init__(self, branches, **kwargs):
+        super().__init__(**kwargs)
+        self.branches = []
+        for i, b in enumerate(branches):
+            setattr(self, f"b{i}", b)
+            self.branches.append(b)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[b(x) for b in self.branches], dim=1)
+
+
+def _make_A(pool_features):
+    return _Branches([
+        _conv(64, 1),
+        _seq(_conv(48, 1), _conv(64, 5, padding=2)),
+        _seq(_conv(64, 1), _conv(96, 3, padding=1), _conv(96, 3, padding=1)),
+        _seq(nn.AvgPool2D(3, 1, 1), _conv(pool_features, 1)),
+    ])
+
+
+def _make_B():
+    return _Branches([
+        _conv(384, 3, 2),
+        _seq(_conv(64, 1), _conv(96, 3, padding=1), _conv(96, 3, 2)),
+        _seq(nn.MaxPool2D(3, 2)),
+    ])
+
+
+def _make_C(channels_7x7):
+    c = channels_7x7
+    return _Branches([
+        _conv(192, 1),
+        _seq(_conv(c, 1), _conv(c, (1, 7), padding=(0, 3)),
+             _conv(192, (7, 1), padding=(3, 0))),
+        _seq(_conv(c, 1), _conv(c, (7, 1), padding=(3, 0)),
+             _conv(c, (1, 7), padding=(0, 3)),
+             _conv(c, (7, 1), padding=(3, 0)),
+             _conv(192, (1, 7), padding=(0, 3))),
+        _seq(nn.AvgPool2D(3, 1, 1), _conv(192, 1)),
+    ])
+
+
+def _make_D():
+    return _Branches([
+        _seq(_conv(192, 1), _conv(320, 3, 2)),
+        _seq(_conv(192, 1), _conv(192, (1, 7), padding=(0, 3)),
+             _conv(192, (7, 1), padding=(3, 0)), _conv(192, 3, 2)),
+        _seq(nn.MaxPool2D(3, 2)),
+    ])
+
+
+class _MixedE(HybridBlock):
+    """Mixed 7a/7b: branches whose sub-branches themselves fan out."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.b0 = _conv(320, 1)
+        self.b1_stem = _conv(384, 1)
+        self.b1a = _conv(384, (1, 3), padding=(0, 1))
+        self.b1b = _conv(384, (3, 1), padding=(1, 0))
+        self.b2_stem = _seq(_conv(448, 1), _conv(384, 3, padding=1))
+        self.b2a = _conv(384, (1, 3), padding=(0, 1))
+        self.b2b = _conv(384, (3, 1), padding=(1, 0))
+        self.b3 = _seq(nn.AvgPool2D(3, 1, 1), _conv(192, 1))
+
+    def hybrid_forward(self, F, x):
+        y1 = self.b1_stem(x)
+        y2 = self.b2_stem(x)
+        return F.concat(self.b0(x), self.b1a(y1), self.b1b(y1),
+                        self.b2a(y2), self.b2b(y2), self.b3(x), dim=1)
+
+
+def _seq(*blocks):
+    out = nn.HybridSequential()
+    for b in blocks:
+        out.add(b)
+    return out
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        f = nn.HybridSequential()
+        f.add(_conv(32, 3, 2))
+        f.add(_conv(32, 3))
+        f.add(_conv(64, 3, padding=1))
+        f.add(nn.MaxPool2D(3, 2))
+        f.add(_conv(80, 1))
+        f.add(_conv(192, 3))
+        f.add(nn.MaxPool2D(3, 2))
+        f.add(_make_A(32))
+        f.add(_make_A(64))
+        f.add(_make_A(64))
+        f.add(_make_B())
+        f.add(_make_C(128))
+        f.add(_make_C(160))
+        f.add(_make_C(160))
+        f.add(_make_C(192))
+        f.add(_make_D())
+        f.add(_MixedE())
+        f.add(_MixedE())
+        f.add(nn.GlobalAvgPool2D())
+        f.add(nn.Dropout(0.5))
+        self.features = f
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, classes=1000, **kwargs):
+    return Inception3(classes=classes, **kwargs)

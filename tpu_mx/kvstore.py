@@ -26,7 +26,14 @@ from .base import MXNetError, get_env
 from .ndarray import NDArray
 from .optimizer import Updater, create as _create_optimizer
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "create", "dist_init"]
+
+
+def dist_init():
+    """Ensure membership in the launcher's collective group (see
+    base.dist_boot; `import tpu_mx` already boots it)."""
+    from .base import dist_boot
+    return dist_boot()
 
 
 class KVStore:

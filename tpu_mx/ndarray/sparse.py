@@ -121,6 +121,10 @@ class CSRNDArray(BaseSparseNDArray):
     def slice(self, start, stop):
         """Row slice (the reference supports csr row slicing)."""
         start, stop = int(start), int(stop)
+        if not (0 <= start <= stop <= self._shape[0]):
+            raise IndexError(
+                f"csr slice [{start}:{stop}] out of bounds for "
+                f"{self._shape[0]} rows")
         ptr = self._indptr[start:stop + 1]
         lo, hi = int(ptr[0]), int(ptr[-1])
         return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],
@@ -224,7 +228,9 @@ def zeros(stype, shape, ctx=None, dtype="float32"):
     if stype == "row_sparse":
         return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
                                 jnp.zeros((0,), jnp.int32), shape)
-    return NDArray(jnp.zeros(shape, dtype))
+    if stype == "default":
+        return NDArray(jnp.zeros(shape, dtype))
+    raise ValueError(f"unknown storage type {stype!r}")
 
 
 # ----------------------------------------------------------------------------
@@ -289,13 +295,18 @@ def cast_storage(arr, stype):
 
 
 def elemwise_add(a, b):
-    """row_sparse + row_sparse → row_sparse (gradient accumulation)."""
+    """row_sparse + row_sparse → row_sparse (gradient accumulation).
+    The result is canonical: unique sorted indices, duplicates summed —
+    the invariant the reference guarantees for row_sparse."""
     if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
         if a._shape != b._shape:
             raise ValueError("shape mismatch")
-        idx = jnp.concatenate([a._indices, b._indices])
+        idx = np.concatenate([np.asarray(a._indices), np.asarray(b._indices)])
         vals = jnp.concatenate([a._values, b._values])
-        return RowSparseNDArray(vals, idx, a._shape)
+        uniq, inv = np.unique(idx, return_inverse=True)
+        merged = jax.ops.segment_sum(vals, jnp.asarray(inv),
+                                     num_segments=len(uniq))
+        return RowSparseNDArray(merged, uniq.astype(np.int32), a._shape)
     return cast_storage(a, "default") + cast_storage(b, "default")
 
 

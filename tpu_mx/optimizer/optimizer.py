@@ -22,7 +22,7 @@ from ..ndarray.ops import (adam_update_core, sgd_mom_update_core,
                            sgd_update_core)
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "AdaGrad",
-           "AdaDelta", "Ftrl", "Signum", "LAMB", "create", "register", "Updater",
+           "AdaDelta", "Ftrl", "Signum", "LAMB", "LBSGD", "create", "register", "Updater",
            "get_updater", "registry"]
 
 registry = Registry("optimizer")
@@ -158,6 +158,48 @@ class SGD(Optimizer):
                                    self.clip_gradient), None
         return sgd_mom_update_core(weight, grad, state, lr, self.momentum, wd,
                                    self.rescale_grad, self.clip_gradient)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD: momentum SGD with LARS layer-wise adaptive rates
+    and warmup (REF optimizer.py LBSGD — You et al., "Large Batch Training
+    of Convolutional Networks")."""
+
+    def __init__(self, momentum=0.0,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 eta=0.001, epsilon=1e-9, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta          # LARS trust coefficient
+        self.epsilon = epsilon
+        self.warmup_updates = max(1, int(warmup_epochs * updates_per_epoch))
+        self.warmup_strategy = warmup_strategy
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return jnp.zeros(weight.shape, jnp.float32 if weight.dtype in
+                             (jnp.float16, jnp.bfloat16) else weight.dtype)
+        return None
+
+    def update_core(self, weight, grad, state, lr, wd, t):
+        # linear warmup on top of the scheduler-provided lr
+        warm = jnp.minimum(1.0, t / self.warmup_updates) \
+            if self.warmup_strategy == "linear" else 1.0
+        g = self._preprocess(grad, weight, wd)
+        # LARS: scale lr by ||w|| / (||g|| + wd*||w|| + eps) per layer
+        wnorm = jnp.sqrt(jnp.sum(weight.astype(jnp.float32) ** 2))
+        gnorm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        trust = jnp.where(
+            (wnorm > 0) & (gnorm > 0),
+            self.eta * wnorm / (gnorm + wd * wnorm + self.epsilon), 1.0)
+        eff_lr = (lr * warm * trust).astype(weight.dtype)
+        g = g + wd * weight
+        if self.momentum == 0.0:
+            return weight - eff_lr * g, None
+        new_mom = self.momentum * state + g
+        return weight - eff_lr * new_mom, new_mom
 
 
 @register
