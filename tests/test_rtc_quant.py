@@ -1,0 +1,73 @@
+"""mx.rtc (Pallas user kernels) + contrib.quantization tests."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd, gluon
+from tpu_mx.base import MXNetError
+from tpu_mx.contrib import quantization as q
+
+
+def test_rtc_kernel_launch():
+    def scale_kernel(x_ref, o_ref, *, alpha):
+        o_ref[:] = x_ref[:] * alpha
+
+    mod = mx.rtc.PallasModule({"scale": scale_kernel})
+    k = mod.get_kernel("scale", alpha=3.0)
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    y = k.launch((x,), out_shape=x.shape)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 3.0)
+
+
+def test_rtc_two_input_kernel():
+    def addmul(a_ref, b_ref, o_ref):
+        o_ref[:] = a_ref[:] * b_ref[:] + a_ref[:]
+
+    mod = mx.rtc.PallasModule(addmul)
+    k = mod.get_kernel("addmul")
+    a = nd.array(np.full((4, 4), 2.0, np.float32))
+    b = nd.array(np.full((4, 4), 5.0, np.float32))
+    np.testing.assert_allclose(k((a, b)).asnumpy(), 12.0)
+
+
+def test_rtc_unknown_kernel():
+    mod = mx.rtc.PallasModule({}, exports=[])
+    with pytest.raises(MXNetError):
+        mod.get_kernel("nope")
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.random.RandomState(0).randn(32, 16).astype(np.float32)
+    qx, lo, hi = q.quantize(nd.array(x))
+    assert qx.dtype == "int8"
+    back = q.dequantize(qx, lo, hi)
+    amax = max(abs(lo), abs(hi))
+    np.testing.assert_allclose(back.asnumpy(), x, atol=amax / 127 + 1e-6)
+
+
+def test_quantized_dense_close_to_float():
+    rng = np.random.RandomState(1)
+    net = gluon.nn.Dense(8, in_units=16)
+    net.initialize()
+    x = nd.array(rng.rand(4, 16).astype(np.float32))
+    ref = net(x).asnumpy()
+    qd = q.QuantizedDense(net, (0.0, 1.0))
+    out = qd(x).asnumpy()
+    scale = np.abs(ref).max() + 1e-8
+    assert np.abs(out - ref).max() / scale < 0.05
+
+
+def test_quantize_net_end_to_end():
+    rng = np.random.RandomState(2)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu", in_units=16))
+    net.add(gluon.nn.Dense(4, in_units=32))
+    net.initialize()
+    calib = nd.array(rng.rand(16, 16).astype(np.float32))
+    qnet = q.quantize_net(net, calib_data=calib)
+    x = nd.array(rng.rand(8, 16).astype(np.float32))
+    ref = net(x).asnumpy()
+    out = qnet(x).asnumpy()
+    scale = np.abs(ref).max() + 1e-8
+    assert np.abs(out - ref).max() / scale < 0.12, \
+        f"int8 divergence {np.abs(out - ref).max() / scale}"

@@ -45,3 +45,4 @@ from . import monitor
 from . import runtime
 from . import engine
 from . import operator
+from . import rtc

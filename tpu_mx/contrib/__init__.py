@@ -1,3 +1,4 @@
 """mx.contrib — auxiliary capabilities (REF:python/mxnet/contrib/)."""
 from . import compression
 from . import amp
+from . import quantization

@@ -18,7 +18,8 @@ __all__ = ["Trainer"]
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 fuse_update=True):
         if isinstance(params, dict) or hasattr(params, "values"):
             params = list(params.values())
         self._params = [p for p in params if p.grad_req != "null"]
@@ -37,6 +38,12 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._kv_initialized = False
         self._scale = 1.0
+        # fused update: ONE XLA program applies the optimizer to every
+        # parameter (the reference's aggregated multi_sgd/multi_mp_sgd
+        # kernels, REF:src/operator/optimizer_op.cc) instead of one
+        # dispatch per parameter
+        self._fuse_update = fuse_update
+        self._fused_cache = {}
 
     @property
     def learning_rate(self):
@@ -86,8 +93,62 @@ class Trainer:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(i, p.data())
                 self._states_inited[i] = True
+        opt_cls = type(self._optimizer)
+        has_pure_core = opt_cls.update_core is not \
+            opt_mod.Optimizer.update_core and \
+            opt_cls.update_multi_precision is \
+            opt_mod.Optimizer.update_multi_precision and \
+            opt_cls.update is opt_mod.Optimizer.update
+        if self._fuse_update and has_pure_core and len(self._params) > 1:
+            return self._update_fused()
+        for i, p in enumerate(self._params):
             self._states[i] = self._optimizer.update_multi_precision(
                 i, p.data(), p.grad, self._states[i])
+
+    def _update_fused(self):
+        import jax
+        import jax.numpy as jnp
+        opt = self._optimizer
+        n = len(self._params)
+        for i in range(n):
+            opt._update_count(i)
+        lrs = jnp.asarray([opt._get_lr(i) for i in range(n)], jnp.float32)
+        wds = jnp.asarray([opt._get_wd(i) for i in range(n)], jnp.float32)
+        ts = jnp.asarray([opt._index_update_count[i] for i in range(n)],
+                         jnp.float32)
+        weights = [p.data()._data for p in self._params]
+        grads = [p.grad._data for p in self._params]
+        # static per-param facts baked into the trace; rescale/clip are read
+        # from the optimizer at trace time, so they key the cache
+        mp = [opt.multi_precision and w.dtype in (jnp.float16, jnp.bfloat16)
+              for w in weights]
+        key = (id(opt), opt.rescale_grad, opt.clip_gradient, tuple(mp),
+               tuple(w.shape for w in weights))
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            def step_all(weights, grads, states, lrs, wds, ts):
+                new_w, new_s = [], []
+                for i in range(n):
+                    if mp[i]:
+                        master, inner = states[i]
+                        nm, ni = opt.update_core(
+                            master, grads[i].astype(jnp.float32), inner,
+                            lrs[i], wds[i], ts[i])
+                        new_w.append(nm.astype(weights[i].dtype))
+                        new_s.append((nm, ni))
+                    else:
+                        nw, ns = opt.update_core(
+                            weights[i], grads[i], states[i],
+                            lrs[i], wds[i], ts[i])
+                        new_w.append(nw.astype(weights[i].dtype))
+                        new_s.append(ns)
+                return new_w, new_s
+            fn = jax.jit(step_all)
+            self._fused_cache[key] = fn
+        new_weights, self._states = fn(weights, grads, self._states,
+                                       lrs, wds, ts)
+        for p, w in zip(self._params, new_weights):
+            p.data()._rebind(w)
 
     def save_states(self, fname):
         """Optimizer + update-count state (REF trainer.save_states)."""

@@ -1,0 +1,87 @@
+"""Runtime-compiled custom kernels (REF:python/mxnet/rtc.py CudaModule over
+NVRTC, REF:src/common/rtc.cc).
+
+TPU divergence, stated plainly: there is no C-source JIT on TPU — the
+runtime kernel language is **Pallas** (Python → Mosaic), compiled at first
+call like NVRTC compiled CUDA C at CudaModule construction.  This module
+keeps the reference's *shape* — build a module, `get_kernel(name, ...)`,
+`kernel.launch(args, grid, ...)` — so ported code changes its kernel
+bodies, not its scaffolding.
+
+    def scale_kernel(x_ref, o_ref, *, alpha):
+        o_ref[:] = x_ref[:] * alpha
+
+    mod = mx.rtc.PallasModule({"scale": scale_kernel})
+    k = mod.get_kernel("scale", alpha=3.0)
+    y = k.launch((x,), out_shape=x.shape, out_dtype=x.dtype)
+"""
+from __future__ import annotations
+
+import functools
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["PallasModule", "Kernel"]
+
+
+class Kernel:
+    """A launchable kernel (reference: CudaKernel).  ``launch`` mirrors
+    ``CudaKernel.launch(args, ctx, grid_dims, block_dims)`` with TPU-native
+    block semantics: ``grid`` + Pallas BlockSpecs instead of thread dims."""
+
+    def __init__(self, name, fn, static_kwargs):
+        self.name = name
+        self._fn = fn
+        self._static = static_kwargs
+
+    def launch(self, args, out_shape=None, out_dtype="float32", grid=None,
+               in_specs=None, out_specs=None, interpret=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        if out_shape is None:
+            out_shape = args[0].shape
+        raw = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+               for a in args]
+        kern = functools.partial(self._fn, **self._static) if self._static \
+            else self._fn
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        kwargs = {}
+        if grid is not None:
+            kwargs["grid"] = grid
+        if in_specs is not None:
+            kwargs["in_specs"] = in_specs
+        if out_specs is not None:
+            kwargs["out_specs"] = out_specs
+        out = pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct(tuple(out_shape),
+                                           jnp.dtype(out_dtype)),
+            interpret=interpret,
+            **kwargs,
+        )(*raw)
+        return NDArray(out)
+
+    __call__ = launch
+
+
+class PallasModule:
+    """Holds named kernels (reference: CudaModule holds compiled source).
+    ``exports`` filters which names are visible, as in the reference."""
+
+    def __init__(self, kernels, exports=None):
+        if callable(kernels):
+            kernels = {kernels.__name__: kernels}
+        self._kernels = dict(kernels)
+        self._exports = set(exports) if exports is not None else None
+
+    def get_kernel(self, name, **static_kwargs):
+        if name not in self._kernels or (
+                self._exports is not None and name not in self._exports):
+            raise MXNetError(
+                f"kernel {name!r} not found/exported "
+                f"(have: {sorted(self._kernels)})")
+        return Kernel(name, self._kernels[name], static_kwargs)
