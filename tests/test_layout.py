@@ -1,0 +1,174 @@
+"""Channels-last (NHWC) layout support — the TPU-preferred image path.
+
+Checks that a model built under `tpu_mx.layout.default_layout("NHWC")`
+computes the same function as the default NCHW build (weights permuted
+accordingly), for conv/pool/BN/deconv, and that a full model-zoo net trains
+channels-last.
+"""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+from tpu_mx.gluon import nn
+from tpu_mx.layout import default_layout
+
+
+def _to_nhwc(x):
+    return np.transpose(x, (0, 2, 3, 1))
+
+
+def test_conv2d_nhwc_matches_nchw():
+    x = np.random.RandomState(0).rand(2, 5, 9, 9).astype(np.float32)
+    conv = nn.Conv2D(7, kernel_size=3, strides=2, padding=1, in_channels=5)
+    conv.initialize()
+    y_ref = conv(nd.array(x)).asnumpy()
+
+    with default_layout("NHWC"):
+        conv2 = nn.Conv2D(7, kernel_size=3, strides=2, padding=1,
+                          in_channels=5)
+    conv2.initialize()
+    # OIHW -> OHWI
+    conv2.weight.set_data(nd.array(
+        np.transpose(conv.weight.data().asnumpy(), (0, 2, 3, 1))))
+    conv2.bias.set_data(conv.bias.data())
+    y = conv2(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(np.transpose(y, (0, 3, 1, 2)), y_ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_conv_nhwc():
+    x = np.random.RandomState(1).rand(2, 6, 8, 8).astype(np.float32)
+    conv = nn.Conv2D(6, kernel_size=3, padding=1, groups=6, in_channels=6,
+                     use_bias=False)
+    conv.initialize()
+    y_ref = conv(nd.array(x)).asnumpy()
+    with default_layout("NHWC"):
+        conv2 = nn.Conv2D(6, kernel_size=3, padding=1, groups=6,
+                          in_channels=6, use_bias=False)
+    conv2.initialize()
+    conv2.weight.set_data(nd.array(
+        np.transpose(conv.weight.data().asnumpy(), (0, 2, 3, 1))))
+    y = conv2(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(np.transpose(y, (0, 3, 1, 2)), y_ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_transpose_nhwc():
+    x = np.random.RandomState(2).rand(2, 4, 5, 5).astype(np.float32)
+    deconv = nn.Conv2DTranspose(3, kernel_size=3, strides=2, padding=1,
+                                output_padding=1, in_channels=4)
+    deconv.initialize()
+    y_ref = deconv(nd.array(x)).asnumpy()
+    with default_layout("NHWC"):
+        d2 = nn.Conv2DTranspose(3, kernel_size=3, strides=2, padding=1,
+                                output_padding=1, in_channels=4)
+    d2.initialize()
+    # IOHW -> IHWO
+    d2.weight.set_data(nd.array(
+        np.transpose(deconv.weight.data().asnumpy(), (0, 2, 3, 1))))
+    d2.bias.set_data(deconv.bias.data())
+    y = d2(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(np.transpose(y, (0, 3, 1, 2)), y_ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("pool_cls,kw", [
+    (nn.MaxPool2D, dict(pool_size=3, strides=2, padding=1)),
+    (nn.AvgPool2D, dict(pool_size=2, strides=2)),
+    (nn.AvgPool2D, dict(pool_size=3, strides=2, padding=1, ceil_mode=True)),
+    (nn.GlobalAvgPool2D, {}),
+    (nn.GlobalMaxPool2D, {}),
+])
+def test_pool_nhwc(pool_cls, kw):
+    x = np.random.RandomState(3).rand(2, 4, 9, 9).astype(np.float32)
+    y_ref = pool_cls(**kw)(nd.array(x)).asnumpy()
+    with default_layout("NHWC"):
+        pool = pool_cls(**kw)
+    y = pool(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(np.transpose(y, (0, 3, 1, 2)), y_ref,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_batchnorm_axis_follows_layout():
+    bn_def = nn.BatchNorm()
+    assert bn_def._axis == 1
+    with default_layout("NHWC"):
+        bn = nn.BatchNorm()
+    assert bn._axis == -1
+    x = np.random.RandomState(4).rand(2, 3, 5, 5).astype(np.float32)
+    bn_def.initialize()
+    bn.initialize()
+    y_ref = bn_def(nd.array(x)).asnumpy()
+    y = bn(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(np.transpose(y, (0, 3, 1, 2)), y_ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("factory,size", [
+    ("squeezenet1_1", 64),
+    ("densenet121", 32),
+])
+def test_concat_models_nhwc(factory, size):
+    """Models with channel-axis concat (Fire / dense blocks) must follow the
+    layout: same logits channels-last as channels-first."""
+    from tpu_mx.gluon.model_zoo import vision
+    net_ref = getattr(vision, factory)(classes=7)
+    net_ref.initialize(init="xavier")
+    x = np.random.RandomState(6).rand(1, 3, size, size).astype(np.float32)
+    y_ref = net_ref(nd.array(x)).asnumpy()
+    with default_layout("NHWC"):
+        net = getattr(vision, factory)(classes=7)
+    net.initialize(init="xavier")
+    for p_src, p_dst in zip(net_ref.collect_params().values(),
+                            net.collect_params().values()):
+        a = p_src.data().asnumpy()
+        if a.ndim == 4:
+            a = np.transpose(a, (0, 2, 3, 1))
+        p_dst.set_data(nd.array(a))
+    y = net(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(y, y_ref, rtol=2e-3, atol=2e-3)
+
+
+def test_default_layout_validates():
+    with pytest.raises(ValueError):
+        with default_layout("NHWc"):
+            pass
+    with default_layout("channels_last"):
+        from tpu_mx.layout import bn_axis
+        assert bn_axis() == -1
+
+
+def test_resnet_nhwc_forward_and_train():
+    """Full model-zoo net channels-last: same logits as NCHW with permuted
+    weights, and a train step runs."""
+    from tpu_mx.gluon.model_zoo import vision
+    from tpu_mx import gluon
+    from tpu_mx.parallel import CompiledTrainStep
+
+    net_ref = vision.resnet18_v1(classes=10)
+    net_ref.initialize(init="xavier")
+    x = np.random.RandomState(5).rand(2, 3, 32, 32).astype(np.float32)
+    y_ref = net_ref(nd.array(x)).asnumpy()
+
+    with default_layout("NHWC"):
+        net = vision.resnet18_v1(classes=10)
+    net.initialize(init="xavier")
+    # copy weights in construction order, permuting conv kernels OIHW->OHWI
+    # (names differ between the two nets — global name counters)
+    for p_src, p_dst in zip(net_ref.collect_params().values(),
+                            net.collect_params().values()):
+        a = p_src.data().asnumpy()
+        if a.ndim == 4:
+            a = np.transpose(a, (0, 2, 3, 1))
+        p_dst.set_data(nd.array(a))
+    y = net(nd.array(_to_nhwc(x))).asnumpy()
+    np.testing.assert_allclose(y, y_ref, rtol=2e-4, atol=2e-4)
+
+    # one compiled train step channels-last
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.create("sgd", learning_rate=0.01)
+    step = CompiledTrainStep(net, loss_fn, opt, mesh=None)
+    label = nd.array(np.array([1, 2], dtype=np.float32))
+    l1 = float(np.asarray(step.step(nd.array(_to_nhwc(x)), label)._data).ravel()[0])
+    assert np.isfinite(l1)

@@ -44,5 +44,6 @@ from . import profiler
 from . import monitor
 from . import runtime
 from . import engine
+from . import layout
 from . import operator
 from . import rtc

@@ -1,4 +1,5 @@
 """DenseNet 121/161/169/201 (REF:model_zoo/vision/densenet.py)."""
+from .... import layout as _layout_mod
 from ...block import HybridBlock
 from ... import nn
 
@@ -18,9 +19,10 @@ class _DenseLayer(HybridBlock):
         self.body.add(nn.Conv2D(growth_rate, 3, padding=1, use_bias=False))
         if dropout:
             self.body.add(nn.Dropout(dropout))
+        self._caxis = _layout_mod.bn_axis()
 
     def hybrid_forward(self, F, x):
-        return F.concat(x, self.body(x), dim=1)
+        return F.concat(x, self.body(x), dim=self._caxis)
 
 
 def _make_transition(num_output_features):

@@ -2,6 +2,7 @@
 "Rethinking the Inception Architecture for Computer Vision").  299×299
 input; the four mixed-block families (A/B/C/D/E) mirror the reference's
 channel plan exactly."""
+from .... import layout as _layout_mod
 from ...block import HybridBlock
 from ... import nn
 
@@ -25,9 +26,10 @@ class _Branches(HybridBlock):
         for i, b in enumerate(branches):
             setattr(self, f"b{i}", b)
             self.branches.append(b)
+        self._caxis = _layout_mod.bn_axis()
 
     def hybrid_forward(self, F, x):
-        return F.concat(*[b(x) for b in self.branches], dim=1)
+        return F.concat(*[b(x) for b in self.branches], dim=self._caxis)
 
 
 def _make_A(pool_features):
@@ -83,12 +85,14 @@ class _MixedE(HybridBlock):
         self.b2a = _conv(384, (1, 3), padding=(0, 1))
         self.b2b = _conv(384, (3, 1), padding=(1, 0))
         self.b3 = _seq(nn.AvgPool2D(3, 1, 1), _conv(192, 1))
+        self._caxis = _layout_mod.bn_axis()
 
     def hybrid_forward(self, F, x):
         y1 = self.b1_stem(x)
         y2 = self.b2_stem(x)
         return F.concat(self.b0(x), self.b1a(y1), self.b1b(y1),
-                        self.b2a(y2), self.b2b(y2), self.b3(x), dim=1)
+                        self.b2a(y2), self.b2b(y2), self.b3(x),
+                        dim=self._caxis)
 
 
 def _seq(*blocks):

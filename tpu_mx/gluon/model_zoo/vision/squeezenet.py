@@ -1,4 +1,5 @@
 """SqueezeNet 1.0/1.1 (REF:model_zoo/vision/squeezenet.py)."""
+from .... import layout as _layout_mod
 from ...block import HybridBlock
 from ... import nn
 
@@ -15,9 +16,11 @@ def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
             self.e1 = nn.Conv2D(expand1x1_channels, 1, activation="relu")
             self.e3 = nn.Conv2D(expand3x3_channels, 3, padding=1,
                                 activation="relu")
+            self._caxis = _layout_mod.bn_axis()  # channel axis under the
+            # active default_layout at build time
 
         def hybrid_forward(self, F, x):
-            return F.concat(self.e1(x), self.e3(x), dim=1)
+            return F.concat(self.e1(x), self.e3(x), dim=self._caxis)
 
     out.add(_Expand())
     return out

@@ -4,6 +4,7 @@ from __future__ import annotations
 import numpy as np
 
 from ... import autograd
+from ... import layout as _layout_mod
 from ..block import Block, HybridBlock
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
@@ -126,12 +127,15 @@ class BatchNorm(HybridBlock):
     Aux mutation flows through the apply-scope updates dict under hybridize —
     the functional replacement for the reference's FMutateInputs."""
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+    def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones", running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0, **kwargs):
         super().__init__(**kwargs)
-        self._axis = axis
+        # axis=None (the default) resolves against the active
+        # tpu_mx.layout.default_layout: 1 for channels-first (the reference's
+        # default), -1 under a channels-last block.
+        self._axis = _layout_mod.bn_axis() if axis is None else axis
         self._momentum = momentum
         self._eps = epsilon
         self._center = center
@@ -161,9 +165,10 @@ class BatchNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         ndim = len(x.shape)
+        axis = self._axis % ndim
         shape = [1] * ndim
-        shape[self._axis] = x.shape[self._axis]
-        red = tuple(i for i in range(ndim) if i != self._axis)
+        shape[axis] = x.shape[axis]
+        red = tuple(i for i in range(ndim) if i != axis)
         g = gamma if self._scale else F.ones_like(gamma)
         b = beta if self._center else F.zeros_like(beta)
         training = autograd.is_training() and not self._use_global_stats

@@ -1,8 +1,16 @@
-"""Gluon conv/pool layers (REF:python/mxnet/gluon/nn/conv_layers.py)."""
+"""Gluon conv/pool layers (REF:python/mxnet/gluon/nn/conv_layers.py).
+
+Layout: every layer takes the reference's ``layout=`` kwarg; passing None
+picks up the thread-local default from `tpu_mx.layout.default_layout`, so a
+whole NCHW-written model can be built channels-last (TPU-preferred) in one
+`with` block.  Channels-last weights are O<spatial>I (I<spatial>O for
+transpose convs), matching the reference's NHWC convention.
+"""
 from __future__ import annotations
 
 import numpy as np
 
+from ... import layout as _layout_mod
 from ..block import HybridBlock
 from .basic_layers import Activation
 
@@ -33,12 +41,11 @@ class _Conv(HybridBlock):
         self._padding = _tuple(padding, ndim)
         self._dilation = _tuple(dilation, ndim)
         self._groups = groups
-        self._layout = layout
+        self._layout = layout or _layout_mod.get_default_layout(ndim)
+        self._channels_last = _layout_mod.is_channels_last(self._layout)
         self._transpose = transpose
         self._output_padding = _tuple(output_padding, ndim)
-        wshape = ((in_channels, channels // groups) if transpose
-                  else (channels, in_channels // groups if in_channels else 0)) \
-            + kernel_size
+        wshape = self._weight_shape(in_channels)
         self.weight = self.params.get("weight", shape=wshape, dtype=dtype,
                                       init=weight_initializer,
                                       allow_deferred_init=True)
@@ -50,14 +57,18 @@ class _Conv(HybridBlock):
             self.bias = None
         self.act = Activation(activation) if activation else None
 
-    def infer_shape(self, x, *args):
-        c_in = x.shape[1]
+    def _weight_shape(self, c_in):
         if self._transpose:
-            self.weight.shape_hint((c_in, self._channels // self._groups)
-                                   + self._kernel)
+            io = (c_in, self._channels // self._groups)
         else:
-            self.weight.shape_hint((self._channels, c_in // self._groups)
-                                   + self._kernel)
+            io = (self._channels, c_in // self._groups if c_in else 0)
+        if self._channels_last:
+            return (io[0],) + self._kernel + (io[1],)
+        return io + self._kernel
+
+    def infer_shape(self, x, *args):
+        c_in = x.shape[-1 if self._channels_last else 1]
+        self.weight.shape_hint(self._weight_shape(c_in))
 
     def hybrid_forward(self, F, x, weight, bias=None):
         if self._transpose:
@@ -66,12 +77,13 @@ class _Conv(HybridBlock):
                                   pad=self._padding, adj=self._output_padding,
                                   num_filter=self._channels,
                                   num_group=self._groups,
-                                  no_bias=bias is None)
+                                  no_bias=bias is None, layout=self._layout)
         else:
             out = F.Convolution(x, weight, bias, kernel=self._kernel,
                                 stride=self._strides, dilate=self._dilation,
                                 pad=self._padding, num_filter=self._channels,
-                                num_group=self._groups, no_bias=bias is None)
+                                num_group=self._groups, no_bias=bias is None,
+                                layout=self._layout)
         return self.act(out) if self.act else out
 
     def __repr__(self):
@@ -82,7 +94,7 @@ class _Conv(HybridBlock):
 
 class Conv1D(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
-                 groups=1, layout="NCW", in_channels=0, activation=None,
+                 groups=1, layout=None, in_channels=0, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", **kwargs):
         super().__init__(channels, _tuple(kernel_size, 1), strides, padding,
@@ -93,7 +105,7 @@ class Conv1D(_Conv):
 
 class Conv2D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", in_channels=0,
+                 dilation=(1, 1), groups=1, layout=None, in_channels=0,
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", **kwargs):
         super().__init__(channels, _tuple(kernel_size, 2), strides, padding,
@@ -105,7 +117,7 @@ class Conv2D(_Conv):
 class Conv3D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", in_channels=0, activation=None, use_bias=True,
+                 layout=None, in_channels=0, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", **kwargs):
         super().__init__(channels, _tuple(kernel_size, 3), strides, padding,
                          dilation, groups, layout, in_channels, activation,
@@ -115,7 +127,7 @@ class Conv3D(_Conv):
 
 class Conv1DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 output_padding=0, dilation=1, groups=1, layout=None,
                  in_channels=0, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", **kwargs):
         super().__init__(channels, _tuple(kernel_size, 1), strides, padding,
@@ -128,7 +140,7 @@ class Conv1DTranspose(_Conv):
 class Conv2DTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  output_padding=(0, 0), dilation=(1, 1), groups=1,
-                 layout="NCHW", in_channels=0, activation=None, use_bias=True,
+                 layout=None, in_channels=0, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", **kwargs):
         super().__init__(channels, _tuple(kernel_size, 2), strides, padding,
                          dilation, groups, layout, in_channels, activation,
@@ -146,6 +158,7 @@ class _Pool(HybridBlock):
         self._pad = padding
         self._global = global_pool
         self._type = pool_type
+        self._layout = layout or _layout_mod.get_default_layout(len(pool_size))
         self._convention = "full" if ceil_mode else "valid"
         self._count_include_pad = count_include_pad
 
@@ -153,7 +166,8 @@ class _Pool(HybridBlock):
         return F.Pooling(x, kernel=self._kernel, pool_type=self._type,
                          global_pool=self._global, stride=self._stride,
                          pad=self._pad, pooling_convention=self._convention,
-                         count_include_pad=self._count_include_pad)
+                         count_include_pad=self._count_include_pad,
+                         layout=self._layout)
 
     def __repr__(self):
         if self._global:

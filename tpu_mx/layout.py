@@ -1,0 +1,66 @@
+"""Global conv data-layout switch (TPU-first redesign).
+
+MXNet threads ``layout=`` through every conv/pool constructor
+(REF:python/mxnet/gluon/nn/conv_layers.py).  We keep those kwargs, but add a
+thread-local *default* so a whole model (e.g. the NCHW-written model zoo) can
+be instantiated channels-last without editing each constructor:
+
+    with tpu_mx.layout.default_layout("NHWC"):
+        net = vision.resnet50_v1()
+    # net now expects NHWC input and runs channels-last end-to-end.
+
+Why: XLA:TPU keeps the minor-most dimension in the 128-wide lane registers.
+Channels-last puts C (a multiple of 128 through most of ResNet) in the lanes,
+so convolutions tile straight onto the MXU with no layout copies; NCHW puts
+W there instead and the compiler has to relayout around every conv.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+_state = threading.local()
+
+_CHANNELS_FIRST = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+_CHANNELS_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+
+
+def get_default_layout(ndim: int = 2) -> str:
+    """Current default data layout for an ``ndim``-spatial-dim conv."""
+    mode = getattr(_state, "mode", "channels_first")
+    return (_CHANNELS_LAST if mode == "channels_last" else _CHANNELS_FIRST)[ndim]
+
+
+_KNOWN = (set(_CHANNELS_FIRST.values()) | set(_CHANNELS_LAST.values())
+          | {"channels_first", "channels_last"})
+
+
+def is_channels_last(layout: str | None) -> bool:
+    return layout is not None and layout.endswith("C")
+
+
+def bn_axis() -> int:
+    """Default BatchNorm channel axis under the current layout mode."""
+    return -1 if getattr(_state, "mode", "channels_first") == "channels_last" \
+        else 1
+
+
+@contextmanager
+def default_layout(layout: str):
+    """Set the default conv/pool/BatchNorm layout for blocks built inside.
+
+    ``layout`` is any MXNet layout string ("NHWC", "NCHW", "NWC", ...) or a
+    Keras-style "channels_first"/"channels_last"; only the orientation is
+    recorded.
+    """
+    if layout not in _KNOWN:
+        raise ValueError(
+            f"unknown layout {layout!r}; expected one of {sorted(_KNOWN)}")
+    prev = getattr(_state, "mode", "channels_first")
+    _state.mode = "channels_last" \
+        if layout == "channels_last" or layout.endswith("C") \
+        else "channels_first"
+    try:
+        yield
+    finally:
+        _state.mode = prev

@@ -24,6 +24,7 @@ from jax import lax
 
 from .. import autograd
 from .. import _functional
+from .. import layout as _layout_mod
 from .ndarray import NDArray, array, concatenate, load, save, waitall
 from ..context import current_context
 
@@ -780,14 +781,25 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=None, num_group=1, no_bias=False,
                 layout=None, **kw):
     """N-D convolution (REF:src/operator/nn/convolution.cc; cuDNN path replaced
-    by `lax.conv_general_dilated`, which XLA tiles onto the MXU).  NCHW layout
-    API-side; XLA:TPU relayouts internally."""
+    by `lax.conv_general_dilated`, which XLA tiles onto the MXU).
+
+    `layout` selects the data layout as in the reference ("NCHW", "NHWC",
+    "NCW", "NWC", "NCDHW", "NDHWC"; default channels-first).  Channels-last
+    puts C in the TPU lane dimension, so prefer NHWC for the image path
+    (weight layout is then O<spatial>I, matching the reference's NHWC
+    convention)."""
     nd_ = len(kernel)
     strides = _pair(stride, nd_) if stride else (1,) * nd_
     dilation = _pair(dilate, nd_) if dilate else (1,) * nd_
     padding = [(p, p) for p in (_pair(pad, nd_) if pad else (0,) * nd_)]
     spatial = "DHW"[-nd_:]
-    dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    if layout is None:
+        layout = "NC" + spatial
+    channels_last = _layout_mod.is_channels_last(layout)
+    wspec = ("O" + spatial + "I") if channels_last else ("OI" + spatial)
+    dn = (layout, wspec, layout)
+    bshape = ((1,) * (nd_ + 1) + (-1,)) if channels_last \
+        else ((1, -1) + (1,) * nd_)
 
     def f(x, w, *b):
         # NOTE: no preferred_element_type — jax 0.9's conv transpose rule
@@ -798,7 +810,7 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
             rhs_dilation=dilation, dimension_numbers=dn,
             feature_group_count=num_group)
         if b:
-            y = y + b[0].reshape((1, -1) + (1,) * nd_)
+            y = y + b[0].reshape(bshape)
         return y
 
     args = [data, weight] + ([] if (no_bias or bias is None) else [bias])
@@ -807,17 +819,24 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
 
 def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                   pad=None, adj=None, num_filter=None, num_group=1, no_bias=True,
-                  **kw):
+                  layout=None, **kw):
     """Transposed conv (REF:src/operator/nn/deconvolution.cc).  `adj` (the
     output_padding) extends the trailing pad so out = (i-1)*s - 2p + d*(k-1)
-    + 1 + adj, matching the reference's output-size formula."""
+    + 1 + adj, matching the reference's output-size formula.  `layout` as in
+    Convolution; channels-last weights are I<spatial>O."""
     nd_ = len(kernel)
     strides = _pair(stride, nd_) if stride else (1,) * nd_
     dilation = _pair(dilate, nd_) if dilate else (1,) * nd_
     padding = _pair(pad, nd_) if pad else (0,) * nd_
     adjust = _pair(adj, nd_) if adj else (0,) * nd_
     spatial = "DHW"[-nd_:]
-    dn = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
+    if layout is None:
+        layout = "NC" + spatial
+    channels_last = _layout_mod.is_channels_last(layout)
+    wspec = ("I" + spatial + "O") if channels_last else ("IO" + spatial)
+    dn = (layout, wspec, layout)
+    bshape = ((1,) * (nd_ + 1) + (-1,)) if channels_last \
+        else ((1, -1) + (1,) * nd_)
 
     def f(x, w, *b):
         pads = [(d * (k - 1) - p, d * (k - 1) - p + a)
@@ -827,7 +846,7 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
             lhs_dilation=strides, rhs_dilation=dilation,
             dimension_numbers=dn, feature_group_count=num_group)
         if b:
-            y = y + b[0].reshape((1, -1) + (1,) * nd_)
+            y = y + b[0].reshape(bshape)
         return y
 
     args = [data, weight] + ([] if (no_bias or bias is None) else [bias])
@@ -835,28 +854,36 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
 
 
 def Pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
-            pad=None, pooling_convention="valid", count_include_pad=True, **kw):
+            pad=None, pooling_convention="valid", count_include_pad=True,
+            layout=None, **kw):
     """Max/avg/sum pooling via `lax.reduce_window`
-    (REF:src/operator/nn/pooling.cc)."""
+    (REF:src/operator/nn/pooling.cc).  `layout` as in Convolution."""
+    channels_last = _layout_mod.is_channels_last(layout)
 
     def f(x):
         nd_ = x.ndim - 2
+        spatial_axes = tuple(range(1, x.ndim - 1)) if channels_last \
+            else tuple(range(2, x.ndim))
         if global_pool:
-            return x.mean(axis=tuple(range(2, x.ndim)), keepdims=True) \
+            return x.mean(axis=spatial_axes, keepdims=True) \
                 if pool_type == "avg" else (
-                    x.max(axis=tuple(range(2, x.ndim)), keepdims=True)
+                    x.max(axis=spatial_axes, keepdims=True)
                     if pool_type == "max"
-                    else x.sum(axis=tuple(range(2, x.ndim)), keepdims=True))
+                    else x.sum(axis=spatial_axes, keepdims=True))
         k = _pair(kernel, nd_)
         s = _pair(stride, nd_) if stride else k
         p = _pair(pad, nd_) if pad else (0,) * nd_
-        window = (1, 1) + k
-        strides = (1, 1) + s
-        padding = [(0, 0), (0, 0)] + [(pp, pp) for pp in p]
         if pooling_convention == "full":
             # ceil-mode: extend right/bottom padding so no element is dropped
-            padding = [(0, 0), (0, 0)] + [
-                (pp, pp + st - 1) for pp, st in zip(p, s)]
+            spad = [(pp, pp + st - 1) for pp, st in zip(p, s)]
+        else:
+            spad = [(pp, pp) for pp in p]
+        if channels_last:
+            window, strides = (1,) + k + (1,), (1,) + s + (1,)
+            padding = [(0, 0)] + spad + [(0, 0)]
+        else:
+            window, strides = (1, 1) + k, (1, 1) + s
+            padding = [(0, 0), (0, 0)] + spad
         if pool_type == "max":
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
             return lax.reduce_window(x, init, lax.max, window, strides, padding)
@@ -1004,6 +1031,7 @@ def batch_norm_core(x, gamma, beta, moving_mean, moving_var, eps, use_batch_stat
     """Pure BN forward; returns (out, batch_mean, batch_var).  Gluon's
     BatchNorm layer owns the running-stat update (the reference did it via
     FMutateInputs on aux states — here state flows functionally, SURVEY §7.1)."""
+    axis = axis % x.ndim
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
