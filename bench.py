@@ -1,41 +1,88 @@
 """Benchmark: ResNet-50 training throughput, single chip (BASELINE headline).
 
 Runs the full compiled train step (fwd+bwd+SGD update in one XLA program,
-bf16 compute / f32 master state) and prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+bf16 compute / f32 master state, channels-last NHWC layout) and prints ONE
+JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N, "mfu": ...}
 vs_baseline is against the A100 ballpark in BASELINE.md (~2800 img/s AMP).
 
-Env: BENCH_SMOKE=1 shrinks shapes for a CPU smoke run.
+Engineering for the tunneled TPU backend (BENCH_r01 failure + VERDICT weak#1):
+backend init can hang indefinitely inside a C call, which no in-process
+timeout can interrupt.  So the outer process (this file, run with no args)
+imports NO jax; it supervises `python bench.py --inner` children with a hard
+timeout and retry/backoff, streams the child's stage prints to stderr, and
+ALWAYS emits a JSON line — a real number, or a partial record with "error"
+set if every attempt died.
+
+Env knobs: BENCH_SMOKE=1 (CPU smoke, small shapes), BENCH_LAYOUT=NCHW
+(default NHWC), BENCH_BATCH / BENCH_ITERS overrides, BENCH_ATTEMPTS (default
+3), BENCH_TIMEOUT seconds per attempt (default 600).
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+A100_BASELINE = 2800.0  # img/s, BASELINE.md ballpark
+V5E_PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e chip
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.09e9  # fwd GMACs*2, *3 for fwd+bwd
 
 
-def main():
-    import jax
+def log(msg):
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+# ---------------------------------------------------------------------------
+# inner: the actual benchmark (may hang on a flaky backend; outer kills us)
+# ---------------------------------------------------------------------------
+def inner():
     smoke = os.environ.get("BENCH_SMOKE") == "1"
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC")
+    log(f"inner start (smoke={smoke}, layout={layout})")
+
+    import jax
     if smoke:
         jax.config.update("jax_platforms", "cpu")
 
+    log("probing backend (jax.devices)...")
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    log(f"backend up: {devs[0].platform} x{len(devs)} "
+        f"in {time.perf_counter() - t0:.1f}s")
+
+    log("staged warmup: tiny jit matmul...")
+    import jax.numpy as jnp
+    t0 = time.perf_counter()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    jax.jit(lambda a: a @ a)(x).block_until_ready()
+    log(f"tiny jit ok in {time.perf_counter() - t0:.1f}s")
+
+    import numpy as np
     import tpu_mx as mx
     from tpu_mx import gluon, nd
     from tpu_mx.gluon.model_zoo import vision
+    from tpu_mx.layout import default_layout
     from tpu_mx.parallel import CompiledTrainStep
 
     if smoke:
         batch, size, warmup, iters = 8, 64, 1, 3
-        net = vision.resnet18_v1(classes=100)
+        classes, factory = 100, "resnet18_v1"
     else:
-        batch, size, warmup, iters = 128, 224, 3, 30
-        net = vision.resnet50_v1(classes=1000)
+        batch, size, warmup, iters = 256, 224, 3, 30
+        classes, factory = 1000, "resnet50_v1"
+    batch = int(os.environ.get("BENCH_BATCH", batch))
+    iters = int(os.environ.get("BENCH_ITERS", iters))
 
+    log(f"building {factory} ({layout}), batch={batch}, size={size}")
+    shape = (batch, size, size, 3) if layout == "NHWC" else (batch, 3, size, size)
+    with default_layout(layout):
+        net = getattr(vision, factory)(classes=classes)
     net.initialize(init="xavier")
-    x = nd.array(np.random.rand(batch, 3, size, size).astype(np.float32))
+    x = nd.array(np.random.rand(*shape).astype(np.float32))
     _ = net(x)  # finalize deferred shapes
     net.cast("bfloat16")
 
@@ -44,11 +91,12 @@ def main():
                               wd=1e-4, multi_precision=True)
     step = CompiledTrainStep(net, loss_fn, opt, mesh=None)
 
-    data = nd.cast(
-        nd.array(np.random.rand(batch, 3, size, size).astype(np.float32)),
-        "bfloat16")
-    label = nd.array(np.random.randint(0, 100 if smoke else 1000, (batch,)),
-                     dtype="float32")
+    data = nd.cast(nd.array(np.random.rand(*shape).astype(np.float32)),
+                   "bfloat16")
+    label = nd.array(np.random.randint(0, classes, (batch,)), dtype="float32")
+
+    log("compiling full train step (first call)...")
+    t0 = time.perf_counter()
 
     # Sync via a host fetch of the loss scalar, not wait_to_read: on the
     # tunneled single-chip backend block_until_ready returns before the
@@ -64,20 +112,75 @@ def main():
         float(np.asarray(loss._data).ravel()[0])
         return time.perf_counter() - t0
 
+    timed_run(1)
+    log(f"first step (compile+run) {time.perf_counter() - t0:.1f}s; warmup...")
     for _ in range(warmup):
         timed_run(1)
+    log(f"timing {iters} steps x repeats...")
     repeats = 1 if smoke else 3
-    dt = min(timed_run(iters) for _ in range(repeats))
+    best = None
+    for r in range(repeats):
+        dt = timed_run(iters)
+        log(f"  repeat {r}: {dt:.3f}s ({batch * iters / dt:.1f} img/s)")
+        best = dt if best is None else min(best, dt)
 
-    img_s = batch * iters / dt
-    print(json.dumps({
+    img_s = batch * iters / best
+    mfu = (img_s * RESNET50_TRAIN_FLOPS_PER_IMG / V5E_PEAK_FLOPS
+           if not smoke else None)
+    rec = {
         "metric": "resnet50_train_images_per_sec_per_chip"
         if not smoke else "resnet18_smoke_images_per_sec",
         "value": round(img_s, 2),
         "unit": "img/s",
-        "vs_baseline": round(img_s / 2800.0, 4),
-    }))
+        "vs_baseline": round(img_s / A100_BASELINE, 4),
+    }
+    if mfu is not None:
+        rec["mfu"] = round(mfu, 4)
+    rec["layout"] = layout
+    rec["batch"] = batch
+    print(json.dumps(rec), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# outer: supervisor — no jax import, hard timeouts, retry, partial JSON
+# ---------------------------------------------------------------------------
+def outer():
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    timeout = float(os.environ.get("BENCH_TIMEOUT", "600"))
+    last_err = "unknown"
+    for attempt in range(1, attempts + 1):
+        log(f"attempt {attempt}/{attempts} (timeout {timeout:.0f}s)")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner"],
+                stdout=subprocess.PIPE, timeout=timeout, text=True)
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempt} timed out after {timeout:.0f}s"
+            log(last_err + "; backing off 15s")
+            time.sleep(15)
+            continue
+        out = (proc.stdout or "").strip().splitlines()
+        json_lines = [ln for ln in out if ln.startswith("{")]
+        if proc.returncode == 0 and json_lines:
+            print(json_lines[-1], flush=True)
+            return 0
+        last_err = (f"attempt {attempt} rc={proc.returncode}, "
+                    f"stdout tail: {out[-3:] if out else '(empty)'}")
+        log(last_err + "; backing off 15s")
+        time.sleep(15)
+    # every attempt failed — still emit parseable JSON for the driver
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "img/s",
+        "vs_baseline": 0.0,
+        "error": last_err,
+    }), flush=True)
+    return 0  # JSON was emitted; don't let the driver see a crash
 
 
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv:
+        inner()
+    else:
+        sys.exit(outer())

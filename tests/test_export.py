@@ -1,0 +1,72 @@
+"""HybridBlock.export() → StableHLO artifact → SymbolBlock.imports roundtrip
+(REF:python/mxnet/gluon/block.py export/SymbolBlock; SURVEY §5.4 'export() →
+StableHLO artifact')."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+from tpu_mx.gluon import nn, SymbolBlock
+from tpu_mx.base import MXNetError
+
+
+def _small_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=3, activation="relu"))
+    net.add(nn.BatchNorm())
+    net.add(nn.GlobalAvgPool2D())
+    net.add(nn.Flatten())
+    net.add(nn.Dense(5))
+    return net
+
+
+def test_export_roundtrip_bit_identical(tmp_path):
+    net = _small_net()
+    net.initialize(init="xavier")
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32))
+    y_ref = net(x)  # records input avals + caches the jit
+    prefix = str(tmp_path / "model")
+    net.export(prefix, epoch=3)
+
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0003.params.npz")
+    assert os.path.exists(prefix + "-0003.stablehlo")
+    manifest = json.load(open(prefix + "-symbol.json"))
+    assert manifest["format"] == "tpu_mx-stablehlo-v1"
+    assert manifest["inputs"][0]["shape"] == [2, 3, 8, 8]
+
+    blk = SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                              prefix + "-0003.params.npz")
+    y = blk(x)
+    np.testing.assert_array_equal(y.asnumpy(), y_ref.asnumpy())
+
+
+def test_export_with_example_inputs_no_prior_call(tmp_path):
+    net = _small_net()
+    net.initialize(init="xavier")
+    x = nd.array(np.random.RandomState(1).rand(1, 3, 6, 6).astype(np.float32))
+    _ = net(x)  # finalize deferred shapes (eager; no hybridize)
+    prefix = str(tmp_path / "m2")
+    net.export(prefix, epoch=0, example_inputs=[x])
+    blk = SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                              prefix + "-0000.params.npz")
+    np.testing.assert_allclose(blk(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_export_without_shapes_raises(tmp_path):
+    net = _small_net()
+    net.initialize(init="xavier")
+    with pytest.raises(MXNetError):
+        net.export(str(tmp_path / "m3"))
+
+
+def test_imports_rejects_bad_format(tmp_path):
+    p = tmp_path / "bad-symbol.json"
+    p.write_text(json.dumps({"format": "mxnet-json-v1"}))
+    with pytest.raises(MXNetError):
+        SymbolBlock.imports(str(p), ["data"], "unused")

@@ -1,15 +1,21 @@
 #!/usr/bin/env python
 """Distributed job launcher (reference analog: tools/launch.py over the
-dmlc trackers, REF:3rdparty/dmlc-core/tracker/dmlc_tracker/local.py).
+dmlc trackers, REF:3rdparty/dmlc-core/tracker/dmlc_tracker/{local,ssh}.py).
 
 The reference booted a parameter-server topology (scheduler + servers +
 workers over ZeroMQ).  TPU-native training is SPMD: every process runs the
 same program and `jax.distributed.initialize` forms the collective group,
 so the launcher's job shrinks to "start N identical processes with the
-right bootstrap env" — exactly the reference's `--launcher local` pattern,
-minus the server/scheduler roles.
+right bootstrap env" — the reference's local and ssh trackers, minus the
+server/scheduler roles.
 
+    # local: N processes on this machine
     python tools/launch.py -n 4 python train.py --kv-store dist_sync
+
+    # ssh: one process per host listed in the hostfile (round-robin when
+    # n > number of hosts), same env protocol shipped over the ssh command
+    python tools/launch.py -n 4 --launcher ssh -H hosts.txt \
+        python train.py --kv-store dist_sync
 
 Env protocol handed to each worker (mirrors DMLC_* in spirit):
     TPUMX_COORDINATOR   host:port of process 0
@@ -21,6 +27,7 @@ processes default to the CPU backend with JAX_PLATFORMS=cpu.
 """
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -34,32 +41,95 @@ def free_port():
     return port
 
 
+def worker_env(coord, num_proc, rank, extra=()):
+    """The bootstrap env protocol for one worker (shared by both trackers)."""
+    env = {
+        "TPUMX_COORDINATOR": coord,
+        "TPUMX_NUM_PROC": str(num_proc),
+        "TPUMX_PROC_ID": str(rank),
+    }
+    for kv in extra:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    return env
+
+
+def read_hostfile(path):
+    """One host per line; '#' comments and blanks ignored (the dmlc ssh
+    tracker's hostfile format)."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                hosts.append(line)
+    if not hosts:
+        raise ValueError(f"hostfile {path} has no hosts")
+    return hosts
+
+
+def build_ssh_commands(hosts, num_proc, coord, command, env_extra=(),
+                       ssh_opts=()):
+    """Construct the per-rank ssh argv list (pure — unit-testable without a
+    cluster).  Rank r runs on hosts[r % len(hosts)]; the env protocol is
+    inlined into the remote command since ssh does not forward arbitrary
+    env vars."""
+    cmds = []
+    for rank in range(num_proc):
+        host = hosts[rank % len(hosts)]
+        env = worker_env(coord, num_proc, rank, env_extra)
+        assigns = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in sorted(env.items()))
+        remote = f"cd {shlex.quote(os.getcwd())} && env {assigns} " + \
+            " ".join(shlex.quote(c) for c in command)
+        cmds.append((host, ["ssh", "-o", "StrictHostKeyChecking=no",
+                            *ssh_opts, host, remote]))
+    return cmds
+
+
+def launch_local(args, coord):
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(worker_env(coord, args.num_workers, rank, args.env))
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        procs.append(subprocess.Popen(args.command, env=env))
+    return procs
+
+
+def launch_ssh(args, coord):
+    import random
+    hosts = read_hostfile(args.hostfile)
+    # The jax.distributed coordinator runs INSIDE rank 0 — i.e. on hosts[0],
+    # not on this launcher machine — so that's the address every rank must
+    # dial.  The port can't be probed remotely; pick one from the dynamic
+    # range (collision odds are negligible and a clash fails fast).
+    port = random.randint(49152, 65535)
+    coord = f"{hosts[0]}:{port}"
+    cmds = build_ssh_commands(hosts, args.num_workers, coord, args.command,
+                              args.env)
+    return [subprocess.Popen(argv) for _host, argv in cmds]
+
+
 def main():
     ap = argparse.ArgumentParser(
-        description="Launch a local multi-process SPMD job")
+        description="Launch a multi-process SPMD job (local or ssh)")
     ap.add_argument("-n", "--num-workers", type=int, required=True)
-    ap.add_argument("--launcher", default="local", choices=["local"],
-                    help="multi-host pods boot via their own pod runtime; "
-                         "this tool covers the reference's local tracker")
+    ap.add_argument("--launcher", default="local", choices=["local", "ssh"])
+    ap.add_argument("-H", "--hostfile",
+                    help="hosts file for --launcher ssh (one per line)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VAL for the workers")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+    if args.launcher == "ssh" and not args.hostfile:
+        ap.error("--launcher ssh requires -H/--hostfile")
 
     coord = f"127.0.0.1:{free_port()}"
-    procs = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update(TPUMX_COORDINATOR=coord,
-                   TPUMX_NUM_PROC=str(args.num_workers),
-                   TPUMX_PROC_ID=str(rank))
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        for kv in args.env:
-            k, _, v = kv.partition("=")
-            env[k] = v
-        procs.append(subprocess.Popen(args.command, env=env))
+    procs = launch_local(args, coord) if args.launcher == "local" \
+        else launch_ssh(args, coord)
     code = 0
     for p in procs:
         code = p.wait() or code

@@ -45,5 +45,6 @@ from . import monitor
 from . import runtime
 from . import engine
 from . import layout
+from . import elastic
 from . import operator
 from . import rtc

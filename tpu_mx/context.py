@@ -42,7 +42,11 @@ class Context:
         return _DEVTYPE_ALIASES[self.device_type]
 
     def jax_device(self):
-        """Resolve to a concrete jax.Device (lazily; raises if id out of range)."""
+        """Resolve to a concrete jax.Device (lazily; raises if id out of range).
+
+        Indexes the *process-local* device list: under multi-process SPMD
+        (jax.distributed) `cpu(0)`/`tpu(0)` means this worker's first device —
+        global devices owned by other processes are not addressable."""
         kind = self.kind
         if kind == "tpu":
             devs = _accelerator_devices()
@@ -54,9 +58,9 @@ class Context:
                 )
             return devs[self.device_id]
         try:
-            return jax.devices("cpu")[self.device_id]
+            return jax.local_devices(backend="cpu")[self.device_id]
         except RuntimeError:
-            return jax.devices()[0]  # CPU backend absent: fall back to default
+            return jax.local_devices()[0]  # CPU backend absent: use default
 
     # -- `with ctx:` ---------------------------------------------------------
     def __enter__(self):
@@ -85,8 +89,8 @@ class Context:
 
 
 def _accelerator_devices():
-    """All non-CPU jax devices; empty list when running CPU-only."""
-    devs = jax.devices()
+    """This process's non-CPU jax devices; empty list when running CPU-only."""
+    devs = jax.local_devices()
     accel = [d for d in devs if d.platform != "cpu"]
     return accel
 

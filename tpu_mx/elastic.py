@@ -1,0 +1,108 @@
+"""Elastic-lite: multi-host failure detection + auto-resume (SURVEY §5.3).
+
+The reference's ps-lite tracked worker liveness through the scheduler and
+could re-admit workers.  A TPU SPMD job has no scheduler tier and XLA
+collectives simply hang if a peer dies — so the cheap, robust design is:
+
+1. **Failure detection** = a *timeout barrier* between training epochs (or
+   every N steps): every worker calls `barrier(tag, timeout)`; if any peer
+   is gone, the survivors get a clean `WorkerFailure` within the timeout
+   instead of hanging forever in a collective.
+2. **Recovery** = the auto-resume contract: checkpoints carry epoch numbers
+   (`prefix-0007.params` ...), `latest_checkpoint(prefix)` finds the newest
+   complete one, and a `--resume` run restarts the whole SPMD job from it.
+   Re-forming the collective group is the launcher's job (just rerun it);
+   re-forming *state* is this module's.
+
+The barrier runs `multihost_utils.sync_global_devices` on a daemon thread
+and joins with a timeout — a hung collective (dead peer) leaves a parked
+daemon thread behind but the main thread gets control back, reports, and
+can exit for the supervisor to restart.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+
+from .base import MXNetError
+
+__all__ = ["WorkerFailure", "barrier", "latest_checkpoint", "auto_resume"]
+
+
+class WorkerFailure(MXNetError):
+    """A peer did not reach the barrier within the timeout (died or hung)."""
+
+
+def barrier(tag="tpumx_elastic", timeout=60.0):
+    """Synchronize all processes; raise `WorkerFailure` if the group does not
+    converge within `timeout` seconds.  Single-process: no-op.
+
+    Call between epochs (cheap: one tiny collective) so a dead rank turns
+    into a clean, fast failure instead of an indefinite hang in the next
+    psum."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    done = threading.Event()
+    err = []
+
+    def _sync():
+        try:
+            multihost_utils.sync_global_devices(tag)
+        except Exception as e:  # pragma: no cover - backend-specific
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_sync, daemon=True, name=f"barrier-{tag}")
+    t.start()
+    if not done.wait(timeout):
+        raise WorkerFailure(
+            f"barrier '{tag}' timed out after {timeout:.0f}s: a worker is "
+            f"dead or hung (rank {jax.process_index()} of "
+            f"{jax.process_count()} reporting). Restart the job with "
+            "--resume to continue from the last checkpoint.")
+    if err:
+        raise WorkerFailure(f"barrier '{tag}' failed: {err[0]}")
+
+
+_EPOCH_RE = re.compile(r"-(\d{4})\.params(\.npz)?$")
+
+
+def latest_checkpoint(prefix):
+    """Newest `(epoch, params_path)` under the reference's checkpoint naming
+    (`prefix-0007.params[.npz]`), or (None, None) if none exist."""
+    best = (None, None)
+    for path in glob.glob(f"{prefix}-*.params*"):
+        m = _EPOCH_RE.search(path)
+        if m:
+            epoch = int(m.group(1))
+            if best[0] is None or epoch > best[0]:
+                best = (epoch, path)
+    return best
+
+
+def auto_resume(prefix, net=None, module=None, trainer=None):
+    """Restore the newest checkpoint for a Gluon net (or Module) + optional
+    Trainer states; returns the epoch to resume FROM (0 if fresh).
+
+    The `--resume` contract (SURVEY §5.3): a restarted job calls this before
+    the train loop and starts at the returned epoch."""
+    epoch, params = latest_checkpoint(prefix)
+    if epoch is None:
+        return 0
+    if net is not None:
+        net.load_parameters(params)
+    if module is not None:
+        sym, arg, aux = __import__("tpu_mx").model.load_checkpoint(
+            prefix, epoch)
+        module.set_params(arg, aux)
+    if trainer is not None:
+        states = f"{prefix}-{epoch:04d}.states"
+        if os.path.exists(states):
+            trainer.load_states(states)
+    return epoch + 1

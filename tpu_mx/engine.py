@@ -7,6 +7,12 @@ XLA program order: ops issue immediately and execute in stream order, and
 ``jit`` regions are the bulked segments.  This module keeps the control
 surface: ``bulk`` is honored as a hint (ops inside are already batched by
 dispatch), and the wait functions map to ``block_until_ready``.
+
+DIVERGENCE — read before benchmarking dispatch overhead: ``set_bulk_size``
+and ``bulk()`` are **semantic no-ops** here.  They record the value and
+restore it, but do not change how ops execute; XLA fusion under
+``hybridize()``/``jit`` is the real bulking mechanism.  Numbers measured
+inside ``bulk()`` scopes reflect plain eager dispatch.
 """
 from __future__ import annotations
 

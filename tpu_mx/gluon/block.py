@@ -226,6 +226,7 @@ class HybridBlock(Block):
         self._flags = {}
         self._cached_fns = {}          # (train, arg_struct) -> jitted fn
         self._param_order = None
+        self._last_input_avals = None  # recorded for export()
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   inline_limit=None, **kwargs):
@@ -262,6 +263,14 @@ class HybridBlock(Block):
 
     def __call__(self, *args, **kwargs):
         from .parameter import _active_substitution
+        if _active_substitution() is None and not kwargs and args and \
+                all(isinstance(a, (NDArray, jnp.ndarray, np.ndarray))
+                    for a in args):
+            # remember concrete input shapes for export() (works even if the
+            # call below takes the eager path)
+            self._last_input_avals = [
+                jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+                for a in args]
         if not self._active or _active_substitution() is not None:
             # plain path: not hybridized, OR already inside an enclosing
             # block's functional trace (children trace inline — one compiled
@@ -362,28 +371,80 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path, epoch=0):
-        """Serialize compiled graph + params (reference: symbol JSON + params;
-        here: StableHLO text + npz params)."""
+    def export(self, path, epoch=0, example_inputs=None):
+        """Serialize the compiled inference graph + params
+        (REF:python/mxnet/gluon/block.py export — symbol JSON + params file).
+
+        TPU-native artifact set:
+          ``{path}-symbol.json``          manifest (format, input specs)
+          ``{path}-{epoch:04d}.params.npz``  parameters
+          ``{path}-{epoch:04d}.stablehlo``   serialized `jax.export` program
+
+        The StableHLO program is the inference (predict-mode) forward with
+        static input shapes.  Shapes come from ``example_inputs`` or, if
+        omitted, from the most recent call to this block.  Load it back with
+        `SymbolBlock.imports` — forward results are bit-identical to the
+        exporting block's.
+        """
+        import json
+
+        import numpy as _np
+        from jax import export as jexport
+
         params = self._collect_params_with_prefix()
         payload = {k: p.data() for k, p in params.items() if p._data is not None}
         from ..ndarray import save as nd_save
         nd_save(f"{path}-{epoch:04d}.params.npz", payload)
-        if self._cached_fns:
-            train, fn = next(iter(self._cached_fns.items()))
-            # StableHLO artifact requires example inputs; emitted lazily on
-            # first export after a cached call — see ExportedProgram below.
+
+        if example_inputs is not None:
+            in_avals = [
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in example_inputs]
+        elif self._last_input_avals is not None:
+            in_avals = self._last_input_avals
+        else:
+            raise MXNetError(
+                "export() needs input shapes: call the block once (after "
+                "hybridize()) or pass example_inputs=")
+
+        # exported signature: (params_by_prefixed_name, key, *inputs);
+        # prefixed names match the .params.npz keys so a loader needs no
+        # other name mapping
+        global_of = {k: p.name for k, p in params.items()
+                     if p._data is not None}
+
+        def infer_fn(pmap, key, *inputs):
+            gmap = {global_of[k]: v for k, v in pmap.items()}
+            out, _updates = self._functional_call(gmap, key, False, inputs)
+            return out
+
+        key0 = _random.take_key()
+        param_avals = {k: jax.ShapeDtypeStruct(p.data().shape, p.data().dtype)
+                       for k, p in params.items() if p._data is not None}
+        exported = jexport.export(jax.jit(infer_fn))(
+            param_avals, jax.ShapeDtypeStruct(key0.shape, key0.dtype),
+            *in_avals)
+        with open(f"{path}-{epoch:04d}.stablehlo", "wb") as f:
+            f.write(exported.serialize())
+
         with open(f"{path}-symbol.json", "w") as f:
-            import json
-            json.dump({"format": "tpu_mx-hlo", "name": self.name,
-                       "params": sorted(payload)}, f)
+            json.dump({
+                "format": "tpu_mx-stablehlo-v1",
+                "name": self.name,
+                "params": sorted(payload),
+                "inputs": [{"shape": list(a.shape),
+                            "dtype": _np.dtype(a.dtype).name}
+                           for a in in_avals],
+                "artifact": f"{path.split('/')[-1]}-{epoch:04d}.stablehlo",
+            }, f)
 
     def optimize_for(self, *args, **kwargs):
         self.hybridize(True)
 
 
 class SymbolBlock(HybridBlock):
-    """Reference SymbolBlock wraps a saved symbol; here a saved jitted fn."""
+    """Reference SymbolBlock wraps a saved symbol; here a saved compiled
+    program (REF:python/mxnet/gluon/block.py SymbolBlock).  Build one from
+    an `export()` artifact with `SymbolBlock.imports`."""
 
     def __init__(self, fn, params=None, prefix=None):
         super().__init__(prefix=prefix)
@@ -391,3 +452,41 @@ class SymbolBlock(HybridBlock):
 
     def hybrid_forward(self, F, *args, **params):
         return self._fn(*args)
+
+    @staticmethod
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None):
+        """Load an `export()`ed model: returns a callable block whose forward
+        runs the deserialized StableHLO program (bit-identical to the
+        exporter's inference forward).  Mirrors the reference's
+        SymbolBlock.imports(symbol_file, input_names, param_file)."""
+        import json
+        import os
+
+        import numpy as _np
+        from jax import export as jexport
+
+        with open(symbol_file) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != "tpu_mx-stablehlo-v1":
+            raise MXNetError(f"unsupported export format in {symbol_file}")
+        art = os.path.join(os.path.dirname(symbol_file) or ".",
+                           manifest["artifact"])
+        with open(art, "rb") as f:
+            exported = jexport.deserialize(f.read())
+        from ..ndarray import load as nd_load
+        if param_file is None:
+            raise MXNetError("param_file is required")
+        payload = {k: v._data for k, v in nd_load(param_file).items()}
+        key0 = _random.take_key()
+
+        def fn(*inputs):
+            raw = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                   for a in inputs]
+            out = exported.call(payload, key0, *raw)
+            if isinstance(out, (tuple, list)):
+                return [NDArray(o) for o in out]
+            return NDArray(out)
+
+        blk = SymbolBlock(fn)
+        blk._export_manifest = manifest
+        return blk

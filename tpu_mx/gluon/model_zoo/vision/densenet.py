@@ -19,7 +19,7 @@ class _DenseLayer(HybridBlock):
         self.body.add(nn.Conv2D(growth_rate, 3, padding=1, use_bias=False))
         if dropout:
             self.body.add(nn.Dropout(dropout))
-        self._caxis = _layout_mod.bn_axis()
+        self._caxis = _layout_mod.channel_axis()
 
     def hybrid_forward(self, F, x):
         return F.concat(x, self.body(x), dim=self._caxis)

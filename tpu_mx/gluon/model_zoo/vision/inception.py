@@ -26,7 +26,7 @@ class _Branches(HybridBlock):
         for i, b in enumerate(branches):
             setattr(self, f"b{i}", b)
             self.branches.append(b)
-        self._caxis = _layout_mod.bn_axis()
+        self._caxis = _layout_mod.channel_axis()
 
     def hybrid_forward(self, F, x):
         return F.concat(*[b(x) for b in self.branches], dim=self._caxis)
@@ -85,7 +85,7 @@ class _MixedE(HybridBlock):
         self.b2a = _conv(384, (1, 3), padding=(0, 1))
         self.b2b = _conv(384, (3, 1), padding=(1, 0))
         self.b3 = _seq(nn.AvgPool2D(3, 1, 1), _conv(192, 1))
-        self._caxis = _layout_mod.bn_axis()
+        self._caxis = _layout_mod.channel_axis()
 
     def hybrid_forward(self, F, x):
         y1 = self.b1_stem(x)

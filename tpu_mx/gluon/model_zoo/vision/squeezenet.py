@@ -16,7 +16,7 @@ def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
             self.e1 = nn.Conv2D(expand1x1_channels, 1, activation="relu")
             self.e3 = nn.Conv2D(expand3x3_channels, 3, padding=1,
                                 activation="relu")
-            self._caxis = _layout_mod.bn_axis()  # channel axis under the
+            self._caxis = _layout_mod.channel_axis()  # channel axis under the
             # active default_layout at build time
 
         def hybrid_forward(self, F, x):

@@ -73,8 +73,12 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         """Aggregate gradients: sum over the per-device list (CommDevice
-        analog).  Under multi-host SPMD the cross-host sum happens inside the
-        jitted step via psum; this host-level sum covers the eager path."""
+        analog), then — for `dist_*` stores — a *real* cross-process reduce
+        (REF:src/kvstore/kvstore_dist.h push → ps-lite server-side sum;
+        REF:tests/nightly/dist_sync_kvstore.py asserts this math).  The jitted
+        train-step path uses an in-program psum instead; this covers eager
+        push/pull.  Compression (2-bit sim) is applied per-worker before the
+        reduce, matching the reference's worker→server message compression."""
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vlist = v if isinstance(v, list) else [v]
@@ -83,10 +87,22 @@ class KVStore:
                 agg = agg + extra
             if self._compression is not None:
                 agg = self._compression.compress_decompress(agg)
+            agg = self._global_sum(agg)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
             else:
                 self._store[f"_pending_{k}"] = agg
+
+    def _global_sum(self, agg):
+        """Eager cross-process sum: allgather over the process group, reduce
+        on host.  Every rank must call push with the same keys in the same
+        order (the reference's bulk-synchronous contract)."""
+        if not self._is_dist or self._num_workers <= 1:
+            return agg
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(agg._data)  # (W, ...)
+        return NDArray(jnp.asarray(gathered).sum(axis=0).astype(agg.dtype))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)

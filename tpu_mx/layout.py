@@ -39,10 +39,16 @@ def is_channels_last(layout: str | None) -> bool:
     return layout is not None and layout.endswith("C")
 
 
-def bn_axis() -> int:
-    """Default BatchNorm channel axis under the current layout mode."""
+def channel_axis() -> int:
+    """Channel axis under the current layout mode (for concat, BatchNorm,
+    any channel-wise op): 1 channels-first, -1 channels-last."""
     return -1 if getattr(_state, "mode", "channels_first") == "channels_last" \
         else 1
+
+
+def bn_axis() -> int:
+    """Default BatchNorm channel axis — alias of `channel_axis()`."""
+    return channel_axis()
 
 
 @contextmanager

@@ -33,9 +33,15 @@ def _is_float(dtype):
 def _ctx_of(data):
     try:
         dev = list(data.devices())[0]
-        if dev.platform == "cpu":
-            return Context("cpu", dev.id)
-        return Context("tpu", dev.id)
+        kind = "cpu" if dev.platform == "cpu" else "tpu"
+        # Context ids are indices into this process's local device list, not
+        # raw jax device ids (under jax.distributed a worker's only local CPU
+        # device can carry a global id like 2048).
+        locals_ = [d for d in jax.local_devices() if d.platform == dev.platform]
+        try:
+            return Context(kind, locals_.index(dev))
+        except ValueError:
+            return Context(kind, 0)  # non-addressable/global array
     except Exception:
         return default_context()
 
@@ -116,7 +122,18 @@ class NDArray:
         return self._data  # "handle" = the underlying buffer in this stack
 
     def attach_grad(self, grad_req="write", stype=None):
-        """Allocate a gradient buffer so backward() deposits into ``.grad``."""
+        """Allocate a gradient buffer so backward() deposits into ``.grad``.
+
+        Divergence (SURVEY §7.3.4): ``stype='row_sparse'`` gradients are
+        DENSE here — XLA:TPU has no sparse gradient storage; the request is
+        honored numerically (same values, dense layout) and warned about.
+        """
+        if stype not in (None, "default"):
+            import warnings
+            warnings.warn(
+                f"attach_grad(stype={stype!r}): TPU gradients are always "
+                "dense; storing dense values (documented divergence, "
+                "SURVEY §7.3.4)", stacklevel=2)
         self._grad = NDArray(jnp.zeros(self.shape, self.dtype))
         self._grad_req = grad_req
 
@@ -488,8 +505,21 @@ def from_numpy(a, zero_copy=False):
 
 
 def waitall():
-    """Engine WaitForAll analog."""
-    (jax.device_put(0.0) + 0).block_until_ready()
+    """Engine WaitForAll analog (REF:include/mxnet/engine.h WaitForAll).
+
+    Blocks until every live jax.Array in the process is ready — a real sync
+    of all previously dispatched device work, not just a fresh dummy
+    computation (which would only bound the dispatch queue, not completion
+    on every device)."""
+    for a in jax.live_arrays():
+        try:
+            a.block_until_ready()
+        except RuntimeError as e:
+            # deleted/donated buffers are expected flotsam; real async
+            # computation failures must surface (WaitForAll semantics)
+            if "deleted" in str(e).lower() or "donated" in str(e).lower():
+                continue
+            raise
     try:
         jax.effects_barrier()
     except Exception:

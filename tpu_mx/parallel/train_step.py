@@ -223,3 +223,56 @@ class CompiledTrainStep:
         self.masters = sd.get("masters", {})
         self.opt_states = sd["opt_states"]
         self._t = sd["t"]
+
+    # -- sharded checkpointing (SURVEY §5.4) ----------------------------------
+    def _abstract_state(self):
+        """ShapeDtypeStructs of the full train state with CURRENT mesh
+        shardings — the restore target, so a checkpoint saved on one mesh
+        (e.g. dp=2×tp=2) reshards onto this one (e.g. dp=4) at load."""
+        def leaf(spec):
+            def f(v):
+                sh = sharding_for(self.mesh, spec)
+                if sh is None:
+                    return jax.ShapeDtypeStruct(jnp.shape(v),
+                                                jnp.result_type(v))
+                return jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v),
+                                            sharding=sh)
+            return f
+
+        return {
+            "values": {k: leaf(self._specs[k])(v)
+                       for k, v in self.values.items()},
+            "masters": {k: leaf(self._specs[k])(v)
+                        for k, v in self.masters.items()},
+            "opt_states": {
+                k: jax.tree_util.tree_map(leaf(self._specs[k]),
+                                          self.opt_states[k])
+                for k in self._diff_keys},
+            "t": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+
+    def save_checkpoint(self, path):
+        """Sharded checkpoint: every host writes only its own parameter
+        shards, in parallel, via orbax/tensorstore — no gather through host
+        memory (the reference gathered to rank 0 and wrote one file;
+        REF:python/mxnet/module/module.py save_checkpoint)."""
+        import orbax.checkpoint as ocp
+        import os
+        state = dict(self.state_dict())
+        state["t"] = jnp.asarray(state["t"], jnp.int32)
+        ck = ocp.StandardCheckpointer()
+        ck.save(os.path.abspath(str(path)), state, force=True)
+        ck.wait_until_finished()
+
+    def load_checkpoint(self, path):
+        """Restore a sharded checkpoint onto THIS step's mesh — the saved
+        mesh/layout may differ (dp=2×tp=2 → dp=4 etc.); every host reads
+        only the shards its devices need."""
+        import orbax.checkpoint as ocp
+        import os
+        ck = ocp.StandardCheckpointer()
+        state = ck.restore(os.path.abspath(str(path)), self._abstract_state())
+        self.values = state["values"]
+        self.masters = state.get("masters", {})
+        self.opt_states = state["opt_states"]
+        self._t = int(state["t"])
