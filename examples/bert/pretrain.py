@@ -93,7 +93,9 @@ def main():
     n_seq = args.steps * args.batch_size
     print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f}  "
           f"({n_seq / (time.time() - tic):.1f} seq/s)")
-    assert losses[-1] < losses[0], "MLM loss should decrease"
+    k = min(3, len(losses))
+    assert sum(losses[-k:]) / k < sum(losses[:k]) / k, \
+        "MLM loss should decrease"
 
 
 if __name__ == "__main__":

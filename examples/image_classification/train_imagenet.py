@@ -56,6 +56,7 @@ def main():
     if args.smoke:
         args.network, args.num_classes = "resnet18_v1", 100
         args.batch_size, args.image_shape = 8, 64
+        args.lr = 0.02  # full-run lr diverges on the 16-sample smoke set
 
     net = vision.get_model(args.network, classes=args.num_classes)
     net.initialize(init="xavier")

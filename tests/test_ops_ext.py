@@ -1,0 +1,421 @@
+"""Extended operator families (VERDICT r1 item 4): linalg la_op, ROI ops,
+spatial transforms, CTC, fused RNN, int8 compute, per-element samplers.
+Oracles: numpy/scipy math, torch CPU (CTC, RNN), analytic identities."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+from tpu_mx.test_utils import check_numeric_gradient
+
+
+rs = np.random.RandomState(42)
+
+
+# ---------------------------------------------------------------------------
+# linalg (REF:src/operator/tensor/la_op.cc)
+# ---------------------------------------------------------------------------
+class TestLinalg:
+    def _spd(self, n=4):
+        a = rs.rand(n, n).astype(np.float32)
+        return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+    def test_trsm(self):
+        a = np.tril(rs.rand(4, 4).astype(np.float32)) + 2 * np.eye(4, dtype=np.float32)
+        b = rs.rand(4, 3).astype(np.float32)
+        x = nd.linalg_trsm(nd.array(a), nd.array(b), alpha=2.0).asnumpy()
+        np.testing.assert_allclose(a @ x, 2.0 * b, rtol=1e-4, atol=1e-5)
+        # rightside: X op(A) = alpha B with B (3, 4)
+        b2 = rs.rand(3, 4).astype(np.float32)
+        x2 = nd.linalg_trsm(nd.array(a), nd.array(b2), rightside=True).asnumpy()
+        np.testing.assert_allclose(x2 @ a, b2, rtol=1e-4, atol=1e-5)
+
+    def test_trmm(self):
+        a = rs.rand(4, 4).astype(np.float32)
+        b = rs.rand(4, 3).astype(np.float32)
+        out = nd.linalg_trmm(nd.array(a), nd.array(b)).asnumpy()
+        np.testing.assert_allclose(out, np.tril(a) @ b, rtol=1e-5)
+        out_t = nd.linalg_trmm(nd.array(a), nd.array(b), transpose=True).asnumpy()
+        np.testing.assert_allclose(out_t, np.tril(a).T @ b, rtol=1e-5)
+
+    def test_det_slogdet_inverse(self):
+        a = self._spd()
+        np.testing.assert_allclose(nd.linalg_det(nd.array(a)).asnumpy(),
+                                   np.linalg.det(a), rtol=1e-3)
+        sign, logabs = nd.linalg_slogdet(nd.array(a))
+        s_ref, l_ref = np.linalg.slogdet(a)
+        np.testing.assert_allclose(sign.asnumpy(), s_ref, rtol=1e-5)
+        np.testing.assert_allclose(logabs.asnumpy(), l_ref, rtol=1e-4)
+        np.testing.assert_allclose(nd.linalg_inverse(nd.array(a)).asnumpy(),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+
+    def test_potri(self):
+        spd = self._spd()
+        L = np.linalg.cholesky(spd).astype(np.float32)
+        out = nd.linalg_potri(nd.array(L)).asnumpy()
+        np.testing.assert_allclose(out, np.linalg.inv(spd), rtol=1e-2,
+                                   atol=1e-3)
+
+    def test_diag_roundtrip(self):
+        v = rs.rand(5).astype(np.float32)
+        m = nd.linalg_makediag(nd.array(v)).asnumpy()
+        np.testing.assert_allclose(m, np.diag(v))
+        np.testing.assert_allclose(
+            nd.linalg_extractdiag(nd.array(m)).asnumpy(), v)
+        m1 = nd.linalg_makediag(nd.array(v), offset=1).asnumpy()
+        np.testing.assert_allclose(m1, np.diag(v, k=1))
+
+    def test_trian_roundtrip(self):
+        a = rs.rand(4, 4).astype(np.float32)
+        packed = nd.linalg_extracttrian(nd.array(a)).asnumpy()
+        assert packed.shape == (10,)
+        back = nd.linalg_maketrian(nd.array(packed)).asnumpy()
+        np.testing.assert_allclose(back, np.tril(a), rtol=1e-6)
+
+    def test_gelqf(self):
+        a = rs.rand(3, 5).astype(np.float32)
+        L, Q = nd.linalg_gelqf(nd.array(a))
+        L, Q = L.asnumpy(), Q.asnumpy()
+        np.testing.assert_allclose(L @ Q, a, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(Q @ Q.T, np.eye(3), atol=1e-5)
+        assert np.all(np.diag(L) >= 0)
+
+    def test_syevd(self):
+        a = self._spd()
+        U, lam = nd.linalg_syevd(nd.array(a))
+        U, lam = U.asnumpy(), lam.asnumpy()
+        np.testing.assert_allclose(U.T @ np.diag(lam) @ U, a, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_sumlogdiag(self):
+        a = self._spd()
+        np.testing.assert_allclose(
+            nd.linalg_sumlogdiag(nd.array(a)).asnumpy(),
+            np.sum(np.log(np.diag(a))), rtol=1e-5)
+
+    def test_det_gradient(self):
+        a = self._spd(3)
+        check_numeric_gradient(lambda xs: nd.linalg_det(xs[0]), [a],
+                               rtol=1e-2, atol=1e-2)
+
+    def test_trsm_gradient(self):
+        a = np.tril(rs.rand(3, 3).astype(np.float32)) + 2 * np.eye(3, dtype=np.float32)
+        b = rs.rand(3, 2).astype(np.float32)
+        check_numeric_gradient(
+            lambda xs: nd.sum(nd.linalg_trsm(nd.array(a), xs[0])),
+            [b], rtol=1e-2, atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# ROI + spatial transforms
+# ---------------------------------------------------------------------------
+class TestVisionOps:
+    def test_roipooling_uniform(self):
+        # constant feature map -> every pooled cell equals the constant
+        x = np.full((1, 2, 8, 8), 5.0, np.float32)
+        rois = np.array([[0, 0, 0, 7, 7], [0, 2, 2, 5, 5]], np.float32)
+        out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                            spatial_scale=1.0).asnumpy()
+        assert out.shape == (2, 2, 2, 2)
+        np.testing.assert_allclose(out, 5.0)
+
+    def test_roipooling_max_structure(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 0, 0] = 9.0  # hot corner
+        rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+        out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2)
+                            ).asnumpy()
+        assert out[0, 0, 0, 0] == 9.0 and out[0, 0, 1, 1] == 0.0
+
+    def test_roialign_uniform_and_grad(self):
+        x = np.full((1, 3, 8, 8), 2.5, np.float32)
+        rois = np.array([[0, 1.0, 1.0, 6.0, 6.0]], np.float32)
+        out = nd.ROIAlign(nd.array(x), nd.array(rois), pooled_size=(3, 3),
+                          spatial_scale=1.0).asnumpy()
+        np.testing.assert_allclose(out, 2.5, rtol=1e-6)
+        xv = rs.rand(1, 1, 6, 6).astype(np.float32)
+        check_numeric_gradient(
+            lambda xs: nd.sum(nd.ROIAlign(xs[0], nd.array(rois),
+                                          pooled_size=(2, 2))),
+            [xv], rtol=1e-2, atol=1e-2)
+
+    def test_grid_generator_identity(self):
+        theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)  # identity affine
+        grid = nd.GridGenerator(nd.array(theta), "affine",
+                                target_shape=(4, 6)).asnumpy()
+        assert grid.shape == (1, 2, 4, 6)
+        np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 6),
+                                   atol=1e-6)
+        np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 4),
+                                   atol=1e-6)
+
+    def test_spatial_transformer_identity(self):
+        x = rs.rand(2, 3, 5, 5).astype(np.float32)
+        theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+        out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                    target_shape=(5, 5)).asnumpy()
+        np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+    def test_bilinear_sampler_grad(self):
+        x = rs.rand(1, 2, 5, 5).astype(np.float32)
+        theta = np.array([[0.8, 0.1, 0.0, -0.1, 0.9, 0.05]], np.float32)
+        grid = nd.GridGenerator(nd.array(theta), "affine", target_shape=(4, 4))
+        check_numeric_gradient(
+            lambda xs: nd.sum(nd.BilinearSampler(xs[0], grid)),
+            [x], rtol=1e-2, atol=1e-2)
+
+    def test_bilinear_resize_and_upsampling(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = nd.BilinearResize2D(nd.array(x), height=8, width=8).asnumpy()
+        assert out.shape == (1, 1, 8, 8)
+        assert abs(out.mean() - x.mean()) < 0.2
+        up = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest"
+                           ).asnumpy()
+        assert up.shape == (1, 1, 8, 8)
+        np.testing.assert_allclose(up[0, 0, :2, :2], x[0, 0, 0, 0])
+
+    def test_proposal_shapes_and_validity(self):
+        N, A, Hf, Wf = 1, 3, 4, 4
+        cls = rs.rand(N, 2 * A, Hf, Wf).astype(np.float32)
+        deltas = (rs.rand(N, 4 * A, Hf, Wf).astype(np.float32) - 0.5) * 0.1
+        im_info = np.array([[64, 64, 1.0]], np.float32)
+        rois = nd.Proposal(nd.array(cls), nd.array(deltas), nd.array(im_info),
+                           rpn_pre_nms_top_n=20, rpn_post_nms_top_n=8,
+                           feature_stride=16, scales=(2, 4, 8),
+                           ratios=(1.0,), rpn_min_size=1).asnumpy()
+        assert rois.shape == (1, 8, 5)
+        assert np.all(rois[..., 0] == 0)  # batch index
+        assert np.all(rois[..., 1:] >= 0) and np.all(rois[..., 1:] <= 63)
+        assert np.all(rois[..., 3] >= rois[..., 1])  # x2 >= x1
+
+
+# ---------------------------------------------------------------------------
+# CTC vs torch (REF:src/operator/contrib/ctc_loss)
+# ---------------------------------------------------------------------------
+class TestCTC:
+    def _torch_ctc(self, acts, labels, in_lens, lab_lens, blank):
+        import torch
+        logp = torch.log_softmax(torch.tensor(acts), dim=-1)
+        return torch.nn.functional.ctc_loss(
+            logp, torch.tensor(labels), torch.tensor(in_lens),
+            torch.tensor(lab_lens), blank=blank, reduction="none",
+            zero_infinity=False).numpy()
+
+    def test_matches_torch_blank_first(self):
+        T, N, C, L = 10, 3, 6, 4
+        acts = rs.rand(T, N, C).astype(np.float32) * 2
+        labels = rs.randint(1, C, (N, L)).astype(np.float32)
+        lab_lens = np.array([4, 2, 3])
+        padded = labels.copy()
+        for i, ll in enumerate(lab_lens):
+            padded[i, ll:] = 0  # blank_label='first': 0-padding ends label
+        out = nd.ctc_loss(nd.array(acts), nd.array(padded)).asnumpy()
+        ref = self._torch_ctc(acts, labels.astype(np.int64),
+                              [T] * N, lab_lens, blank=0)
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_matches_torch_with_lengths(self):
+        T, N, C, L = 12, 2, 5, 3
+        acts = rs.rand(T, N, C).astype(np.float32)
+        labels = rs.randint(0, C - 1, (N, L)).astype(np.float32)
+        in_lens = np.array([12, 9])
+        lab_lens = np.array([3, 2])
+        out = nd.ctc_loss(nd.array(acts), nd.array(labels),
+                          data_lengths=nd.array(in_lens),
+                          label_lengths=nd.array(lab_lens),
+                          use_data_lengths=True, use_label_lengths=True,
+                          blank_label="last").asnumpy()
+        ref = self._torch_ctc(acts, labels.astype(np.int64), in_lens,
+                              lab_lens, blank=C - 1)
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_gluon_ctc_loss_and_grad(self):
+        from tpu_mx import autograd, gluon
+        T, N, C = 8, 2, 5
+        acts = nd.array(rs.rand(N, T, C).astype(np.float32))  # NTC layout
+        labels = nd.array(np.array([[1, 2, 0], [3, 1, 4]], np.float32))
+        loss_fn = gluon.loss.CTCLoss()
+        acts.attach_grad()
+        with autograd.record():
+            l = loss_fn(acts, labels).mean()
+        l.backward()
+        g = acts.grad.asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+# ---------------------------------------------------------------------------
+# fused RNN op vs torch (REF:src/operator/rnn.cc)
+# ---------------------------------------------------------------------------
+class TestFusedRNN:
+    def _pack_torch(self, tmod, mode, num_layers, bidirectional):
+        """Pack torch weights into the cuDNN-layout blob RNN expects."""
+        parts_w, parts_b = [], []
+        d = 2 if bidirectional else 1
+        for layer in range(num_layers):
+            for di in range(d):
+                sfx = f"_l{layer}" + ("_reverse" if di else "")
+                parts_w.append(getattr(tmod, f"weight_ih{sfx}").detach().numpy().ravel())
+                parts_w.append(getattr(tmod, f"weight_hh{sfx}").detach().numpy().ravel())
+        for layer in range(num_layers):
+            for di in range(d):
+                sfx = f"_l{layer}" + ("_reverse" if di else "")
+                parts_b.append(getattr(tmod, f"bias_ih{sfx}").detach().numpy().ravel())
+                parts_b.append(getattr(tmod, f"bias_hh{sfx}").detach().numpy().ravel())
+        return np.concatenate(parts_w + parts_b).astype(np.float32)
+
+    @pytest.mark.parametrize("mode,layers,bi", [
+        ("lstm", 1, False), ("lstm", 2, False), ("lstm", 1, True),
+        ("gru", 1, False), ("gru", 2, True),
+        ("rnn_tanh", 1, False), ("rnn_relu", 1, False),
+    ])
+    def test_matches_torch(self, mode, layers, bi):
+        import torch
+        T, N, I, H = 5, 3, 4, 6
+        d = 2 if bi else 1
+        torch.manual_seed(0)
+        cls = {"lstm": torch.nn.LSTM, "gru": torch.nn.GRU}.get(mode)
+        if cls is None:
+            tmod = torch.nn.RNN(I, H, layers, bidirectional=bi,
+                                nonlinearity=mode.split("_")[1])
+        else:
+            tmod = cls(I, H, layers, bidirectional=bi)
+        x = rs.rand(T, N, I).astype(np.float32)
+        h0 = np.zeros((layers * d, N, H), np.float32)
+        params = self._pack_torch(tmod, mode, layers, bi)
+        from tpu_mx.ndarray.rnn_op import rnn_param_size
+        assert params.size == rnn_param_size(mode, I, H, layers, bi)
+
+        args = dict(state_size=H, num_layers=layers, mode=mode,
+                    bidirectional=bi, state_outputs=True)
+        if mode == "lstm":
+            c0 = np.zeros((layers * d, N, H), np.float32)
+            out, hN, cN = nd.RNN(nd.array(x), nd.array(params), nd.array(h0),
+                                 nd.array(c0), **args)
+        else:
+            out, hN = nd.RNN(nd.array(x), nd.array(params), nd.array(h0),
+                             **args)
+        with torch.no_grad():
+            if mode == "lstm":
+                t_out, (t_h, t_c) = tmod(torch.tensor(x))
+                np.testing.assert_allclose(cN.asnumpy(), t_c.numpy(),
+                                           rtol=1e-4, atol=1e-5)
+            else:
+                t_out, t_h = tmod(torch.tensor(x))
+        np.testing.assert_allclose(out.asnumpy(), t_out.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(hN.asnumpy(), t_h.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rnn_grad_flows(self):
+        from tpu_mx import autograd
+        from tpu_mx.ndarray.rnn_op import rnn_param_size
+        T, N, I, H = 4, 2, 3, 5
+        params = nd.array(rs.rand(
+            rnn_param_size("lstm", I, H)).astype(np.float32) * 0.1)
+        x = nd.array(rs.rand(T, N, I).astype(np.float32))
+        h0 = nd.zeros((1, N, H))
+        c0 = nd.zeros((1, N, H))
+        params.attach_grad()
+        with autograd.record():
+            out = nd.RNN(x, params, h0, c0, state_size=H, num_layers=1,
+                         mode="lstm")
+            loss = nd.sum(out)
+        loss.backward()
+        assert float(nd.norm(params.grad).asnumpy()) > 0
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized compute (REF:src/operator/quantization/)
+# ---------------------------------------------------------------------------
+class TestQuantized:
+    def test_quantize_dequantize_roundtrip(self):
+        x = (rs.rand(4, 8).astype(np.float32) - 0.5) * 6
+        q, mn, mx_ = nd.quantize_v2(nd.array(x))
+        assert q.dtype == np.int8
+        back = nd.dequantize(q, mn, mx_).asnumpy()
+        assert np.abs(back - x).max() < np.abs(x).max() / 127 + 1e-6
+
+    def test_quantized_fully_connected_vs_float(self):
+        x = (rs.rand(5, 16).astype(np.float32) - 0.5) * 4
+        w = (rs.rand(8, 16).astype(np.float32) - 0.5) * 2
+        qx, mnx, mxx = nd.quantize_v2(nd.array(x))
+        qw, mnw, mxw = nd.quantize_v2(nd.array(w))
+        y32, mny, mxy = nd.quantized_fully_connected(
+            qx, qw, None, mnx, mxx, mnw, mxw, num_hidden=8, no_bias=True)
+        assert y32.dtype == np.int32
+        y = nd.dequantize(nd.cast(y32, "int8"), mny, mxy)  # not the real path
+        # proper dequant of the int32 accumulator:
+        amax = float(mxy.asnumpy().ravel()[0] if hasattr(mxy, 'asnumpy') else mxy)
+        y_real = y32.asnumpy().astype(np.float32) * (amax / 127.0 ** 2)
+        ref = x @ w.T
+        tol = np.abs(ref).max() * 0.03 + 0.05
+        assert np.abs(y_real - ref).max() < tol
+
+    def test_quantized_conv_vs_float(self):
+        x = (rs.rand(1, 4, 6, 6).astype(np.float32) - 0.5) * 2
+        w = (rs.rand(3, 4, 3, 3).astype(np.float32) - 0.5)
+        qx, mnx, mxx = nd.quantize_v2(nd.array(x))
+        qw, mnw, mxw = nd.quantize_v2(nd.array(w))
+        y32, mny, mxy = nd.quantized_conv(
+            qx, qw, None, mnx, mxx, mnw, mxw, kernel=(3, 3), num_filter=3,
+            pad=(1, 1))
+        amax = float(mxy.asnumpy().ravel()[0])
+        y_real = y32.asnumpy().astype(np.float32) * (amax / 127.0 ** 2)
+        import jax.numpy as jnp
+        from jax import lax
+        ref = np.asarray(lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW")))
+        tol = np.abs(ref).max() * 0.05 + 0.05
+        assert np.abs(y_real - ref).max() < tol
+
+    def test_requantize(self):
+        x = (rs.rand(3, 3).astype(np.float32) - 0.5) * 8
+        qx, mn, mx_ = nd.quantize_v2(nd.array(x))
+        # fake an int32 accumulator representing x directly
+        import numpy as np_
+        q32 = nd.cast(nd.array(np.round(x * (127.0 ** 2) / 8.0)), "int32")
+        q8, mn8, mx8 = nd.requantize(q32, nd.array(-8.0), nd.array(8.0))
+        back = q8.asnumpy().astype(np.float32) * \
+            (float(mx8.asnumpy().ravel()[0]) / 127.0)
+        assert np.abs(back - x).max() < 0.2
+
+
+# ---------------------------------------------------------------------------
+# per-element samplers (REF:src/operator/random/multisample_op.cc)
+# ---------------------------------------------------------------------------
+class TestSamplers:
+    def test_sample_normal_shapes_and_moments(self):
+        mu = nd.array(np.array([[0.0, 10.0]], np.float32))
+        sig = nd.array(np.array([[1.0, 0.1]], np.float32))
+        out = nd.sample_normal(mu, sig, shape=4000).asnumpy()
+        assert out.shape == (1, 2, 4000)
+        assert abs(out[0, 0].mean()) < 0.15
+        assert abs(out[0, 1].mean() - 10.0) < 0.05
+
+    def test_sample_gamma_mean(self):
+        alpha = nd.array(np.array([2.0, 9.0], np.float32))
+        beta = nd.array(np.array([3.0, 0.5], np.float32))
+        out = nd.sample_gamma(alpha, beta, shape=4000).asnumpy()
+        assert out.shape == (2, 4000)
+        np.testing.assert_allclose(out.mean(1), [6.0, 4.5], rtol=0.15)
+
+    def test_sample_exponential_poisson(self):
+        lam = nd.array(np.array([0.5, 4.0], np.float32))
+        e = nd.sample_exponential(lam, shape=4000).asnumpy()
+        np.testing.assert_allclose(e.mean(1), [2.0, 0.25], rtol=0.2)
+        p = nd.sample_poisson(lam, shape=4000).asnumpy()
+        np.testing.assert_allclose(p.mean(1), [0.5, 4.0], rtol=0.2)
+
+    def test_negative_binomial_mean(self):
+        k = nd.array(np.array([4.0], np.float32))
+        p = nd.array(np.array([0.5], np.float32))
+        out = nd.sample_negative_binomial(k, p, shape=4000).asnumpy()
+        # mean = k (1-p)/p = 4
+        np.testing.assert_allclose(out.mean(), 4.0, rtol=0.25)
+        g = nd.sample_generalized_negative_binomial(
+            nd.array(np.array([3.0], np.float32)),
+            nd.array(np.array([0.4], np.float32)), shape=4000).asnumpy()
+        np.testing.assert_allclose(g.mean(), 3.0, rtol=0.25)
+        r = nd.random_negative_binomial(k=3, p=0.4, shape=(2000,))
+        np.testing.assert_allclose(r.asnumpy().mean(), 4.5, rtol=0.3)

@@ -1,0 +1,120 @@
+"""CTC loss (REF:src/operator/contrib/ctc_loss-inl.h — warp-ctc/cuDNN CTC
+kernels; REF:python/mxnet/gluon/loss.py CTCLoss).
+
+TPU-native design: the classic alpha (forward) recursion in log space,
+expressed as a `lax.scan` over time with the extended label sequence
+(blank-interleaved) as a static-width lane dimension — one fused XLA loop,
+batch vmapped.  The backward pass is jax autodiff through the scan (the
+reference hand-writes the beta recursion; vjp-of-scan computes exactly
+that), so CTCLoss composes with every other op and with jit.
+
+Layout conventions match the reference: data (T, N, C+1) activations
+(softmax applied internally), label (N, L) with padding, blank index 0 or
+C (`blank_label` 'first'/'last').
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ops import _apply
+
+__all__ = ["CTCLoss", "ctc_loss"]
+
+_NEG = -1e30
+
+
+def _logsumexp2(a, b):
+    m = jnp.maximum(a, b)
+    m_safe = jnp.maximum(m, _NEG)
+    return m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe))
+
+
+def _logsumexp3(a, b, c):
+    return _logsumexp2(_logsumexp2(a, b), c)
+
+
+def _ctc_single(logp, labels, input_len, label_len, blank):
+    """Negative log likelihood for one sequence.
+    logp: (T, C) log-probs; labels: (L,) int; lens: scalars."""
+    T, C = logp.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((S,), blank, jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    pos = jnp.arange(S)
+    valid_s = pos < 2 * label_len + 1
+    # transitions: from s (stay), s-1, and s-2 when ext[s] != blank and
+    # ext[s] != ext[s-2] (the CTC skip rule)
+    ext_m2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((S,), _NEG)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(label_len > 0, logp[0, ext[1]], _NEG))
+
+    def step(alpha, logp_t):
+        a_prev = jnp.concatenate([jnp.full((1,), _NEG), alpha[:-1]])
+        a_prev2 = jnp.concatenate([jnp.full((2,), _NEG), alpha[:-2]])
+        a = _logsumexp3(alpha, a_prev,
+                        jnp.where(can_skip, a_prev2, _NEG))
+        a = a + logp_t[ext]
+        a = jnp.where(valid_s, a, _NEG)
+        return a, a
+
+    _, alphas = lax.scan(step, alpha0, logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], 0)     # (T, S)
+    # likelihood at t = input_len - 1, states 2*label_len and 2*label_len - 1
+    t_last = jnp.clip(input_len - 1, 0, T - 1)
+    a_T = alphas[t_last]
+    end1 = a_T[jnp.clip(2 * label_len, 0, S - 1)]
+    end2 = jnp.where(label_len > 0,
+                     a_T[jnp.clip(2 * label_len - 1, 0, S - 1)], _NEG)
+    return -_logsumexp2(end1, end2)
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first", **kw):
+    """(T, N, C) activations + (N, L) labels -> (N,) loss.  Without explicit
+    lengths, label padding follows the reference: `-1` padding always ends a
+    label; with blank_label='first', `0` padding also ends it (labels are
+    then 1-based with 0 reserved for blank)."""
+
+    def f(acts, lab, *lens):
+        T, N, C = acts.shape
+        logp = jax.nn.log_softmax(acts.astype(jnp.float32), axis=-1)
+        logp = jnp.transpose(logp, (1, 0, 2))                # (N, T, C)
+        lab = lab.astype(jnp.int32)
+        if use_data_lengths:
+            in_lens = lens[0].astype(jnp.int32)
+        else:
+            in_lens = jnp.full((N,), T, jnp.int32)
+        pad_end = (lab < 0) | ((lab == 0) if blank_label == "first" else
+                               jnp.zeros_like(lab, bool))
+        if use_label_lengths:
+            lab_lens = lens[-1].astype(jnp.int32)
+        else:
+            # first padding position (or L)
+            lab_lens = jnp.argmax(
+                jnp.concatenate(
+                    [pad_end, jnp.ones((N, 1), bool)], 1), axis=1
+            ).astype(jnp.int32)
+        # labels are direct class indices in both conventions: 1..C-1 when
+        # blank is channel 0 ('first'), 0..C-2 when blank is the last channel
+        blank = 0 if blank_label == "first" else C - 1
+        lab_eff = jnp.clip(lab, 0, C - 1)
+        return jax.vmap(_ctc_single, in_axes=(0, 0, 0, 0, None))(
+            logp, lab_eff, in_lens, lab_lens, blank)
+
+    args = [data, label]
+    if use_data_lengths:
+        args.append(data_lengths)
+    if use_label_lengths:
+        args.append(label_lengths)
+    return _apply(f, args, "ctc_loss")
+
+
+CTCLoss = ctc_loss

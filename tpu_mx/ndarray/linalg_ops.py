@@ -1,0 +1,183 @@
+"""mx.nd.linalg_* — the la_op family (REF:src/operator/tensor/la_op.cc,
+la_op.h: LAPACK/cuSOLVER kernels registered per-op).
+
+TPU-native design: every op is a thin pure wrapper over
+`jax.scipy.linalg`/`jnp.linalg`, which XLA lowers to its native
+triangular-solve / cholesky / eigh HLOs (tiled onto the MXU where possible)
+— no LAPACK workspace management, and batching comes from the leading
+dimensions instead of hand-written batch loops.  All ops operate on the
+last two axes and broadcast over the rest, matching the reference's
+"tensor of matrices" convention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ops import _apply
+
+__all__ = ["linalg_trsm", "linalg_trmm", "linalg_det", "linalg_slogdet",
+           "linalg_inverse", "linalg_potri", "linalg_makediag",
+           "linalg_extractdiag", "linalg_maketrian", "linalg_extracttrian",
+           "linalg_gelqf", "linalg_syevd", "linalg_sumlogdiag"]
+
+
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0,
+                **kw):
+    """Triangular solve: op(A) X = alpha*B (or X op(A) = alpha*B when
+    `rightside`).  REF:la_op trsm."""
+
+    def f(a, b):
+        if rightside:
+            # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
+            x = jax.scipy.linalg.solve_triangular(
+                a, jnp.swapaxes(alpha * b, -1, -2),
+                trans=0 if transpose else 1, lower=lower)
+            return jnp.swapaxes(x, -1, -2)
+        return jax.scipy.linalg.solve_triangular(
+            a, alpha * b, trans=1 if transpose else 0, lower=lower)
+
+    return _apply(f, [A, B], "linalg_trsm")
+
+
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0,
+                **kw):
+    """Triangular matrix multiply: alpha * op(tri(A)) @ B (B @ op(tri(A))
+    when `rightside`).  REF:la_op trmm."""
+
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            tri = jnp.swapaxes(tri, -1, -2)
+        return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+
+    return _apply(f, [A, B], "linalg_trmm")
+
+
+def linalg_det(A, **kw):
+    """Matrix determinant (REF:la_op det)."""
+    return _apply(jnp.linalg.det, [A], "linalg_det")
+
+
+def linalg_slogdet(A, **kw):
+    """(sign, log|det|) pair (REF:la_op slogdet)."""
+
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return sign, logabs
+
+    return _apply(f, [A], "linalg_slogdet")
+
+
+def linalg_inverse(A, **kw):
+    """Matrix inverse (REF:la_op inverse)."""
+    return _apply(jnp.linalg.inv, [A], "linalg_inverse")
+
+
+def linalg_potri(A, lower=True, **kw):
+    """Inverse of the SPD matrix whose Cholesky factor is A:
+    out = (A Aᵀ)⁻¹ for lower A (REF:la_op potri, LAPACK dpotri)."""
+
+    def f(a):
+        eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+        inv_a = jax.scipy.linalg.solve_triangular(a, eye, lower=lower)
+        return (jnp.matmul(jnp.swapaxes(inv_a, -1, -2), inv_a) if lower
+                else jnp.matmul(inv_a, jnp.swapaxes(inv_a, -1, -2)))
+
+    return _apply(f, [A], "linalg_potri")
+
+
+def linalg_makediag(A, offset=0, **kw):
+    """Vector(s) -> diagonal matrix (REF:la_op makediag)."""
+
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        return base.at[..., r, c].set(a)
+
+    return _apply(f, [A], "linalg_makediag")
+
+
+def linalg_extractdiag(A, offset=0, **kw):
+    """Matrix diagonal(s) -> vector (REF:la_op extractdiag)."""
+    return _apply(lambda a: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1),
+                  [A], "linalg_extractdiag")
+
+
+def linalg_maketrian(A, offset=0, lower=True, **kw):
+    """Packed triangle vector -> triangular matrix (REF:la_op maketrian)."""
+
+    def f(a):
+        k = a.shape[-1]
+        # n(n+1)/2 = k  ->  n
+        n = int((-1 + (1 + 8 * k) ** 0.5) / 2) + abs(offset)
+        m = n  # square output
+        if lower:
+            r, c = jnp.tril_indices(m, k=-abs(offset) if offset else 0)
+            if offset:
+                mask = r - c >= abs(offset)
+                r, c = r[mask][:k], c[mask][:k]
+        else:
+            r, c = jnp.triu_indices(m, k=abs(offset) if offset else 0)
+            if offset:
+                mask = c - r >= abs(offset)
+                r, c = r[mask][:k], c[mask][:k]
+        out = jnp.zeros(a.shape[:-1] + (m, m), a.dtype)
+        return out.at[..., r, c].set(a)
+
+    return _apply(f, [A], "linalg_maketrian")
+
+
+def linalg_extracttrian(A, offset=0, lower=True, **kw):
+    """Triangular part -> packed vector (REF:la_op extracttrian)."""
+
+    def f(a):
+        m = a.shape[-1]
+        if lower:
+            r, c = jnp.tril_indices(m, k=-offset if offset else 0)
+        else:
+            r, c = jnp.triu_indices(m, k=offset if offset else 0)
+        return a[..., r, c]
+
+    return _apply(f, [A], "linalg_extracttrian")
+
+
+def linalg_gelqf(A, **kw):
+    """LQ factorization A = L Q with Q orthonormal rows (REF:la_op gelqf,
+    LAPACK dgelqf).  Computed as the transposed QR of Aᵀ."""
+
+    def f(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+        l = jnp.swapaxes(r, -1, -2)
+        qt = jnp.swapaxes(q, -1, -2)
+        # sign-normalize so L has a non-negative diagonal (LAPACK convention
+        # is sign-ambiguous; fix for determinism)
+        d = jnp.sign(jnp.diagonal(l, axis1=-2, axis2=-1))
+        d = jnp.where(d == 0, 1.0, d).astype(a.dtype)
+        return l * d[..., None, :], qt * d[..., :, None]
+
+    return _apply(f, [A], "linalg_gelqf")
+
+
+def linalg_syevd(A, **kw):
+    """Symmetric eigendecomposition: returns (U, lambda) with
+    A = Uᵀ diag(lambda) U (rows of U are eigenvectors — the reference's
+    convention, REF:la_op syevd)."""
+
+    def f(a):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+
+    return _apply(f, [A], "linalg_syevd")
+
+
+def linalg_sumlogdiag(A, **kw):
+    """sum(log(diag(A))) per matrix (REF:la_op sumlogdiag)."""
+    return _apply(
+        lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)),
+                          axis=-1),
+        [A], "linalg_sumlogdiag")

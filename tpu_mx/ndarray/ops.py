@@ -1316,3 +1316,15 @@ def Custom(*args, op_type=None, **op_params):
     if op_type is None:
         raise ValueError("Custom requires op_type=")
     return _op_mod._invoke_custom(args, op_type, **op_params)
+
+
+# ----------------------------------------------------------------------------
+# extended operator families (separate modules, one public namespace — the
+# reference's registry likewise flattens src/operator/** into mx.nd.*)
+# ----------------------------------------------------------------------------
+from .linalg_ops import *      # noqa: F401,F403,E402
+from .vision_ops import *      # noqa: F401,F403,E402
+from .ctc import *             # noqa: F401,F403,E402
+from .rnn_op import *          # noqa: F401,F403,E402
+from .quantized_ops import *   # noqa: F401,F403,E402
+from .sample_ops import *      # noqa: F401,F403,E402

@@ -1,0 +1,153 @@
+"""Int8 quantized compute ops (REF:src/operator/quantization/*: quantize_v2,
+dequantize, requantize, quantized_fully_connected, quantized_conv — the
+MKLDNN/cuDNN int8 kernels).
+
+TPU-native design: int8 storage with `lax.dot_general`/`conv_general_dilated`
+`preferred_element_type=int32` — the actual int8 matmul path XLA lowers onto
+the MXU's int8 mode — followed by the float32 scale composition the
+reference carries in its (min, max) calibration ranges.  Ranges ride along
+as explicit (min, max) scalars exactly like the reference's three-output
+convention: every quantized op returns (data, min, max).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ops import _apply
+
+__all__ = ["quantize_v2", "dequantize", "requantize",
+           "quantized_fully_connected", "quantized_conv",
+           "quantized_flatten"]
+
+_INT8_RANGE = 127.0
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8", **kw):
+    """f32 -> int8 with symmetric scale from calibrated (or observed) range;
+    returns (q, min, max) (REF:quantization/quantize_v2-inl.h)."""
+
+    def f(x):
+        if min_calib_range is not None:
+            mn = jnp.asarray(min_calib_range, jnp.float32)
+            mx = jnp.asarray(max_calib_range, jnp.float32)
+        else:
+            mn = x.min().astype(jnp.float32)
+            mx = x.max().astype(jnp.float32)
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        scale = _INT8_RANGE / jnp.maximum(amax, 1e-12)
+        q = jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
+        return q, -amax, amax
+
+    return _apply(f, [data], "quantize_v2", nondiff=True)
+
+
+def dequantize(data, min_range, max_range, out_type="float32", **kw):
+    """int8 -> f32 (REF:quantization/dequantize-inl.h)."""
+
+    def f(q, mn, mx):
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        return q.astype(jnp.float32) * (amax / _INT8_RANGE)
+
+    return _apply(f, [data, min_range, max_range], "dequantize", nondiff=True)
+
+
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, **kw):
+    """int32 accumulator -> int8 with a new range
+    (REF:quantization/requantize-inl.h)."""
+
+    def f(q32, mn, mx):
+        # incoming int32 represents values q32 * (amax_in / (127*127))
+        amax_in = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        real = q32.astype(jnp.float32) * (amax_in / (_INT8_RANGE ** 2))
+        if min_calib_range is not None:
+            amax_out = jnp.maximum(abs(float(min_calib_range)),
+                                   abs(float(max_calib_range)))
+        else:
+            amax_out = jnp.maximum(jnp.abs(real).max(), 1e-12)
+        q8 = jnp.clip(jnp.round(real * (_INT8_RANGE / amax_out)),
+                      -127, 127).astype(jnp.int8)
+        return q8, -amax_out, amax_out
+
+    return _apply(f, [data, min_range, max_range], "requantize", nondiff=True)
+
+
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden=None, no_bias=False,
+                              **kw):
+    """int8 x int8 -> int32 dense (REF:quantization/quantized_fully_connected.cc).
+    Returns (y_int32, min_out, max_out) where y represents
+    y * (amax_d * amax_w / 127^2)."""
+
+    def f(x, w, *rest):
+        if no_bias:
+            mnd, mxd, mnw, mxw = rest[:4]
+            b = None
+        else:
+            b, mnd, mxd, mnw, mxw = rest[0], rest[1], rest[2], rest[3], rest[4]
+        y = lax.dot_general(
+            x.astype(jnp.int8), w.astype(jnp.int8),
+            dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        amax_d = jnp.maximum(jnp.abs(mnd), jnp.abs(mxd))
+        amax_w = jnp.maximum(jnp.abs(mnw), jnp.abs(mxw))
+        out_scale = amax_d * amax_w  # value = q * out_scale / 127^2
+        if b is not None:
+            # bias arrives int8 with its own range; rescale into the
+            # accumulator's grid
+            mnb, mxb = rest[5], rest[6]
+            amax_b = jnp.maximum(jnp.abs(mnb), jnp.abs(mxb))
+            b32 = jnp.round(
+                b.astype(jnp.float32) * (amax_b / _INT8_RANGE)
+                * (_INT8_RANGE ** 2) / jnp.maximum(out_scale, 1e-12)
+            ).astype(jnp.int32)
+            y = y + b32
+        return y, -out_scale, out_scale
+
+    args = [data, weight] + ([] if no_bias else [bias]) + \
+        [min_data, max_data, min_weight, max_weight] + \
+        ([] if no_bias or min_bias is None else [min_bias, max_bias])
+    return _apply(f, args, "quantized_fully_connected", nondiff=True)
+
+
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, kernel=None, stride=None, pad=None,
+                   num_filter=None, no_bias=True, layout="NCHW", **kw):
+    """int8 conv with int32 accumulation
+    (REF:quantization/quantized_conv.cc).  Same (out, min, max) contract as
+    quantized_fully_connected."""
+    nd_ = len(kernel)
+    strides = stride or (1,) * nd_
+    padding = [(p_, p_) for p_ in (pad or (0,) * nd_)]
+    spatial = "DHW"[-nd_:]
+    if layout is None:
+        layout = "NC" + spatial
+    channels_last = layout.endswith("C")
+    wspec = ("O" + spatial + "I") if channels_last else ("OI" + spatial)
+    dn = (layout, wspec, layout)
+
+    def f(x, w, *rest):
+        mnd, mxd, mnw, mxw = rest[:4]
+        y = lax.conv_general_dilated(
+            x.astype(jnp.int8), w.astype(jnp.int8), window_strides=strides,
+            padding=padding, dimension_numbers=dn,
+            preferred_element_type=jnp.int32)
+        amax_d = jnp.maximum(jnp.abs(mnd), jnp.abs(mxd))
+        amax_w = jnp.maximum(jnp.abs(mnw), jnp.abs(mxw))
+        out_scale = amax_d * amax_w
+        return y, -out_scale, out_scale
+
+    args = [data, weight] + [min_data, max_data, min_weight, max_weight]
+    return _apply(f, args, "quantized_conv", nondiff=True)
+
+
+def quantized_flatten(data, min_data, max_data, **kw):
+    def f(x, mn, mx):
+        return x.reshape(x.shape[0], -1), mn, mx
+
+    return _apply(f, [data, min_data, max_data], "quantized_flatten",
+                  nondiff=True)

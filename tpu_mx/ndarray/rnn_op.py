@@ -1,0 +1,151 @@
+"""Fused RNN operator — mx.nd.RNN (REF:src/operator/rnn.cc: the cuDNN
+RNN/LSTM/GRU fused kernel with packed parameter blob).
+
+TPU-native design: one `lax.scan` per layer/direction with the input
+projection for ALL timesteps hoisted into a single (T*N, I)x(I, G*H) matmul
+before the scan (the MXU-friendly shape; inside the scan only the (N, H)
+recurrent matmul remains).  The packed `parameters` blob uses the
+reference's cuDNN layout — per layer/direction: Wx gates, Wh gates, then
+all biases (bx, bh per gate) at the tail of the blob — so checkpoints and
+Module code that treat the blob as opaque keep working.
+
+Gate orders (cuDNN = reference): LSTM i,f,g,o ; GRU r,z,n.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ops import _apply
+
+__all__ = ["RNN", "rnn_param_size"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers=1,
+                   bidirectional=False):
+    """Total packed-parameter count (matches the reference's blob size)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        for _ in range(d):
+            total += g * state_size * in_sz + g * state_size * state_size
+            total += 2 * g * state_size
+    return total
+
+
+def _unpack(params, mode, input_size, state_size, num_layers, bidirectional):
+    """Slice the flat blob into per-layer/direction (Wx, Wh, bx, bh)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    H = state_size
+    out = []
+    off = 0
+    # weights first for ALL layers, then all biases (cuDNN blob layout)
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * d
+        dirs = []
+        for _ in range(d):
+            wx = lax.dynamic_slice(params, (off,), (g * H * in_sz,)
+                                   ).reshape(g * H, in_sz)
+            off += g * H * in_sz
+            wh = lax.dynamic_slice(params, (off,), (g * H * H,)
+                                   ).reshape(g * H, H)
+            off += g * H * H
+            dirs.append([wx, wh])
+        out.append(dirs)
+    for layer in range(num_layers):
+        for di in range(d):
+            bx = lax.dynamic_slice(params, (off,), (g * H,))
+            off += g * H
+            bh = lax.dynamic_slice(params, (off,), (g * H,))
+            off += g * H
+            out[layer][di] += [bx, bh]
+    return out
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, xproj, wh, bh):
+            h, c = carry
+            gates = xproj + h @ wh.T + bh
+            i, f, gq, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            gq = jnp.tanh(gq)
+            c2 = f * c + i * gq
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+        return step
+    if mode == "gru":
+        def step(carry, xproj, wh, bh):
+            h = carry[0]
+            rx, zx, nx = jnp.split(xproj, 3, axis=-1)
+            rh, zh, nh = jnp.split(h @ wh.T + bh, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            n = jnp.tanh(nx + r * nh)
+            h2 = (1 - z) * n + z * h
+            return (h2,), h2
+        return step
+
+    act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+    def step(carry, xproj, wh, bh):
+        h = carry[0]
+        h2 = act(xproj + h @ wh.T + bh)
+        return (h2,), h2
+    return step
+
+
+def RNN(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, **kw):
+    """Fused multi-layer (bi)RNN.  data: (T, N, I); state: (L*D, N, H);
+    state_cell (LSTM): (L*D, N, H).  Returns output (T, N, H*D), and with
+    `state_outputs` the final h (and c for LSTM) — reference semantics."""
+    H = state_size
+    d = 2 if bidirectional else 1
+    is_lstm = mode == "lstm"
+
+    def f(x, params, h0, *maybe_c):
+        T, N, I = x.shape
+        c0 = maybe_c[0] if is_lstm else None
+        layers = _unpack(params, mode, I, H, num_layers, bidirectional)
+        step_cell = _cell_step(mode, H)
+        hs_out, cs_out = [], []
+        inp = x
+        for li, dirs in enumerate(layers):
+            outs = []
+            for di, (wx, wh, bx, bh) in enumerate(dirs):
+                seq = inp if di == 0 else jnp.flip(inp, 0)
+                # hoisted input projection: one big MXU matmul over T*N rows
+                xproj = (seq.reshape(T * N, -1) @ wx.T + bx).reshape(
+                    T, N, -1)
+                idx = li * d + di
+                carry = (h0[idx], c0[idx]) if is_lstm else (h0[idx],)
+
+                def scan_step(carry, xp):
+                    return step_cell(carry, xp, wh, bh)
+
+                carry, ys = lax.scan(scan_step, carry, xproj)
+                if di == 1:
+                    ys = jnp.flip(ys, 0)
+                outs.append(ys)
+                hs_out.append(carry[0])
+                if is_lstm:
+                    cs_out.append(carry[1])
+            inp = outs[0] if d == 1 else jnp.concatenate(outs, -1)
+        out = inp
+        if state_outputs:
+            hN = jnp.stack(hs_out, 0)
+            if is_lstm:
+                return out, hN, jnp.stack(cs_out, 0)
+            return out, hN
+        return out
+
+    args = [data, parameters, state] + ([state_cell] if is_lstm else [])
+    return _apply(f, args, "RNN")
