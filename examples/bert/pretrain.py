@@ -60,7 +60,8 @@ def main():
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=128)
-    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--lr", type=float, default=None,
+                    help="default: 1e-3 in --smoke (overfit), 1e-4 otherwise")
     ap.add_argument("--optimizer", default="lamb")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--smoke", action="store_true")
@@ -68,10 +69,15 @@ def main():
 
     if args.smoke:
         cfg = bert_base_config(vocab_size=1000, max_len=args.seq_len)
-        cfg.update(num_layers=2, units=128, hidden_size=512, num_heads=2)
+        # dropout=0 in smoke: the learn-signal is memorization of ONE fixed
+        # batch, and dropout noise over 10 steps can swamp it.
+        cfg.update(num_layers=2, units=128, hidden_size=512, num_heads=2,
+                   dropout=0.0)
         args.steps = min(args.steps, 10)
     else:
         cfg = bert_base_config(max_len=args.seq_len)
+    if args.lr is None:
+        args.lr = 1e-3 if args.smoke else 1e-4
 
     net = BERTModel(cfg, dtype=args.dtype)
     net.initialize()
@@ -84,9 +90,13 @@ def main():
                               multi_precision=True)
     step = CompiledTrainStep(net, MLMLoss(), opt, extra_fwd_args=1)
 
+    fixed = synthetic_batch(rng, args.batch_size, args.seq_len,
+                            cfg["vocab_size"]) if args.smoke else None
     losses, tic = [], time.time()
     for i in range(args.steps):
-        tokens, types, labels = synthetic_batch(
+        # Smoke overfits one fixed batch (memorization is the reliable
+        # learn-signal); real runs stream fresh batches.
+        tokens, types, labels = fixed or synthetic_batch(
             rng, args.batch_size, args.seq_len, cfg["vocab_size"])
         loss = step.step(nd.array(tokens), nd.array(types), nd.array(labels))
         losses.append(float(loss.asnumpy()))
