@@ -14,10 +14,13 @@ ops, LAMB, KVStore DP.  This implementation is TPU-first:
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import numpy as np
 
+from .. import autograd
+from .. import random as _random
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ndarray import ops
@@ -57,6 +60,7 @@ class SelfAttention(HybridBlock):
         super().__init__(**kwargs)
         self._units = units
         self._heads = num_heads
+        self._dropout = dropout
         self._mesh = mesh
         self.qkv_weight = self.params.get("qkv_weight",
                                           shape=(3 * units, units))
@@ -65,8 +69,8 @@ class SelfAttention(HybridBlock):
                                               shape=(units, units))
         self.attnout_bias = self.params.get("attnout_bias", shape=(units,))
 
-    def hybrid_forward(self, F, x, qkv_weight, qkv_bias, attnout_weight,
-                       attnout_bias):
+    def hybrid_forward(self, F, x, valid_length=None, qkv_weight=None,
+                       qkv_bias=None, attnout_weight=None, attnout_bias=None):
         B, T, U = x.shape
         H, D = self._heads, U // self._heads
         qkv = F.FullyConnected(x, qkv_weight, qkv_bias,
@@ -77,9 +81,19 @@ class SelfAttention(HybridBlock):
         k = F.squeeze(F.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
         v = F.squeeze(F.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
         mesh = self._mesh
-        out = ops._apply(
-            lambda qq, kk, vv: _attention(qq, kk, vv, mesh=mesh, causal=False),
-            [q, k, v], "RingAttention")                           # (B,H,T,D)
+        # attention-prob dropout: train-mode only, keyed from the RNG stream
+        # (traced key inside the functional call, eager split otherwise)
+        rate = self._dropout if autograd.is_training() else 0.0
+        drop_key = _random.take_key() if rate > 0.0 else None
+        attn = functools.partial(_attention, mesh=mesh, causal=False,
+                                 dropout_rate=rate, dropout_key=drop_key)
+        if valid_length is not None:
+            out = ops._apply(
+                lambda qq, kk, vv, vl: attn(qq, kk, vv, valid_length=vl),
+                [q, k, v, valid_length], "RingAttention")        # (B,H,T,D)
+        else:
+            out = ops._apply(lambda qq, kk, vv: attn(qq, kk, vv),
+                             [q, k, v], "RingAttention")         # (B,H,T,D)
         out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, T, U))
         return F.FullyConnected(out, attnout_weight, attnout_bias,
                                 num_hidden=U, flatten=False)
@@ -102,9 +116,9 @@ class TransformerLayer(HybridBlock):
         self._hidden = hidden_size
         self._units = units
 
-    def hybrid_forward(self, F, x, ffn1_weight, ffn1_bias, ffn2_weight,
-                       ffn2_bias):
-        att = self.attention(x)
+    def hybrid_forward(self, F, x, valid_length=None, ffn1_weight=None,
+                       ffn1_bias=None, ffn2_weight=None, ffn2_bias=None):
+        att = self.attention(x, valid_length)
         if self.dropout:
             att = self.dropout(att)
         x = self.ln1(x + att)
@@ -136,8 +150,9 @@ class BERTEncoder(HybridBlock):
             self.layers.add(TransformerLayer(units, hidden_size, num_heads,
                                              dropout, mesh=mesh))
 
-    def hybrid_forward(self, F, tokens, token_types, word_embed_weight,
-                       pos_embed_weight, type_embed_weight):
+    def hybrid_forward(self, F, tokens, token_types, valid_length=None,
+                       word_embed_weight=None, pos_embed_weight=None,
+                       type_embed_weight=None):
         T = tokens.shape[1]
         x = F.Embedding(tokens, word_embed_weight)
         x = x + F.Embedding(token_types, type_embed_weight)
@@ -147,7 +162,7 @@ class BERTEncoder(HybridBlock):
         if self.dropout:
             x = self.dropout(x)
         for layer in self.layers._children.values():
-            x = layer(x)
+            x = layer(x, valid_length)
         return x
 
 
@@ -165,8 +180,9 @@ class BERTModel(HybridBlock):
         self.mlm_bias = self.params.get("mlm_bias",
                                         shape=(cfg["vocab_size"],))
 
-    def hybrid_forward(self, F, tokens, token_types, mlm_bias):
-        x = self.encoder(tokens, token_types)
+    def hybrid_forward(self, F, tokens, token_types, valid_length=None,
+                       mlm_bias=None):
+        x = self.encoder(tokens, token_types, valid_length)
         h = F.gelu(self.mlm_dense(x))
         h = self.mlm_ln(h)
         # tied decoder: logits = h · E^T  (one MXU matmul over vocab)

@@ -7,11 +7,18 @@ Design: the sequence axis is sharded over mesh axis `sp`.  Each device holds a
 `lax.ppermute`, accumulating flash-attention style online-softmax statistics
 (running max m, denominator l, numerator o) so the full T×T attention is
 computed in n steps with O(T/n) memory per device and compute/communication
-overlap on ICI.  Causal masking uses the rotating K-block index.
+overlap on ICI.  Causal masking uses the rotating K-block index, and
+key-padding masks (`valid_length`, the reference-era GluonNLP BERT contract)
+ride the same index: each rotating K block masks its own global positions.
 
 The same blockwise kernel with n=1 is the local attention path, so models can
 call `attention()` unconditionally and get ring behavior exactly when the
 mesh has an `sp` axis.
+
+Attention-prob dropout: on the ring and dense paths the keep-mask is drawn
+per (device, ring-step) from a folded key; on the local TPU path it runs
+inside the Pallas kernel's PRNG (kernels.flash_attention).  The softmax
+normalizer always uses the un-dropped probabilities.
 """
 from __future__ import annotations
 
@@ -57,9 +64,12 @@ def _count(path, detail="", warn=False):
         _logger.info("attention dispatch: %s %s", path, detail)
 
 
-def _block_attn(q, k, v, bias=None, mask=None, scale=1.0):
+def _block_attn(q, k, v, bias=None, mask=None, scale=1.0,
+                dropout_rate=0.0, dropout_key=None):
     """One q-block × k-block attention: returns (scores-exp sum stats).
-    q: (B, H, Tq, D), k/v: (B, H, Tk, D)."""
+    q: (B, H, Tq, D), k/v: (B, H, Tk, D).  mask: bool, True = attend.
+    Dropout hits only the V-accumulation; the denominator l stays
+    un-dropped (standard inverted dropout on softmax probs)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if bias is not None:
         s = s + bias
@@ -70,6 +80,9 @@ def _block_attn(q, k, v, bias=None, mask=None, scale=1.0):
     m_safe = jnp.maximum(m, -1e30)
     p = jnp.exp(s - m_safe[..., None])                        # (B,H,Tq,Tk)
     l = jnp.sum(p, axis=-1)                                   # (B,H,Tq)
+    if dropout_rate > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, v)                   # (B,H,Tq,D)
     return m_safe, l, o
 
@@ -84,8 +97,11 @@ def _merge(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
-def _ring_body(q, k, v, axis_name, causal, scale):
-    """Runs inside shard_map: q/k/v are LOCAL blocks (B, H, Tb, D)."""
+def _ring_body(q, k, v, valid, seed, *, axis_name, causal, scale, rate,
+               masked, dropped):
+    """Runs inside shard_map: q/k/v are LOCAL blocks (B, H, Tb, D);
+    valid (B,) global key counts (replicated over the ring) or a dummy;
+    seed (1,) int32 or a dummy — staticness comes from masked/dropped."""
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, H, Tb, D = q.shape
@@ -93,19 +109,27 @@ def _ring_body(q, k, v, axis_name, causal, scale):
     zero_l = jnp.zeros((B, H, Tb), q.dtype)
     zero_o = jnp.zeros_like(q)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    base_key = jax.random.fold_in(jax.random.PRNGKey(seed[0]),
+                                  my_idx) if dropped else None
 
     def step(carry, i):
         m, l, o, k_cur, v_cur = carry
         k_idx = (my_idx - i) % n  # whose K block we currently hold
+        kpos = k_idx * Tb + jnp.arange(Tb)
+        mask = None
         if causal:
-            # global positions: q row r -> my_idx*Tb + r; k col c -> k_idx*Tb + c
+            # global positions: q row r -> my_idx*Tb + r; k col c -> kpos[c]
             qpos = my_idx * Tb + jnp.arange(Tb)
-            kpos = k_idx * Tb + jnp.arange(Tb)
-            mask = qpos[:, None] >= kpos[None, :]
-            mask = mask[None, None]
-        else:
-            mask = None
-        bm, bl, bo = _block_attn(q, k_cur, v_cur, mask=mask, scale=scale)
+            mask = (qpos[:, None] >= kpos[None, :])[None, None]
+        if masked:
+            # the padding mask rides the rotating K index: this k block's
+            # global columns are valid iff kpos < valid_length[b]
+            km = kpos[None, None, None, :] < valid[:, None, None, None]
+            mask = km if mask is None else jnp.logical_and(mask, km)
+        key_i = jax.random.fold_in(base_key, i) if dropped else None
+        bm, bl, bo = _block_attn(q, k_cur, v_cur, mask=mask, scale=scale,
+                                 dropout_rate=rate if dropped else 0.0,
+                                 dropout_key=key_i)
         m, l, o = _merge(m, l, o, bm, bl, bo)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
@@ -117,66 +141,106 @@ def _ring_body(q, k, v, axis_name, causal, scale):
 
 
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
-                   q_spec=None):
+                   q_spec=None, valid_length=None, dropout_rate=0.0,
+                   dropout_key=None, batch_axes=("dp", "tp")):
     """Sequence-parallel attention.  q/k/v: GLOBAL (B, H, T, D) arrays whose
     T axis is sharded over `axis_name`.  Returns attention output with the
     same sharding.  `q_spec` overrides the default
-    P('dp', 'tp', axis_name, None) layout (axes absent from the mesh are
-    dropped automatically)."""
+    P(batch_axes[0], batch_axes[1], axis_name, None) layout (axes absent
+    from the mesh are dropped automatically; pass `batch_axes` to rename
+    the batch/heads mesh axes without a full spec).
+    valid_length: (B,) int32 valid key counts (global positions).
+    dropout_rate/dropout_key: attention-prob dropout, drawn per ring step."""
     from jax.experimental.shard_map import shard_map
 
     def present(ax):
         return ax in mesh.axis_names
 
-    spec = q_spec or P("dp" if present("dp") else None,
-                       "tp" if present("tp") else None,
+    bax, hax = (tuple(batch_axes) + (None, None))[:2]
+    spec = q_spec or P(bax if bax and present(bax) else None,
+                       hax if hax and present(hax) else None,
                        axis_name if present(axis_name) else None,
                        None)
     scale = 1.0 / math.sqrt(q.shape[-1])
+    dropped = dropout_rate > 0.0 and dropout_key is not None
     if not present(axis_name):
         # no sequence axis: plain (flash-style blockwise on one device)
-        mask = None
-        if causal:
-            t = q.shape[2]
-            mask = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])[None, None]
-        m, l, o = _block_attn(q, k, v, mask=mask, scale=scale)
+        mask = _dense_mask(q.shape[2], k.shape[2], causal, valid_length)
+        m, l, o = _block_attn(q, k, v, mask=mask, scale=scale,
+                              dropout_rate=dropout_rate if dropped else 0.0,
+                              dropout_key=dropout_key)
         return o / jnp.maximum(l, 1e-30)[..., None]
 
     _count("ring", f"sp={mesh.shape[axis_name]} shape={q.shape}")
+    masked = valid_length is not None
+    B = q.shape[0]
+    valid = (jnp.asarray(valid_length, jnp.int32) if masked
+             else jnp.zeros((B,), jnp.int32))
+    seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1, jnp.int32)
+            if dropped else jnp.zeros((1,), jnp.int32))
+    # valid is per-batch → shard like q's batch axis; seed replicated
+    vspec = P(spec[0]) if masked else P(None)
     fn = shard_map(
         functools.partial(_ring_body, axis_name=axis_name, causal=causal,
-                          scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
-    return fn(q, k, v)
+                          scale=scale, rate=float(dropout_rate),
+                          masked=masked, dropped=dropped),
+        mesh=mesh, in_specs=(spec, spec, spec, vspec, P(None)),
+        out_specs=spec, check_rep=False)
+    return fn(q, k, v, valid, seed)
 
 
-def local_flash_attention(q, k, v, causal=False):
+def _dense_mask(t, tk, causal, valid_length):
+    """Combined causal + key-padding mask, or None.  True = attend."""
+    mask = None
+    if causal:
+        mask = (jnp.arange(t)[:, None] >= jnp.arange(tk)[None, :])[None, None]
+    if valid_length is not None:
+        km = (jnp.arange(tk)[None, None, None, :] <
+              jnp.asarray(valid_length, jnp.int32)[:, None, None, None])
+        mask = km if mask is None else jnp.logical_and(mask, km)
+    return mask
+
+
+def local_flash_attention(q, k, v, causal=False, valid_length=None,
+                          dropout_rate=0.0, dropout_key=None):
     """Single-device attention with the same numerics as the ring kernel.
     On TPU with tile-friendly shapes this runs the Pallas flash kernel
-    (tpu_mx.kernels.flash_attention: blockwise online softmax, O(T) memory);
-    otherwise the XLA dense path."""
+    (tpu_mx.kernels.flash_attention: blockwise online softmax, O(T) memory,
+    in-kernel padding mask and prob dropout); otherwise the XLA dense path."""
     from ..kernels import flash_attention as fa
     on_tpu = jax.default_backend() == "tpu"
-    if on_tpu and fa.supported(q.shape, q.dtype, kv_len=k.shape[2]):
+    dropped = dropout_rate > 0.0 and dropout_key is not None
+    rate = float(dropout_rate) if dropped else 0.0
+    if on_tpu and fa.supported(q.shape, q.dtype, kv_len=k.shape[2],
+                               dropout_rate=rate):
         _count("pallas_flash", f"shape={q.shape}")
-        return fa.mha_flash_attention(q, k, v, causal=causal)
+        seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1,
+                                   jnp.int32) if dropped else None)
+        return fa.mha_flash_attention(q, k, v, causal=causal,
+                                      valid_length=valid_length,
+                                      dropout_rate=rate, dropout_seed=seed)
     _count("xla_dense",
            f"shape={q.shape} dtype={q.dtype} kv_len={k.shape[2]}",
            warn=on_tpu)  # CPU dense path is expected; only warn on TPU
     scale = 1.0 / math.sqrt(q.shape[-1])
-    mask = None
-    if causal:
-        t = q.shape[2]
-        mask = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])[None, None]
-    m, l, o = _block_attn(q, k, v, mask=mask, scale=scale)
+    mask = _dense_mask(q.shape[2], k.shape[2], causal, valid_length)
+    m, l, o = _block_attn(q, k, v, mask=mask, scale=scale,
+                          dropout_rate=rate, dropout_key=dropout_key)
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
-def attention(q, k, v, mesh=None, causal=False):
+def attention(q, k, v, mesh=None, causal=False, valid_length=None,
+              dropout_rate=0.0, dropout_key=None):
     """Dispatch: ring attention when a mesh with an `sp` axis is active,
-    local flash otherwise."""
+    local flash otherwise.  valid_length (B,) masks padded keys; dropout
+    is attention-prob dropout (pass a key only in training mode)."""
     if mesh is not None and "sp" in mesh.axis_names and \
             mesh.shape["sp"] > 1:
-        return ring_attention(q, k, v, mesh, causal=causal)
-    return local_flash_attention(q, k, v, causal=causal)
+        return ring_attention(q, k, v, mesh, causal=causal,
+                              valid_length=valid_length,
+                              dropout_rate=dropout_rate,
+                              dropout_key=dropout_key)
+    return local_flash_attention(q, k, v, causal=causal,
+                                 valid_length=valid_length,
+                                 dropout_rate=dropout_rate,
+                                 dropout_key=dropout_key)
