@@ -88,7 +88,7 @@ def main():
 
     opt = mx.optimizer.create(args.optimizer, learning_rate=args.lr,
                               multi_precision=True)
-    step = CompiledTrainStep(net, MLMLoss(), opt, extra_fwd_args=1)
+    step = CompiledTrainStep(net, MLMLoss(), opt)
 
     fixed = synthetic_batch(rng, args.batch_size, args.seq_len,
                             cfg["vocab_size"]) if args.smoke else None

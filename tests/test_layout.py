@@ -172,3 +172,53 @@ def test_resnet_nhwc_forward_and_train():
     label = nd.array(np.array([1, 2], dtype=np.float32))
     l1 = float(np.asarray(step.step(nd.array(_to_nhwc(x)), label)._data).ravel()[0])
     assert np.isfinite(l1)
+
+
+def test_space_to_depth_op_roundtrip():
+    """REF:src/operator/tensor/matrix_op.cc space_to_depth/depth_to_space:
+    NCHW (N,C,H,W) -> (N, b*b*C, H/b, W/b), block offsets leading."""
+    from tpu_mx.ndarray import ops
+    x = nd.array(np.arange(2 * 3 * 8 * 8).reshape(2, 3, 8, 8)
+                 .astype(np.float32))
+    y = ops.space_to_depth(x, 4)
+    assert y.shape == (2, 48, 2, 2)
+    np.testing.assert_allclose(ops.depth_to_space(y, 4).asnumpy(),
+                               x.asnumpy())
+    # spot-check the rearrangement: out[n, (bh*b + bw)*C + c, i, j]
+    # == in[n, c, i*b + bh, j*b + bw]
+    xa, ya = x.asnumpy(), y.asnumpy()
+    assert ya[1, (2 * 4 + 3) * 3 + 1, 0, 1] == xa[1, 1, 2, 7]
+
+
+@pytest.mark.parametrize("layout", ["NHWC", "NCHW"])
+def test_s2d_stem_forward_and_train(layout):
+    """The TPU stem variant (4x4 space-to-depth + 3x3 conv, VERDICT r2
+    ask#1) must produce the same feature-map geometry as the classic stem
+    and train end-to-end in either layout."""
+    from tpu_mx import gluon
+    from tpu_mx.gluon.model_zoo import vision
+    from tpu_mx.parallel import CompiledTrainStep
+
+    shape = (2, 64, 64, 3) if layout == "NHWC" else (2, 3, 64, 64)
+    with default_layout(layout):
+        net = vision.resnet18_v1(classes=10, stem="s2d")
+        classic = vision.resnet18_v1(classes=10)
+    net.initialize(init="xavier")
+    classic.initialize(init="xavier")
+    x = nd.array(np.random.RandomState(0).rand(*shape).astype(np.float32))
+    out = net(x)
+    assert out.shape == classic(x).shape == (2, 10)
+    # stem output geometry matches classic (56x56-equivalent at 1/4 stride)
+    s2d_feat = net.features._children["0"](x)
+    classic_feat = x
+    for i in range(4):  # conv, bn, relu, maxpool
+        classic_feat = classic.features._children[str(i)](classic_feat)
+    assert s2d_feat.shape == classic_feat.shape
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.create("sgd", learning_rate=0.05, momentum=0.9)
+    step = CompiledTrainStep(net, loss_fn, opt, mesh=None)
+    label = nd.array(np.array([1, 2], dtype=np.float32))
+    losses = [float(np.asarray(step.step(x, label)._data).ravel()[0])
+              for _ in range(8)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]

@@ -9,7 +9,8 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
+__all__ = ["ResNetV1", "ResNetV2", "SpaceToDepthStem", "BasicBlockV1",
+           "BasicBlockV2",
            "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
            "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
@@ -19,6 +20,39 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
 def _conv3x3(channels, stride, in_channels):
     return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
                      use_bias=False, in_channels=in_channels)
+
+
+class SpaceToDepthStem(HybridBlock):
+    """TPU-friendly ResNet stem (the standard MLPerf-era trick): a 4×4
+    space-to-depth on the input image followed by a 3×3 stride-1 conv.
+
+    The classic 7×7/2 conv contracts over 7·7·3 = 147 values with C=3 in
+    the 128-wide lane dimension — the MXU runs it ~43× under-filled.  The
+    transform moves the 4×4 spatial block into channels (C=3 → 48), so the
+    first conv contracts over 3·3·48 = 432 lane-aligned values.  Output is
+    (N, 56, 56, C0) — the same shape/stride as conv7x7/2 + maxpool3x3/2,
+    with matched ~12×12 receptive field, so the rest of the network is
+    untouched.  Select with ``get_resnet(..., stem="s2d")``."""
+
+    def __init__(self, channels, block=4, **kwargs):
+        super().__init__(**kwargs)
+        from ....layout import get_default_layout, is_channels_last
+        self._block = block
+        self._nhwc = is_channels_last(get_default_layout(2))
+        self.conv = nn.Conv2D(channels, kernel_size=3, strides=1, padding=1,
+                              use_bias=False, in_channels=3 * block * block)
+        self.bn = nn.BatchNorm()
+
+    def hybrid_forward(self, F, x):
+        b = self._block
+        if self._nhwc:
+            N, H, W, C = x.shape
+            x = F.reshape(x, shape=(N, H // b, b, W // b, b, C))
+            x = F.transpose(x, axes=(0, 1, 3, 2, 4, 5))
+            x = F.reshape(x, shape=(N, H // b, W // b, b * b * C))
+        else:
+            x = F.space_to_depth(x, block_size=b)
+        return F.Activation(self.bn(self.conv(x)), act_type="relu")
 
 
 class BasicBlockV1(HybridBlock):
@@ -141,12 +175,14 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 stem="classic", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self.features = nn.HybridSequential()
         if thumbnail:
             self.features.add(_conv3x3(channels[0], 1, 0))
+        elif stem == "s2d":
+            self.features.add(SpaceToDepthStem(channels[0]))
         else:
             self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
             self.features.add(nn.BatchNorm())
@@ -175,13 +211,15 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 stem="classic", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self.features = nn.HybridSequential()
         self.features.add(nn.BatchNorm(scale=False, center=False))
         if thumbnail:
             self.features.add(_conv3x3(channels[0], 1, 0))
+        elif stem == "s2d":
+            self.features.add(SpaceToDepthStem(channels[0]))
         else:
             self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
             self.features.add(nn.BatchNorm())

@@ -418,6 +418,33 @@ def expand_dims(data, axis, **kw):
     return _apply(lambda x: jnp.expand_dims(x, axis), [data], "expand_dims")
 
 
+def space_to_depth(data, block_size, **kw):
+    """REF:src/operator/tensor/matrix_op.cc space_to_depth — NCHW:
+    (N,C,H,W) -> (N, b²C, H/b, W/b), block offsets leading the channels."""
+    b = int(block_size)
+
+    def f(x):
+        n, c, h, w = x.shape
+        y = jnp.reshape(x, (n, c, h // b, b, w // b, b))
+        y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+        return jnp.reshape(y, (n, b * b * c, h // b, w // b))
+
+    return _apply(f, [data], "space_to_depth")
+
+
+def depth_to_space(data, block_size, **kw):
+    """Inverse of space_to_depth (REF:src/operator/tensor/matrix_op.cc)."""
+    b = int(block_size)
+
+    def f(x):
+        n, c, h, w = x.shape
+        y = jnp.reshape(x, (n, b, b, c // (b * b), h, w))
+        y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+        return jnp.reshape(y, (n, c // (b * b), h * b, w * b))
+
+    return _apply(f, [data], "depth_to_space")
+
+
 def squeeze(data, axis=None, **kw):
     return _apply(lambda x: jnp.squeeze(x, axis=axis), [data], "squeeze")
 

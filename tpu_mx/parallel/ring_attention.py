@@ -98,10 +98,13 @@ def _merge(m1, l1, o1, m2, l2, o2):
 
 
 def _ring_body(q, k, v, valid, seed, *, axis_name, causal, scale, rate,
-               masked, dropped):
+               masked, dropped, key_axes=()):
     """Runs inside shard_map: q/k/v are LOCAL blocks (B, H, Tb, D);
     valid (B,) global key counts (replicated over the ring) or a dummy;
-    seed (1,) int32 or a dummy — staticness comes from masked/dropped."""
+    seed (1,) int32 or a dummy — staticness comes from masked/dropped.
+    key_axes: every mesh axis the q spec shards over — each device's
+    dropout key folds in ALL its coordinates, so shards that differ only
+    in dp/tp draw independent masks (not the same mask on different data)."""
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, H, Tb, D = q.shape
@@ -109,8 +112,11 @@ def _ring_body(q, k, v, valid, seed, *, axis_name, causal, scale, rate,
     zero_l = jnp.zeros((B, H, Tb), q.dtype)
     zero_o = jnp.zeros_like(q)
     perm = [(i, (i + 1) % n) for i in range(n)]
-    base_key = jax.random.fold_in(jax.random.PRNGKey(seed[0]),
-                                  my_idx) if dropped else None
+    base_key = None
+    if dropped:
+        base_key = jax.random.PRNGKey(seed[0])
+        for ax in key_axes:
+            base_key = jax.random.fold_in(base_key, lax.axis_index(ax))
 
     def step(carry, i):
         m, l, o, k_cur, v_cur = carry
@@ -180,10 +186,11 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
             if dropped else jnp.zeros((1,), jnp.int32))
     # valid is per-batch → shard like q's batch axis; seed replicated
     vspec = P(spec[0]) if masked else P(None)
+    key_axes = tuple(ax for ax in spec if ax is not None)
     fn = shard_map(
         functools.partial(_ring_body, axis_name=axis_name, causal=causal,
                           scale=scale, rate=float(dropout_rate),
-                          masked=masked, dropped=dropped),
+                          masked=masked, dropped=dropped, key_axes=key_axes),
         mesh=mesh, in_specs=(spec, spec, spec, vspec, P(None)),
         out_specs=spec, check_rep=False)
     return fn(q, k, v, valid, seed)

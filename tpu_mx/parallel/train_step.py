@@ -56,10 +56,13 @@ class CompiledTrainStep:
     mesh       — jax.sharding.Mesh or None
     rules      — [(regex, PartitionSpec)] parameter sharding rules
     data_specs — PartitionSpecs for the batch inputs (default P('dp') on axis0)
+    n_loss_args — how many TRAILING step() args go to the loss instead of
+                  the network forward (default 1: the label; 2 for e.g.
+                  (label, sample_weight) losses)
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
-                 data_specs=None, donate=True, extra_fwd_args=0):
+                 data_specs=None, donate=True, n_loss_args=1):
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -96,6 +99,9 @@ class CompiledTrainStep:
                        for k, v in self.values.items()}
         self._data_specs = data_specs
         self._donate = donate
+        if n_loss_args < 1:
+            raise ValueError("n_loss_args must be >= 1 (the label)")
+        self._n_loss_args = n_loss_args
         self._jitted = None
 
     # -- sharding helpers -----------------------------------------------------
@@ -132,8 +138,10 @@ class CompiledTrainStep:
 
         mp_keys = set(self._mp_keys)
 
+        n_loss = self._n_loss_args
+
         def fn(values, masters, opt_states, t, lr, key, *batch):
-            data_args, label = batch[:-1], batch[-1]
+            data_args, loss_args = batch[:-n_loss], batch[-n_loss:]
             diff_vals = {k: values[k] for k in diff_keys}
             const_vals = {k: v for k, v in values.items()
                           if k not in set(diff_keys)}
@@ -144,7 +152,7 @@ class CompiledTrainStep:
                 out, updates = net._functional_call(pm, key, True, data_args)
                 if isinstance(out, (tuple, list)):
                     out = out[0]
-                l = loss_fn(out, label)
+                l = loss_fn(out, *loss_args)
                 return jnp.mean(l), updates
 
             (loss, updates), grads = jax.value_and_grad(
