@@ -350,3 +350,147 @@ def test_bert_valid_length_masks_padding():
     u1 = net(nd.array(tokens), nd.array(types)).asnumpy()
     u2 = net(nd.array(tokens2), nd.array(types)).asnumpy()
     assert np.abs(u1[0, :10] - u2[0, :10]).max() > 1e-4
+
+
+def _mlp_stage(params, x):
+    import jax.numpy as jnp
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _mk_stage_params(rng, d, hidden):
+    import jax.numpy as jnp
+    return {"w1": jnp.asarray(rng.randn(d, hidden) * 0.3, jnp.float32),
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "w2": jnp.asarray(rng.randn(hidden, d) * 0.3, jnp.float32),
+            "b2": jnp.zeros((d,), jnp.float32)}
+
+
+@pytest.mark.parametrize("axes,micro", [({"dp": 4, "pp": 2}, 4),
+                                        ({"dp": 2, "pp": 4}, 4),
+                                        ({"dp": 4, "pp": 2}, 8)])
+def test_pipeline_matches_sequential(axes, micro):
+    """GPipe microbatch schedule over shard_map+ppermute must equal plain
+    sequential stage application, forward AND gradients (VERDICT r2 ask#8)."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.parallel import P, pipeline_apply, stack_stage_params
+
+    mesh = _mesh(**axes)
+    S = axes["pp"]
+    rng = np.random.RandomState(0)
+    stages = [_mk_stage_params(rng, 8, 16) for _ in range(S)]
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.randn(32, 8), jnp.float32)
+    dspec = P("dp") if "dp" in axes else None
+
+    def piped_loss(stacked, x):
+        y = pipeline_apply(_mlp_stage, stacked, x, mesh,
+                           num_microbatches=micro, data_spec=dspec)
+        return jnp.sum(jnp.sin(y))
+
+    def seq_loss(stacked, x):
+        y = x
+        for s in range(S):
+            p = jax.tree_util.tree_map(lambda a: a[s], stacked)
+            y = _mlp_stage(p, y)
+        return jnp.sum(jnp.sin(y))
+
+    assert abs(float(piped_loss(stacked, x)) -
+               float(seq_loss(stacked, x))) < 1e-4
+    g1 = jax.grad(piped_loss)(stacked, x)
+    g2 = jax.grad(seq_loss)(stacked, x)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_pipeline_trains():
+    """A dp×pp-pipelined regression MLP must learn under jit + grad."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.parallel import P, pipeline_apply, stack_stage_params
+
+    mesh = _mesh(dp=4, pp=2)
+    rng = np.random.RandomState(1)
+    stages = [_mk_stage_params(rng, 4, 8) for _ in range(2)]
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.randn(16, 4), jnp.float32)
+    t = jnp.asarray(np.asarray(x) @ (rng.randn(4, 4) * 0.3), jnp.float32)
+
+    @jax.jit
+    def step(stacked, x, t):
+        def loss(stacked):
+            y = pipeline_apply(_mlp_stage, stacked, x, mesh,
+                               num_microbatches=4, data_spec=P("dp"))
+            return jnp.mean((y - t) ** 2)
+        l, g = jax.value_and_grad(loss)(stacked)
+        return l, jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg,
+                                         stacked, g)
+
+    losses = []
+    for _ in range(60):
+        l, stacked = step(stacked, x, t)
+        losses.append(float(l))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+@pytest.mark.parametrize("ctype", ["2bit", "int8"])
+def test_compressed_instep_allreduce(ctype):
+    """Quantized in-step gradient psum (SURVEY §2.3 stretch / VERDICT r2
+    ask#7): with error feedback the compressed run must track the
+    uncompressed run within quantization tolerance and still learn."""
+    from tpu_mx.parallel import CompiledTrainStep
+
+    def build():
+        np.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        net(nd.ones((1, 8)))
+        return net
+
+    x = nd.array(np.random.RandomState(2).rand(16, 8).astype(np.float32))
+    y = nd.array(np.random.RandomState(3).randint(0, 4, (16,)),
+                 dtype="float32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = _mesh(dp=8)
+
+    def run(compression):
+        net = build()
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        step = CompiledTrainStep(net, loss_fn, opt, mesh=mesh,
+                                 gradient_compression=compression)
+        return [float(step.step(x, y).asscalar()) for _ in range(15)]
+
+    ref = run(None)
+    comp = run({"type": ctype, "threshold": 0.05})
+    assert comp[-1] < comp[0], "compressed run did not learn"
+    # error feedback keeps the trajectories close (not bitwise equal)
+    assert abs(comp[-1] - ref[-1]) < 0.35 * ref[0], (ref[-1], comp[-1])
+
+
+def test_compression_rejects_bad_configs():
+    from jax.sharding import PartitionSpec as P
+    from tpu_mx.parallel import CompiledTrainStep
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=8))
+    net.initialize()
+    net(nd.ones((1, 8)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.create("sgd")
+    with pytest.raises(ValueError, match="mesh"):
+        CompiledTrainStep(net, loss_fn, opt, mesh=None,
+                          gradient_compression={"type": "2bit"})
+    with pytest.raises(ValueError, match="pure-DP"):
+        CompiledTrainStep(net, loss_fn, opt, mesh=_mesh(dp=4, tp=2),
+                          rules=[("weight", P("tp", None))],
+                          gradient_compression={"type": "2bit"})
+    with pytest.raises(ValueError, match="type"):
+        CompiledTrainStep(net, loss_fn, opt, mesh=_mesh(dp=8),
+                          gradient_compression={"type": "4bit"})
+    with pytest.raises(ValueError, match="'dp' only"):
+        CompiledTrainStep(net, loss_fn, opt, mesh=_mesh(dp=4, sp=2),
+                          data_specs=(P(("dp", "sp")), P(("dp", "sp"))),
+                          gradient_compression={"type": "int8"})

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from ..ndarray import NDArray
 
-__all__ = ["GradientCompression", "quantize_2bit_core"]
+__all__ = ["GradientCompression", "quantize_2bit_core", "quantize_int8_core"]
 
 
 def quantize_2bit_core(grad, residual, threshold):
@@ -25,10 +25,22 @@ def quantize_2bit_core(grad, residual, threshold):
     return q, acc - q
 
 
+def quantize_int8_core(grad, residual):
+    """int8 per-tensor max-abs quantization with error feedback: returns
+    (dequantized_grad, new_residual).  The wire value is round(acc/scale)
+    in [-127, 127]; scale = max|acc|/127 rides alongside (simulated here by
+    dequantizing immediately, as the reference's kvstore compression did)."""
+    acc = grad + residual
+    scale = jnp.maximum(jnp.max(jnp.abs(acc)), 1e-8) / 127.0
+    deq = jnp.clip(jnp.round(acc / scale), -127, 127) * scale
+    return deq, acc - deq
+
+
 class GradientCompression:
     def __init__(self, type="2bit", threshold=0.5):
-        if type != "2bit":
-            raise ValueError(f"unsupported compression type {type!r} (have: 2bit)")
+        if type not in ("2bit", "int8"):
+            raise ValueError(f"unsupported compression type {type!r} "
+                             "(have: 2bit, int8)")
         self.type = type
         self.threshold = float(threshold)
         self._residuals = {}
@@ -41,7 +53,11 @@ class GradientCompression:
         residual = self._residuals.get(rkey)
         if residual is None or residual.shape != raw.shape:
             residual = jnp.zeros_like(raw)
-        q, new_residual = quantize_2bit_core(raw, residual, self.threshold)
+        if self.type == "2bit":
+            q, new_residual = quantize_2bit_core(raw, residual,
+                                                 self.threshold)
+        else:
+            q, new_residual = quantize_int8_core(raw, residual)
         self._residuals[rkey] = new_residual
         return NDArray(q) if isinstance(grad, NDArray) else q
 

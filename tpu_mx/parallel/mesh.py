@@ -7,7 +7,7 @@ Axis conventions used across the framework:
   fsdp — parameter-sharded data parallel (reduce_scatter/all_gather)
   tp   — tensor/model parallel (Megatron-style sharded matmuls)
   sp   — sequence/context parallel (ring attention over ICI)
-  pp   — pipeline stages (reserved; not yet wired)
+  pp   — pipeline stages (GPipe microbatching; parallel.pipeline)
 """
 from __future__ import annotations
 

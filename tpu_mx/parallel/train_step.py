@@ -59,10 +59,20 @@ class CompiledTrainStep:
     n_loss_args — how many TRAILING step() args go to the loss instead of
                   the network forward (default 1: the label; 2 for e.g.
                   (label, sample_weight) losses)
+    gradient_compression — None, or {"type": "2bit", "threshold": t} /
+                  {"type": "int8"}: the in-step quantized gradient
+                  allreduce (SURVEY §2.3 stretch; the reference compressed
+                  only on the kvstore push wire,
+                  REF:src/kvstore/gradient_compression.cc).  Per-device
+                  partial gradients are quantized with per-device error
+                  feedback (carried in the train state, dp-sharded), summed
+                  with a psum over `dp`, and dequantized into the optimizer.
+                  Requires a mesh with dp>1 and pure-DP (replicated) params.
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
-                 data_specs=None, donate=True, n_loss_args=1):
+                 data_specs=None, donate=True, n_loss_args=1,
+                 gradient_compression=None):
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -102,6 +112,47 @@ class CompiledTrainStep:
         if n_loss_args < 1:
             raise ValueError("n_loss_args must be >= 1 (the label)")
         self._n_loss_args = n_loss_args
+        self._compression = None
+        self._efs = {}
+        if gradient_compression:
+            ctype = gradient_compression.get("type", "2bit")
+            if ctype not in ("2bit", "int8"):
+                raise ValueError(f"unsupported compression type {ctype!r} "
+                                 "(have: 2bit, int8)")
+            if mesh is None or "dp" not in mesh.axis_names or \
+                    mesh.shape["dp"] < 2:
+                raise ValueError(
+                    "gradient_compression needs a mesh with a dp axis >1 "
+                    "(it compresses the dp gradient reduction)")
+            sharded = [k for k in self._diff_keys
+                       if any(ax is not None for ax in self._specs[k])]
+            if sharded:
+                raise ValueError(
+                    "gradient_compression supports pure-DP (replicated) "
+                    f"params; these are sharded: {sharded[:3]}...")
+            # the compressed reduce psums over 'dp' only; batch sharding
+            # over any other axis would silently drop those contributions
+            bad_axes = set()
+            for spec in (data_specs or ()):
+                for ax in spec:
+                    for a in (ax if isinstance(ax, tuple) else (ax,)):
+                        if a is not None and a != "dp":
+                            bad_axes.add(a)
+            if bad_axes:
+                raise ValueError(
+                    "gradient_compression reduces over 'dp' only, but "
+                    f"data_specs shard the batch over {sorted(bad_axes)}")
+            self._compression = dict(gradient_compression, type=ctype)
+            ndp = mesh.shape["dp"]
+            # per-device quantization error feedback, dp-sharded on axis 0;
+            # allocated ALREADY sharded (out_shardings) so a big model never
+            # materializes ndp full copies on one device
+            ef_sh = sharding_for(mesh, P("dp"))
+            self._efs = {
+                k: jax.jit(lambda s=self.values[k].shape:
+                           jnp.zeros((ndp,) + s, jnp.float32),
+                           out_shardings=ef_sh)()
+                for k in self._diff_keys}
         self._jitted = None
 
     # -- sharding helpers -----------------------------------------------------
@@ -128,6 +179,9 @@ class CompiledTrainStep:
         ss = self._state_shardings()
         self.opt_states = {k: jax.device_put(s, ss[k])
                            for k, s in self.opt_states.items()}
+        ef_sh = sharding_for(self.mesh, P("dp"))
+        self._efs = {k: jax.device_put(v, ef_sh)
+                     for k, v in self._efs.items()}
 
     # -- the compiled program -------------------------------------------------
     def _build(self, n_batch_args):
@@ -139,13 +193,10 @@ class CompiledTrainStep:
         mp_keys = set(self._mp_keys)
 
         n_loss = self._n_loss_args
+        compression = self._compression
+        mesh = self.mesh
 
-        def fn(values, masters, opt_states, t, lr, key, *batch):
-            data_args, loss_args = batch[:-n_loss], batch[-n_loss:]
-            diff_vals = {k: values[k] for k in diff_keys}
-            const_vals = {k: v for k, v in values.items()
-                          if k not in set(diff_keys)}
-
+        def make_lfn(const_vals, key, data_args, loss_args):
             def lfn(dv):
                 pm = dict(const_vals)
                 pm.update(dv)
@@ -154,9 +205,65 @@ class CompiledTrainStep:
                     out = out[0]
                 l = loss_fn(out, *loss_args)
                 return jnp.mean(l), updates
+            return lfn
 
-            (loss, updates), grads = jax.value_and_grad(
-                lfn, has_aux=True)(diff_vals)
+        def compressed_grads(diff_vals, const_vals, efs, key, batch):
+            """shard_map over dp: each device takes partial grads on its
+            batch shard, quantizes them with its own error feedback, and
+            the reduction is a psum of the QUANTIZED values (the EQuARX-
+            style in-collective compression the reference could only do on
+            the kvstore wire)."""
+            from jax.experimental.shard_map import shard_map
+            from ..contrib.compression import (quantize_2bit_core,
+                                               quantize_int8_core)
+
+            ndp = mesh.shape["dp"]
+            ctype = compression["type"]
+            threshold = float(compression.get("threshold", 0.5))
+            dspecs = self._data_specs or tuple(
+                P("dp") for _ in range(len(batch)))
+
+            def per_shard(dv, cv, efs_l, key, *b_local):
+                # decorrelate per-shard dropout/augment draws
+                key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+                dat, lar = b_local[:-n_loss], b_local[-n_loss:]
+                (loss, updates), grads = jax.value_and_grad(
+                    make_lfn(cv, key, dat, lar), has_aux=True)(dv)
+                red, new_efs = {}, {}
+                for k in diff_keys:
+                    g = grads[k].astype(jnp.float32)
+                    ef = efs_l[k][0]
+                    if ctype == "2bit":
+                        deq, new_ef = quantize_2bit_core(g, ef, threshold)
+                    else:
+                        deq, new_ef = quantize_int8_core(g, ef)
+                    red[k] = jax.lax.psum(deq, "dp") / ndp
+                    new_efs[k] = new_ef[None]
+                loss = jax.lax.pmean(loss, "dp")
+                updates = {uk: jax.lax.pmean(uv, "dp")
+                           for uk, uv in updates.items()}
+                return loss, red, new_efs, updates
+
+            fn = shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(), P(), P("dp"), P()) + tuple(dspecs),
+                out_specs=(P(), P(), P("dp"), P()), check_rep=False)
+            return fn(diff_vals, const_vals, efs, key, *batch)
+
+        def fn(values, masters, opt_states, efs, t, lr, key, *batch):
+            data_args, loss_args = batch[:-n_loss], batch[-n_loss:]
+            diff_vals = {k: values[k] for k in diff_keys}
+            const_vals = {k: v for k, v in values.items()
+                          if k not in set(diff_keys)}
+
+            if compression:
+                loss, grads, new_efs, updates = compressed_grads(
+                    diff_vals, const_vals, efs, key, batch)
+            else:
+                (loss, updates), grads = jax.value_and_grad(
+                    make_lfn(const_vals, key, data_args, loss_args),
+                    has_aux=True)(diff_vals)
+                new_efs = efs
             new_vals = dict(values)
             new_masters = {}
             new_states = {}
@@ -178,24 +285,25 @@ class CompiledTrainStep:
             for k, v in updates.items():
                 if k in new_vals:
                     new_vals[k] = v.astype(new_vals[k].dtype)
-            return new_vals, new_masters, new_states, loss
+            return new_vals, new_masters, new_states, new_efs, loss
 
+        donate = (0, 1, 2, 3) if self._donate else ()
         if self.mesh is None:
-            self._jitted = jax.jit(
-                fn, donate_argnums=(0, 1, 2) if self._donate else ())
+            self._jitted = jax.jit(fn, donate_argnums=donate)
             return
         repl = sharding_for(self.mesh, P())
         dspecs = self._data_specs or tuple(P("dp") for _ in range(n_batch_args))
         batch_sh = tuple(sharding_for(self.mesh, s) for s in dspecs)
         master_sh = {k: sharding_for(self.mesh, self._specs[k])
                      for k in self._mp_keys}
+        efs_sh = {k: sharding_for(self.mesh, P("dp")) for k in self._efs}
         in_sh = (self._value_shardings(), master_sh, self._state_shardings(),
-                 repl, repl, repl) + batch_sh
+                 efs_sh, repl, repl, repl) + batch_sh
         out_sh = (self._value_shardings(), master_sh, self._state_shardings(),
-                  repl)
+                  efs_sh, repl)
         self._jitted = jax.jit(
             fn, in_shardings=in_sh, out_shardings=out_sh,
-            donate_argnums=(0, 1, 2) if self._donate else ())
+            donate_argnums=donate)
 
     def step(self, *batch, lr=None):
         """Run one step; batch = (*data_args, label) as NDArray/array."""
@@ -210,8 +318,9 @@ class CompiledTrainStep:
             sched = self.optimizer.lr_scheduler
             lr = sched(self._t) if sched else self.optimizer.lr
         key = _random.take_key()
-        self.values, self.masters, self.opt_states, loss = self._jitted(
-            self.values, self.masters, self.opt_states,
+        (self.values, self.masters, self.opt_states, self._efs,
+         loss) = self._jitted(
+            self.values, self.masters, self.opt_states, self._efs,
             jnp.asarray(self._t, jnp.float32), jnp.asarray(lr, jnp.float32),
             key, *raw)
         return NDArray(loss)
@@ -223,13 +332,20 @@ class CompiledTrainStep:
             p._data._rebind(self.values[k])
 
     def state_dict(self):
-        return {"values": self.values, "masters": self.masters,
-                "opt_states": self.opt_states, "t": self._t}
+        sd = {"values": self.values, "masters": self.masters,
+              "opt_states": self.opt_states, "t": self._t}
+        if self._efs:
+            sd["efs"] = self._efs
+        return sd
 
     def load_state_dict(self, sd):
         self.values = sd["values"]
         self.masters = sd.get("masters", {})
         self.opt_states = sd["opt_states"]
+        efs = sd.get("efs")
+        if efs and all(k in efs and efs[k].shape == v.shape
+                       for k, v in self._efs.items()):
+            self._efs = efs  # same dp topology; otherwise keep fresh zeros
         self._t = sd["t"]
 
     # -- sharded checkpointing (SURVEY §5.4) ----------------------------------
@@ -256,6 +372,11 @@ class CompiledTrainStep:
                 k: jax.tree_util.tree_map(leaf(self._specs[k]),
                                           self.opt_states[k])
                 for k in self._diff_keys},
+            # efs (compression error feedback) is deliberately NOT part of
+            # the checkpoint: it is per-DEVICE residual state whose global
+            # shape bakes in the dp size, which would break the
+            # reshard-on-restore contract below.  Losing it on restore
+            # costs one transient quantization error — acceptable.
             "t": jax.ShapeDtypeStruct((), jnp.int32),
         }
 
@@ -267,6 +388,7 @@ class CompiledTrainStep:
         import orbax.checkpoint as ocp
         import os
         state = dict(self.state_dict())
+        state.pop("efs", None)  # per-device; see _abstract_state
         state["t"] = jnp.asarray(state["t"], jnp.int32)
         ck = ocp.StandardCheckpointer()
         ck.save(os.path.abspath(str(path)), state, force=True)
