@@ -1,0 +1,141 @@
+"""mx.np / mx.npx namespace (REF:python/mxnet/numpy — the ver>=1.6 numpy
+API).  Checks: numpy-parity results, autograd through np ops, functional
+trace compatibility, random/linalg submodules, npx extensions."""
+import numpy as onp
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import autograd, nd
+from tpu_mx.ndarray import NDArray
+
+np = mx.np
+npx = mx.npx
+
+
+def test_creation_and_default_dtype():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(a, NDArray) and a.dtype == onp.float32
+    assert np.zeros((2, 3)).dtype == onp.float32
+    assert np.arange(5).dtype == onp.int32
+    assert np.linspace(0, 1, 5).shape == (5,)
+    onp.testing.assert_allclose(np.eye(3).asnumpy(), onp.eye(3))
+    assert np.full((2,), 7).asnumpy().tolist() == [7, 7]
+
+
+def test_numpy_parity_broad():
+    rng = onp.random.RandomState(0)
+    x = rng.rand(3, 4).astype(onp.float32)
+    y = rng.rand(3, 4).astype(onp.float32)
+    ax, ay = np.array(x), np.array(y)
+    cases = [
+        (np.add(ax, ay), x + y),
+        (np.matmul(ax, ay.T if hasattr(ay, "T") else ay), x @ y.T),
+        (np.sum(ax, 1), x.sum(1)),
+        (np.mean(ax), x.mean()),
+        (np.concatenate([ax, ay], 0), onp.concatenate([x, y], 0)),
+        (np.stack([ax, ay]), onp.stack([x, y])),
+        (np.where(ax > 0.5, ax, ay), onp.where(x > 0.5, x, y)),
+        (np.clip(ax, 0.2, 0.8), onp.clip(x, 0.2, 0.8)),
+        (np.transpose(ax), x.T),
+        (np.sqrt(ax), onp.sqrt(x)),
+        (np.argmax(ax, 1), onp.argmax(x, 1)),
+        (np.tile(ax, (2, 1)), onp.tile(x, (2, 1))),
+        (np.cumsum(ax, 1), onp.cumsum(x, 1)),
+        (np.maximum(ax, ay), onp.maximum(x, y)),
+        (np.tensordot(ax, ay, ([1], [1])),
+         onp.tensordot(x, y, ([1], [1]))),
+        (np.einsum("ij,kj->ik", ax, ay), onp.einsum("ij,kj->ik", x, y)),
+    ]
+    for got, want in cases:
+        onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-5,
+                                    atol=1e-6)
+
+
+def test_autograd_through_np_ops():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(np.square(np.sin(x)))
+    y.backward()
+    expect = 2 * onp.sin(x.asnumpy()) * onp.cos(x.asnumpy())
+    onp.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_multi_output_and_int_ops():
+    a = np.array([3.0, 1.0, 2.0])
+    parts = np.split(np.arange(6), 3)
+    assert len(parts) == 3 and parts[1].asnumpy().tolist() == [2, 3]
+    assert np.sort(a).asnumpy().tolist() == [1, 2, 3]
+    u = np.unique(np.array([1, 1, 2]))
+    assert u.asnumpy().tolist() == [1, 2]
+
+
+def test_np_random_and_seed():
+    np.random.seed(42)
+    a = np.random.uniform(0, 1, (100,))
+    np.random.seed(42)
+    b = np.random.uniform(0, 1, (100,))
+    onp.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    assert 0.0 <= float(a.asnumpy().min()) and float(a.asnumpy().max()) <= 1
+    n = np.random.normal(2.0, 0.5, (2000,))
+    assert abs(float(np.mean(n).asnumpy()) - 2.0) < 0.1
+    r = np.random.randint(0, 10, (50,))
+    assert r.dtype == onp.int32 and r.asnumpy().max() < 10
+    p = np.random.permutation(10)
+    assert sorted(p.asnumpy().tolist()) == list(range(10))
+
+
+def test_np_linalg():
+    a = np.array([[2.0, 1.0], [1.0, 3.0]])
+    onp.testing.assert_allclose(float(np.linalg.det(a).asnumpy()), 5.0,
+                                rtol=1e-5)
+    inv = np.linalg.inv(a)
+    onp.testing.assert_allclose((inv.asnumpy() @ a.asnumpy()), onp.eye(2),
+                                atol=1e-5)
+    assert abs(float(np.linalg.norm(a).asnumpy()) -
+               onp.linalg.norm(a.asnumpy())) < 1e-5
+    # grad through linalg
+    x = np.array([[2.0, 0.0], [0.0, 3.0]])
+    x.attach_grad()
+    with autograd.record():
+        l = np.sum(np.linalg.inv(x))
+    l.backward()
+    assert onp.isfinite(x.grad.asnumpy()).all()
+
+
+def test_npx_extensions():
+    x = np.array([[1.0, -1.0], [0.5, 2.0]])
+    onp.testing.assert_allclose(npx.relu(x).asnumpy(),
+                                onp.maximum(x.asnumpy(), 0))
+    s = npx.softmax(x, axis=-1).asnumpy()
+    onp.testing.assert_allclose(s.sum(-1), [1, 1], rtol=1e-6)
+    oh = npx.one_hot(np.array([0, 2]).astype("int32"), 3)
+    onp.testing.assert_allclose(oh.asnumpy(),
+                                [[1, 0, 0], [0, 0, 1]])
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array()
+    npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_np_in_functional_trace():
+    """np ops must trace into hybridized blocks (one compiled graph)."""
+    from tpu_mx import gluon
+    from tpu_mx.gluon import nn
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.fc = nn.Dense(4, in_units=3)
+
+        def hybrid_forward(self, F, x):
+            return np.tanh(self.fc(x)) + np.ones(4)
+
+    net = Net()
+    net.initialize()
+    x = nd.array(onp.ones((2, 3), onp.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    onp.testing.assert_allclose(eager, hybrid, rtol=1e-6)

@@ -107,7 +107,15 @@ def test_onnx_roundtrip_mlp_embedding(tmp_path):
     y_ref = out.eval(**feeds)[0].asnumpy()
 
     path = str(tmp_path / "mlp.onnx")
-    onnx_mx.export_model(out, params, {"tokens": (3, 4)}, path)
+    onnx_mx.export_model(out, params, {"tokens": (3, 4)}, path,
+                         input_dtypes={"tokens": "int32"})
+    # declared input elem_type must be INT32 (6), not the float default —
+    # foreign runtimes reject misdeclared feeds
+    with open(path, "rb") as f:
+        graph = decode(decode(f.read())[7][0])
+    vi = decode(graph[11][0])
+    ttype = decode(decode(vi[2][0])[1][0])
+    assert ttype[1][0] == 6, "tokens input must be declared int32"
     sym2, arg2, aux2 = onnx_mx.import_model(path)
     feeds2 = {"tokens": data}
     feeds2.update(arg2)

@@ -1,0 +1,87 @@
+"""contrib.text vocabulary + embeddings (REF:tests/python/unittest/
+test_contrib_text.py patterns: counter -> vocab -> embedding matrix)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx.base import MXNetError
+from tpu_mx.contrib import text
+
+
+def test_count_tokens():
+    c = text.count_tokens_from_str("a b b\nc c c", to_lower=False)
+    assert c == {"a": 1, "b": 2, "c": 3}
+    c2 = text.count_tokens_from_str("A a", to_lower=True)
+    assert c2 == {"a": 2}
+
+
+def test_vocabulary_order_and_limits():
+    c = text.count_tokens_from_str("a b b c c c d")
+    v = text.Vocabulary(c, most_freq_count=None, min_freq=1,
+                        reserved_tokens=["<pad>"])
+    # index 0 unk, 1 reserved, then by (-freq, token)
+    assert v.idx_to_token[:3] == ["<unk>", "<pad>", "c"]
+    assert v.to_indices("zzz") == 0  # unknown
+    assert v.to_indices(["c", "b"]) == [2, 3]
+    assert v.to_tokens([2, 3]) == ["c", "b"]
+    v2 = text.Vocabulary(c, most_freq_count=3)
+    assert len(v2) == 3  # unk + 2 most frequent
+    v3 = text.Vocabulary(c, min_freq=2)
+    assert set(v3.idx_to_token) == {"<unk>", "b", "c"}
+    with pytest.raises(MXNetError):
+        text.Vocabulary(c, reserved_tokens=["<unk>"])
+
+
+def _write_vecs(tmp_path):
+    p = tmp_path / "vecs.txt"
+    p.write_text("hello 1 2 3\nworld 4 5 6\n")
+    return str(p)
+
+
+def test_custom_embedding(tmp_path):
+    emb = text.CustomEmbedding(_write_vecs(tmp_path))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["world", "missing"]).asnumpy(),
+        [[4, 5, 6], [0, 0, 0]])
+    # matrix is Embedding-ready: rows match token indices
+    mat = emb.idx_to_vec.asnumpy()
+    assert mat.shape == (len(emb), 3)
+    np.testing.assert_allclose(mat[emb.token_to_idx["hello"]], [1, 2, 3])
+    emb.update_token_vectors("hello", np.array([9., 9., 9.]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+    with pytest.raises(MXNetError):
+        emb.update_token_vectors("nope", np.zeros(3))
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    c = text.count_tokens_from_str("hello hello unseen")
+    v = text.Vocabulary(c)
+    emb = text.CustomEmbedding(_write_vecs(tmp_path), vocabulary=v,
+                               init_unknown_vec=np.ones)
+    # vocab token with no pretrained vec gets the unknown init
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("unseen").asnumpy(), [1, 1, 1])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+
+
+def test_composite_embedding(tmp_path):
+    p2 = tmp_path / "v2.txt"
+    p2.write_text("hello 7 8\n")
+    c = text.count_tokens_from_str("hello world")
+    v = text.Vocabulary(c)
+    e1 = text.CustomEmbedding(_write_vecs(tmp_path))
+    e2 = text.CustomEmbedding(str(p2))
+    comp = text.CompositeEmbedding(v, [e1, e2])
+    assert comp.vec_len == 5
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3, 7, 8])
+
+
+def test_pretrained_catalog_documented_divergence():
+    with pytest.raises(MXNetError, match="hermetic"):
+        text.get_pretrained_file_names("glove")

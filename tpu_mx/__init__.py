@@ -35,6 +35,8 @@ from . import kvstore as kv
 from . import module
 from . import model
 from . import callback
+from . import numpy as np
+from . import npx
 from . import contrib
 from . import recordio
 from . import io

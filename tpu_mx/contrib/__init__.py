@@ -2,3 +2,5 @@
 from . import compression
 from . import amp
 from . import quantization
+from . import text
+from . import onnx

@@ -268,6 +268,9 @@ def export_model(sym, params, input_shapes=None, onnx_file_path="model.onnx",
     params — {name: NDArray|ndarray} for every parameter/aux variable
     input_shapes — [(shape…)] for the remaining (data) variables, in
         list_arguments order, or {name: shape}
+    input_dtypes — matching dtypes (list or {name: dtype}); default
+        float32 — int inputs (token ids) MUST declare int32/int64 or
+        foreign runtimes will reject the feed
     Returns the path written.  Raises MXNetError on unsupported ops."""
     from ..symbol.symbol import _topo
 
@@ -281,6 +284,17 @@ def export_model(sym, params, input_shapes=None, onnx_file_path="model.onnx",
     missing = [n for n in data_names if n not in shape_map]
     if missing:
         raise MXNetError(f"ONNX export: missing input shapes for {missing}")
+    if isinstance(input_dtypes, dict):
+        dtype_map = dict(input_dtypes)
+    else:
+        dtype_map = dict(zip(data_names, input_dtypes or []))
+
+    def elem_type_of(name):
+        dt = np.dtype(dtype_map.get(name, np.float32))
+        if dt not in _NP2ONNX:
+            raise MXNetError(f"ONNX export: unsupported input dtype {dt} "
+                             f"for {name!r}")
+        return _NP2ONNX[dt]
 
     ex = _Exporter()
     order = _topo(sym._entries)
@@ -294,7 +308,8 @@ def export_model(sym, params, input_shapes=None, onnx_file_path="model.onnx",
                 inits.append(_tensor(node.name, arr))
             else:
                 graph_inputs.append(_value_info(node.name,
-                                                shape_map[node.name]))
+                                                shape_map[node.name],
+                                                elem_type_of(node.name)))
             continue
         if node.num_outputs != 1:
             raise MXNetError(
@@ -352,8 +367,14 @@ def _parse_tensor(raw):
     name = f.get(8, [b""])[0].decode()
     if 9 in f:
         arr = np.frombuffer(f[9][0], dtype=dtype).reshape(dims).copy()
-    elif 4 in f:                              # float_data fallback
-        arr = np.asarray(f[4], np.float32).reshape(dims)
+    elif 4 in f:                              # float_data (packed or not)
+        vals = []
+        for v in f[4]:
+            if isinstance(v, (bytes, bytearray)):
+                vals.extend(np.frombuffer(v, np.float32))
+            else:
+                vals.append(v)
+        arr = np.asarray(vals, np.float32).reshape(dims)
     elif 7 in f:
         arr = np.asarray(decode_packed_ints(f[7]), np.int64).reshape(dims)
     else:

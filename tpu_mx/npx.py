@@ -1,0 +1,82 @@
+"""`mx.npx` — neural-network extensions for the numpy namespace
+(REF:python/mxnet/ndarray/numpy_extension/ + python/mxnet/util.py set_np).
+
+Upstream these are separate C++ kernels re-exported under npx; here the
+classic op library already IS the jax-backed implementation, so npx simply
+re-exports it under the numpy-era names.  `set_np`/`is_np_array` keep the
+upstream switch-semantics API; the unified NDArray means the switch only
+tracks intent (documented divergence — both namespaces share one array
+type, so there is nothing to toggle)."""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray import ops as _ops
+
+_np_active = False
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Upstream flips Gluon into numpy-array mode; the unified NDArray is
+    always numpy-flavored, so this records intent only."""
+    global _np_active
+    _np_active = bool(array)
+
+
+def reset_np():
+    global _np_active
+    _np_active = False
+
+
+def is_np_array():
+    return _np_active
+
+
+def is_np_shape():
+    return _np_active
+
+
+# nn extensions: numpy-era names -> classic op library (same kernels)
+activation = _ops.Activation
+batch_norm = _ops.BatchNorm
+convolution = _ops.Convolution
+fully_connected = _ops.FullyConnected
+pooling = _ops.Pooling
+dropout = _ops.Dropout
+embedding = _ops.Embedding
+one_hot = _ops.one_hot
+pick = _ops.pick
+topk = _ops.topk
+softmax = _ops.softmax
+log_softmax = _ops.log_softmax
+sigmoid = _ops.sigmoid
+relu = _ops.relu
+batch_dot = _ops.batch_dot
+reshape_like = _ops.reshape_like
+gather_nd = _ops.gather_nd
+sequence_mask = _ops.SequenceMask
+leaky_relu = _ops.LeakyReLU
+
+
+def gelu(data, **kw):
+    return _ops.gelu(data)
+
+
+def load(fname):
+    from .ndarray import load as _load
+    return _load(fname)
+
+
+def save(fname, data):
+    from .ndarray import save as _save
+    return _save(fname, data)
+
+
+def waitall():
+    from .ndarray import waitall as _waitall
+    return _waitall()
+
+
+def seed(s):
+    from . import random as _random
+    _random.seed(s)

@@ -1,0 +1,27 @@
+"""`mx.np.linalg` (REF:python/mxnet/numpy/linalg.py) — jax.numpy.linalg
+through the autograd-aware dispatch layer."""
+from __future__ import annotations
+
+import jax.numpy as _jnp
+
+from ..ndarray import ops as _ops
+
+
+def _wrap(name):
+    jfn = getattr(_jnp.linalg, name)
+
+    def op(*args, **kwargs):
+        return _ops._apply(lambda *raw: jfn(*raw, **kwargs), list(args),
+                           f"linalg_{name}")
+
+    op.__name__ = name
+    return op
+
+
+_WRAPPED = ["cholesky", "det", "eigh", "eigvalsh", "inv", "lstsq",
+            "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv",
+            "qr", "slogdet", "solve", "svd", "tensorinv", "tensorsolve"]
+for _name in _WRAPPED:
+    globals()[_name] = _wrap(_name)
+
+__all__ = list(_WRAPPED)

@@ -1,0 +1,110 @@
+"""`mx.np.random` (REF:python/mxnet/numpy/random.py) — numpy-style
+sampling from the framework RNG stream (explicit-key JAX PRNG under the
+hood: traced keys inside functional traces, eager splits otherwise)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as _jnp
+import numpy as _onp
+
+from .. import random as _random
+from ..ndarray import NDArray
+
+__all__ = ["uniform", "normal", "randn", "rand", "randint", "choice",
+           "shuffle", "permutation", "multinomial", "beta", "gamma",
+           "exponential", "seed"]
+
+
+def seed(s):
+    _random.seed(s)
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.uniform(key, _shape(size),
+                                      dtype or _jnp.float32,
+                                      minval=low, maxval=high))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    out = jax.random.normal(key, _shape(size), dtype or _jnp.float32)
+    return NDArray(out * scale + loc)
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None):
+    if high is None:
+        low, high = 0, low
+    key = _random.take_key()
+    return NDArray(jax.random.randint(key, _shape(size), low, high,
+                                      dtype or _jnp.int32))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    key = _random.take_key()
+    arr = _jnp.arange(a) if isinstance(a, int) else _jnp.asarray(
+        a._data if isinstance(a, NDArray) else a)
+    pr = None if p is None else _jnp.asarray(
+        p._data if isinstance(p, NDArray) else p)
+    return NDArray(jax.random.choice(key, arr, _shape(size),
+                                     replace=replace, p=pr))
+
+
+def permutation(x):
+    key = _random.take_key()
+    arr = _jnp.arange(x) if isinstance(x, int) else _jnp.asarray(
+        x._data if isinstance(x, NDArray) else x)
+    return NDArray(jax.random.permutation(key, arr))
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (numpy contract; the NDArray handle
+    is rebound to the permuted buffer)."""
+    if not isinstance(x, NDArray):
+        raise TypeError("shuffle needs an NDArray")
+    key = _random.take_key()
+    x._rebind(jax.random.permutation(key, x._data))
+
+
+def multinomial(n, pvals, size=None):
+    key = _random.take_key()
+    pv = _jnp.asarray(pvals._data if isinstance(pvals, NDArray) else pvals)
+    draws = jax.random.categorical(
+        key, _jnp.log(_jnp.maximum(pv, 1e-30)), shape=_shape(size) + (n,))
+    counts = jax.vmap(lambda d: _jnp.bincount(d, length=pv.shape[-1]))(
+        draws.reshape(-1, n)) if draws.ndim > 1 else _jnp.bincount(
+        draws, length=pv.shape[-1])
+    return NDArray(counts.reshape(_shape(size) + (pv.shape[-1],))
+                   if size is not None else counts)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.beta(key, a, b, _shape(size),
+                                   dtype or _jnp.float32))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    out = jax.random.gamma(key, shape, _shape(size), dtype or _jnp.float32)
+    return NDArray(out * scale)
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.exponential(
+        key, _shape(size), dtype or _jnp.float32) * scale)

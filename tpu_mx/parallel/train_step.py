@@ -146,13 +146,15 @@ class CompiledTrainStep:
             ndp = mesh.shape["dp"]
             # per-device quantization error feedback, dp-sharded on axis 0;
             # allocated ALREADY sharded (out_shardings) so a big model never
-            # materializes ndp full copies on one device
+            # materializes ndp full copies on one device — one compile for
+            # the whole dict, not one per tensor
             ef_sh = sharding_for(mesh, P("dp"))
-            self._efs = {
-                k: jax.jit(lambda s=self.values[k].shape:
-                           jnp.zeros((ndp,) + s, jnp.float32),
-                           out_shardings=ef_sh)()
-                for k in self._diff_keys}
+            shapes = {k: self.values[k].shape for k in self._diff_keys}
+            alloc = jax.jit(
+                lambda: {k: jnp.zeros((ndp,) + s, jnp.float32)
+                         for k, s in shapes.items()},
+                out_shardings={k: ef_sh for k in shapes})
+            self._efs = alloc()
         self._jitted = None
 
     # -- sharding helpers -----------------------------------------------------
@@ -343,8 +345,8 @@ class CompiledTrainStep:
         self.masters = sd.get("masters", {})
         self.opt_states = sd["opt_states"]
         efs = sd.get("efs")
-        if efs and all(k in efs and efs[k].shape == v.shape
-                       for k, v in self._efs.items()):
+        if self._efs and efs and all(k in efs and efs[k].shape == v.shape
+                                     for k, v in self._efs.items()):
             self._efs = efs  # same dp topology; otherwise keep fresh zeros
         self._t = sd["t"]
 
