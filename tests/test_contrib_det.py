@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import tpu_mx as mx
+from tpu_mx import nd
 
 
 def np_iou(a, b):
@@ -179,3 +180,99 @@ def test_multibox_symbolic():
     ex.arg_dict["label"][:] = -np.ones((1, 2, 5), "float32")
     outs = ex.forward()
     assert outs[2].shape == (1, 3)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """With all-zero offsets DCN must reproduce the plain convolution
+    (REF:contrib/deformable_convolution.cc identity property)."""
+    from tpu_mx.ndarray import contrib, ops
+    rng = np.random.RandomState(0)
+    N, C, H, W, Cout, K = 2, 4, 8, 8, 6, 3
+    x = nd.array(rng.rand(N, C, H, W).astype(np.float32))
+    w = nd.array(rng.rand(Cout, C, K, K).astype(np.float32) * 0.2)
+    b = nd.array(rng.rand(Cout).astype(np.float32))
+    off = nd.zeros((N, 2 * K * K, H, W))
+    out = contrib.DeformableConvolution(
+        x, off, w, b, kernel=(K, K), pad=(1, 1), num_filter=Cout)
+    ref = ops.Convolution(x, w, b, kernel=(K, K), pad=(1, 1),
+                          num_filter=Cout)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    """A constant integer offset samples the shifted input: interior
+    outputs must equal the plain conv of the rolled feature map."""
+    from tpu_mx.ndarray import contrib, ops
+    rng = np.random.RandomState(1)
+    N, C, H, W, Cout, K = 1, 2, 10, 10, 3, 3
+    x = rng.rand(N, C, H, W).astype(np.float32)
+    w = nd.array(rng.rand(Cout, C, K, K).astype(np.float32))
+    off = np.zeros((N, 2 * K * K, H - 2, W - 2), np.float32)
+    off[:, 0::2] = 1.0  # dy = +1 for every tap
+    out = contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), w, kernel=(K, K), num_filter=Cout,
+        no_bias=True)
+    shifted = np.roll(x, -1, axis=2)  # sampling y+1 == shifting map up
+    ref = ops.Convolution(nd.array(shifted), w, kernel=(K, K),
+                          num_filter=Cout, no_bias=True)
+    # rows whose +1-shifted taps stay in range: all but the last output row
+    np.testing.assert_allclose(out.asnumpy()[:, :, :-1],
+                               ref.asnumpy()[:, :, :-1], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_grad_flows_to_offsets():
+    from tpu_mx import autograd
+    from tpu_mx.ndarray import contrib
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.rand(1, 2, 6, 6).astype(np.float32))
+    w = nd.array(rng.rand(2, 2, 3, 3).astype(np.float32))
+    off = nd.array(rng.rand(1, 18, 4, 4).astype(np.float32) * 0.3)
+    off.attach_grad()
+    x.attach_grad()
+    with autograd.record():
+        y = contrib.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                          num_filter=2, no_bias=True)
+        loss = (y * y).sum()
+    loss.backward()
+    assert np.isfinite(off.grad.asnumpy()).all()
+    assert np.abs(off.grad.asnumpy()).max() > 0
+    assert np.abs(x.grad.asnumpy()).max() > 0
+
+
+def test_count_sketch():
+    from tpu_mx.ndarray import contrib
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 5).astype(np.float32)
+    h = np.array([0, 2, 2, 1, 0], np.int32)   # collisions accumulate
+    s = np.array([1, -1, 1, 1, -1], np.float32)
+    out = contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                               out_dim=3).asnumpy()
+    ref = np.zeros((2, 3), np.float32)
+    for i in range(5):
+        ref[:, h[i]] += s[i] * x[:, i]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_boolean_mask():
+    from tpu_mx import gluon
+    from tpu_mx.ndarray import contrib
+    x = nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    idx = nd.array(np.array([1, 0, 1, 0], np.float32))
+    out = contrib.boolean_mask(x, idx)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.arange(12).reshape(4, 3)[[0, 2]])
+
+    # inside a functional trace: clean refusal, not an XLA crash
+    class Bad(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return contrib.boolean_mask(x, x[:, 0] > 0)
+
+    net = Bad()
+    net.initialize()
+    net.hybridize()
+    import pytest as _pytest
+    from tpu_mx.base import MXNetError
+    with _pytest.raises((MXNetError, Exception), match="boolean_mask|static"):
+        net(x)

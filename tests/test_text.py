@@ -25,7 +25,7 @@ def test_vocabulary_order_and_limits():
     assert v.to_indices(["c", "b"]) == [2, 3]
     assert v.to_tokens([2, 3]) == ["c", "b"]
     v2 = text.Vocabulary(c, most_freq_count=3)
-    assert len(v2) == 3  # unk + 2 most frequent
+    assert len(v2) == 4  # unk + 3 most frequent counter tokens (ref contract)
     v3 = text.Vocabulary(c, min_freq=2)
     assert set(v3.idx_to_token) == {"<unk>", "b", "c"}
     with pytest.raises(MXNetError):
@@ -67,6 +67,11 @@ def test_embedding_with_vocabulary(tmp_path):
         emb.get_vecs_by_tokens("unseen").asnumpy(), [1, 1, 1])
     np.testing.assert_allclose(
         emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    # the vocabulary FILTERS the file: out-of-vocab rows ('world') are not
+    # indexed, so the matrix matches the vocab size exactly
+    assert len(emb) == len(v)
+    assert "world" not in emb.token_to_idx
+    assert emb.idx_to_vec.shape == (len(v), 3)
 
 
 def test_composite_embedding(tmp_path):
@@ -85,3 +90,9 @@ def test_composite_embedding(tmp_path):
 def test_pretrained_catalog_documented_divergence():
     with pytest.raises(MXNetError, match="hermetic"):
         text.get_pretrained_file_names("glove")
+
+
+def test_count_tokens_regex_delim_escaped():
+    # '.' as a delimiter must be literal, not the regex wildcard
+    c = text.count_tokens_from_str("a.b c", seq_delim=".")
+    assert c == {"a": 1, "b": 1, "c": 1}

@@ -25,7 +25,7 @@ __all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding",
 def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
                           to_lower=False, counter_to_update=None):
     """Token frequency counter (REF:contrib/text/utils.py)."""
-    source_str = re.sub(rf"{seq_delim}", token_delim, source_str)
+    source_str = re.sub(re.escape(seq_delim), token_delim, source_str)
     if to_lower:
         source_str = source_str.lower()
     counter = counter_to_update if counter_to_update is not None \
@@ -53,8 +53,9 @@ class Vocabulary:
         if counter is not None:
             pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
             taken = set(self._idx_to_token)
-            budget = most_freq_count - len(self._idx_to_token) \
-                if most_freq_count is not None else None
+            # most_freq_count bounds COUNTER tokens only (reference
+            # contract): unknown/reserved tokens ride on top
+            budget = most_freq_count if most_freq_count is not None else None
             for tok, freq in pairs:
                 if freq < min_freq or tok in taken:
                     continue
@@ -108,6 +109,7 @@ class _TokenEmbedding(Vocabulary):
         super().__init__(**kwargs)
         self._vec_len = 0
         self._idx_to_vec = None
+        self._host_cache = None  # lazy host copy for token lookups
 
     @property
     def vec_len(self):
@@ -118,7 +120,7 @@ class _TokenEmbedding(Vocabulary):
         return self._idx_to_vec
 
     def _load_embedding(self, path, elem_delim, init_unknown_vec,
-                        encoding="utf8"):
+                        encoding="utf8", restrict_to_vocab=False):
         tokens, vecs = [], []
         with open(path, encoding=encoding) as f:
             for line_num, line in enumerate(f):
@@ -126,6 +128,8 @@ class _TokenEmbedding(Vocabulary):
                 if len(parts) <= 2:
                     continue  # header or malformed line (fastText header)
                 tok, elems = parts[0], parts[1:]
+                if restrict_to_vocab and tok not in self._token_to_idx:
+                    continue  # vocabulary filter: don't index OOV file rows
                 if self._vec_len and len(elems) != self._vec_len:
                     raise MXNetError(
                         f"line {line_num + 1}: dim {len(elems)} != "
@@ -134,10 +138,11 @@ class _TokenEmbedding(Vocabulary):
                 tokens.append(tok)
                 vecs.append(np.asarray(elems, np.float32))
         table = {t: v for t, v in zip(tokens, vecs)}
-        for tok in tokens:
-            if tok not in self._token_to_idx:
-                self._token_to_idx[tok] = len(self._idx_to_token)
-                self._idx_to_token.append(tok)
+        if not restrict_to_vocab:
+            for tok in tokens:
+                if tok not in self._token_to_idx:
+                    self._token_to_idx[tok] = len(self._idx_to_token)
+                    self._idx_to_token.append(tok)
         mat = np.empty((len(self), self._vec_len), np.float32)
         unk = init_unknown_vec((self._vec_len,)) if init_unknown_vec \
             else np.zeros((self._vec_len,), np.float32)
@@ -152,7 +157,11 @@ class _TokenEmbedding(Vocabulary):
             toks = [t if t in self._token_to_idx else t.lower()
                     for t in toks]
         idx = [self._token_to_idx.get(t, 0) for t in toks]
-        vecs = self._idx_to_vec.asnumpy()[idx]
+        if self._host_cache is None:
+            # one host copy, reused across lookups (a 400k-row table would
+            # otherwise ride device->host on every call)
+            self._host_cache = self._idx_to_vec.asnumpy()
+        vecs = self._host_cache[idx]
         return NDArray(vecs[0] if single else vecs)
 
     def update_token_vectors(self, tokens, new_vectors):
@@ -167,6 +176,7 @@ class _TokenEmbedding(Vocabulary):
                                  "tokens can be updated")
             mat[self._token_to_idx[t]] = v
         self._idx_to_vec = NDArray(mat)
+        self._host_cache = None
 
 
 class CustomEmbedding(_TokenEmbedding):
@@ -183,7 +193,8 @@ class CustomEmbedding(_TokenEmbedding):
             self._idx_to_token = list(vocabulary.idx_to_token)
             self._token_to_idx = dict(vocabulary.token_to_idx)
         self._load_embedding(pretrained_file_path, elem_delim,
-                             init_unknown_vec, encoding)
+                             init_unknown_vec, encoding,
+                             restrict_to_vocab=vocabulary is not None)
 
 
 class CompositeEmbedding(_TokenEmbedding):
@@ -202,6 +213,7 @@ class CompositeEmbedding(_TokenEmbedding):
         mat = np.concatenate(parts, axis=1)
         self._vec_len = mat.shape[1]
         self._idx_to_vec = NDArray(mat)
+        self._host_cache = None
 
 
 def get_pretrained_file_names(embedding_name=None):

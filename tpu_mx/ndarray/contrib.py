@@ -19,7 +19,8 @@ import numpy as np
 from .ops import _apply
 
 __all__ = ["box_iou", "box_nms", "MultiBoxPrior", "MultiBoxTarget",
-           "MultiBoxDetection"]
+           "MultiBoxDetection", "DeformableConvolution", "count_sketch",
+           "boolean_mask"]
 
 
 # --------------------------------------------------------------------------
@@ -309,3 +310,131 @@ def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
 
     return _apply(f, [cls_prob, loc_pred, anchor], "MultiBoxDetection",
                   nondiff=True)
+
+
+# --------------------------------------------------------------------------
+# deformable convolution, count_sketch, boolean_mask
+# (REF:src/operator/contrib/{deformable_convolution,count_sketch,
+#  boolean_mask}.cc)
+# --------------------------------------------------------------------------
+
+def _bilinear_zero(feat, ys, xs):
+    """feat: (C, H, W); sample at fractional (ys, xs) with ZERO padding —
+    each bilinear corner contributes only if it is a real pixel (the DCN
+    im2col contract, unlike the ROI ops' border-clamp)."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = None
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yi = y0.astype(jnp.int32) + dy
+            xi = x0.astype(jnp.int32) + dx
+            ok = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+            v = feat[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+            term = v * (wy * wx * ok.astype(feat.dtype))
+            out = term if out is None else out + term
+    return out
+
+
+def DeformableConvolution(data, offset, weight, bias=None, kernel=None,
+                          stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                          num_filter=None, num_group=1,
+                          num_deformable_group=1, no_bias=False, **kw):
+    """Deformable convolution v1 (REF:src/operator/contrib/
+    deformable_convolution.cc, Dai et al. 2017).
+
+    TPU-native design: instead of the reference's deformable_im2col CUDA
+    kernel, the offset taps are gathered with a vectorized zero-padded
+    bilinear sampler into an (N, C·KH·KW, Ho·Wo) patch tensor, and the
+    convolution itself is ONE MXU matmul against the (Cout, C·KH·KW)
+    weight — gather feeds the systolic array.
+
+    data: (N, C, H, W); offset: (N, 2·dg·KH·KW, Ho, Wo) interleaved
+    (dy, dx) per tap; weight: (Cout, C/num_group, KH, KW)."""
+    if num_group != 1:
+        raise ValueError("DeformableConvolution: num_group>1 not supported")
+    kh, kw_ = kernel
+    sh, sw = stride if isinstance(stride, (tuple, list)) else (stride,) * 2
+    dh, dw = dilate if isinstance(dilate, (tuple, list)) else (dilate,) * 2
+    ph, pw = pad if isinstance(pad, (tuple, list)) else (pad,) * 2
+    dg = num_deformable_group
+
+    def f(x, off, w, *b):
+        N, C, H, W = x.shape
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw_ - 1) - 1) // sw + 1
+        base_y = (jnp.arange(Ho) * sh - ph)[:, None, None]      # (Ho,1,1)
+        base_x = (jnp.arange(Wo) * sw - pw)[None, :, None]      # (1,Wo,1)
+        tap_y = (jnp.arange(kh) * dh)[None, None, :, None]      # (1,1,kh,1)
+        tap_x = (jnp.arange(kw_) * dw)[None, None, None, :]     # (1,1,1,kw)
+
+        def one(feat, o):
+            # o: (2*dg*kh*kw, Ho, Wo) -> (dg, kh, kw, 2, Ho, Wo)
+            o = o.reshape(dg, kh, kw_, 2, Ho, Wo)
+
+            # positions: (Ho, Wo, kh, kw) per deformable group
+            ys = (base_y[..., None] + tap_y)                     # (Ho,1,kh,1)
+            xs = (base_x[..., None] + tap_x)                     # (1,Wo,1,kw)
+            ys = jnp.broadcast_to(ys, (Ho, Wo, kh, kw_))
+            xs = jnp.broadcast_to(xs, (Ho, Wo, kh, kw_))
+            outs = []
+            cg = C // dg
+            for g in range(dg):
+                dy = jnp.transpose(o[g, :, :, 0], (2, 3, 0, 1))  # (Ho,Wo,kh,kw)
+                dx = jnp.transpose(o[g, :, :, 1], (2, 3, 0, 1))
+                sampled = _bilinear_zero(feat[g * cg:(g + 1) * cg],
+                                         ys + dy, xs + dx)       # (cg,Ho,Wo,kh,kw)
+                outs.append(sampled)
+            return jnp.concatenate(outs, axis=0)                 # (C,Ho,Wo,kh,kw)
+
+        patches = jax.vmap(one)(x, off)                          # (N,C,Ho,Wo,kh,kw)
+        patches = jnp.transpose(patches, (0, 1, 4, 5, 2, 3))     # (N,C,kh,kw,Ho,Wo)
+        col = patches.reshape(N, C * kh * kw_, Ho * Wo)
+        wmat = w.reshape(num_filter, C * kh * kw_)
+        out = jnp.einsum("ok,nkp->nop", wmat, col).reshape(
+            N, num_filter, Ho, Wo)
+        if b:
+            out = out + b[0][None, :, None, None]
+        return out
+
+    args = [data, offset, weight] + ([] if (no_bias or bias is None)
+                                     else [bias])
+    return _apply(f, args, "DeformableConvolution")
+
+
+def count_sketch(data, h, s, out_dim=None, **kw):
+    """Count sketch projection (REF:src/operator/contrib/count_sketch.cc,
+    compact bilinear pooling): out[:, h[i]] += s[i]·data[:, i] — one XLA
+    scatter-add, differentiable w.r.t. data."""
+    out_dim = int(out_dim)
+
+    def f(x, hh, ss):
+        n = x.shape[0]
+        idx = hh.astype(jnp.int32)
+        zero = jnp.zeros((n, out_dim), x.dtype)
+        return zero.at[:, idx].add(x * ss.astype(x.dtype))
+
+    return _apply(f, [data, h, s], "count_sketch")
+
+
+def boolean_mask(data, index, axis=0, **kw):
+    """Select rows where index != 0 (REF:src/operator/contrib/
+    boolean_mask.cc).  DATA-DEPENDENT output shape: eager-only by design —
+    XLA requires static shapes, so inside hybridize/jit use
+    `where`/`SequenceMask` style masking instead (documented divergence)."""
+    from .. import _functional
+    if _functional.active():
+        from ..base import MXNetError
+        raise MXNetError(
+            "boolean_mask has a data-dependent output shape and cannot be "
+            "traced into a compiled graph; use where()/SequenceMask-style "
+            "masking inside hybridized blocks")
+
+    def f(x, idx):
+        keep = jnp.asarray(idx) != 0
+        return jnp.compress(keep, x, axis=axis)
+
+    return _apply(f, [data, index], "boolean_mask")
