@@ -42,9 +42,18 @@ V5E_PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e chip
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.09e9  # fwd GMACs*2, *3 for fwd+bwd
 
 
-def bert_train_flops_per_seq(params, num_layers, units, seq_len):
-    """6·P·T matmul flops (fwd 2PT + bwd 4PT) + the attention T² term."""
-    return 6 * params * seq_len + 3 * 4 * num_layers * units * seq_len ** 2
+def bert_train_flops_per_seq(num_layers, units, hidden, vocab, seq_len,
+                             n_masked):
+    """Matmul-only train flops per sequence, counted per executed matmul
+    (fwd 2·flops, bwd 4·flops): per-layer qkv/attn-out/ffn + the T² score
+    and AV terms over all T positions, the MLM dense + tied vocab head over
+    ONLY the n_masked positions (embedding lookups are gathers, not
+    matmuls, and are excluded)."""
+    per_tok_layer = 2 * units * (3 * units) + 2 * units * units \
+        + 2 * 2 * units * hidden
+    body = num_layers * seq_len * (per_tok_layer + 4 * seq_len * units)
+    head = n_masked * (2 * units * units + 2 * vocab * units)
+    return 3 * (body + head)
 
 
 def log(msg):
@@ -165,34 +174,42 @@ def bench_bert(smoke):
     tokens = rng.randint(4, cfg["vocab_size"], (batch, seq_len)).astype(
         np.int32)
     types = np.zeros((batch, seq_len), np.int32)
-    labels = np.where(rng.rand(batch, seq_len) < 0.15, tokens, -1).astype(
-        np.int32)
-    net(nd.array(tokens), nd.array(types))  # finalize shapes
+    # reference pretraining contract: the vocab head runs ONLY on the 15%
+    # masked positions (B, M) — full-T logits would be ~4 GB at this scale
+    n_masked = max(1, int(0.15 * seq_len))
+    positions = np.stack([rng.choice(seq_len, n_masked, replace=False)
+                          for _ in range(batch)]).astype(np.int32)
+    labels = np.take_along_axis(tokens, positions, axis=1)
+    # finalize deferred shapes on ONE row through the masked head — the
+    # full-batch full-T head would materialize ~4 GB of logits here
+    net(nd.array(tokens[:1]), nd.array(types[:1]), None,
+        nd.array(positions[:1]))
 
     class MLMLoss(gluon.loss.Loss):
+        """CE over the gathered masked positions (every label is a real
+        token id on this path — no ignore-index sentinel needed)."""
+
         def __init__(self, **kw):
             super().__init__(weight=None, batch_axis=0, **kw)
             self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
 
         def hybrid_forward(self, F, logits, labels):
             vocab = logits.shape[-1]
-            fl = F.reshape(logits, shape=(-1, vocab))
-            ll = F.reshape(labels, shape=(-1,))
-            m = ll >= 0
-            safe = F.where(m, ll, F.zeros_like(ll))
-            ce = F.where(m, self._ce(fl, safe),
-                         F.zeros_like(self._ce(fl, safe)))
-            return F.sum(ce) / F.maximum(F.sum(m.astype("float32")), 1.0)
+            return F.mean(self._ce(F.reshape(logits, shape=(-1, vocab)),
+                                   F.reshape(labels, shape=(-1,))))
 
     opt = mx.optimizer.create("lamb", learning_rate=1e-4,
                               multi_precision=True)
     step = CompiledTrainStep(net, MLMLoss(), opt)
-    t_nd, ty_nd, l_nd = nd.array(tokens), nd.array(types), nd.array(labels)
+    t_nd, ty_nd = nd.array(tokens), nd.array(types)
+    p_nd, l_nd = nd.array(positions), nd.array(labels)
+    none_vl = None  # full sequences: no padding in the bench batch
 
     log("bert: compiling full train step (first call)...")
     fetch = lambda l: float(np.asarray(l._data).ravel()[0])
-    seq_s = _run_timed(lambda: step.step(t_nd, ty_nd, l_nd), fetch,
-                       warmup, iters, repeats, batch, "bert")
+    seq_s = _run_timed(
+        lambda: step.step(t_nd, ty_nd, none_vl, p_nd, l_nd), fetch,
+        warmup, iters, repeats, batch, "bert")
 
     # which attention path compiled in (VERDICT r2 ask#2: prove flash, not
     # the dense O(T²) fallback)
@@ -202,10 +219,9 @@ def bench_bert(smoke):
         path = "ring"
     else:
         path = "xla_dense"
-    params = sum(int(np.prod(p.shape))
-                 for p in net.collect_params().values())
-    flops = bert_train_flops_per_seq(params, cfg["num_layers"],
-                                     cfg["units"], seq_len)
+    flops = bert_train_flops_per_seq(cfg["num_layers"], cfg["units"],
+                                     cfg["hidden_size"],
+                                     cfg["vocab_size"], seq_len, n_masked)
     rec = {
         "metric": "bert_base_train_seqs_per_sec_per_chip"
         if not smoke else "bert_smoke_seqs_per_sec",

@@ -494,3 +494,46 @@ def test_compression_rejects_bad_configs():
         CompiledTrainStep(net, loss_fn, opt, mesh=_mesh(dp=4, sp=2),
                           data_specs=(P(("dp", "sp")), P(("dp", "sp"))),
                           gradient_compression={"type": "int8"})
+
+
+def test_bert_masked_positions_match_full_logits():
+    """masked_positions must equal gathering the full-T logits at those
+    positions (the reference pretraining head contract) and train through
+    CompiledTrainStep with a None valid_length passthrough."""
+    from tpu_mx.models.bert import BERTModel, bert_base_config
+    from tpu_mx.parallel import CompiledTrainStep
+
+    cfg = bert_base_config(vocab_size=60, max_len=12)
+    cfg.update(num_layers=1, units=16, hidden_size=32, num_heads=2,
+               dropout=0.0)
+    net = BERTModel(cfg)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(4, 60, (2, 12)).astype(np.int32)
+    types = np.zeros((2, 12), np.int32)
+    pos = np.stack([rng.choice(12, 3, replace=False)
+                    for _ in range(2)]).astype(np.int32)
+
+    full = net(nd.array(tokens), nd.array(types)).asnumpy()
+    masked = net(nd.array(tokens), nd.array(types), None,
+                 nd.array(pos)).asnumpy()
+    ref = np.take_along_axis(full, pos[..., None], axis=1)
+    np.testing.assert_allclose(masked, ref, rtol=1e-4, atol=1e-5)
+
+    class L(gluon.loss.Loss):
+        def __init__(self, **kw):
+            super().__init__(weight=None, batch_axis=0, **kw)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, logits, labels):
+            v = logits.shape[-1]
+            return F.mean(self._ce(F.reshape(logits, shape=(-1, v)),
+                                   F.reshape(labels, shape=(-1,))))
+
+    labels = np.take_along_axis(tokens, pos, axis=1)
+    opt = mx.optimizer.create("adam", learning_rate=3e-3)
+    step = CompiledTrainStep(net, L(), opt)
+    losses = [float(step.step(nd.array(tokens), nd.array(types), None,
+                              nd.array(pos), nd.array(labels)).asscalar())
+              for _ in range(8)]
+    assert losses[-1] < losses[0]

@@ -5,6 +5,7 @@ from ... import nn
 
 __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
            "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
+           "mobilenet_v2_0_75", "mobilenet_v2_0_25",
            "mobilenet_v2_0_5"]
 
 
@@ -103,3 +104,11 @@ def mobilenet_v2_1_0(**kw):
 
 def mobilenet_v2_0_5(**kw):
     return MobileNetV2(0.5, **kw)
+
+
+def mobilenet_v2_0_75(**kw):
+    return MobileNetV2(0.75, **kw)
+
+
+def mobilenet_v2_0_25(**kw):
+    return MobileNetV2(0.25, **kw)

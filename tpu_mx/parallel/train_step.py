@@ -310,7 +310,10 @@ class CompiledTrainStep:
     def step(self, *batch, lr=None):
         """Run one step; batch = (*data_args, label) as NDArray/array."""
         from .. import random as _random
-        raw = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b)
+        # None batch args pass through (optional model inputs like
+        # valid_length); they contribute no leaves to the jitted signature
+        raw = tuple(b._data if isinstance(b, NDArray)
+                    else (None if b is None else jnp.asarray(b))
                     for b in batch)
         if self._jitted is None:
             self._build(len(raw))
