@@ -99,6 +99,16 @@ class KVStore:
         order (the reference's bulk-synchronous contract)."""
         if not self._is_dist or self._num_workers <= 1:
             return agg
+        if not getattr(self, "_warned_eager_dist", False):
+            self._warned_eager_dist = True
+            import logging
+            logging.getLogger(__name__).warning(
+                "dist KVStore eager push: allgather-per-key with a host-side "
+                "reduce (W× reduce bytes, one collective per key). This is "
+                "the parity/debug path — at scale use "
+                "parallel.CompiledTrainStep, whose psum compiles into the "
+                "step and rides ICI (Trainer with update_on_kvstore on a "
+                "dist_* store takes THIS slow path)")
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
         gathered = multihost_utils.process_allgather(agg._data)  # (W, ...)

@@ -1355,3 +1355,120 @@ from .ctc import *             # noqa: F401,F403,E402
 from .rnn_op import *          # noqa: F401,F403,E402
 from .quantized_ops import *   # noqa: F401,F403,E402
 from .sample_ops import *      # noqa: F401,F403,E402
+
+
+# ----------------------------------------------------------------------------
+# long-tail parity ops (REF:src/operator/tensor/*, src/operator/*.cc)
+# ----------------------------------------------------------------------------
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, **kw):
+    """C' = alpha·op(A)·op(B) + beta·C (REF:src/operator/tensor/la_op.cc)."""
+
+    def f(a, b, c):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b) + beta * c
+
+    return _apply(f, [A, B, C], "linalg_gemm")
+
+
+def batch_take(a, indices, **kw):
+    """out[i] = a[i, indices[i]] (REF:src/operator/tensor/indexing_op.cc)."""
+    return _apply(
+        lambda x, idx: jnp.take_along_axis(
+            x, idx.astype(jnp.int32)[:, None], axis=1)[:, 0],
+        [a, indices], "batch_take")
+
+
+def diag(data, k=0, axis1=0, axis2=1, **kw):
+    """1-D in: build a k-diagonal matrix; N-D in: extract the k-diagonal
+    over (axis1, axis2) — reference defaults (0, 1), NOT numpy's last-two
+    (REF:src/operator/tensor/diag_op.cc)."""
+
+    def f(x):
+        if x.ndim == 1:
+            return jnp.diag(x, k)
+        return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+    return _apply(f, [data], "diag")
+
+
+def smooth_l1(data, scalar=1.0, **kw):
+    """Huber-style loss elementwise (REF:src/operator/tensor/
+    elemwise_unary_op_basic.cc smooth_l1): 0.5(σx)²/σ² if |x|<1/σ² else
+    |x|-0.5/σ²."""
+    s2 = float(scalar) ** 2
+
+    def f(x):
+        ax = jnp.abs(x)
+        return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+    return _apply(f, [data], "smooth_l1")
+
+
+def make_loss(data, **kw):
+    """Mark a symbol/array as a loss output (REF:src/operator/
+    make_loss.cc) — identity forward; gradient of ones flows from it."""
+    return _apply(lambda x: x, [data], "make_loss")
+
+
+def unravel_index(data, shape=None, **kw):
+    """Flat indices -> coordinate rows (REF:src/operator/tensor/
+    ravel.cc): out is (ndim, N) like the reference."""
+    dims = tuple(int(s) for s in shape)
+
+    def f(x):
+        return jnp.stack(jnp.unravel_index(x.astype(jnp.int32), dims))
+
+    return _apply(f, [data], "unravel_index")
+
+
+def ravel_multi_index(data, shape=None, **kw):
+    """Coordinate rows (ndim, N) -> flat indices (REF:src/operator/tensor/
+    ravel.cc)."""
+    dims = tuple(int(s) for s in shape)
+
+    def f(x):
+        coords = tuple(x[i].astype(jnp.int32) for i in range(len(dims)))
+        return jnp.ravel_multi_index(coords, dims, mode="clip")
+
+    return _apply(f, [data], "ravel_multi_index")
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5, **kw):
+    """clip(alpha·x + beta, 0, 1) (REF:src/operator/tensor/
+    elemwise_unary_op_basic.cc)."""
+    return _apply(lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0), [data],
+                  "hard_sigmoid")
+
+
+def softrelu(data, **kw):
+    """log(1+exp(x)) — softplus (Activation('softrelu') as a free op)."""
+    return _apply(lambda x: jax.nn.softplus(x), [data], "softrelu")
+
+
+def Crop(data, *like, offset=(0, 0), h_w=(0, 0), center_crop=False, **kw):
+    """Spatial crop (REF:src/operator/crop.cc, NCHW): to `h_w`, or to the
+    second input's spatial size; offset or center anchoring."""
+
+    if not like and (int(h_w[0]) <= 0 or int(h_w[1]) <= 0):
+        raise ValueError("Crop: pass a crop_like second input or a "
+                         "positive h_w target size")
+
+    def f(x, *rest):
+        th, tw = (rest[0].shape[2:4] if rest else
+                  (int(h_w[0]), int(h_w[1])))
+        H, W = x.shape[2], x.shape[3]
+        if center_crop:
+            oy, ox = (H - th) // 2, (W - tw) // 2
+        else:
+            oy, ox = int(offset[0]), int(offset[1])
+        return x[:, :, oy:oy + th, ox:ox + tw]
+
+    return _apply(f, [data] + list(like), "Crop")
+
+
+Reshape = reshape
+astype = cast

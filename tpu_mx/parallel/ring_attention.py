@@ -97,11 +97,14 @@ def _merge(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
-def _ring_body(q, k, v, valid, seed, *, axis_name, causal, scale, rate,
-               masked, dropped, key_axes=()):
+def _ring_body(q, k, v, valid, seed, bias, *, axis_name, causal, scale,
+               rate, masked, dropped, biased, key_axes=()):
     """Runs inside shard_map: q/k/v are LOCAL blocks (B, H, Tb, D);
     valid (B,) global key counts (replicated over the ring) or a dummy;
-    seed (1,) int32 or a dummy — staticness comes from masked/dropped.
+    seed (1,) int32 or a dummy — staticness comes from masked/dropped;
+    bias is this device's (B|1, H|1, Tb, T_global) row-slice of the
+    attention bias (ALiBi, relative position, …): each ring step slices
+    the columns belonging to the K block it currently holds.
     key_axes: every mesh axis the q spec shards over — each device's
     dropout key folds in ALL its coordinates, so shards that differ only
     in dp/tp draw independent masks (not the same mask on different data)."""
@@ -132,8 +135,13 @@ def _ring_body(q, k, v, valid, seed, *, axis_name, causal, scale, rate,
             # global columns are valid iff kpos < valid_length[b]
             km = kpos[None, None, None, :] < valid[:, None, None, None]
             mask = km if mask is None else jnp.logical_and(mask, km)
+        b_blk = None
+        if biased:
+            # bias columns for the K block currently held
+            b_blk = lax.dynamic_slice_in_dim(bias, k_idx * Tb, Tb, axis=3)
         key_i = jax.random.fold_in(base_key, i) if dropped else None
-        bm, bl, bo = _block_attn(q, k_cur, v_cur, mask=mask, scale=scale,
+        bm, bl, bo = _block_attn(q, k_cur, v_cur, bias=b_blk, mask=mask,
+                                 scale=scale,
                                  dropout_rate=rate if dropped else 0.0,
                                  dropout_key=key_i)
         m, l, o = _merge(m, l, o, bm, bl, bo)
@@ -148,7 +156,7 @@ def _ring_body(q, k, v, valid, seed, *, axis_name, causal, scale, rate,
 
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
                    q_spec=None, valid_length=None, dropout_rate=0.0,
-                   dropout_key=None, batch_axes=("dp", "tp")):
+                   dropout_key=None, bias=None, batch_axes=("dp", "tp")):
     """Sequence-parallel attention.  q/k/v: GLOBAL (B, H, T, D) arrays whose
     T axis is sharded over `axis_name`.  Returns attention output with the
     same sharding.  `q_spec` overrides the default
@@ -156,7 +164,10 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
     from the mesh are dropped automatically; pass `batch_axes` to rename
     the batch/heads mesh axes without a full spec).
     valid_length: (B,) int32 valid key counts (global positions).
-    dropout_rate/dropout_key: attention-prob dropout, drawn per ring step."""
+    dropout_rate/dropout_key: attention-prob dropout, drawn per ring step.
+    bias: (B|1, H|1, T, T) additive attention bias (ALiBi, relative
+    position, …) — rows shard with q over `axis_name`, columns stay whole
+    and are sliced per ring step to match the rotating K block."""
     from jax.experimental.shard_map import shard_map
 
     def present(ax):
@@ -172,28 +183,36 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
     if not present(axis_name):
         # no sequence axis: plain (flash-style blockwise on one device)
         mask = _dense_mask(q.shape[2], k.shape[2], causal, valid_length)
-        m, l, o = _block_attn(q, k, v, mask=mask, scale=scale,
+        m, l, o = _block_attn(q, k, v, bias=bias, mask=mask, scale=scale,
                               dropout_rate=dropout_rate if dropped else 0.0,
                               dropout_key=dropout_key)
         return o / jnp.maximum(l, 1e-30)[..., None]
 
     _count("ring", f"sp={mesh.shape[axis_name]} shape={q.shape}")
     masked = valid_length is not None
+    biased = bias is not None
     B = q.shape[0]
     valid = (jnp.asarray(valid_length, jnp.int32) if masked
              else jnp.zeros((B,), jnp.int32))
     seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1, jnp.int32)
             if dropped else jnp.zeros((1,), jnp.int32))
-    # valid is per-batch → shard like q's batch axis; seed replicated
+    bias_arr = bias if biased else jnp.zeros((1, 1, q.shape[2], 1), q.dtype)
+    # valid is per-batch → shard like q's batch axis; seed replicated;
+    # bias rows follow the q sharding (batch/head axes only when the bias
+    # actually carries them), columns replicated
     vspec = P(spec[0]) if masked else P(None)
+    bspec = P(spec[0] if biased and bias_arr.shape[0] > 1 else None,
+              spec[1] if biased and bias_arr.shape[1] > 1 else None,
+              spec[2], None)
     key_axes = tuple(ax for ax in spec if ax is not None)
     fn = shard_map(
         functools.partial(_ring_body, axis_name=axis_name, causal=causal,
                           scale=scale, rate=float(dropout_rate),
-                          masked=masked, dropped=dropped, key_axes=key_axes),
-        mesh=mesh, in_specs=(spec, spec, spec, vspec, P(None)),
+                          masked=masked, dropped=dropped, biased=biased,
+                          key_axes=key_axes),
+        mesh=mesh, in_specs=(spec, spec, spec, vspec, P(None), bspec),
         out_specs=spec, check_rep=False)
-    return fn(q, k, v, valid, seed)
+    return fn(q, k, v, valid, seed, bias_arr)
 
 
 def _dense_mask(t, tk, causal, valid_length):
@@ -209,17 +228,20 @@ def _dense_mask(t, tk, causal, valid_length):
 
 
 def local_flash_attention(q, k, v, causal=False, valid_length=None,
-                          dropout_rate=0.0, dropout_key=None):
+                          dropout_rate=0.0, dropout_key=None, bias=None):
     """Single-device attention with the same numerics as the ring kernel.
     On TPU with tile-friendly shapes this runs the Pallas flash kernel
     (tpu_mx.kernels.flash_attention: blockwise online softmax, O(T) memory,
-    in-kernel padding mask and prob dropout); otherwise the XLA dense path."""
+    in-kernel padding mask and prob dropout); otherwise the XLA dense path.
+    An additive `bias` routes to the dense path (the Pallas kernel carries
+    masks and dropout but not arbitrary bias tensors)."""
     from ..kernels import flash_attention as fa
     on_tpu = jax.default_backend() == "tpu"
     dropped = dropout_rate > 0.0 and dropout_key is not None
     rate = float(dropout_rate) if dropped else 0.0
-    if on_tpu and fa.supported(q.shape, q.dtype, kv_len=k.shape[2],
-                               dropout_rate=rate):
+    if bias is None and on_tpu and \
+            fa.supported(q.shape, q.dtype, kv_len=k.shape[2],
+                         dropout_rate=rate):
         _count("pallas_flash", f"shape={q.shape}")
         seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1,
                                    jnp.int32) if dropped else None)
@@ -231,23 +253,24 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
            warn=on_tpu)  # CPU dense path is expected; only warn on TPU
     scale = 1.0 / math.sqrt(q.shape[-1])
     mask = _dense_mask(q.shape[2], k.shape[2], causal, valid_length)
-    m, l, o = _block_attn(q, k, v, mask=mask, scale=scale,
+    m, l, o = _block_attn(q, k, v, bias=bias, mask=mask, scale=scale,
                           dropout_rate=rate, dropout_key=dropout_key)
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
 def attention(q, k, v, mesh=None, causal=False, valid_length=None,
-              dropout_rate=0.0, dropout_key=None):
+              dropout_rate=0.0, dropout_key=None, bias=None):
     """Dispatch: ring attention when a mesh with an `sp` axis is active,
     local flash otherwise.  valid_length (B,) masks padded keys; dropout
-    is attention-prob dropout (pass a key only in training mode)."""
+    is attention-prob dropout (pass a key only in training mode); bias is
+    an additive (B|1, H|1, Tq, Tk) attention bias (ALiBi, relative pos)."""
     if mesh is not None and "sp" in mesh.axis_names and \
             mesh.shape["sp"] > 1:
         return ring_attention(q, k, v, mesh, causal=causal,
                               valid_length=valid_length,
                               dropout_rate=dropout_rate,
-                              dropout_key=dropout_key)
+                              dropout_key=dropout_key, bias=bias)
     return local_flash_attention(q, k, v, causal=causal,
                                  valid_length=valid_length,
                                  dropout_rate=dropout_rate,
-                                 dropout_key=dropout_key)
+                                 dropout_key=dropout_key, bias=bias)
