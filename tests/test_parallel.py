@@ -593,3 +593,94 @@ def test_attention_bias_broadcast_shapes():
     out = ring_attention(q, k, v, mesh, bias=bias)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
                                atol=2e-5)
+
+
+def test_grad_accumulation_matches_big_batch():
+    """K microbatch step()s must produce exactly the update of one step on
+    the concatenated K-times batch (mean-of-means == global mean for equal
+    microbatches) — the reference grad_req='add' + delayed Trainer.step
+    contract."""
+    from tpu_mx.parallel import CompiledTrainStep
+
+    def build():
+        np.random.seed(9)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(3))
+        net.initialize()
+        net(nd.ones((1, 6)))
+        return net
+
+    rng = np.random.RandomState(4)
+    micro = [(rng.rand(4, 6).astype(np.float32),
+              rng.randint(0, 3, (4,)).astype(np.float32))
+             for _ in range(3)]
+    big_x = np.concatenate([m[0] for m in micro])
+    big_y = np.concatenate([m[1] for m in micro])
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # K=3 accumulation
+    net_a = build()
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    step_a = CompiledTrainStep(net_a, loss_fn, opt, accum_steps=3)
+    for x, y in micro:
+        step_a.step(nd.array(x), nd.array(y))
+    assert step_a._t == 1  # one applied update
+    step_a.sync_to_net()
+    wa = {k: p.data().asnumpy() for k, p in net_a.collect_params().items()}
+
+    # one big-batch step
+    net_b = build()
+    opt_b = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    step_b = CompiledTrainStep(net_b, loss_fn, opt_b)
+    step_b.step(nd.array(big_x), nd.array(big_y))
+    step_b.sync_to_net()
+    wb = {k: p.data().asnumpy() for k, p in net_b.collect_params().items()}
+
+    for (_, a), (_, b) in zip(sorted(wa.items()), sorted(wb.items())):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_learns_on_mesh():
+    from tpu_mx.parallel import CompiledTrainStep
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 4)))
+    x = nd.array(np.random.RandomState(0).rand(8, 4).astype(np.float32))
+    y = nd.array(np.random.RandomState(1).randint(0, 2, (8,)),
+                 dtype="float32")
+    opt = mx.optimizer.create("adam", learning_rate=3e-3)
+    step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             opt, mesh=_mesh(dp=8), accum_steps=2)
+    losses = [float(step.step(x, y).asscalar()) for _ in range(20)]
+    assert step._t == 10
+    assert losses[-1] < losses[0]
+    with pytest.raises(ValueError, match="compose"):
+        CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), opt,
+                          mesh=_mesh(dp=8), accum_steps=2,
+                          gradient_compression={"type": "2bit"})
+
+
+def test_grad_accumulation_reset_on_load():
+    """Restoring state mid-accumulation must discard in-flight microbatch
+    gradients (they were computed against the discarded weights)."""
+    from tpu_mx.parallel import CompiledTrainStep
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net(nd.ones((1, 3)))
+    x = nd.array(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    y = nd.array(np.array([0, 1, 2, 3], np.float32))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             opt, accum_steps=3)
+    sd = step.state_dict()
+    step.step(x, y)
+    step.step(x, y)  # mid-accumulation: _micro == 2
+    assert step._micro == 2
+    step.load_state_dict(sd)
+    assert step._micro == 0
+    assert all(float(np.abs(np.asarray(v)).max()) == 0.0
+               for v in step._gacc.values())

@@ -68,11 +68,18 @@ class CompiledTrainStep:
                   feedback (carried in the train state, dp-sharded), summed
                   with a psum over `dp`, and dequantized into the optimizer.
                   Requires a mesh with dp>1 and pure-DP (replicated) params.
+    accum_steps — gradient accumulation: every K-th step() applies the
+                  optimizer with the MEAN of the last K microbatch
+                  gradients (the reference's grad_req='add' + delayed
+                  Trainer.step pattern, REF:python/mxnet/gluon/trainer.py).
+                  Two compiled programs (accumulate / apply) — static
+                  control flow stays outside jit.  BN stats still update
+                  every microbatch.
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
                  data_specs=None, donate=True, n_loss_args=1,
-                 gradient_compression=None):
+                 gradient_compression=None, accum_steps=1):
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -112,6 +119,16 @@ class CompiledTrainStep:
         if n_loss_args < 1:
             raise ValueError("n_loss_args must be >= 1 (the label)")
         self._n_loss_args = n_loss_args
+        if accum_steps < 1:
+            raise ValueError("accum_steps must be >= 1")
+        if accum_steps > 1 and gradient_compression:
+            raise ValueError("accum_steps does not compose with "
+                             "gradient_compression yet (compress once per "
+                             "applied update is the right design; pick one)")
+        self._accum = int(accum_steps)
+        self._micro = 0
+        self._gacc = None     # lazy f32 grad-accumulation buffers
+        self._accum_jit = None
         self._compression = None
         self._efs = {}
         if gradient_compression:
@@ -252,7 +269,9 @@ class CompiledTrainStep:
                 out_specs=(P(), P(), P("dp"), P()), check_rep=False)
             return fn(diff_vals, const_vals, efs, key, *batch)
 
-        def fn(values, masters, opt_states, efs, t, lr, key, *batch):
+        K = self._accum
+
+        def fn(values, masters, opt_states, efs, gacc, t, lr, key, *batch):
             data_args, loss_args = batch[:-n_loss], batch[-n_loss:]
             diff_vals = {k: values[k] for k in diff_keys}
             const_vals = {k: v for k, v in values.items()
@@ -266,6 +285,13 @@ class CompiledTrainStep:
                     make_lfn(const_vals, key, data_args, loss_args),
                     has_aux=True)(diff_vals)
                 new_efs = efs
+            if K > 1:
+                # fold the final microbatch into the accumulated mean
+                grads = {k: grads[k].astype(jnp.float32) / K + gacc[k]
+                         for k in diff_keys}
+                new_gacc = {k: jnp.zeros_like(v) for k, v in gacc.items()}
+            else:
+                new_gacc = gacc
             new_vals = dict(values)
             new_masters = {}
             new_states = {}
@@ -279,7 +305,11 @@ class CompiledTrainStep:
                     new_masters[k] = w
                     new_vals[k] = w.astype(values[k].dtype)
                 else:
-                    w, s = opt.update_core(values[k], grads[k], opt_states[k],
+                    # match the param dtype regardless of path (the K>1
+                    # fold and compression accumulate in f32)
+                    w, s = opt.update_core(values[k],
+                                           grads[k].astype(values[k].dtype),
+                                           opt_states[k],
                                            lr * lr_mults[k],
                                            base_wd * wd_mults[k], t)
                     new_vals[k] = w.astype(values[k].dtype)
@@ -287,11 +317,42 @@ class CompiledTrainStep:
             for k, v in updates.items():
                 if k in new_vals:
                     new_vals[k] = v.astype(new_vals[k].dtype)
-            return new_vals, new_masters, new_states, new_efs, loss
+            return new_vals, new_masters, new_states, new_efs, new_gacc, loss
 
-        donate = (0, 1, 2, 3) if self._donate else ()
+        def accum_fn(values, gacc, key, *batch):
+            """Microbatch accumulate: grads/K into the f32 buffers, BN-stat
+            aux updates applied, NO optimizer step."""
+            data_args, loss_args = batch[:-n_loss], batch[-n_loss:]
+            diff_vals = {k: values[k] for k in diff_keys}
+            const_vals = {k: v for k, v in values.items()
+                          if k not in set(diff_keys)}
+            (loss, updates), grads = jax.value_and_grad(
+                make_lfn(const_vals, key, data_args, loss_args),
+                has_aux=True)(diff_vals)
+            new_gacc = {k: gacc[k] + grads[k].astype(jnp.float32) / K
+                        for k in diff_keys}
+            new_vals = dict(values)
+            for k, v in updates.items():
+                if k in new_vals:
+                    new_vals[k] = v.astype(new_vals[k].dtype)
+            return new_vals, new_gacc, loss
+
+        def alloc_gacc(shardings=None):
+            if K <= 1 or self._gacc is not None:
+                return
+            shapes = {k: self.values[k].shape for k in self._diff_keys}
+            self._gacc = jax.jit(
+                lambda: {k: jnp.zeros(s, jnp.float32)
+                         for k, s in shapes.items()},
+                **({"out_shardings": shardings} if shardings else {}))()
+
+        donate = (0, 1, 2, 3, 4) if self._donate else ()
         if self.mesh is None:
             self._jitted = jax.jit(fn, donate_argnums=donate)
+            if K > 1:
+                self._accum_jit = jax.jit(
+                    accum_fn, donate_argnums=(0, 1) if self._donate else ())
+                alloc_gacc()
             return
         repl = sharding_for(self.mesh, P())
         dspecs = self._data_specs or tuple(P("dp") for _ in range(n_batch_args))
@@ -299,13 +360,23 @@ class CompiledTrainStep:
         master_sh = {k: sharding_for(self.mesh, self._specs[k])
                      for k in self._mp_keys}
         efs_sh = {k: sharding_for(self.mesh, P("dp")) for k in self._efs}
+        gacc_sh = {k: sharding_for(self.mesh, self._specs[k])
+                   for k in (self._diff_keys if K > 1 else [])}
         in_sh = (self._value_shardings(), master_sh, self._state_shardings(),
-                 efs_sh, repl, repl, repl) + batch_sh
+                 efs_sh, gacc_sh, repl, repl, repl) + batch_sh
         out_sh = (self._value_shardings(), master_sh, self._state_shardings(),
-                  efs_sh, repl)
+                  efs_sh, gacc_sh, repl)
         self._jitted = jax.jit(
             fn, in_shardings=in_sh, out_shardings=out_sh,
             donate_argnums=donate)
+        if K > 1:
+            self._accum_jit = jax.jit(
+                accum_fn,
+                in_shardings=(self._value_shardings(), gacc_sh, repl)
+                + batch_sh,
+                out_shardings=(self._value_shardings(), gacc_sh, repl),
+                donate_argnums=(0, 1) if self._donate else ())
+            alloc_gacc(gacc_sh)
 
     def step(self, *batch, lr=None):
         """Run one step; batch = (*data_args, label) as NDArray/array."""
@@ -318,16 +389,26 @@ class CompiledTrainStep:
         if self._jitted is None:
             self._build(len(raw))
             self.place()
+        key = _random.take_key()
+        if self._accum > 1 and self._micro < self._accum - 1:
+            # microbatch: accumulate grads, no optimizer application
+            self.values, self._gacc, loss = self._accum_jit(
+                self.values, self._gacc, key, *raw)
+            self._micro += 1
+            return NDArray(loss)
         self._t += 1
+        self._micro = 0
         if lr is None:
             sched = self.optimizer.lr_scheduler
             lr = sched(self._t) if sched else self.optimizer.lr
-        key = _random.take_key()
-        (self.values, self.masters, self.opt_states, self._efs,
+        gacc = self._gacc if self._accum > 1 else {}
+        (self.values, self.masters, self.opt_states, self._efs, gacc,
          loss) = self._jitted(
-            self.values, self.masters, self.opt_states, self._efs,
+            self.values, self.masters, self.opt_states, self._efs, gacc,
             jnp.asarray(self._t, jnp.float32), jnp.asarray(lr, jnp.float32),
             key, *raw)
+        if self._accum > 1:
+            self._gacc = gacc
         return NDArray(loss)
 
     def sync_to_net(self):
@@ -352,6 +433,17 @@ class CompiledTrainStep:
                                      for k, v in self._efs.items()):
             self._efs = efs  # same dp topology; otherwise keep fresh zeros
         self._t = sd["t"]
+        self._reset_accumulation()
+
+    def _reset_accumulation(self):
+        """Discard in-flight microbatch state: restored weights invalidate
+        partial gradients accumulated against the previous weights (the
+        silent-corruption alternative is worse than dropping ≤K-1
+        microbatches)."""
+        self._micro = 0
+        if self._gacc is not None:
+            self._gacc = jax.tree_util.tree_map(
+                lambda a: jnp.zeros_like(a), self._gacc)
 
     # -- sharded checkpointing (SURVEY §5.4) ----------------------------------
     def _abstract_state(self):
@@ -411,3 +503,4 @@ class CompiledTrainStep:
         self.masters = state.get("masters", {})
         self.opt_states = state["opt_states"]
         self._t = int(state["t"])
+        self._reset_accumulation()
