@@ -50,3 +50,11 @@ def test_imagenet_example_smoke():
                "--epochs", "2")
     losses = [float(m) for m in re.findall(r"epoch \d+: loss ([\d.]+)", out)]
     assert len(losses) == 2 and losses[-1] < losses[0], out[-500:]
+
+
+def test_long_context_example_smoke():
+    # the script asserts the ring path engaged AND the long-range copy
+    # learned (loss < 0.7x start) — SURVEY §5.7's capability end to end
+    out = _run("examples/long_context/train.py")
+    m = re.search(r"ring_dispatches=(\d+)", out)
+    assert m and int(m.group(1)) > 0, out[-300:]

@@ -16,6 +16,7 @@ device jit).
 """
 from __future__ import annotations
 
+import functools
 import re
 
 import jax
@@ -271,19 +272,36 @@ class CompiledTrainStep:
 
         K = self._accum
 
-        def fn(values, masters, opt_states, efs, gacc, t, lr, key, *batch):
+        def grads_and_updates(values, key, batch):
+            """Shared by the apply and accumulate programs: forward+grad
+            over the diff params, plus the BN-stat aux updates applied to
+            a copy of `values`."""
             data_args, loss_args = batch[:-n_loss], batch[-n_loss:]
             diff_vals = {k: values[k] for k in diff_keys}
             const_vals = {k: v for k, v in values.items()
                           if k not in set(diff_keys)}
+            (loss, updates), grads = jax.value_and_grad(
+                make_lfn(const_vals, key, data_args, loss_args),
+                has_aux=True)(diff_vals)
+            new_vals = dict(values)
+            for k, v in updates.items():
+                if k in new_vals:
+                    new_vals[k] = v.astype(new_vals[k].dtype)
+            return loss, grads, new_vals
 
+        def fn(values, masters, opt_states, efs, gacc, t, lr, key, *batch):
             if compression:
+                diff_vals = {k: values[k] for k in diff_keys}
+                const_vals = {k: v for k, v in values.items()
+                              if k not in set(diff_keys)}
                 loss, grads, new_efs, updates = compressed_grads(
                     diff_vals, const_vals, efs, key, batch)
+                aux_vals = dict(values)
+                for k, v in updates.items():
+                    if k in aux_vals:
+                        aux_vals[k] = v.astype(aux_vals[k].dtype)
             else:
-                (loss, updates), grads = jax.value_and_grad(
-                    make_lfn(const_vals, key, data_args, loss_args),
-                    has_aux=True)(diff_vals)
+                loss, grads, aux_vals = grads_and_updates(values, key, batch)
                 new_efs = efs
             if K > 1:
                 # fold the final microbatch into the accumulated mean
@@ -292,7 +310,7 @@ class CompiledTrainStep:
                 new_gacc = {k: jnp.zeros_like(v) for k, v in gacc.items()}
             else:
                 new_gacc = gacc
-            new_vals = dict(values)
+            new_vals = aux_vals  # starts from the BN-stat-updated copy
             new_masters = {}
             new_states = {}
             for k in diff_keys:
@@ -314,27 +332,14 @@ class CompiledTrainStep:
                                            base_wd * wd_mults[k], t)
                     new_vals[k] = w.astype(values[k].dtype)
                 new_states[k] = s
-            for k, v in updates.items():
-                if k in new_vals:
-                    new_vals[k] = v.astype(new_vals[k].dtype)
             return new_vals, new_masters, new_states, new_efs, new_gacc, loss
 
         def accum_fn(values, gacc, key, *batch):
             """Microbatch accumulate: grads/K into the f32 buffers, BN-stat
             aux updates applied, NO optimizer step."""
-            data_args, loss_args = batch[:-n_loss], batch[-n_loss:]
-            diff_vals = {k: values[k] for k in diff_keys}
-            const_vals = {k: v for k, v in values.items()
-                          if k not in set(diff_keys)}
-            (loss, updates), grads = jax.value_and_grad(
-                make_lfn(const_vals, key, data_args, loss_args),
-                has_aux=True)(diff_vals)
+            loss, grads, new_vals = grads_and_updates(values, key, batch)
             new_gacc = {k: gacc[k] + grads[k].astype(jnp.float32) / K
                         for k in diff_keys}
-            new_vals = dict(values)
-            for k, v in updates.items():
-                if k in new_vals:
-                    new_vals[k] = v.astype(new_vals[k].dtype)
             return new_vals, new_gacc, loss
 
         def alloc_gacc(shardings=None):
@@ -418,10 +423,14 @@ class CompiledTrainStep:
             p._data._rebind(self.values[k])
 
     def state_dict(self):
-        sd = {"values": self.values, "masters": self.masters,
-              "opt_states": self.opt_states, "t": self._t}
+        """Snapshot of the train state.  Leaves are COPIED: with buffer
+        donation active (the default), later step() calls delete the live
+        arrays — a snapshot that aliased them would die with them."""
+        copy = functools.partial(jax.tree_util.tree_map, jnp.copy)
+        sd = {"values": copy(self.values), "masters": copy(self.masters),
+              "opt_states": copy(self.opt_states), "t": self._t}
         if self._efs:
-            sd["efs"] = self._efs
+            sd["efs"] = copy(self._efs)
         return sd
 
     def load_state_dict(self, sd):
