@@ -25,7 +25,9 @@ set if every attempt died.
 
 Env knobs: BENCH_SMOKE=1 (CPU smoke, small shapes), BENCH_LAYOUT=NCHW
 (default NHWC), BENCH_STEM=classic (default s2d), BENCH_BATCH / BENCH_ITERS /
-BENCH_BERT_BATCH overrides, BENCH_MODELS=resnet50|bert|resnet50,bert,
+BENCH_BERT_BATCH overrides, BENCH_MODELS ⊆ {resnet50, bert, scaling}
+(default resnet50,bert; scaling = weak-scaling efficiency over all visible
+devices, BASELINE metric 3),
 BENCH_ATTEMPTS (default 3), BENCH_TIMEOUT seconds per attempt (default 900).
 """
 from __future__ import annotations
@@ -64,6 +66,14 @@ def log(msg):
 # ---------------------------------------------------------------------------
 # inner: the actual benchmark (may hang on a flaky backend; outer kills us)
 # ---------------------------------------------------------------------------
+def _fetch_loss(l):
+    """Host-fetch the loss scalar — the sync point for every benchmark
+    here (see the comment in _timed: block_until_ready lies on the
+    tunneled backend; a host fetch bounds the full update chain)."""
+    import numpy as np
+    return float(np.asarray(l._data).ravel()[0])
+
+
 def _timed(step_fn, fetch_loss, n):
     t0 = time.perf_counter()
     loss = None
@@ -129,8 +139,7 @@ def bench_resnet(smoke, layout, stem):
     label = nd.array(np.random.randint(0, classes, (batch,)), dtype="float32")
 
     log("resnet: compiling full train step (first call)...")
-    fetch = lambda l: float(np.asarray(l._data).ravel()[0])
-    img_s = _run_timed(lambda: step.step(data, label), fetch, warmup, iters,
+    img_s = _run_timed(lambda: step.step(data, label), _fetch_loss, warmup, iters,
                        1 if smoke else 3, batch, "resnet")
     rec = {
         "metric": "resnet50_train_images_per_sec_per_chip"
@@ -206,9 +215,8 @@ def bench_bert(smoke):
     none_vl = None  # full sequences: no padding in the bench batch
 
     log("bert: compiling full train step (first call)...")
-    fetch = lambda l: float(np.asarray(l._data).ravel()[0])
     seq_s = _run_timed(
-        lambda: step.step(t_nd, ty_nd, none_vl, p_nd, l_nd), fetch,
+        lambda: step.step(t_nd, ty_nd, none_vl, p_nd, l_nd), _fetch_loss,
         warmup, iters, repeats, batch, "bert")
 
     # which attention path compiled in (VERDICT r2 ask#2: prove flash, not
@@ -237,6 +245,62 @@ def bench_bert(smoke):
     return rec
 
 
+def bench_scaling(smoke):
+    """Weak-scaling efficiency over all visible devices (BASELINE metric 3
+    'scaling efficiency' — the full 8→256-chip number needs a pod slice;
+    this harness measures whatever mesh the process sees, e.g. the
+    8-virtual-device CPU mesh in smoke or a real slice when available):
+    throughput(dp=N, batch=N·b) / (N · throughput(dp=1, batch=b))."""
+    import numpy as np
+    import jax
+    import tpu_mx as mx
+    from tpu_mx import gluon, nd
+    from tpu_mx.gluon.model_zoo import vision
+    from tpu_mx.layout import default_layout
+    from tpu_mx.parallel import CompiledTrainStep, make_mesh
+
+    n = len(jax.devices())
+    if n == 1:
+        log("scaling: only one device visible — weak scaling is trivially "
+            "1.0; skipping the duplicate run (needs a pod slice)")
+        return {"metric": "weak_scaling_efficiency_dp1", "value": 1.0,
+                "unit": "ratio", "vs_baseline": 1.0,
+                "note": "single device; measure on a multi-chip slice"}
+    per_dev_batch, size, iters = (4, 32, 3) if smoke else (64, 96, 10)
+
+    def throughput(ndev):
+        batch = per_dev_batch * ndev
+        with default_layout("NHWC"):
+            net = vision.resnet18_v1(classes=100)
+        net.initialize(init="xavier")
+        x = nd.array(np.random.rand(batch, size, size, 3)
+                     .astype(np.float32))
+        net(x)
+        mesh = make_mesh({"dp": ndev}, devices=jax.devices()[:ndev]) \
+            if ndev > 1 else None
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 opt, mesh=mesh)
+        y = nd.array(np.random.randint(0, 100, (batch,)), dtype="float32")
+        _timed(lambda: step.step(x, y), _fetch_loss, 1)    # compile
+        dt = _timed(lambda: step.step(x, y), _fetch_loss, iters)
+        return batch * iters / dt
+
+    t1 = throughput(1)
+    tn = throughput(n)
+    eff = tn / (n * t1)
+    log(f"scaling: dp=1 {t1:.1f} img/s, dp={n} {tn:.1f} img/s, "
+        f"efficiency {eff:.3f}")
+    return {
+        "metric": f"weak_scaling_efficiency_dp{n}",
+        "value": round(eff, 4),
+        "unit": "ratio",
+        "vs_baseline": round(eff, 4),
+        "throughput_dp1": round(t1, 2),
+        f"throughput_dp{n}": round(tn, 2),
+    }
+
+
 def inner():
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
@@ -244,7 +308,7 @@ def inner():
     models = [m.strip() for m in
               os.environ.get("BENCH_MODELS", "resnet50,bert").split(",")
               if m.strip()]
-    unknown = set(models) - {"resnet50", "bert"}
+    unknown = set(models) - {"resnet50", "bert", "scaling"}
     if unknown or not models:
         raise SystemExit(f"BENCH_MODELS: unknown/empty model list {models}")
     log(f"inner start (smoke={smoke}, layout={layout}, stem={stem}, "
@@ -271,10 +335,13 @@ def inner():
     if "resnet50" in models:
         rec = bench_resnet(smoke, layout, stem)
     bert_rec = bench_bert(smoke) if "bert" in models else None
+    scal_rec = bench_scaling(smoke) if "scaling" in models else None
     if rec is None:
-        rec = bert_rec
-    elif bert_rec is not None:
+        rec = bert_rec or scal_rec
+    if bert_rec is not None and rec is not bert_rec:
         rec["bert"] = bert_rec
+    if scal_rec is not None and rec is not scal_rec:
+        rec["scaling"] = scal_rec
     print(json.dumps(rec), flush=True)
 
 

@@ -212,3 +212,39 @@ def test_dist_sync_kvstore_cross_process_sum(tmp_path):
     import re
     assert sorted(re.findall(r"KVOK rank=(\d)", out.stdout)) == ["0", "1"], \
         out.stdout
+
+
+def test_bandwidth_tool():
+    """tools/bandwidth.py (REF:tools/bandwidth/measure.py analog) emits
+    parseable per-collective records with positive bandwidth."""
+    import json as _json
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bandwidth.py"),
+         "--devices", "8", "--sizes", "0.5", "--iters", "2"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PALLAS_AXON_POOL_IPS": "",
+             "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-500:]
+    recs = [_json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    names = {r["collective"] for r in recs}
+    assert names == {"psum", "all_gather", "reduce_scatter", "ppermute"}
+    assert all(r["alg_bandwidth_gbps"] > 0 for r in recs)
+    assert all(r["devices"] == 8 for r in recs)
+
+
+def test_bench_scaling_mode():
+    """BENCH_MODELS=scaling measures weak-scaling efficiency on the
+    virtual mesh (the BASELINE metric-3 harness)."""
+    import json as _json
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PALLAS_AXON_POOL_IPS": "",
+             "BENCH_SMOKE": "1", "BENCH_MODELS": "scaling",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert out.returncode == 0, out.stderr[-500:]
+    rec = _json.loads([l for l in out.stdout.splitlines()
+                       if l.startswith("{")][-1])
+    assert rec["metric"].startswith("weak_scaling_efficiency")
+    assert 0 < rec["value"] <= 1.5
