@@ -150,3 +150,21 @@ def test_lbsgd_trains():
 def test_inception_v3_registered():
     from tpu_mx.gluon.model_zoo import vision
     assert "inception_v3" in [m for m in vision.get_model.__globals__["_models"]]
+
+
+def test_engine_push_async_hook():
+    """The Horovod-era external-op injection point (MXEnginePushAsync
+    analog): fn sees settled reads and can rebind writes."""
+    import numpy as np
+    from tpu_mx import engine, nd
+
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    out = nd.zeros((2,))
+
+    def external(reads, writes):
+        writes[0]._rebind((reads[0] * 3)._data)
+        return "ok"
+
+    assert engine.push_async(external, [a], [out]) == "ok"
+    np.testing.assert_allclose(out.asnumpy(), [3.0, 6.0])
+    assert engine.push_sync is engine.push_async

@@ -19,7 +19,8 @@ from __future__ import annotations
 import contextlib
 import os
 
-__all__ = ["bulk", "set_bulk_size", "wait_for_all", "engine_type"]
+__all__ = ["bulk", "set_bulk_size", "wait_for_all", "engine_type",
+           "push_async", "push_sync"]
 
 try:
     _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
@@ -60,3 +61,26 @@ def wait_for_all():
     (Engine::WaitForAll)."""
     from .ndarray import waitall
     waitall()
+
+
+def push_async(fn, read_arrays=(), write_arrays=(), name="external_op"):
+    """External-op injection point (REF:include/mxnet/c_api.h
+    MXEnginePushAsync/MXEnginePushSync — the hook Horovod used to insert
+    allreduce ops with engine-tracked dependencies).
+
+    TPU-natively there is no dependency engine to register with: values ARE
+    the dependencies (functional arrays), and XLA program order serializes
+    conflicting work.  So the contract reduces to: wait for the reads to be
+    real, run `fn(read_arrays, write_arrays)`, and let it rebind outputs
+    (`NDArray._rebind`).  fn runs on the host thread — collectives that
+    should overlap compute belong INSIDE the compiled step
+    (parallel.CompiledTrainStep), not here; this hook exists for
+    extensibility parity (external optimizers, logging, custom comm)."""
+    for a in read_arrays:
+        wait = getattr(a, "wait_to_read", None)
+        if wait is not None:
+            wait()
+    return fn(list(read_arrays), list(write_arrays))
+
+
+push_sync = push_async  # dispatch is synchronous from Python's view

@@ -562,3 +562,25 @@ def load(fname):
     if layout == "list":
         return [items[f"arr_{i}"] for i in range(len(items))]
     return items
+
+
+# ---------------------------------------------------------------------------
+# remaining method-form op delegators (REF:python/mxnet/ndarray/ndarray.py
+# exposes most ops as methods; the explicit ones above carry custom
+# signatures, these are straight passthroughs)
+# ---------------------------------------------------------------------------
+def _delegate_method(name):
+    def method(self, *args, **kwargs):
+        from . import ops
+        return getattr(ops, name)(self, *args, **kwargs)
+    method.__name__ = name
+    method.__doc__ = f"Method form of mx.nd.{name} (self as first input)."
+    setattr(NDArray, name, method)
+
+
+for _m in ("round", "floor", "ceil", "pick", "pad", "sort", "argsort",
+           "topk", "slice", "slice_like", "swapaxes", "sign", "rint",
+           "log2", "log10", "log1p", "expm1", "rsqrt", "cbrt",
+           "reciprocal", "diag"):
+    _delegate_method(_m)
+del _m
