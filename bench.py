@@ -334,8 +334,30 @@ def inner():
     rec = None
     if "resnet50" in models:
         rec = bench_resnet(smoke, layout, stem)
-    bert_rec = bench_bert(smoke) if "bert" in models else None
-    scal_rec = bench_scaling(smoke) if "scaling" in models else None
+        if rec is not None:
+            # stream the primary record as soon as it exists: if a later
+            # sub-bench dies/hangs and the attempt is killed, the outer's
+            # next attempt can still narrow BENCH_MODELS from the logs
+            log("resnet record: " + json.dumps(rec))
+    bert_rec = scal_rec = None
+    try:
+        bert_rec = bench_bert(smoke) if "bert" in models else None
+    except Exception as e:  # keep the primary metric alive
+        log(f"bert bench failed: {type(e).__name__}: {e}")
+        bert_rec = {"metric": "bert_base_train_seqs_per_sec_per_chip",
+                    "value": 0.0, "unit": "seq/s", "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+        if rec is None:
+            raise
+    try:
+        scal_rec = bench_scaling(smoke) if "scaling" in models else None
+    except Exception as e:
+        log(f"scaling bench failed: {type(e).__name__}: {e}")
+        if rec is None and bert_rec is None:
+            raise
+        scal_rec = {"metric": "weak_scaling_efficiency", "value": 0.0,
+                    "unit": "ratio", "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:300]}
     if rec is None:
         rec = bert_rec or scal_rec
     if bert_rec is not None and rec is not bert_rec:
@@ -349,8 +371,10 @@ def inner():
 # outer: supervisor — no jax import, hard timeouts, retry, partial JSON
 # ---------------------------------------------------------------------------
 def outer():
-    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
-    timeout = float(os.environ.get("BENCH_TIMEOUT", "900"))
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
+    # two full workloads now compile+run in one attempt (~8-12 min on the
+    # tunneled chip); 1500s keeps a slow-but-alive run from being killed
+    timeout = float(os.environ.get("BENCH_TIMEOUT", "1500"))
     last_err = "unknown"
     for attempt in range(1, attempts + 1):
         log(f"attempt {attempt}/{attempts} (timeout {timeout:.0f}s)")

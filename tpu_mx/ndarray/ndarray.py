@@ -410,9 +410,6 @@ class NDArray:
         from . import ops
         return ops.norm(self, ord=ord, axis=axis, keepdims=keepdims)
 
-    def dot(self, other):
-        from . import ops
-        return ops.dot(self, other)
 
     def softmax(self, axis=-1):
         from . import ops
@@ -422,17 +419,8 @@ class NDArray:
         from . import ops
         return ops.log_softmax(self, axis=axis)
 
-    def relu(self):
-        from . import ops
-        return ops.relu(self)
 
-    def sigmoid(self):
-        from . import ops
-        return ops.sigmoid(self)
 
-    def tanh(self):
-        from . import ops
-        return ops.tanh(self)
 
     def one_hot(self, depth, on_value=1.0, off_value=0.0):
         from . import ops
@@ -458,13 +446,7 @@ class NDArray:
         from . import ops
         return ops.split(self, num_outputs=num_outputs, axis=axis, squeeze_axis=squeeze_axis)
 
-    def zeros_like(self):
-        from . import ops
-        return ops.zeros_like(self)
 
-    def ones_like(self):
-        from . import ops
-        return ops.ones_like(self)
 
     def __array__(self, dtype=None):
         a = self.asnumpy()
@@ -581,6 +563,7 @@ def _delegate_method(name):
 for _m in ("round", "floor", "ceil", "pick", "pad", "sort", "argsort",
            "topk", "slice", "slice_like", "swapaxes", "sign", "rint",
            "log2", "log10", "log1p", "expm1", "rsqrt", "cbrt",
-           "reciprocal", "diag"):
+           "reciprocal", "diag", "relu", "sigmoid", "tanh", "dot",
+           "zeros_like", "ones_like"):
     _delegate_method(_m)
 del _m
