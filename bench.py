@@ -175,9 +175,13 @@ def bench_bert(smoke):
         batch, warmup, iters, repeats = 512, 3, 20, 3
     batch = int(os.environ.get("BENCH_BERT_BATCH", batch))
 
+    remat = os.environ.get("BENCH_BERT_REMAT", "1") == "1"
     log(f"building bert ({cfg['num_layers']}L u{cfg['units']}), "
-        f"batch={batch}, seq={seq_len}")
-    net = BERTModel(cfg, dtype="bfloat16")
+        f"batch={batch}, seq={seq_len}, remat={remat}")
+    # per-layer jax.checkpoint: batch 512 × seq 128 activations for 12
+    # layers exceed the 16 GB HBM (measured 27 GB); remat trades ~1 extra
+    # forward for O(1)-segment activation memory
+    net = BERTModel(cfg, dtype="bfloat16", remat=remat)
     net.initialize()
     rng = np.random.RandomState(0)
     tokens = rng.randint(4, cfg["vocab_size"], (batch, seq_len)).astype(

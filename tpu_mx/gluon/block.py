@@ -227,6 +227,26 @@ class HybridBlock(Block):
         self._cached_fns = {}          # (train, arg_struct) -> jitted fn
         self._param_order = None
         self._last_input_avals = None  # recorded for export()
+        self._remat = False
+        self._remat_policy = None
+
+    def remat(self, active=True, policy=None):
+        """Gradient rematerialization for this block's forward segment.
+
+        When this block runs inside an enclosing compiled trace (a
+        hybridized parent or `CompiledTrainStep`), its forward is wrapped
+        in `jax.checkpoint`: activations inside the segment are recomputed
+        during backward instead of stored, trading ~1 extra forward of
+        FLOPs for the segment's activation HBM (SURVEY §7.1 — the TPU
+        answer to big-batch training; no reference analog, MXNet 1.x
+        mirrored memory via `mirror_stage` graph attrs).  Mark the
+        repeated unit (e.g. each transformer layer), not the whole model.
+        `policy` is forwarded to `jax.checkpoint` (a
+        `jax.checkpoint_policies` entry) to keep select intermediates.
+        Eager (non-traced) execution ignores the flag.  Returns self."""
+        self._remat = bool(active)
+        self._remat_policy = policy
+        return self
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   inline_limit=None, **kwargs):
@@ -253,6 +273,39 @@ class HybridBlock(Block):
             out = self.forward(*raw_args)
         return out, updates
 
+    def _remat_segment(self, args, kwargs):
+        """Run this block's forward as a `jax.checkpoint` segment inside
+        the enclosing functional trace (see `remat()`).  The segment is a
+        pure function of (own params, rng key, positional array args);
+        None/scalar args, kwargs, and any unused outer-scope values ride
+        in the closure (jax.checkpoint differentiates closed-over tracers
+        correctly — they just stay checkpoint residuals).  Aux updates
+        (BatchNorm stats) recorded inside the segment are merged into the
+        enclosing updates dict so they still reach the caller."""
+        from .parameter import _active_substitution
+        mapping, outer_updates = _active_substitution()
+        own = {k: mapping[k] for k in self.collect_params() if k in mapping}
+        key = _random.take_key()
+        arr_idx = [i for i, a in enumerate(args)
+                   if isinstance(a, (NDArray, jnp.ndarray, np.ndarray))]
+        arrs = [args[i]._data if isinstance(args[i], NDArray) else args[i]
+                for i in arr_idx]
+
+        def seg(own_map, key, *arrs):
+            m = dict(mapping)
+            m.update(own_map)
+            full = list(args)
+            for i, a in zip(arr_idx, arrs):
+                full[i] = a
+            with param_substitution(m) as upd, _random.key_scope(key):
+                out = Block.__call__(self, *full, **kwargs)
+            return out, upd
+
+        out, upd = jax.checkpoint(seg, policy=self._remat_policy)(
+            own, key, *arrs)
+        outer_updates.update(upd)
+        return out
+
     def _ensure_cached(self, train):
         if train not in self._cached_fns:
             def pure_fn(param_map, key, *raw_args):
@@ -271,7 +324,10 @@ class HybridBlock(Block):
             self._last_input_avals = [
                 jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
                 for a in args]
-        if not self._active or _active_substitution() is not None:
+        inside = _active_substitution() is not None
+        if inside and self._remat and not self._uninitialized():
+            return self._remat_segment(args, kwargs)
+        if not self._active or inside:
             # plain path: not hybridized, OR already inside an enclosing
             # block's functional trace (children trace inline — one compiled
             # graph per outermost hybridized block, like CachedOp inlining)

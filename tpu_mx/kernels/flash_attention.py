@@ -60,8 +60,16 @@ def _interpret():
 def _keep_mask(seed_ref, b, qi, ki, rate, block_q, block_k):
     """Regenerable dropout keep-mask for score block (qi, ki) of batch b.
     Seeding immediately before the draw makes the bits a pure function of
-    (seed, b, qi, ki), so fwd / dq / dkv kernels all see the same mask."""
-    pltpu.prng_seed(seed_ref[0], b, qi, ki)
+    (seed, b, qi, ki), so fwd / dq / dkv kernels all see the same mask.
+    Mosaic on some TPUs caps prng_seed at two scalar values, so the tuple
+    is folded injectively into two int32 lanes: (seed ⊕ b·φ, qi·2¹⁶+ki)
+    with φ = 0x9E3779B9 (odd ⇒ b·φ bijective mod 2³²) — distinct
+    (b, qi, ki) give distinct lanes for a fixed seed, needing qi < 2¹⁶
+    AND ki < 2¹⁶ (both hold for any T the VMEM guard admits).  The
+    multiply-XOR (rather than seed+b) keeps arithmetically related seeds
+    across calls — counters, seed+layer schemes — from aligning whole
+    rows' masks."""
+    pltpu.prng_seed(seed_ref[0] ^ (b * -1640531527), qi * 65536 + ki)
     bits = pltpu.prng_random_bits((block_q, block_k))
     bits = pltpu.bitcast(bits, jnp.uint32)
     thresh = jnp.uint32(min(int(rate * (2 ** 32)), 2 ** 32 - 1))
