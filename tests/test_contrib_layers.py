@@ -1,0 +1,179 @@
+"""gluon.contrib layers/cells/estimator (REF:tests/python/unittest/
+test_gluon_contrib.py territory)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd, autograd, gluon
+from tpu_mx.gluon import nn
+from tpu_mx.gluon.contrib import nn as cnn
+from tpu_mx.gluon.contrib import rnn as crnn
+from tpu_mx.gluon.contrib.estimator import (CheckpointHandler,
+                                            EarlyStoppingHandler, Estimator,
+                                            LoggingHandler)
+
+
+def test_concurrent_concat():
+    net = cnn.HybridConcurrent(axis=-1)
+    net.add(nn.Dense(3, in_units=4))
+    net.add(nn.Dense(5, in_units=4))
+    net.add(cnn.Identity())
+    net.initialize()
+    x = nd.array(np.random.rand(2, 4).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 3 + 5 + 4)
+    np.testing.assert_allclose(np.asarray(out._data)[:, -4:],
+                               np.asarray(x._data), rtol=1e-6)
+
+
+def test_pixelshuffle_2d_matches_manual():
+    ps = cnn.PixelShuffle2D(2)
+    x = np.arange(1 * 8 * 2 * 3, dtype=np.float32).reshape(1, 8, 2, 3)
+    out = np.asarray(ps(nd.array(x))._data)
+    assert out.shape == (1, 2, 4, 6)
+    # manual: (N, C r1 r2, H, W) -> (N, C, H r1, W r2)
+    ref = x.reshape(1, 2, 2, 2, 2, 3).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(1, 2, 4, 6)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_pixelshuffle_1d_and_3d_shapes():
+    x1 = nd.array(np.random.rand(2, 6, 5).astype(np.float32))
+    assert cnn.PixelShuffle1D(3)(x1).shape == (2, 2, 15)
+    x3 = nd.array(np.random.rand(1, 16, 2, 3, 4).astype(np.float32))
+    assert cnn.PixelShuffle3D(2)(x3).shape == (1, 2, 4, 6, 8)
+
+
+def test_sync_batchnorm_global_stats_under_dp_mesh():
+    """The TPU-native sync-BN property: with the batch sharded over an
+    8-device dp mesh, BatchNorm statistics are computed over the GLOBAL
+    batch (GSPMD all-reduces the partial moments) — per-shard stats would
+    give a different output for a heterogeneous batch."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    bn = cnn.SyncBatchNorm(in_channels=4, num_devices=8)
+    bn.initialize()
+    # heterogeneous batch: each of 8 shards has a wildly different scale,
+    # so per-shard normalization != global normalization
+    x = np.concatenate([np.random.RandomState(i).randn(2, 4, 3, 3) *
+                        (10.0 ** (i % 4)) for i in range(8)]).astype(
+        np.float32)
+
+    with autograd.record():
+        ref = bn(nd.array(x))  # single-device: global stats by definition
+    ref = np.asarray(ref._data)
+
+    devices = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devices), ("dp",))
+    sharded = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    params = {k: p.data()._data for k, p in bn.collect_params().items()}
+
+    def fwd(pm, xx):
+        out, _ = bn._functional_call(pm, jax.random.PRNGKey(0), True, (xx,))
+        return out
+
+    with mesh:
+        out = jax.jit(fwd)(params, sharded)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_lstmp_cell_projection_shapes():
+    cell = crnn.LSTMPCell(hidden_size=8, projection_size=5)
+    cell.initialize()
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    states = cell.begin_state(batch_size=3)
+    out, new_states = cell(x, states)
+    assert out.shape == (3, 5)
+    assert new_states[0].shape == (3, 5)   # projected h
+    assert new_states[1].shape == (3, 8)   # cell state
+
+
+def test_variational_dropout_locked_mask():
+    base = crnn.LSTMPCell(hidden_size=6, projection_size=4)
+    cell = crnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize()
+    x = nd.array(np.ones((2, 3), np.float32))
+    states = cell.begin_state(batch_size=2)
+    with autograd.record():
+        o1, states = cell(x, states)
+        o2, states = cell(x, states)
+    z1 = np.asarray(o1._data) == 0.0
+    z2 = np.asarray(o2._data) == 0.0
+    # locked mask: the SAME output units are dropped at both steps
+    np.testing.assert_array_equal(z1, z2)
+    assert z1.any()  # rate 0.5 on 8 units: P(no drop) = 2^-8
+
+
+@pytest.mark.parametrize("cell_cls,ndim", [
+    (crnn.Conv1DLSTMCell, 1), (crnn.Conv2DLSTMCell, 2),
+    (crnn.Conv2DGRUCell, 2), (crnn.Conv2DRNNCell, 2),
+    (crnn.Conv3DLSTMCell, 3),
+])
+def test_conv_rnn_cells_step(cell_cls, ndim):
+    spatial = (5, 6, 7)[:ndim]
+    cell = cell_cls(hidden_channels=4, kernel=3)
+    cell.initialize()
+    x = nd.array(np.random.rand(2, 3, *spatial).astype(np.float32))
+    zeros = [nd.zeros((2, 4) + spatial)
+             for _ in range(getattr(cell, "_n_states"))]
+    out, states = cell(x, zeros)
+    assert out.shape == (2, 4) + spatial
+    out2, _ = cell(x, states)  # second step, same input channels
+    assert out2.shape == (2, 4) + spatial
+    assert not np.allclose(np.asarray(out._data), np.asarray(out2._data))
+
+
+def test_conv_lstm_unroll_learns():
+    """2-step unrolled Conv2DLSTM regression — checks grads flow through
+    the recurrent conv."""
+    cell = crnn.Conv2DLSTMCell(hidden_channels=2, kernel=3)
+    cell.initialize()
+    head = nn.Dense(1, flatten=True)
+    head.initialize()
+    params = list(cell.collect_params().values()) + \
+        list(head.collect_params().values())
+    xs = [nd.array(np.random.RandomState(i).rand(4, 1, 4, 4)
+                   .astype(np.float32)) for i in range(2)]
+    target = nd.array(np.random.RandomState(9).rand(4, 1)
+                      .astype(np.float32))
+    trainer = gluon.Trainer({p.name: p for p in params}, "adam",
+                            {"learning_rate": 0.05})
+    first = None
+    for it in range(12):
+        states = [nd.zeros((4, 2, 4, 4)), nd.zeros((4, 2, 4, 4))]
+        with autograd.record():
+            out = None
+            for x in xs:
+                out, states = cell(x, states)
+            pred = head(out)
+            loss = ((pred - target) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+        v = float(np.asarray(loss._data))
+        first = v if first is None else first
+    assert v < first, (first, v)
+
+
+def test_estimator_fit_and_early_stop(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8))
+    net.add(nn.Dense(2, in_units=16))
+    net.initialize()
+    net.hybridize()
+    X = np.random.RandomState(0).rand(64, 8).astype(np.float32)
+    Y = (X.sum(axis=1) > 4.0).astype(np.float32)
+    data = [(nd.array(X[i:i + 16]), nd.array(Y[i:i + 16]))
+            for i in range(0, 64, 16)]
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.05}))
+    ckpt = CheckpointHandler(str(tmp_path), max_checkpoints=2)
+    early = EarlyStoppingHandler(monitor="loss", patience=2, mode="min")
+    est.fit(data, epochs=8, event_handlers=[ckpt, early,
+                                            LoggingHandler(log_interval=100)])
+    # loss metric decreased vs an untrained net / checkpoints written
+    saved = list(tmp_path.glob("model-epoch*.params"))
+    assert 1 <= len(saved) <= 2
+    result = est.evaluate(data)
+    assert result["loss"] < 0.69  # below chance-level CE

@@ -8,4 +8,5 @@ from .trainer import Trainer
 from . import rnn
 from . import model_zoo
 from . import utils
+from . import contrib
 from .utils import split_and_load

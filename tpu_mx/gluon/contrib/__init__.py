@@ -1,0 +1,7 @@
+"""gluon.contrib (REF:python/mxnet/gluon/contrib/__init__.py): nn layers,
+rnn cells, and the Estimator training-loop facade."""
+from . import nn
+from . import rnn
+from . import estimator
+
+__all__ = ["nn", "rnn", "estimator"]

@@ -1,0 +1,147 @@
+"""gluon.contrib.nn (REF:python/mxnet/gluon/contrib/nn/basic_layers.py).
+
+Capabilities kept: Concurrent / HybridConcurrent containers, Identity,
+SparseEmbedding, SyncBatchNorm, PixelShuffle1D/2D/3D.  TPU-native notes
+inline — the interesting one is SyncBatchNorm, which under the compiled
+SPMD train step is not a separate kernel at all (see its docstring).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from .. import nn as _nn
+from ..block import HybridBlock
+from ...ndarray import ops as F
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class HybridConcurrent(_nn.HybridSequential):
+    """Feed the same input to every child, concat the outputs along `axis`
+    (REF contrib/nn: HybridConcurrent — the Inception-branch container)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        # container routing (HybridSequential pattern): every child sees the
+        # SAME input, outputs concat along self.axis
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+    def hybrid_forward(self, Fm, x):
+        return self.forward(x)
+
+
+class Concurrent(HybridConcurrent):
+    """Imperative alias (REF contrib/nn: Concurrent); identical here — the
+    single Block/HybridBlock split collapses because every op is traceable."""
+
+
+class Identity(HybridBlock):
+    """Pass-through (REF contrib/nn: Identity) — placeholder branch for
+    Concurrent containers."""
+
+    def hybrid_forward(self, Fm, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """Embedding with the reference's sparse-gradient intent
+    (REF contrib/nn: SparseEmbedding, grad_stype='row_sparse').
+
+    DIVERGENCE (DIVERGENCES.md #5): on TPU the gradient is a dense
+    scatter-add produced by XLA — `row_sparse` storage doesn't exist.  The
+    API is kept so reference models construct unchanged; memory-wise XLA's
+    scatter in the fused backward is the efficient path here.
+    """
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    """Cross-device synchronized BatchNorm (REF contrib/nn: SyncBatchNorm,
+    src/operator/contrib/sync_batch_norm.cc — GPU allreduce of per-device
+    moments).
+
+    TPU-native design note: under the compiled SPMD train step
+    (`CompiledTrainStep`, batch sharded over the `dp` mesh axis) the plain
+    `BatchNorm` already IS sync-BN — `mean(x, batch_axes)` runs on the
+    logically-global array, and GSPMD partitions it into per-device partial
+    sums + an all-reduce over ICI.  There is no second kernel to write;
+    this class exists so reference code constructs unchanged, and
+    `num_devices` is accepted and ignored (the mesh defines the sync
+    group).  The only path where stats are per-device is the eager
+    `split_and_load` loop, where the reference synced via NCCL; that eager
+    divergence is documented rather than emulated (the compiled step is
+    the trainings path).  A test asserts the global-stats property on an
+    8-device mesh (tests/test_contrib_layers.py).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        if num_devices is not None and num_devices <= 0:
+            raise MXNetError("num_devices must be positive")
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+
+
+class _PixelShuffle(HybridBlock):
+    """r-factor sub-pixel upsample: (N, C·Πr, *S) -> (N, C, *(S·r))
+    (REF contrib/nn: PixelShuffle1D/2D/3D).  Pure reshape+transpose —
+    XLA folds it into the neighbouring conv's layout assignment."""
+
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = (int(factor),) * ndim if isinstance(
+            factor, (int, float)) else tuple(int(f) for f in factor)
+        if len(self._factors) != ndim:
+            raise MXNetError(f"factor must be int or length-{ndim} tuple")
+        self._ndim = ndim
+
+    def hybrid_forward(self, Fm, x):
+        f = self._factors
+        n = self._ndim
+        shape = x.shape
+        C = shape[1]
+        prod = 1
+        for v in f:
+            prod *= v
+        if C % prod:
+            raise MXNetError(
+                f"PixelShuffle: channels {C} not divisible by {prod}")
+        c_out = C // prod
+        spatial = shape[2:]
+        # (N, c_out, f1..fn, s1..sn) -> interleave -> (N, c_out, s1·f1, ...)
+        x = F.reshape(x, shape=(shape[0], c_out) + f + tuple(spatial))
+        perm = [0, 1]
+        for i in range(n):
+            perm.extend([2 + n + i, 2 + i])  # si, fi adjacent
+        x = F.transpose(x, axes=tuple(perm))
+        out_sp = tuple(s * ff for s, ff in zip(spatial, f))
+        return F.reshape(x, shape=(shape[0], c_out) + out_sp)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

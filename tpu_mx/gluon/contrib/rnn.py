@@ -1,0 +1,249 @@
+"""gluon.contrib.rnn (REF:python/mxnet/gluon/contrib/rnn/{rnn_cell,
+conv_rnn_cell}.py).
+
+Capabilities kept: VariationalDropoutCell (same mask across time steps),
+LSTMPCell (projection LSTM), Conv{1,2,3}D{RNN,LSTM,GRU}Cell.  All are
+expressed over the same `lax.scan`-unrolled RecurrentCell protocol as the
+core cells — the conv cells' gates are two `lax.conv_general_dilated`
+calls XLA fuses per step.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..rnn.rnn_cell import LSTMCell, ModifierCell, RecurrentCell
+from ...ndarray import ops as F
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell", "Conv1DRNNCell",
+           "Conv2DRNNCell", "Conv3DRNNCell", "Conv1DLSTMCell",
+           "Conv2DLSTMCell", "Conv3DLSTMCell", "Conv1DGRUCell",
+           "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (locked) dropout: ONE mask per sequence, reused every
+    step (REF contrib/rnn: VariationalDropoutCell; Gal & Ghahramani).  The
+    masks are drawn lazily on the first step from the shapes observed and
+    cached until `reset()`."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self._mask_in = None
+        self._mask_out = None
+        self._mask_states = None
+
+    def reset(self):
+        super().reset()
+        self._mask_in = self._mask_out = self._mask_states = None
+
+    @staticmethod
+    def _draw(rate, like):
+        keep = 1.0 - rate
+        mask = F.random.bernoulli(prob=keep, shape=like.shape,
+                                  dtype=str(like.dtype))
+        return mask / keep
+
+    def hybrid_forward(self, Fm, inputs, states):
+        from ... import autograd
+        training = autograd.is_training()
+        if training and self._di > 0:
+            if self._mask_in is None:
+                self._mask_in = self._draw(self._di, inputs)
+            inputs = inputs * self._mask_in
+        if training and self._ds > 0:
+            if self._mask_states is None:
+                self._mask_states = [self._draw(self._ds, s) for s in states]
+            states = [s * m for s, m in zip(states, self._mask_states)]
+        out, new_states = self.base_cell(inputs, states)
+        if training and self._do > 0:
+            if self._mask_out is None:
+                self._mask_out = self._draw(self._do, out)
+            out = out * self._mask_out
+        return out, new_states
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projection of the hidden state (REF contrib/rnn:
+    LSTMPCell; Sak et al. 2014) — h = (o ∘ tanh(c)) · W_proj, shrinking
+    the recurrent matmul from h²  to h·p."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape_hint((4 * self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, Fm, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        gates = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                                 num_hidden=4 * self._hidden_size) + \
+            F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                             num_hidden=4 * self._hidden_size)
+        parts = F.split(gates, 4, axis=-1)
+        i = F.sigmoid(parts[0])
+        f = F.sigmoid(parts[1])
+        g = F.tanh(parts[2])
+        o = F.sigmoid(parts[3])
+        c = f * states[1] + i * g
+        r = F.FullyConnected(o * F.tanh(c), h2r_weight, None, no_bias=True,
+                             num_hidden=self._projection_size)
+        return r, [r, c]
+
+
+class _ConvRNNBase(RecurrentCell):
+    """Shared machinery for the conv cells: gates = conv(x; Wi) +
+    conv(h; Wh), state layout NC<spatial> (channels-first like the
+    reference's conv cells)."""
+
+    def __init__(self, hidden_channels, kernel, n_gates, ndim,
+                 input_shape=None, i2h_pad=None, activation="tanh",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hc = hidden_channels
+        self._ndim = ndim
+        self._kernel = (kernel,) * ndim if isinstance(kernel, int) \
+            else tuple(kernel)
+        if len(self._kernel) != ndim:
+            raise MXNetError(f"kernel must be int or length-{ndim}")
+        if any(k % 2 == 0 for k in self._kernel):
+            raise MXNetError("conv-RNN kernels must be odd (same-pad)")
+        self._pad = tuple(k // 2 for k in self._kernel)
+        self._ng = n_gates
+        self._activation = activation
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(n_gates * hidden_channels, 0) + self._kernel,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight",
+            shape=(n_gates * hidden_channels, hidden_channels) + self._kernel,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(n_gates * hidden_channels,), init="zeros",
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(n_gates * hidden_channels,), init="zeros",
+            allow_deferred_init=True)
+        self._spatial = None
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape_hint(
+            (self._ng * self._hc, x.shape[1]) + self._kernel)
+        self._spatial = tuple(x.shape[2:])
+
+    def state_info(self, batch_size=0):
+        sp = self._spatial or (0,) * self._ndim
+        return [{"shape": (batch_size, self._hc) + sp, "__layout__": "NC" +
+                 "DHW"[-self._ndim:]}] * self._n_states
+
+    def _gates(self, inputs, h):
+        gi = F.Convolution(inputs, self.i2h_weight.data(),
+                           self.i2h_bias.data(), kernel=self._kernel,
+                           pad=self._pad, num_filter=self._ng * self._hc)
+        gh = F.Convolution(h, self.h2h_weight.data(), self.h2h_bias.data(),
+                           kernel=self._kernel, pad=self._pad,
+                           num_filter=self._ng * self._hc)
+        return gi + gh
+
+    def _act(self, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_ConvRNNBase):
+    _n_states = 1
+
+    def __init__(self, hidden_channels, kernel, ndim, **kwargs):
+        super().__init__(hidden_channels, kernel, 1, ndim, **kwargs)
+
+    def hybrid_forward(self, Fm, inputs, states, **_params):
+        self._spatial = tuple(inputs.shape[2:])
+        h = self._act(self._gates(inputs, states[0]))
+        return h, [h]
+
+
+class _ConvLSTMCell(_ConvRNNBase):
+    _n_states = 2
+
+    def __init__(self, hidden_channels, kernel, ndim, **kwargs):
+        super().__init__(hidden_channels, kernel, 4, ndim, **kwargs)
+
+    def hybrid_forward(self, Fm, inputs, states, **_params):
+        self._spatial = tuple(inputs.shape[2:])
+        parts = F.split(self._gates(inputs, states[0]), 4, axis=1)
+        i = F.sigmoid(parts[0])
+        f = F.sigmoid(parts[1])
+        g = self._act(parts[2])
+        o = F.sigmoid(parts[3])
+        c = f * states[1] + i * g
+        h = o * self._act(c)
+        return h, [h, c]
+
+
+class _ConvGRUCell(_ConvRNNBase):
+    _n_states = 1
+
+    def __init__(self, hidden_channels, kernel, ndim, **kwargs):
+        super().__init__(hidden_channels, kernel, 3, ndim, **kwargs)
+
+    def hybrid_forward(self, Fm, inputs, states, **_params):
+        self._spatial = tuple(inputs.shape[2:])
+        h = states[0]
+        gi = F.Convolution(inputs, self.i2h_weight.data(),
+                           self.i2h_bias.data(), kernel=self._kernel,
+                           pad=self._pad, num_filter=3 * self._hc)
+        gh = F.Convolution(h, self.h2h_weight.data(), self.h2h_bias.data(),
+                           kernel=self._kernel, pad=self._pad,
+                           num_filter=3 * self._hc)
+        ir, iz, innew = F.split(gi, 3, axis=1)
+        hr, hz, hnew = F.split(gh, 3, axis=1)
+        r = F.sigmoid(ir + hr)
+        z = F.sigmoid(iz + hz)
+        n = self._act(innew + r * hnew)
+        out = (1 - z) * n + z * h
+        return out, [out]
+
+
+def _make(base, ndim, name):
+    def __init__(self, hidden_channels, kernel=3, **kwargs):
+        base.__init__(self, hidden_channels, kernel, ndim, **kwargs)
+    cls = type(name, (base,), {"__init__": __init__, "__doc__":
+                               f"{name} (REF contrib/rnn conv_rnn_cell.py)"})
+    return cls
+
+
+Conv1DRNNCell = _make(_ConvRNNCell, 1, "Conv1DRNNCell")
+Conv2DRNNCell = _make(_ConvRNNCell, 2, "Conv2DRNNCell")
+Conv3DRNNCell = _make(_ConvRNNCell, 3, "Conv3DRNNCell")
+Conv1DLSTMCell = _make(_ConvLSTMCell, 1, "Conv1DLSTMCell")
+Conv2DLSTMCell = _make(_ConvLSTMCell, 2, "Conv2DLSTMCell")
+Conv3DLSTMCell = _make(_ConvLSTMCell, 3, "Conv3DLSTMCell")
+Conv1DGRUCell = _make(_ConvGRUCell, 1, "Conv1DGRUCell")
+Conv2DGRUCell = _make(_ConvGRUCell, 2, "Conv2DGRUCell")
+Conv3DGRUCell = _make(_ConvGRUCell, 3, "Conv3DGRUCell")

@@ -1,12 +1,20 @@
 """DataLoader (REF:python/mxnet/gluon/data/dataloader.py).
 
-Capabilities kept: batchify, samplers, multi-worker loading, prefetch.
-TPU-native shape: workers are a thread pool feeding a double-buffered
-prefetch queue (the PrefetcherIter pattern, REF:src/io/iter_prefetcher.h);
-the reference's multiprocessing + cpu_shared-NDArray IPC is unnecessary here
-because decode/augment happens in numpy (no GIL-bound tensor math) and the
-device transfer is an async `jax.device_put` — the hot path the reference
-solved with POSIX-shm is solved by XLA's async H2D pipeline.
+Capabilities kept: batchify, samplers, multi-worker loading, prefetch,
+process workers with shared-memory IPC.  TPU-native shape: the default
+workers are a thread pool feeding a double-buffered prefetch queue (the
+PrefetcherIter pattern, REF:src/io/iter_prefetcher.h) — decode/augment in
+numpy releases the GIL and the device transfer is an async
+`jax.device_put`.  For PYTHON-heavy transforms that hold the GIL, pass
+`thread_pool=False` to get fork()ed process workers that ship batches back
+through POSIX shared memory (one segment per batch; the worker writes
+through a view with no serialization copy, the parent copies once out of
+the segment so it can unlink immediately) — the TPU-native equivalent of
+the reference's
+`cpu_shared`-context NDArray IPC (REF:src/storage/
+cpu_shared_storage_manager.h + dataloader.py worker pool).  Process
+workers never touch jax: batches must reach the parent as numpy (the
+default batchify does), and the parent does the NDArray wrap + H2D.
 """
 from __future__ import annotations
 
@@ -19,6 +27,82 @@ from ...ndarray import NDArray, array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def _numpy_batchify(data):
+    """default_batchify_fn minus the NDArray wrap — what process workers
+    run.  jax must not be touched in a fork()ed child, so NDArray samples
+    are rejected loudly (converting them would drive the inherited,
+    fork-unsafe jax client): return numpy from __getitem__ or use thread
+    workers."""
+    if isinstance(data[0], NDArray):
+        raise TypeError(
+            "process workers (thread_pool=False) require numpy samples; "
+            "this dataset returns NDArray — return numpy from __getitem__ "
+            "or use thread workers (thread_pool=True)")
+    if isinstance(data[0], tuple):
+        transposed = list(zip(*data))
+        return tuple(_numpy_batchify(list(t)) for t in transposed)
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _flatten_np(x):
+    """(leaves, structure) for nested tuple/list pytrees of arrays."""
+    if isinstance(x, (tuple, list)):
+        leaves, struct = [], []
+        for e in x:
+            l, s = _flatten_np(e)
+            leaves.extend(l)
+            struct.append(s)
+        return leaves, (isinstance(x, tuple), struct)
+    return [np.ascontiguousarray(np.asarray(x))], None
+
+
+def _unflatten(leaves, struct, wrap):
+    it = iter(leaves)
+
+    def rebuild(s):
+        if s is None:
+            return wrap(next(it))
+        is_tuple, children = s
+        vals = [rebuild(c) for c in children]
+        return tuple(vals) if is_tuple else vals
+
+    return rebuild(struct)
+
+
+def _shm_worker_loop(dataset, batchify, task_q, result_q):
+    """Process-worker body: load + batchify (numpy only), write the leaf
+    arrays into one fresh POSIX shm segment, send (name, metas) back.  The
+    parent owns unlink; the worker closes its mapping immediately."""
+    from multiprocessing import shared_memory
+    while True:
+        item = task_q.get()
+        if item is None:
+            return
+        seq, indices = item
+        try:
+            batch = batchify([dataset[i] for i in indices])
+            leaves, struct = _flatten_np(batch)
+            total = max(1, sum(a.nbytes for a in leaves))
+            shm = shared_memory.SharedMemory(create=True, size=total)
+            off, metas = 0, []
+            for a in leaves:
+                # write through a view over the segment (no tobytes copy)
+                dst = np.frombuffer(shm.buf, dtype=a.dtype, count=a.size,
+                                    offset=off).reshape(a.shape)
+                dst[...] = a
+                del dst
+                metas.append((a.dtype.str, a.shape, off))
+                off += a.nbytes
+            shm.close()
+            result_q.put((seq, shm.name, metas, struct, None))
+        except Exception as e:  # surfaced in the consumer
+            result_q.put((seq, None, None, None,
+                          f"{type(e).__name__}: {e}"))
 
 
 def default_batchify_fn(data):
@@ -53,6 +137,7 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
+        self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * max(num_workers, 1))
 
@@ -65,7 +150,92 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
             return
-        yield from self._threaded_iter()
+        if self._thread_pool:
+            yield from self._threaded_iter()
+        else:
+            yield from self._process_iter()
+
+    def _process_iter(self):
+        """fork()ed process workers + POSIX-shm batch transport with ordered
+        delivery and a sliding prefetch window (see module docstring)."""
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+        ctx = mp.get_context("fork")
+        batches = list(self._batch_sampler)
+        task_q, result_q = ctx.Queue(), ctx.Queue()
+        batchify = self._batchify_fn
+        if batchify is default_batchify_fn:
+            batchify = _numpy_batchify
+        procs = [ctx.Process(target=_shm_worker_loop,
+                             args=(self._dataset, batchify, task_q, result_q),
+                             daemon=True)
+                 for _ in range(self._num_workers)]
+        for p in procs:
+            p.start()
+        window = max(self._prefetch, self._num_workers)
+        issued = 0
+        pending = {}
+        try:
+            for _ in range(min(window, len(batches))):
+                task_q.put((issued, batches[issued]))
+                issued += 1
+            for seq in range(len(batches)):
+                while seq not in pending:
+                    try:
+                        got = result_q.get(timeout=self._timeout)
+                    except _queue.Empty:
+                        dead = [i for i, p in enumerate(procs)
+                                if not p.is_alive()]
+                        raise RuntimeError(
+                            "DataLoader process-worker timeout"
+                            + (f"; dead workers: {dead}" if dead else ""))
+                    pending[got[0]] = got[1:]
+                shm_name, metas, struct, err = pending.pop(seq)
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                shm = shared_memory.SharedMemory(name=shm_name)
+                try:
+                    leaves = []
+                    for dtype, shape, off in metas:
+                        cnt = int(np.prod(shape, dtype=np.int64)) if shape \
+                            else 1
+                        view = np.frombuffer(shm.buf, dtype=dtype, count=cnt,
+                                             offset=off)
+                        leaves.append(np.array(view.reshape(shape)))  # copy
+                        del view  # release the exported pointer pre-close
+                finally:
+                    shm.close()
+                    shm.unlink()
+                if issued < len(batches):
+                    task_q.put((issued, batches[issued]))
+                    issued += 1
+                batch = _unflatten(leaves, struct, lambda a: array(a))
+                yield batch
+        finally:
+            for _ in procs:
+                task_q.put(None)
+            for p in procs:
+                p.join(timeout=5.0)
+                if p.is_alive():
+                    p.terminate()
+            # unlink every produced-but-unconsumed segment (early generator
+            # close / error path) so /dev/shm doesn't fill across epochs
+            leftovers = [v[0] for v in pending.values()]
+            while True:
+                try:
+                    got = result_q.get_nowait()
+                except _queue.Empty:
+                    break
+                leftovers.append(got[1])
+            for name in leftovers:
+                if not name:
+                    continue
+                try:
+                    seg = shared_memory.SharedMemory(name=name)
+                    seg.close()
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
 
     def _threaded_iter(self):
         """Worker threads + ordered result delivery with bounded prefetch

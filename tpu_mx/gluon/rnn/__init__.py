@@ -1,4 +1,5 @@
 """gluon.rnn (REF:python/mxnet/gluon/rnn/)."""
-from .rnn_cell import (DropoutCell, GRUCell, LSTMCell, RecurrentCell,
-                       ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell)
+from .rnn_cell import (DropoutCell, GRUCell, LSTMCell, ModifierCell,
+                       RecurrentCell, ResidualCell, RNNCell,
+                       SequentialRNNCell, ZoneoutCell)
 from .rnn_layer import GRU, LSTM, RNN

@@ -236,17 +236,35 @@ class DropoutCell(RecurrentCell):
         return inputs, states
 
 
-class ZoneoutCell(RecurrentCell):
-    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
-                 **kwargs):
+class ModifierCell(RecurrentCell):
+    """Base for cells that wrap another cell (REF rnn_cell.py:ModifierCell):
+    state protocol delegates to the wrapped cell."""
+
+    def __init__(self, base_cell, **kwargs):
         super().__init__(**kwargs)
         self.base_cell = base_cell
-        self._zo = zoneout_outputs
-        self._zs = zoneout_states
-        self._prev_output = None
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func=func, **kwargs)
+
+    def reset(self):
+        # guard: RecurrentCell.__init__ resets before base_cell is assigned
+        super().reset()
+        base = getattr(self, "base_cell", None)
+        if base is not None:
+            base.reset()
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
 
     def hybrid_forward(self, F, inputs, states):
         out, new_states = self.base_cell(inputs, states)
@@ -269,14 +287,7 @@ class ZoneoutCell(RecurrentCell):
         self._prev_output = None
 
 
-class ResidualCell(RecurrentCell):
-    def __init__(self, base_cell, **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
-
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
-
+class ResidualCell(ModifierCell):
     def hybrid_forward(self, F, inputs, states):
         out, new_states = self.base_cell(inputs, states)
         return out + inputs, new_states
