@@ -103,6 +103,18 @@ def test_variational_dropout_locked_mask():
     # locked mask: the SAME output units are dropped at both steps
     np.testing.assert_array_equal(z1, z2)
     assert z1.any()  # rate 0.5 on 8 units: P(no drop) = 2^-8
+    # a new sequence (unroll resets) must redraw the mask eventually:
+    # P(same 8-unit mask 12 times) = 2^-96
+    seq = nd.array(np.ones((2, 3, 3), np.float32))
+    changed = False
+    for _ in range(12):
+        with autograd.record():
+            outs, _ = cell.unroll(3, seq, layout="NTC")
+        z = np.asarray(outs._data)[:, 0, :] == 0.0
+        if not np.array_equal(z, z1):
+            changed = True
+            break
+    assert changed, "variational mask never redrawn across sequences"
 
 
 @pytest.mark.parametrize("cell_cls,ndim", [
@@ -112,16 +124,30 @@ def test_variational_dropout_locked_mask():
 ])
 def test_conv_rnn_cells_step(cell_cls, ndim):
     spatial = (5, 6, 7)[:ndim]
-    cell = cell_cls(hidden_channels=4, kernel=3)
+    cell = cell_cls(hidden_channels=4, kernel=3,
+                    input_shape=(3,) + spatial)
     cell.initialize()
     x = nd.array(np.random.rand(2, 3, *spatial).astype(np.float32))
-    zeros = [nd.zeros((2, 4) + spatial)
-             for _ in range(getattr(cell, "_n_states"))]
-    out, states = cell(x, zeros)
+    states = cell.begin_state(batch_size=2)  # input_shape makes this work
+    out, states = cell(x, states)
     assert out.shape == (2, 4) + spatial
     out2, _ = cell(x, states)  # second step, same input channels
     assert out2.shape == (2, 4) + spatial
     assert not np.allclose(np.asarray(out._data), np.asarray(out2._data))
+
+
+def test_conv_rnn_unroll_and_deferred_state_error():
+    # unroll through the standard protocol, states from begin_state
+    cell = crnn.Conv2DLSTMCell(hidden_channels=2, kernel=3,
+                               input_shape=(1, 4, 4))
+    cell.initialize()
+    seq = nd.array(np.random.rand(2, 3, 1, 4, 4).astype(np.float32))
+    outs, states = cell.unroll(3, seq, layout="NTC")
+    assert outs.shape == (2, 3, 2, 4, 4)
+    # without input_shape and before any forward: loud error
+    cell2 = crnn.Conv2DLSTMCell(hidden_channels=2, kernel=3)
+    with pytest.raises(mx.base.MXNetError, match="input_shape"):
+        cell2.begin_state(batch_size=2)
 
 
 def test_conv_lstm_unroll_learns():

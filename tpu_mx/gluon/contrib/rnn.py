@@ -124,8 +124,7 @@ class _ConvRNNBase(RecurrentCell):
     reference's conv cells)."""
 
     def __init__(self, hidden_channels, kernel, n_gates, ndim,
-                 input_shape=None, i2h_pad=None, activation="tanh",
-                 **kwargs):
+                 input_shape=None, activation="tanh", **kwargs):
         super().__init__(**kwargs)
         self._hc = hidden_channels
         self._ndim = ndim
@@ -138,8 +137,21 @@ class _ConvRNNBase(RecurrentCell):
         self._pad = tuple(k // 2 for k in self._kernel)
         self._ng = n_gates
         self._activation = activation
+        # input_shape=(C, *spatial) — the reference conv cells' ctor arg;
+        # with it begin_state()/unroll() work before any forward, without
+        # it spatial dims resolve on the first forward
+        in_c = 0
+        self._spatial = None
+        if input_shape is not None:
+            input_shape = tuple(int(s) for s in input_shape)
+            if len(input_shape) != ndim + 1:
+                raise MXNetError(
+                    f"input_shape must be (C, {'x'.join('S' * ndim)})")
+            in_c = input_shape[0]
+            self._spatial = input_shape[1:]
         self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(n_gates * hidden_channels, 0) + self._kernel,
+            "i2h_weight",
+            shape=(n_gates * hidden_channels, in_c) + self._kernel,
             allow_deferred_init=True)
         self.h2h_weight = self.params.get(
             "h2h_weight",
@@ -151,7 +163,6 @@ class _ConvRNNBase(RecurrentCell):
         self.h2h_bias = self.params.get(
             "h2h_bias", shape=(n_gates * hidden_channels,), init="zeros",
             allow_deferred_init=True)
-        self._spatial = None
 
     def infer_shape(self, x, *args):
         self.i2h_weight.shape_hint(
@@ -159,9 +170,14 @@ class _ConvRNNBase(RecurrentCell):
         self._spatial = tuple(x.shape[2:])
 
     def state_info(self, batch_size=0):
-        sp = self._spatial or (0,) * self._ndim
-        return [{"shape": (batch_size, self._hc) + sp, "__layout__": "NC" +
-                 "DHW"[-self._ndim:]}] * self._n_states
+        if self._spatial is None:
+            raise MXNetError(
+                f"{type(self).__name__}: spatial state shape unknown — "
+                "construct with input_shape=(C, *spatial) or run one "
+                "forward before begin_state()/unroll()")
+        return [{"shape": (batch_size, self._hc) + self._spatial,
+                 "__layout__": "NC" + "DHW"[-self._ndim:]}
+                for _ in range(self._n_states)]
 
     def _gates(self, inputs, h):
         gi = F.Convolution(inputs, self.i2h_weight.data(),

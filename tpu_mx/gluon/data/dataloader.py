@@ -50,7 +50,15 @@ def _numpy_batchify(data):
 
 
 def _flatten_np(x):
-    """(leaves, structure) for nested tuple/list pytrees of arrays."""
+    """(leaves, structure) for nested tuple/list pytrees of arrays.
+    Rejects NDArray leaves loudly — this runs in fork()ed workers where
+    touching jax (NDArray.__array__ readback) hangs or crashes; the guard
+    must hold for CUSTOM batchify fns too, not just the default."""
+    if isinstance(x, NDArray):
+        raise TypeError(
+            "process workers (thread_pool=False) require numpy batches; "
+            "got NDArray — return numpy from the dataset/batchify_fn or "
+            "use thread workers (thread_pool=True)")
     if isinstance(x, (tuple, list)):
         leaves, struct = [], []
         for e in x:
@@ -84,6 +92,7 @@ def _shm_worker_loop(dataset, batchify, task_q, result_q):
         if item is None:
             return
         seq, indices = item
+        shm = None
         try:
             batch = batchify([dataset[i] for i in indices])
             leaves, struct = _flatten_np(batch)
@@ -101,6 +110,12 @@ def _shm_worker_loop(dataset, batchify, task_q, result_q):
             shm.close()
             result_q.put((seq, shm.name, metas, struct, None))
         except Exception as e:  # surfaced in the consumer
+            if shm is not None:  # don't leak the segment of a failed batch
+                try:
+                    shm.close()
+                    shm.unlink()
+                except Exception:
+                    pass
             result_q.put((seq, None, None, None,
                           f"{type(e).__name__}: {e}"))
 

@@ -44,8 +44,11 @@ class RecurrentCell(HybridBlock):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         """Static unroll (reference's symbolic unroll; here the per-step python
-        loop is traced once under hybridize so XLA still sees one graph)."""
+        loop is traced once under hybridize so XLA still sees one graph).
+        Resets per-sequence cell state first (counters, cached variational
+        dropout masks) — the reference unroll does the same."""
         from ...ndarray import ops as F
+        self.reset()
         axis = layout.find("T")
         if isinstance(inputs, (list, tuple)):
             steps = list(inputs)

@@ -60,7 +60,9 @@ def _apply(fn, args, name="op", nondiff=False):
 
     diff_idx = [
         i for i, a in enumerate(args)
-        if isinstance(a, NDArray) and jnp.issubdtype(a.dtype, jnp.floating)
+        # inexact = floats AND complex: fft chains produce complex64
+        # intermediates whose cotangents must keep flowing
+        if isinstance(a, NDArray) and jnp.issubdtype(a.dtype, jnp.inexact)
     ]
     diff_inputs = [args[i] for i in diff_idx]
 
@@ -74,7 +76,7 @@ def _apply(fn, args, name="op", nondiff=False):
         out_data, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
         multi = isinstance(out_data, (tuple, list))
         outs_raw = list(out_data) if multi else [out_data]
-        if all(jnp.issubdtype(o.dtype, jnp.floating) for o in outs_raw):
+        if all(jnp.issubdtype(o.dtype, jnp.inexact) for o in outs_raw):
             outs = [NDArray(o) for o in outs_raw]
             autograd._record_op(vjp_fn, diff_inputs, outs, name=name)
             return outs if multi else outs[0]

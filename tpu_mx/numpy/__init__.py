@@ -90,6 +90,11 @@ def _wrap(jnp_name, public=None):
         # so every element participates in autograd, rebuild inside
         # NB: module globals shadow builtins like any/all/sum with wrapped
         # np ops — reach for the real builtins in here
+        # NDArray kwargs (e.g. average(..., weights=w)) are unwrapped to
+        # raw values: they compute correctly but are CONSTANTS to autograd
+        # — pass arrays positionally when their gradient matters
+        kwargs = {k: (v._data if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
         flat, spec = [], []
         for a in args:
             if isinstance(a, (list, tuple)) and _builtins.any(
@@ -120,26 +125,34 @@ _WRAPPED = [
     "abs", "absolute", "add", "all", "amax", "amin", "any", "append",
     "arccos", "arccosh", "arcsin", "arcsinh", "arctan", "arctan2",
     "arctanh", "argmax", "argmin", "argsort", "around", "atleast_1d",
-    "atleast_2d", "atleast_3d", "broadcast_arrays", "broadcast_to",
+    "atleast_2d", "atleast_3d", "average", "bincount", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "broadcast_arrays", "broadcast_to",
     "cbrt", "ceil", "clip", "column_stack", "concatenate", "copysign",
     "cos", "cosh", "cross", "cumprod", "cumsum", "deg2rad", "degrees",
-    "diag", "diagonal", "diff", "divide", "dot", "dsplit", "dstack",
+    "delete", "diag", "diagflat", "diagonal", "diff", "divide", "dot",
+    "dsplit", "dstack",
     "ediff1d", "einsum", "equal", "exp", "exp2", "expand_dims", "expm1",
-    "flip", "fliplr", "flipud", "floor", "floor_divide", "fmax",
-    "fmin", "fmod", "greater", "greater_equal", "histogram", "hsplit",
-    "hstack", "hypot", "inner", "interp", "invert", "isfinite", "isinf",
+    "flatnonzero", "flip", "fliplr", "flipud", "floor", "floor_divide",
+    "fmax", "fmin", "fmod", "gcd", "greater", "greater_equal",
+    "histogram", "hsplit",
+    "hstack", "hypot", "inner", "insert", "interp", "invert", "isclose",
+    "isfinite", "isinf",
     "isnan", "isneginf", "isposinf", "kron", "lcm", "ldexp", "less",
     "less_equal", "log", "log10", "log1p", "log2", "logaddexp",
     "logical_and", "logical_not", "logical_or", "logical_xor", "matmul",
     "max", "maximum", "mean", "median", "meshgrid", "min", "minimum",
-    "mod", "moveaxis", "multiply", "nan_to_num", "negative", "nonzero",
-    "not_equal", "outer", "pad", "percentile", "power", "prod",
-    "quantile", "rad2deg", "radians", "ravel", "reciprocal", "remainder",
-    "repeat", "reshape", "roll", "rot90", "searchsorted", "sign", "sin",
+    "mod", "moveaxis", "multiply", "nan_to_num", "nanmax", "nanmean",
+    "nanmin", "nansum", "negative", "nonzero",
+    "not_equal", "outer", "pad", "percentile", "polyval", "power", "prod",
+    "ptp", "quantile", "rad2deg", "radians", "ravel", "reciprocal",
+    "remainder",
+    "repeat", "reshape", "resize", "roll", "rot90", "searchsorted",
+    "sign", "sin",
     "sinh", "sort", "split", "sqrt", "square", "squeeze", "stack", "std",
-    "subtract", "sum", "swapaxes", "take", "tan", "tanh", "tensordot",
+    "subtract", "sum", "swapaxes", "take", "take_along_axis", "tan",
+    "tanh", "tensordot",
     "tile", "trace", "transpose", "tril", "triu", "true_divide", "trunc",
-    "unique", "unravel_index", "var", "vsplit", "vstack", "where",
+    "unique", "unravel_index", "var", "vdot", "vsplit", "vstack", "where",
 ]
 for _name in _WRAPPED:
     globals()[_name] = _wrap(_name)
@@ -163,6 +176,30 @@ def full_like(a, fill_value, dtype=None, **kw):
                        "full_like")
 
 
+def empty(shape, dtype=None, ctx=None, **kw):
+    # functional arrays are never uninitialized; zeros is the honest analog
+    return zeros(shape, dtype, ctx)
+
+
+def empty_like(a, dtype=None, **kw):
+    return zeros_like(a, dtype)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None, **kw):
+    return NDArray(_jnp.logspace(start, stop, num, endpoint=endpoint,
+                                 base=base, dtype=dtype or "float32"))
+
+
+def indices(dimensions, dtype="int32", ctx=None):
+    # numpy contract: ONE stacked array of shape (ndim, *dimensions)
+    return NDArray(_jnp.indices(tuple(dimensions), dtype=dtype))
+
+
+def diag_indices(n, ndim=2):
+    return tuple(NDArray(a) for a in _jnp.diag_indices(n, ndim))
+
+
 def may_share_memory(a, b):
     return False  # functional arrays never alias
 
@@ -179,10 +216,12 @@ def size(a):
     return int(_onp.prod(a.shape)) if a.shape else 1
 
 
+from . import fft         # noqa: E402
 from . import linalg      # noqa: E402
 from . import random      # noqa: E402
 
 __all__ = (["array", "zeros", "ones", "full", "arange", "linspace", "eye",
             "identity", "zeros_like", "ones_like", "full_like", "ndarray", "fix",
-            "newaxis", "pi", "e", "inf", "nan", "linalg", "random",
-            "shape", "ndim", "size", "round", "concat"] + _WRAPPED)
+            "newaxis", "pi", "e", "inf", "nan", "fft", "linalg", "random",
+            "shape", "ndim", "size", "round", "concat", "empty",
+            "empty_like", "logspace", "indices", "diag_indices"] + _WRAPPED)

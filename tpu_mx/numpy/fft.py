@@ -1,0 +1,40 @@
+"""mx.np.fft — FFT family over jax.numpy.fft through the autograd-aware
+dispatch layer (REF:python/mxnet/numpy/fft counterpart surface; upstream
+exposed FFTs via contrib ops backed by cuFFT, src/operator/contrib/fft).
+On TPU the FFTs lower to XLA's native Fft HLO."""
+from __future__ import annotations
+
+import jax.numpy as _jnp
+
+from ..ndarray import NDArray
+from ..ndarray import ops as _ops
+
+
+def _wrap(name):
+    jfn = getattr(_jnp.fft, name)
+
+    def op(a, *args, **kwargs):
+        return _ops._apply(lambda x: jfn(x, *args, **kwargs), [a],
+                           f"fft.{name}")
+
+    op.__name__ = name
+    op.__doc__ = f"mx.np.fft.{name} — jax.numpy.fft.{name}"
+    return op
+
+
+_WRAPPED = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft",
+            "irfft", "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+            "fftshift", "ifftshift"]
+for _name in _WRAPPED:
+    globals()[_name] = _wrap(_name)
+
+
+def fftfreq(n, d=1.0, dtype=None, ctx=None):
+    return NDArray(_jnp.fft.fftfreq(n, d, dtype=dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, ctx=None):
+    return NDArray(_jnp.fft.rfftfreq(n, d, dtype=dtype or "float32"))
+
+
+__all__ = _WRAPPED + ["fftfreq", "rfftfreq"]
