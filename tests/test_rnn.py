@@ -136,3 +136,26 @@ def test_lstm_lm_model():
     st = lm.begin_state(batch_size=2)
     logits, st2 = lm(x, st)
     assert logits.shape == (6, 2, 30)
+
+
+def test_unroll_valid_length_masks_and_freezes_states():
+    """unroll(valid_length=...): outputs past each sequence's length are
+    zeroed and final states freeze at step valid_length-1 (the reference's
+    SequenceMask + SequenceLast contract)."""
+    from tpu_mx.gluon import rnn as grnn
+    cell = grnn.LSTMCell(5)
+    cell.initialize()
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(2, 4, 3).astype(np.float32))  # (N, T, C)
+    vl = np.array([4, 2], np.float32)
+    outs, states = cell.unroll(4, x, layout="NTC", valid_length=vl)
+    o = np.asarray(outs._data)
+    assert (o[1, 2:] == 0).all() and (o[1, :2] != 0).any()
+    assert (o[0] != 0).any(axis=-1).all()
+    # row 1 states must equal an unroll truncated at T=2
+    outs2, states2 = cell.unroll(2, nd.array(
+        np.asarray(x._data)[:, :2]), layout="NTC")
+    np.testing.assert_allclose(np.asarray(states[0]._data)[1],
+                                np.asarray(states2[0]._data)[1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(states[1]._data)[1],
+                                np.asarray(states2[1]._data)[1], rtol=1e-6)

@@ -60,9 +60,27 @@ class RecurrentCell(HybridBlock):
                 steps = [steps] if not isinstance(steps, list) else steps
         states = begin_state if begin_state is not None else \
             self.begin_state(batch)
+        vl = None
+        if valid_length is not None:
+            vl = valid_length if hasattr(valid_length, "shape") else \
+                F.array(valid_length)
         outputs = []
         for t in range(length):
-            out, states = self(steps[t], states)
+            out, new_states = self(steps[t], states)
+            if vl is not None:
+                # reference semantics (SequenceMask + SequenceLast): outputs
+                # past a sequence's valid_length are zeroed, and its final
+                # states freeze at step valid_length-1
+                live = F.reshape(vl > t, shape=(-1,) + (1,) *
+                                 (len(out.shape) - 1))
+                out = F.where(F.broadcast_to(live, out.shape), out,
+                              F.zeros_like(out))
+                states = [F.where(F.broadcast_to(
+                    F.reshape(vl > t, shape=(-1,) + (1,) *
+                              (len(ns.shape) - 1)), ns.shape), ns, s)
+                    for s, ns in zip(states, new_states)]
+            else:
+                states = new_states
             outputs.append(out)
         if merge_outputs or merge_outputs is None:
             outputs = F.stack(*outputs, axis=axis)

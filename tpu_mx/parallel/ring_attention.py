@@ -41,7 +41,8 @@ _logger = logging.getLogger(__name__)
 # it is once per new shape/dtype — so a shape regression that silently drops
 # the Pallas kernel shows up exactly once, not once per step (VERDICT r1
 # weak#6).  Mirrored into profiler counters.
-dispatch_counts = {"ring": 0, "pallas_flash": 0, "xla_dense": 0}
+dispatch_counts = {"ring": 0, "ulysses": 0, "pallas_flash": 0,
+                   "xla_dense": 0}
 _seen_signatures = set()
 
 
@@ -259,13 +260,27 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
 
 
 def attention(q, k, v, mesh=None, causal=False, valid_length=None,
-              dropout_rate=0.0, dropout_key=None, bias=None):
-    """Dispatch: ring attention when a mesh with an `sp` axis is active,
-    local flash otherwise.  valid_length (B,) masks padded keys; dropout
-    is attention-prob dropout (pass a key only in training mode); bias is
-    an additive (B|1, H|1, Tq, Tk) attention bias (ALiBi, relative pos)."""
+              dropout_rate=0.0, dropout_key=None, bias=None,
+              sp_strategy=None):
+    """Dispatch: sequence-parallel attention when a mesh with an `sp` axis
+    is active (strategy 'ring' or 'ulysses' — per-call `sp_strategy`, else
+    the module default set via `parallel.set_sp_strategy`; ulysses needs
+    H % sp == 0 and quietly falls back to ring otherwise), local flash
+    when not.  valid_length (B,) masks padded keys; dropout is
+    attention-prob dropout (pass a key only in training mode); bias is an
+    additive (B|1, H|1, Tq, Tk) attention bias (ALiBi, relative pos)."""
     if mesh is not None and "sp" in mesh.axis_names and \
             mesh.shape["sp"] > 1:
+        from .ulysses import get_sp_strategy, ulysses_attention
+        strategy = sp_strategy or get_sp_strategy()
+        # ulysses preconditions: heads divide sp, and no REAL head-axis
+        # sharding (size-1 tp is fine) — otherwise quiet ring fallback
+        if strategy == "ulysses" and q.shape[1] % mesh.shape["sp"] == 0 \
+                and mesh.shape.get("tp", 1) == 1:
+            return ulysses_attention(q, k, v, mesh, causal=causal,
+                                     valid_length=valid_length,
+                                     dropout_rate=dropout_rate,
+                                     dropout_key=dropout_key, bias=bias)
         return ring_attention(q, k, v, mesh, causal=causal,
                               valid_length=valid_length,
                               dropout_rate=dropout_rate,
