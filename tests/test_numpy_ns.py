@@ -205,3 +205,140 @@ def test_np_fft_roundtrip_and_grad():
     # just require a finite, nonzero gradient of the right shape
     g = x.grad.asnumpy()
     assert g.shape == (32,) and onp.isfinite(g).all() and (g != 0).any()
+
+
+def test_control_flow_foreach_eager_and_traced():
+    """contrib.foreach: python loop eagerly (tape-recorded), ONE lax.scan
+    in traces; both match a manual unroll, grads flow."""
+    from tpu_mx import autograd, gluon
+    from tpu_mx.ndarray import contrib as C
+
+    data = nd.array(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    w = nd.array(onp.ones(3, onp.float32) * 0.5)
+    w.attach_grad()
+
+    def body(x, s):
+        out = x * w + s
+        return out, out
+
+    with autograd.record():
+        outs, final = C.foreach(body, data, nd.zeros(3))
+        loss = outs.sum()
+    loss.backward()
+    # manual: cumulative sum of x*w rows; dL/dw = sum over t of (T-t)*x_t
+    x = onp.arange(12, dtype=onp.float32).reshape(4, 3)
+    ref = onp.cumsum(x * 0.5, axis=0)
+    onp.testing.assert_allclose(outs.asnumpy(), ref, rtol=1e-6)
+    onp.testing.assert_allclose(final.asnumpy(), ref[-1], rtol=1e-6)
+    wg = (x * onp.arange(4, 0, -1)[:, None]).sum(axis=0)
+    onp.testing.assert_allclose(w.grad.asnumpy(), wg, rtol=1e-6)
+
+    # traced through a hybridized block: same numbers
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, d):
+            outs, _ = C.foreach(lambda x, s: ((x + s), (x + s)), d,
+                                np.zeros(3))
+            return outs
+
+    net = Net()
+    net.initialize()
+    eager = net(data).asnumpy()
+    net.hybridize()
+    onp.testing.assert_allclose(net(data).asnumpy(), eager, rtol=1e-6)
+
+
+def test_control_flow_while_loop_and_cond():
+    from tpu_mx.ndarray import contrib as C
+
+    # sum integers until the running total exceeds 20 (5.5 steps -> 6)
+    def w_cond(i, total):
+        return total < 20.0
+
+    def w_func(i, total):
+        new_total = total + i
+        return new_total, (i + 1.0, new_total)
+
+    outs, (i_fin, total_fin), steps = C.while_loop(
+        w_cond, w_func, (nd.array([1.0]), nd.array([0.0])),
+        max_iterations=10)
+    assert steps == 6  # 1+2+...+6 = 21 >= 20
+    assert float(total_fin.asnumpy()[0]) == 21.0
+    assert outs.shape == (10, 1)
+    assert float(outs.asnumpy()[5, 0]) == 21.0
+    assert (outs.asnumpy()[6:] == 0).all()  # zero padding
+
+    # cond: eager branch pick
+    r = C.cond(nd.array([1.0]), lambda: nd.array([2.0]),
+               lambda: nd.array([3.0]))
+    assert float(r.asnumpy()[0]) == 2.0
+
+    # traced while_loop + cond inside a hybridized block
+    from tpu_mx import gluon
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, (_, tot), steps = C.while_loop(
+                lambda i, t: t < 20.0,
+                lambda i, t: (t + i, (i + 1.0, t + i)),
+                (F.ones((1,)), F.zeros((1,))), max_iterations=10)
+            return C.cond(steps > 5, lambda: tot, lambda: tot * 0.0)
+
+    net = Net()
+    net.initialize()
+    eager = net(nd.array([0.0])).asnumpy()
+    net.hybridize()
+    hybrid = net(nd.array([0.0])).asnumpy()
+    onp.testing.assert_allclose(eager, [21.0])
+    onp.testing.assert_allclose(hybrid, [21.0])
+
+
+def test_npx_round3_aliases():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert npx.batch_flatten(a).shape == (2, 2)
+    al = npx.arange_like(a)
+    onp.testing.assert_allclose(al.asnumpy(), [[0, 1], [2, 3]])
+    ln = npx.layer_norm(a, np.ones(2), np.zeros(2))
+    assert ln.shape == (2, 2)
+    sl1 = npx.smooth_l1(np.array([0.5, 2.0]))
+    onp.testing.assert_allclose(sl1.asnumpy(), [0.125, 1.5])
+    assert npx.foreach is not None and npx.while_loop is not None
+
+
+def test_while_loop_zero_trips_eager_traced_agree():
+    """A loop whose condition is False on entry returns the SAME all-zero
+    buffer + steps=0 in eager and traced mode (no eager-only crash)."""
+    from tpu_mx import gluon
+    from tpu_mx.ndarray import contrib as C
+
+    def run():
+        return C.while_loop(lambda i, t: t < 0.0,
+                            lambda i, t: (t + i, (i + 1.0, t + i)),
+                            (nd.ones((1,)), nd.zeros((1,))),
+                            max_iterations=4)
+
+    outs, (i_f, t_f), steps = run()
+    assert steps == 0 and outs.shape == (4, 1)
+    assert (outs.asnumpy() == 0).all()
+    assert float(i_f.asnumpy()[0]) == 1.0  # loop vars untouched
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, _, steps = C.while_loop(
+                lambda i, t: t < 0.0,
+                lambda i, t: (t + i, (i + 1.0, t + i)),
+                (F.ones((1,)), F.zeros((1,))), max_iterations=4)
+            return outs + F.reshape(x * 0.0, shape=(1, 1))
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    assert (net(nd.array([5.0])).asnumpy() == 0).all()
+
+
+def test_attention_sp_strategy_typo_raises():
+    import jax.numpy as jnp
+    from tpu_mx.parallel import attention, make_mesh
+    mesh = make_mesh({"sp": 8})
+    q = jnp.ones((1, 8, 32, 4), jnp.float32)
+    with pytest.raises(ValueError, match="sp_strategy"):
+        attention(q, q, q, mesh=mesh, sp_strategy="ulyses")

@@ -791,3 +791,50 @@ def test_attention_sp_strategy_dispatch():
         assert float(jnp.abs(a1 - a3).max()) < 1e-5
     finally:
         set_sp_strategy(prev)
+
+
+def test_async_checkpoint_overlaps_training(tmp_path):
+    """save_checkpoint(block=False) snapshots state at save time: training
+    continues (mutating/donating the live buffers) while tensorstore
+    commits; restore must bring back the SAVE-TIME state, not the later
+    one."""
+    from tpu_mx.parallel import CompiledTrainStep
+
+    def build():
+        np.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, in_units=8, activation="relu"), nn.Dense(4))
+        net.initialize()
+        net(nd.ones((1, 8)))
+        return net
+
+    x = nd.array(np.random.RandomState(1).rand(8, 8).astype(np.float32))
+    y = nd.array(np.array([0, 1, 2, 3, 0, 1, 2, 3], dtype=np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def make(net):
+        opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+        return CompiledTrainStep(net, loss_fn, opt, mesh=_mesh(dp=8))
+
+    # reference: sync save at t=2, one more step -> loss3_ref
+    step_a = make(build())
+    step_a.step(x, y)
+    step_a.step(x, y)
+    ck_sync = str(tmp_path / "sync")
+    step_a.save_checkpoint(ck_sync)
+    loss3_ref = float(np.asarray(step_a.step(x, y)._data))
+
+    # async: identical run, async save at t=2, keep training THROUGH the
+    # commit window, then restore and compare
+    step_b = make(build())
+    step_b.step(x, y)
+    step_b.step(x, y)
+    ck_async = str(tmp_path / "async")
+    step_b.save_checkpoint(ck_async, block=False)
+    for _ in range(4):           # donates/overwrites live buffers
+        step_b.step(x, y)
+    step_b.wait_for_checkpoint()
+    step_b.load_checkpoint(ck_async)
+    assert step_b._t == 2
+    loss3 = float(np.asarray(step_b.step(x, y)._data))
+    assert abs(loss3 - loss3_ref) < 1e-5, (loss3, loss3_ref)

@@ -438,3 +438,144 @@ def boolean_mask(data, index, axis=0, **kw):
         return jnp.compress(keep, x, axis=axis)
 
     return _apply(f, [data, index], "boolean_mask")
+
+
+# --------------------------------------------------------------------------
+# control flow (REF:src/operator/control_flow.cc — foreach/while_loop/cond;
+# the reference executed a cached sub-graph per step, here the TPU-native
+# forms are lax.scan / lax.while_loop / lax.cond inside traces and plain
+# Python in eager mode, where every op records on the autograd tape)
+# --------------------------------------------------------------------------
+
+def _as_state_list(states):
+    single = not isinstance(states, (list, tuple))
+    return ([states] if single else list(states)), single
+
+
+def _raw(x):
+    """Unwrap NDArray -> raw jax value (creation ops hand back NDArray
+    wrappers even inside functional traces; lax control flow needs raw
+    pytree leaves)."""
+    from .ndarray import NDArray
+    return x._data if isinstance(x, NDArray) else x
+
+
+def foreach(body, data, init_states):
+    """Scan `body(x_t, states) -> (out_t, new_states)` over data's leading
+    axis (REF control_flow.cc:foreach).  data: array or list of arrays that
+    share the leading axis; states: array or list.  Inside a compiled trace
+    this is ONE `lax.scan` (sequential op count independent of length);
+    eagerly it is a Python loop whose every op lands on the autograd tape.
+    Returns (stacked_outputs, final_states) with the states in the same
+    single/list form they came in."""
+    from .. import _functional
+    from . import ops as F
+    states, single = _as_state_list(init_states)
+    multi_data = isinstance(data, (list, tuple))
+
+    if _functional.active():
+        states = [_raw(s) for s in states]
+        xs = tuple(_raw(d) for d in data) if multi_data else _raw(data)
+
+        def scan_body(carry, x):
+            xt = list(x) if multi_data else x
+            out, new_states = body(xt, list(carry) if not single
+                                   else carry[0])
+            ns, _ = _as_state_list(new_states)
+            return tuple(_raw(v) for v in ns), _raw(out)
+
+        carry, ys = jax.lax.scan(scan_body, tuple(states), xs)
+        final = carry[0] if single else list(carry)
+        return ys, final
+
+    length = (data[0] if multi_data else data).shape[0]
+    outputs = []
+    cur = states[0] if single else states
+    for t in range(length):
+        xt = [d[t] for d in data] if multi_data else data[t]
+        out, cur = body(xt, cur)
+        outputs.append(out)
+    stacked = F.stack(*outputs, axis=0)
+    return stacked, cur
+
+
+def while_loop(cond, func, loop_vars, max_iterations):
+    """`while cond(*loop_vars): out, loop_vars = func(*loop_vars)`
+    (REF control_flow.cc:while_loop).  Outputs are stacked into a
+    fixed (max_iterations, ...) buffer — rows beyond the actual trip count
+    are zeros — plus the final loop_vars and the step count; XLA's static
+    shapes make max_iterations mandatory, exactly as the reference did.
+    The traced form is `lax.while_loop` (NOT differentiable — same
+    limitation as the reference's); differentiate through `foreach` with a
+    fixed length instead when gradients are needed."""
+    from .. import _functional
+    from . import ops as F
+    lvars, single = _as_state_list(loop_vars)
+    if max_iterations is None or max_iterations <= 0:
+        raise ValueError("while_loop requires a positive max_iterations")
+
+    def _pred(vs):
+        c = cond(*vs)
+        c = c.asnumpy() if hasattr(c, "asnumpy") else np.asarray(c)
+        return bool(np.ravel(c)[0])
+
+    if not _functional.active():
+        outputs = []
+        steps = 0
+        cur = lvars
+        while steps < max_iterations and _pred(cur):
+            out, new_vars = func(*cur)
+            cur, _ = _as_state_list(new_vars)
+            outputs.append(out)
+            steps += 1
+        if not outputs:
+            # zero-trip loop: infer the row shape abstractly so eager and
+            # traced agree (both return an all-zero buffer, steps=0)
+            row = jax.eval_shape(lambda vs: _raw(func(*vs)[0]),
+                                 tuple(_raw(v) for v in cur))
+            from .ndarray import NDArray
+            zeros = NDArray(jnp.zeros((max_iterations,) + tuple(row.shape),
+                                      row.dtype))
+            return zeros, (cur[0] if single else cur), 0
+        pad = [F.zeros_like(outputs[0]) for _ in
+               range(max_iterations - steps)]
+        stacked = F.stack(*(outputs + pad), axis=0)
+        return stacked, (cur[0] if single else cur), steps
+
+    # traced: probe one func application for output structure, then run a
+    # fixed-bound while loop writing into a preallocated buffer
+    lvars = [_raw(v) for v in lvars]
+    out0_shape = jax.eval_shape(lambda vs: _raw(func(*vs)[0]), tuple(lvars))
+    buf = jnp.zeros((max_iterations,) + tuple(out0_shape.shape),
+                    out0_shape.dtype)
+
+    def w_cond(carry):
+        i, _, vs = carry
+        return jnp.logical_and(i < max_iterations,
+                               jnp.asarray(_raw(cond(*vs))).reshape(()))
+
+    def w_body(carry):
+        i, b, vs = carry
+        out, new_vars = func(*vs)
+        nv, _ = _as_state_list(new_vars)
+        b = jax.lax.dynamic_update_index_in_dim(b, _raw(out), i, axis=0)
+        return i + 1, b, tuple(_raw(v) for v in nv)
+
+    steps, buf, fin = jax.lax.while_loop(w_cond, w_body,
+                                         (jnp.int32(0), buf, tuple(lvars)))
+    return buf, (fin[0] if single else list(fin)), steps
+
+
+def cond(pred, then_func, else_func):
+    """`then_func() if pred else else_func()` (REF control_flow.cc:cond).
+    Traced: `lax.cond` — both branches must produce matching shapes/dtypes;
+    eager: plain Python branch."""
+    from .. import _functional
+    if not _functional.active():
+        p = pred.asnumpy() if hasattr(pred, "asnumpy") else np.asarray(pred)
+        return then_func() if bool(np.ravel(p)[0]) else else_func()
+    return jax.lax.cond(jnp.asarray(_raw(pred)).reshape(()).astype(bool),
+                        lambda: _raw(then_func()), lambda: _raw(else_func()))
+
+
+__all__ += ["foreach", "while_loop", "cond"]

@@ -80,3 +80,44 @@ def waitall():
 def seed(s):
     from . import random as _random
     _random.seed(s)
+
+
+# round-3 widening: the rest of the heavily-used npx surface, each mapping
+# to an existing classic op (REF:python/mxnet/numpy_extension + _api_internal
+# npx registry)
+from .ndarray import contrib as _contrib
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None, **kw):
+    """arange shaped like data (REF:src/operator/tensor/init_op.cc
+    arange_like): full flat length, or along one axis."""
+    import jax.numpy as _jnp
+    from .ndarray.ops import _apply
+
+    def f(x):
+        n = x.size if axis is None else x.shape[axis]
+        out = start + step * _jnp.arange(n, dtype=_jnp.float32)
+        return out.reshape(x.shape) if axis is None else out
+
+    return _apply(f, [data], "arange_like")
+
+
+batch_flatten = _ops.Flatten
+broadcast_like = _ops.broadcast_like
+ctc_loss = _ops.CTCLoss
+deconvolution = _ops.Deconvolution
+erf = _ops.erf
+erfinv = _ops.erfinv
+layer_norm = _ops.LayerNorm
+multibox_detection = _contrib.MultiBoxDetection
+multibox_prior = _contrib.MultiBoxPrior
+multibox_target = _contrib.MultiBoxTarget
+rnn = _ops.RNN
+roi_pooling = _ops.ROIPooling
+scatter_nd = _ops.scatter_nd
+shape_array = _ops.shape_array
+slice = _ops.slice
+smooth_l1 = _ops.smooth_l1
+foreach = _contrib.foreach
+while_loop = _contrib.while_loop
+cond = _contrib.cond

@@ -192,16 +192,12 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
     _count("ring", f"sp={mesh.shape[axis_name]} shape={q.shape}")
     masked = valid_length is not None
     biased = bias is not None
-    B = q.shape[0]
-    valid = (jnp.asarray(valid_length, jnp.int32) if masked
-             else jnp.zeros((B,), jnp.int32))
-    seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1, jnp.int32)
-            if dropped else jnp.zeros((1,), jnp.int32))
+    valid, seed, vspec = _sp_valid_seed(q, masked, dropped, valid_length,
+                                        dropout_key, spec)
     bias_arr = bias if biased else jnp.zeros((1, 1, q.shape[2], 1), q.dtype)
     # valid is per-batch → shard like q's batch axis; seed replicated;
     # bias rows follow the q sharding (batch/head axes only when the bias
     # actually carries them), columns replicated
-    vspec = P(spec[0]) if masked else P(None)
     bspec = P(spec[0] if biased and bias_arr.shape[0] > 1 else None,
               spec[1] if biased and bias_arr.shape[1] > 1 else None,
               spec[2], None)
@@ -214,6 +210,20 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
         mesh=mesh, in_specs=(spec, spec, spec, vspec, P(None), bspec),
         out_specs=spec, check_rep=False)
     return fn(q, k, v, valid, seed, bias_arr)
+
+
+def _sp_valid_seed(q, masked, dropped, valid_length, dropout_key, spec):
+    """Shared shard_map prologue for the sp strategies (ring, ulysses):
+    the (B,) valid-key counts, the scalar dropout seed, and the valid
+    spec.  Dummies keep the jitted signature static when a feature is
+    off."""
+    B = q.shape[0]
+    valid = (jnp.asarray(valid_length, jnp.int32) if masked
+             else jnp.zeros((B,), jnp.int32))
+    seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1, jnp.int32)
+            if dropped else jnp.zeros((1,), jnp.int32))
+    vspec = P(spec[0]) if masked else P(None)
+    return valid, seed, vspec
 
 
 def _dense_mask(t, tk, causal, valid_length):
@@ -272,6 +282,11 @@ def attention(q, k, v, mesh=None, causal=False, valid_length=None,
     if mesh is not None and "sp" in mesh.axis_names and \
             mesh.shape["sp"] > 1:
         from .ulysses import get_sp_strategy, ulysses_attention
+        if sp_strategy is not None and sp_strategy not in ("ring",
+                                                           "ulysses"):
+            raise ValueError(
+                f"unknown sp_strategy {sp_strategy!r}; use 'ring' or "
+                "'ulysses'")
         strategy = sp_strategy or get_sp_strategy()
         # ulysses preconditions: heads divide sp, and no REAL head-axis
         # sharding (size-1 tp is fine) — otherwise quiet ring fallback

@@ -486,27 +486,49 @@ class CompiledTrainStep:
             "t": jax.ShapeDtypeStruct((), jnp.int32),
         }
 
-    def save_checkpoint(self, path):
+    @property
+    def _checkpointer(self):
+        """One orbax StandardCheckpointer per step instance — its async
+        machinery (background tensorstore commit threads) is reused across
+        saves instead of being rebuilt per call."""
+        if getattr(self, "_ckpt", None) is None:
+            import orbax.checkpoint as ocp
+            self._ckpt = ocp.StandardCheckpointer()
+        return self._ckpt
+
+    def save_checkpoint(self, path, block=True):
         """Sharded checkpoint: every host writes only its own parameter
         shards, in parallel, via orbax/tensorstore — no gather through host
         memory (the reference gathered to rank 0 and wrote one file;
-        REF:python/mxnet/module/module.py save_checkpoint)."""
-        import orbax.checkpoint as ocp
+        REF:python/mxnet/module/module.py save_checkpoint).
+
+        block=False returns as soon as the device→host copy is done (orbax
+        async save guarantees source buffers are copied out before save()
+        returns), so training continues — and may donate/overwrite the live
+        buffers — while tensorstore commits in the background.  Call
+        `wait_for_checkpoint()` (or any later save/load, which waits
+        internally) before reading the files."""
         import os
         state = dict(self.state_dict())
         state.pop("efs", None)  # per-device; see _abstract_state
         state["t"] = jnp.asarray(state["t"], jnp.int32)
-        ck = ocp.StandardCheckpointer()
+        ck = self._checkpointer
         ck.save(os.path.abspath(str(path)), state, force=True)
-        ck.wait_until_finished()
+        if block:
+            ck.wait_until_finished()
+
+    def wait_for_checkpoint(self):
+        """Block until any in-flight async save has committed to disk."""
+        if getattr(self, "_ckpt", None) is not None:
+            self._ckpt.wait_until_finished()
 
     def load_checkpoint(self, path):
         """Restore a sharded checkpoint onto THIS step's mesh — the saved
         mesh/layout may differ (dp=2×tp=2 → dp=4 etc.); every host reads
         only the shards its devices need."""
-        import orbax.checkpoint as ocp
         import os
-        ck = ocp.StandardCheckpointer()
+        ck = self._checkpointer
+        ck.wait_until_finished()  # an async save may still be committing
         state = ck.restore(os.path.abspath(str(path)), self._abstract_state())
         self.values = state["values"]
         self.masters = state.get("masters", {})

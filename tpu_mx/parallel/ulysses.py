@@ -20,14 +20,14 @@ Trade-off table (pick with `set_sp_strategy` / the `sp_strategy` arg):
 from __future__ import annotations
 
 import functools
-import math
+
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .ring_attention import _count, local_flash_attention
+from .ring_attention import _count, _sp_valid_seed, local_flash_attention
 
 __all__ = ["ulysses_attention", "set_sp_strategy", "get_sp_strategy"]
 
@@ -49,7 +49,7 @@ def get_sp_strategy():
     return _SP_STRATEGY
 
 
-def _ulysses_body(q, k, v, valid, seed, bias, *, axis_name, causal, scale,
+def _ulysses_body(q, k, v, valid, seed, bias, *, axis_name, causal,
                   rate, masked, dropped, biased, key_axes=()):
     """Runs inside shard_map.  q/k/v: LOCAL sequence blocks (B, H, Tb, D).
     all_to_all → (B, H/n, T, D) head shards → one full-T local attention →
@@ -129,18 +129,13 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
         raise ValueError(
             "ulysses_attention: the head axis cannot also be mesh-sharded "
             f"(spec {spec}); all-to-all re-shards heads over {axis_name}")
-    scale = 1.0 / math.sqrt(q.shape[-1])
     dropped = dropout_rate > 0.0 and dropout_key is not None
     masked = valid_length is not None
     biased = bias is not None
     _count("ulysses", f"sp={n} shape={q.shape}")
-    B = q.shape[0]
-    valid = (jnp.asarray(valid_length, jnp.int32) if masked
-             else jnp.zeros((B,), jnp.int32))
-    seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1, jnp.int32)
-            if dropped else jnp.zeros((1,), jnp.int32))
+    valid, seed, vspec = _sp_valid_seed(q, masked, dropped, valid_length,
+                                        dropout_key, spec)
     bias_arr = bias if biased else jnp.zeros((1, 1, 1, 1), q.dtype)
-    vspec = P(spec[0]) if masked else P(None)
     # bias: rows and columns stay WHOLE (each device attends over full T
     # after the all-to-all); batch follows q's batch axis when present
     bspec = P(spec[0] if biased and bias_arr.shape[0] > 1 else None,
@@ -148,7 +143,7 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
     key_axes = tuple(ax for ax in (spec[0],) if ax is not None)
     fn = shard_map(
         functools.partial(_ulysses_body, axis_name=axis_name, causal=causal,
-                          scale=scale, rate=float(dropout_rate),
+                          rate=float(dropout_rate),
                           masked=masked, dropped=dropped, biased=biased,
                           key_axes=key_axes),
         mesh=mesh, in_specs=(spec, spec, spec, vspec, P(None), bspec),
