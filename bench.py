@@ -102,7 +102,42 @@ def _run_timed(step_fn, fetch_loss, warmup, iters, repeats, unit_count, tag):
     return unit_count * iters / best
 
 
+def _is_oom(e):
+    s = f"{type(e).__name__}: {e}".lower()
+    return ("hbm" in s or "out of memory" in s or "resource_exhausted" in s
+            or "exceeded" in s and "capacity" in s)
+
+
+def _batch_ladder(env_var, ladder):
+    """BENCH_BATCH/BENCH_BERT_BATCH=N forces one size; unset runs the
+    ladder largest-first, falling back on HBM OOM (larger batches usually
+    win on MXU utilization but the margin to 16 GB is model-dependent —
+    measure, don't guess)."""
+    v = os.environ.get(env_var)
+    return [int(v)] if v else list(ladder)
+
+
+def _run_ladder(tag, ladder, once):
+    """Try batch sizes largest-first; fall back on HBM OOM.  The last
+    rung re-raises (no fallback left)."""
+    for i, batch in enumerate(ladder):
+        try:
+            return once(batch)
+        except Exception as e:
+            if i + 1 < len(ladder) and _is_oom(e):
+                log(f"{tag} batch {batch} OOM ({e}); "
+                    f"falling back to {ladder[i + 1]}")
+                continue
+            raise
+
+
 def bench_resnet(smoke, layout, stem):
+    ladder = _batch_ladder("BENCH_BATCH", (8,) if smoke else (512, 256))
+    return _run_ladder("resnet", ladder,
+                       lambda b: _resnet_once(smoke, layout, stem, b))
+
+
+def _resnet_once(smoke, layout, stem, batch):
     import numpy as np
     import tpu_mx as mx
     from tpu_mx import gluon, nd
@@ -111,12 +146,11 @@ def bench_resnet(smoke, layout, stem):
     from tpu_mx.parallel import CompiledTrainStep
 
     if smoke:
-        batch, size, warmup, iters = 8, 64, 1, 3
+        size, warmup, iters = 64, 1, 3
         classes, factory = 100, "resnet18_v1"
     else:
-        batch, size, warmup, iters = 256, 224, 3, 30
+        size, warmup, iters = 224, 3, 30
         classes, factory = 1000, "resnet50_v1"
-    batch = int(os.environ.get("BENCH_BATCH", batch))
     iters = int(os.environ.get("BENCH_ITERS", iters))
 
     log(f"building {factory} ({layout}, stem={stem}), batch={batch}, "
@@ -158,6 +192,12 @@ def bench_resnet(smoke, layout, stem):
 
 
 def bench_bert(smoke):
+    ladder = _batch_ladder("BENCH_BERT_BATCH",
+                           (8,) if smoke else (512, 256))
+    return _run_ladder("bert", ladder, lambda b: _bert_once(smoke, b))
+
+
+def _bert_once(smoke, batch):
     import numpy as np
     import tpu_mx as mx
     from tpu_mx import gluon, nd
@@ -169,11 +209,10 @@ def bench_bert(smoke):
     if smoke:
         cfg = bert_base_config(vocab_size=1000, max_len=seq_len)
         cfg.update(num_layers=2, units=128, hidden_size=512, num_heads=2)
-        batch, warmup, iters, repeats = 8, 1, 3, 1
+        warmup, iters, repeats = 1, 3, 1
     else:
         cfg = bert_base_config(max_len=seq_len)
-        batch, warmup, iters, repeats = 512, 3, 20, 3
-    batch = int(os.environ.get("BENCH_BERT_BATCH", batch))
+        warmup, iters, repeats = 3, 20, 3
 
     remat = os.environ.get("BENCH_BERT_REMAT", "1") == "1"
     log(f"building bert ({cfg['num_layers']}L u{cfg['units']}), "

@@ -7,7 +7,7 @@ from ... import autograd
 from ... import layout as _layout_mod
 from ..block import Block, HybridBlock
 
-__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm", "GroupNorm",
            "LayerNorm", "InstanceNorm", "Embedding", "Flatten", "Activation",
            "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish", "Lambda",
            "HybridLambda"]
@@ -239,6 +239,38 @@ class InstanceNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.InstanceNorm(x, gamma, beta, eps=self._eps)
+
+
+class GroupNorm(HybridBlock):
+    """Group normalization over channel groups (REF:gluon/nn/basic_layers.py
+    GroupNorm [ver>=1.6], src/operator/nn/group_norm.cc): NCHW-style input,
+    channels split into num_groups, normalized over (group, *spatial) with
+    f32 statistics.  gamma/beta are PER GROUP, shape (num_groups,), exactly
+    the reference contract — so reference GroupNorm weights load
+    unchanged."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._ng = int(num_groups)
+        self._eps = epsilon
+        shape = (self._ng,)
+        self.gamma = self.params.get("gamma", shape=shape,
+                                     init=gamma_initializer,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=shape, init=beta_initializer,
+                                    grad_req="write" if center else "null")
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        if x.shape[1] % self._ng:
+            # shape known here even when in_channels was given up front
+            # (infer_shape only runs for deferred params)
+            from ...base import MXNetError
+            raise MXNetError(f"GroupNorm: channels {x.shape[1]} not "
+                             f"divisible by num_groups {self._ng}")
+        return F.GroupNorm(x, gamma, beta, num_groups=self._ng,
+                           eps=self._eps)
 
 
 class Embedding(HybridBlock):

@@ -1041,6 +1041,24 @@ def InstanceNorm(data, gamma, beta, eps=1e-3, **kw):
     return _apply(f, [data, gamma, beta], "InstanceNorm")
 
 
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5, **kw):
+    """REF:src/operator/nn/group_norm.cc — (N, C, *S) input, C split into
+    num_groups; f32 statistics for low-precision inputs."""
+    def f(x, g, b):
+        n = x.shape[0]
+        xf = x.astype(jnp.float32).reshape((n, num_groups, -1))
+        mu = xf.mean(axis=2, keepdims=True)
+        var = jnp.square(xf - mu).mean(axis=2, keepdims=True)
+        yf = (xf - mu) * lax.rsqrt(var + eps)
+        # affine is PER GROUP, matching the reference's (num_groups,)
+        # gamma/beta (REF:src/operator/nn/group_norm.cc)
+        yf = yf * g.reshape((1, -1, 1)).astype(jnp.float32) + \
+            b.reshape((1, -1, 1)).astype(jnp.float32)
+        return yf.reshape(x.shape).astype(x.dtype)
+
+    return _apply(f, [data, gamma, beta], "GroupNorm")
+
+
 def L2Normalization(data, eps=1e-10, mode="instance", **kw):
     def f(x):
         if mode == "channel":

@@ -279,14 +279,15 @@ def attention(q, k, v, mesh=None, causal=False, valid_length=None,
     when not.  valid_length (B,) masks padded keys; dropout is
     attention-prob dropout (pass a key only in training mode); bias is an
     additive (B|1, H|1, Tq, Tk) attention bias (ALiBi, relative pos)."""
+    if sp_strategy is not None and sp_strategy not in ("ring", "ulysses"):
+        # validate on EVERY call, not just sp>1 meshes — a typo must not
+        # silently select the local path
+        raise ValueError(
+            f"unknown sp_strategy {sp_strategy!r}; use 'ring' or "
+            "'ulysses'")
     if mesh is not None and "sp" in mesh.axis_names and \
             mesh.shape["sp"] > 1:
         from .ulysses import get_sp_strategy, ulysses_attention
-        if sp_strategy is not None and sp_strategy not in ("ring",
-                                                           "ulysses"):
-            raise ValueError(
-                f"unknown sp_strategy {sp_strategy!r}; use 'ring' or "
-                "'ulysses'")
         strategy = sp_strategy or get_sp_strategy()
         # ulysses preconditions: heads divide sp, and no REAL head-axis
         # sharding (size-1 tp is fine) — otherwise quiet ring fallback
