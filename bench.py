@@ -103,9 +103,12 @@ def _run_timed(step_fn, fetch_loss, warmup, iters, repeats, unit_count, tag):
 
 
 def _is_oom(e):
+    # explicit allocation-failure phrases only: a bare "hbm" mention (e.g.
+    # a bandwidth note inside some other error) must NOT trigger the
+    # silent batch fallback
     s = f"{type(e).__name__}: {e}".lower()
-    return ("hbm" in s or "out of memory" in s or "resource_exhausted" in s
-            or "exceeded" in s and "capacity" in s)
+    return ("ran out of memory" in s or "out of memory" in s
+            or "resource_exhausted" in s or "exceeded hbm capacity" in s)
 
 
 def _batch_ladder(env_var, ladder):

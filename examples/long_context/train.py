@@ -35,6 +35,10 @@ def main():
     ap.add_argument("--vocab", type=int, default=32)
     ap.add_argument("--lr", type=float, default=1e-2)
     ap.add_argument("--mesh", default="dp=2,sp=4")
+    ap.add_argument("--sp-strategy", choices=["ring", "ulysses"],
+                    default="ring",
+                    help="sequence-parallel attention strategy (ulysses "
+                         "needs heads %% sp == 0)")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
     if args.smoke:
@@ -58,8 +62,11 @@ def main():
 
     import jax.numpy as jnp
     import numpy as np
-    from tpu_mx.parallel import P, attention, make_mesh
+    from tpu_mx.parallel import (P, attention, make_mesh,
+                                 set_sp_strategy)
     from tpu_mx.parallel.ring_attention import dispatch_counts
+
+    set_sp_strategy(args.sp_strategy)
 
     mesh = make_mesh(axes, devices=jax.devices()[:n_dev])
     B, T, U, H, V = (args.batch_size, args.seq_len, args.units, args.heads,
@@ -127,8 +134,10 @@ def main():
         losses.append(float(l))
     toks = args.steps * B * T / (time.time() - tic)
     print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f}  ({toks:.0f} tok/s)  "
-          f"ring_dispatches={dispatch_counts['ring']}")
-    assert dispatch_counts["ring"] > 0, "ring attention path did not engage"
+          f"{args.sp_strategy}_dispatches="
+          f"{dispatch_counts[args.sp_strategy]}")
+    assert dispatch_counts[args.sp_strategy] > 0, \
+        f"{args.sp_strategy} attention path did not engage"
     if args.smoke:
         # the tuned smoke config must learn decisively; arbitrary user
         # configs (longer T, larger distance) legitimately need more steps

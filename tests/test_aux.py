@@ -168,3 +168,22 @@ def test_engine_push_async_hook():
     assert engine.push_async(external, [a], [out]) == "ok"
     np.testing.assert_allclose(out.asnumpy(), [3.0, 6.0])
     assert engine.push_sync is engine.push_async
+
+
+def test_persistent_compilation_cache(tmp_path):
+    """runtime.set_compilation_cache writes program artifacts that a fresh
+    process would reuse (cache dir gains entries after a novel compile)."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx import runtime
+    d = tmp_path / "xla_cache"
+    runtime.set_compilation_cache(str(d), min_compile_time_secs=0.0)
+    try:
+        @jax.jit
+        def f(x):
+            return (x @ x.T).sum() + 12345.678  # novel constant -> novel key
+        f(jnp.ones((64, 64))).block_until_ready()
+        entries = list(d.rglob("*")) if d.exists() else []
+        assert entries, "no cache entries written"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)

@@ -269,3 +269,96 @@ def test_libsvm_sparse_labels(tmp_path):
     np.testing.assert_array_equal(
         b.label[0].asnumpy(),
         np.array([[1.0, 0.0, 5.0], [0.0, 3.0, 0.0]], np.float32))
+
+
+def test_native_im2rec_roundtrip(tmp_path):
+    """The C++ packer's .rec/.idx must read back through the PYTHON
+    recordio reader with intact headers/labels/ids and decodable images
+    (format interchangeability with tools/im2rec.py, REF:tools/im2rec.cc)."""
+    import cv2
+    from tpu_mx import recordio
+    from tpu_mx.lib.recordio_cpp import native_im2rec
+
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(6):
+        img = (rng.rand(40 + i, 60, 3) * 255).astype(np.uint8)
+        cv2.imwrite(str(imgdir / f"im{i}.jpg"),
+                    img, [cv2.IMWRITE_JPEG_QUALITY, 95])
+        # multi-label rows for i >= 3
+        labels = [float(i)] if i < 3 else [float(i), float(i) * 0.5]
+        lines.append("\t".join([str(i)] + [f"{v}" for v in labels]
+                               + [f"im{i}.jpg"]))
+    lst = tmp_path / "d.lst"
+    lst.write_text("\n".join(lines) + "\n")
+
+    n = native_im2rec(str(lst), str(imgdir), str(tmp_path / "d"),
+                      resize=32, quality=90, num_thread=3)
+    assert n == 6
+    idx_lines = (tmp_path / "d.idx").read_text().strip().splitlines()
+    assert len(idx_lines) == 6 and idx_lines[0].split("\t")[1] == "0"
+
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                     str(tmp_path / "d.rec"), "r")
+    for i in range(6):
+        header, img_bytes = recordio.unpack(rec.read_idx(i))
+        assert header.id == i
+        if i < 3:
+            assert header.flag == 0 and abs(header.label - i) < 1e-6
+        else:
+            assert header.flag == 2
+            np.testing.assert_allclose(header.label, [i, i * 0.5])
+        arr = cv2.imdecode(np.frombuffer(img_bytes, np.uint8),
+                           cv2.IMREAD_COLOR)
+        assert arr is not None and min(arr.shape[:2]) == 32  # shorter side
+
+    # and the native PIPE must accept the native-packed file too
+    from tpu_mx.lib.recordio_cpp import NativeImagePipe
+    pipe = NativeImagePipe(str(tmp_path / "d.rec"), batch_size=2,
+                           data_shape=(3, 24, 24), resize=24,
+                           preprocess_threads=2)
+    data, label = pipe.next_batch()
+    assert data.shape == (2, 3, 24, 24)
+
+
+def test_native_im2rec_skips_bad_and_matches_upscale_semantics(tmp_path):
+    """Missing files and non-JPEGs are SKIPPED (not fatal, matching the
+    Python packer), and small images are stored unresized without
+    upscale=True."""
+    import cv2
+    from tpu_mx import recordio
+    from tpu_mx.lib.recordio_cpp import native_im2rec
+
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    rng = np.random.RandomState(0)
+    small = (rng.rand(20, 30, 3) * 255).astype(np.uint8)
+    cv2.imwrite(str(imgdir / "small.jpg"), small)
+    big = (rng.rand(100, 120, 3) * 255).astype(np.uint8)
+    cv2.imwrite(str(imgdir / "big.jpg"), big)
+    (imgdir / "fake.png").write_bytes(b"\x89PNG\r\n not a jpeg")
+    lst = tmp_path / "d.lst"
+    lst.write_text("0\t0.0\tsmall.jpg\n"
+                   "1\t1.0\tmissing.jpg\n"
+                   "2\t2.0\tfake.png\n"
+                   "3\t3.0\tbig.jpg\n")
+    n = native_im2rec(str(lst), str(imgdir), str(tmp_path / "d"), resize=64)
+    assert n == 2  # small + big packed; missing + png skipped
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                     str(tmp_path / "d.rec"), "r")
+    h0, img0 = recordio.unpack(rec.read_idx(0))
+    a0 = cv2.imdecode(np.frombuffer(img0, np.uint8), cv2.IMREAD_COLOR)
+    assert a0.shape[:2] == (20, 30)  # NOT upscaled to 64
+    h3, img3 = recordio.unpack(rec.read_idx(3))
+    a3 = cv2.imdecode(np.frombuffer(img3, np.uint8), cv2.IMREAD_COLOR)
+    assert min(a3.shape[:2]) == 64   # downscaled
+    # upscale=True does enlarge
+    n = native_im2rec(str(lst), str(imgdir), str(tmp_path / "u"), resize=64,
+                      upscale=True)
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "u.idx"),
+                                     str(tmp_path / "u.rec"), "r")
+    hu, imgu = recordio.unpack(rec.read_idx(0))
+    au = cv2.imdecode(np.frombuffer(imgu, np.uint8), cv2.IMREAD_COLOR)
+    assert min(au.shape[:2]) == 64

@@ -97,6 +97,11 @@ def main():
     ap.add_argument("root", help="image root directory")
     ap.add_argument("--list", action="store_true",
                     help="make the .lst file instead of packing")
+    ap.add_argument("--native", action="store_true",
+                    help="pack with the parallel C++ packer "
+                         "(native/tpumx_io.cpp tmx_im2rec; same output "
+                         "bytes as the Python path)")
+    ap.add_argument("--num-thread", type=int, default=4)
     ap.add_argument("--resize", type=int, default=0,
                     help="resize shorter side to this many pixels")
     ap.add_argument("--upscale", action="store_true")
@@ -108,7 +113,19 @@ def main():
     if args.list:
         make_list(args.prefix, args.root, args)
     else:
-        pack(args.prefix, args.root, args)
+        if args.native:
+            from tpu_mx.lib.recordio_cpp import native_im2rec
+            if getattr(args, "encoding", ".jpg") not in (None, ".jpg"):
+                print("warning: --native packs JPEG only; --encoding "
+                      "ignored", file=sys.stderr)
+            n = native_im2rec(args.prefix + ".lst", args.root, args.prefix,
+                              resize=args.resize or 0,
+                              quality=args.quality,
+                              num_thread=args.num_thread,
+                              upscale=getattr(args, "upscale", False))
+            print(f"packed {n} records (native)")
+        else:
+            pack(args.prefix, args.root, args)
 
 
 if __name__ == "__main__":

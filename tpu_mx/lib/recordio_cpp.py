@@ -13,7 +13,7 @@ import numpy as np
 
 from . import ensure_built
 
-__all__ = ["NativeImagePipe"]
+__all__ = ["NativeImagePipe", "native_im2rec"]
 
 _lib = None
 
@@ -104,3 +104,29 @@ class NativeImagePipe:
             self.close()
         except Exception:
             pass
+
+
+def native_im2rec(lst_path, root, out_prefix, resize=0, quality=95,
+                  num_thread=4, upscale=False):
+    """Parallel C++ dataset packer (native/tpumx_io.cpp tmx_im2rec, the
+    REF:tools/im2rec.cc analog): .lst -> out_prefix.rec/.idx, byte-format-
+    compatible with tools/im2rec.py and every reader here.  resize=0
+    stores original bytes; resize>0 re-encodes with the shorter side at
+    `resize` (decode→bilinear→libjpeg at `quality`; downscale-only unless
+    upscale=True, matching pack()).  JPEG inputs only; unreadable records
+    are skipped with a stderr note.  Returns the record count."""
+    _load()
+    if not hasattr(_lib, "_im2rec_ready"):
+        _lib.tmx_im2rec.restype = ctypes.c_long
+        _lib.tmx_im2rec.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
+        _lib._im2rec_ready = True
+    err = ctypes.create_string_buffer(1024)
+    n = _lib.tmx_im2rec(str(lst_path).encode(), str(root).encode(),
+                        str(out_prefix).encode(), int(resize), int(quality),
+                        int(num_thread), int(bool(upscale)), err, len(err))
+    if n < 0:
+        raise RuntimeError(f"native im2rec failed: {err.value.decode()}")
+    return int(n)

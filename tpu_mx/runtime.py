@@ -85,3 +85,26 @@ class Features(dict):
 def feature_list():
     """Check the library for compile-time/runtime features it supports."""
     return list(Features().values())
+
+
+def set_compilation_cache(directory, min_compile_time_secs=1.0):
+    """Enable XLA's persistent compilation cache (REF analog: the
+    reference's CachedOp graphs lived in-process only; on TPU the first
+    compile of a big train step costs tens of seconds, and this cache
+    carries it across PROCESSES/restarts — essential for the die-and-
+    restart elastic contract in tpu_mx.elastic).
+
+    directory: cache dir (created if missing).  Programs whose compile
+    took less than min_compile_time_secs are not cached (they would only
+    add disk churn)."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", str(directory))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_secs))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def clear_compilation_cache():
+    """Drop the in-memory jit cache (the persistent dir is untouched)."""
+    import jax
+    jax.clear_caches()
