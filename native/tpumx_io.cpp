@@ -140,7 +140,8 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 }
 
 bool DecodeJpeg(const uint8_t* buf, size_t len, std::vector<uint8_t>* rgb,
-                int* h, int* w, int min_short_side) {
+                int* h, int* w, int min_short_side,
+                int* orig_h = nullptr, int* orig_w = nullptr) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
@@ -153,6 +154,8 @@ bool DecodeJpeg(const uint8_t* buf, size_t len, std::vector<uint8_t>* rgb,
   jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
   jpeg_read_header(&cinfo, TRUE);
   cinfo.out_color_space = JCS_RGB;
+  if (orig_h) *orig_h = cinfo.image_height;
+  if (orig_w) *orig_w = cinfo.image_width;
   // DCT-domain downscale: decode at 1/2^k when the target short side
   // allows — decode cost drops ~4x per halving (the reference gets this
   // from OpenCV's IMREAD_REDUCED path; ImageRecordIOParser2 decodes full)
@@ -574,22 +577,20 @@ void PackOne(const std::string& root, int resize, int quality, int upscale,
     res->err = "not a JPEG (use the Python packer for png/bmp): " + full;
     return;
   }
-  int short_side = 0;
-  if (resize > 0) {
-    // peek dims cheaply via a header-only decode? full decode is needed
-    // anyway for re-encode; decide after decode
-  }
   if (resize <= 0) {  // store original bytes untouched
     BuildPayload(job, std::move(bytes), res);
     return;
   }
   std::vector<uint8_t> rgb;
-  int h = 0, w = 0;
-  if (!DecodeJpeg(bytes.data(), bytes.size(), &rgb, &h, &w, resize)) {
+  int h = 0, w = 0, oh = 0, ow = 0;
+  if (!DecodeJpeg(bytes.data(), bytes.size(), &rgb, &h, &w, resize,
+                  &oh, &ow)) {
     res->err = "jpeg decode failed: " + full;
     return;
   }
-  short_side = h < w ? h : w;
+  // downscale-only decision on the ORIGINAL dimensions (DecodeJpeg may
+  // have DCT-downscaled the working copy already)
+  int short_side = oh < ow ? oh : ow;
   if (short_side <= resize && !upscale) {
     // Python pack() semantics: only downscale unless --upscale
     BuildPayload(job, std::move(bytes), res);
@@ -656,6 +657,18 @@ long tmx_im2rec(const char* lst_path, const char* root,
   fclose(lst);
   if (jobs.empty()) return fail("empty .lst");
 
+  // open outputs BEFORE spawning workers: an early return with joinable
+  // threads alive would std::terminate the process
+  std::string rec_path = std::string(out_prefix) + ".rec";
+  std::string idx_path = std::string(out_prefix) + ".idx";
+  FILE* rec = fopen(rec_path.c_str(), "wb");
+  if (!rec) return fail("cannot write " + rec_path);
+  FILE* idx = fopen(idx_path.c_str(), "w");
+  if (!idx) {
+    fclose(rec);
+    return fail("cannot write " + idx_path);
+  }
+
   const size_t window = 256;  // max in-flight encoded payloads
   std::vector<PackResult> results(jobs.size());
   std::vector<uint8_t> done(jobs.size(), 0);
@@ -686,17 +699,9 @@ long tmx_im2rec(const char* lst_path, const char* root,
     });
   }
 
-  std::string rec_path = std::string(out_prefix) + ".rec";
-  std::string idx_path = std::string(out_prefix) + ".idx";
-  FILE* rec = fopen(rec_path.c_str(), "wb");
-  if (!rec) return fail("cannot write " + rec_path);
-  FILE* idx = fopen(idx_path.c_str(), "w");
-  if (!idx) {
-    fclose(rec);
-    return fail("cannot write " + idx_path);
-  }
   uint64_t off = 0;
   long written = 0;
+  std::string io_err;
   for (size_t i = 0; i < jobs.size(); ++i) {
     {
       std::unique_lock<std::mutex> lk(mu);
@@ -716,22 +721,30 @@ long tmx_im2rec(const char* lst_path, const char* root,
     }
     const auto& p = r.payload;
     uint32_t head[2] = {kMagic, static_cast<uint32_t>(p.size())};
-    fwrite(head, 4, 2, rec);
-    fwrite(p.data(), 1, p.size(), rec);
     uint32_t pad = (4 - (p.size() & 3u)) & 3u;
     uint32_t zero = 0;
-    if (pad) fwrite(&zero, 1, pad, rec);
-    fprintf(idx, "%llu\t%llu\n",
-            static_cast<unsigned long long>(jobs[i].id),
-            static_cast<unsigned long long>(off));
+    if (fwrite(head, 4, 2, rec) != 2 ||
+        fwrite(p.data(), 1, p.size(), rec) != p.size() ||
+        (pad && fwrite(&zero, 1, pad, rec) != pad) ||
+        fprintf(idx, "%llu\t%llu\n",
+                static_cast<unsigned long long>(jobs[i].id),
+                static_cast<unsigned long long>(off)) < 0) {
+      io_err = "write failed (disk full?) at record " +
+               std::to_string(i);
+      // drain remaining results so workers can finish, then bail
+      write_pos = jobs.size();
+      cv_room.notify_all();
+      break;
+    }
     off += 8 + p.size() + pad;
     ++written;
     // free the written payload promptly (the memory bound is the point)
     std::vector<uint8_t>().swap(r.payload);
   }
   for (auto& w : workers) w.join();
-  fclose(rec);
-  fclose(idx);
+  bool close_ok = (fclose(rec) == 0) & (fclose(idx) == 0);
+  if (!io_err.empty()) return fail(io_err);
+  if (!close_ok) return fail("close failed (disk full?)");
   return written;
 }
 

@@ -362,3 +362,26 @@ def test_native_im2rec_skips_bad_and_matches_upscale_semantics(tmp_path):
     hu, imgu = recordio.unpack(rec.read_idx(0))
     au = cv2.imdecode(np.frombuffer(imgu, np.uint8), cv2.IMREAD_COLOR)
     assert min(au.shape[:2]) == 64
+
+
+def test_native_im2rec_dct_downscale_still_resizes(tmp_path):
+    """An image whose short side is an exact power-of-two multiple of the
+    target (128 -> 64) must STILL be written at short side 64: the
+    downscale-only decision uses original dims, not the DCT-downscaled
+    decode dims."""
+    import cv2
+    from tpu_mx import recordio
+    from tpu_mx.lib.recordio_cpp import native_im2rec
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    img = (np.random.RandomState(0).rand(128, 192, 3) * 255).astype(np.uint8)
+    cv2.imwrite(str(imgdir / "a.jpg"), img)
+    (tmp_path / "d.lst").write_text("0\t1.0\ta.jpg\n")
+    n = native_im2rec(str(tmp_path / "d.lst"), str(imgdir),
+                      str(tmp_path / "d"), resize=64)
+    assert n == 1
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                     str(tmp_path / "d.rec"), "r")
+    _h, img_bytes = recordio.unpack(rec.read_idx(0))
+    a = cv2.imdecode(np.frombuffer(img_bytes, np.uint8), cv2.IMREAD_COLOR)
+    assert min(a.shape[:2]) == 64, a.shape

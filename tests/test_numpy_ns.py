@@ -342,3 +342,41 @@ def test_attention_sp_strategy_typo_raises():
     q = jnp.ones((1, 8, 32, 4), jnp.float32)
     with pytest.raises(ValueError, match="sp_strategy"):
         attention(q, q, q, mesh=mesh, sp_strategy="ulyses")
+
+
+def test_np_random_samplers_distribution_means():
+    """Round-3 sampler widening: each new distribution's sample mean lands
+    near its analytic mean (seeded, n=4000)."""
+    import tpu_mx.numpy.random as R
+    mx.random.seed(0)
+    cases = [
+        (lambda: R.poisson(4.0, size=(4000,)), 4.0),
+        (lambda: R.binomial(10, 0.3, size=(4000,)), 3.0),
+        (lambda: R.chisquare(3.0, size=(4000,)), 3.0),
+        (lambda: R.geometric(0.35, size=(4000,)), 1 / 0.35),
+        (lambda: R.gumbel(1.0, 2.0, size=(4000,)), 1.0 + 2.0 * 0.5772),
+        (lambda: R.laplace(2.0, 1.0, size=(4000,)), 2.0),
+        (lambda: R.logistic(3.0, 1.0, size=(4000,)), 3.0),
+        (lambda: R.lognormal(0.0, 0.5, size=(4000,)), float(onp.exp(0.125))),
+        (lambda: R.pareto(3.0, size=(4000,)), 0.5),
+        (lambda: R.power(2.0, size=(4000,)), 2 / 3),
+        (lambda: R.rayleigh(2.0, size=(4000,)),
+         2.0 * float(onp.sqrt(onp.pi / 2))),
+        (lambda: R.weibull(2.0, size=(4000,)), 0.8862),
+    ]
+    for fn, mean in cases:
+        a = fn().asnumpy().astype(onp.float64)
+        assert abs(a.mean() - mean) < 0.35 * max(1.0, abs(mean)), \
+            (fn, a.mean(), mean)
+
+
+def test_np_linalg_eig_and_cond():
+    m = np.array([[2.0, 1.0], [0.0, 3.0]])
+    w = np.linalg.eigvals(m)
+    onp.testing.assert_allclose(sorted(onp.real(w.asnumpy())), [2.0, 3.0],
+                                atol=1e-5)
+    w2, v = np.linalg.eig(np.array([[4.0, 0.0], [0.0, 9.0]]))
+    onp.testing.assert_allclose(sorted(onp.real(w2.asnumpy())), [4.0, 9.0],
+                                atol=1e-5)
+    c = np.linalg.cond(np.array([[2.0, 0.0], [0.0, 3.0]]))
+    onp.testing.assert_allclose(float(c.asnumpy()), 1.5, rtol=1e-5)

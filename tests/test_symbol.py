@@ -189,3 +189,28 @@ def test_regression_outputs():
     ex.backward()
     np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), (x - t) / 4,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_print_summary_and_plot_network(capsys):
+    """mx.viz.print_summary (REF:python/mxnet/visualization.py): layer
+    table with shapes + param totals; plot_network raises a clear pointer
+    without graphviz."""
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    out = mx.sym.softmax(fc2, name="softmax")
+    total = mx.viz.print_summary(out, shape={"data": (2, 8)})
+    captured = capsys.readouterr().out
+    assert "fc1" in captured and "fc2" in captured
+    # fc1: 16*8 + 16; fc2: 4*16 + 4
+    assert total == 16 * 8 + 16 + 4 * 16 + 4
+    assert f"Total params: {total}" in captured
+    try:
+        import graphviz  # noqa: F401
+        has_gv = True
+    except ImportError:
+        has_gv = False
+    if not has_gv:
+        with pytest.raises(mx.MXNetError, match="print_summary"):
+            mx.viz.plot_network(out)

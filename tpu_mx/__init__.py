@@ -33,6 +33,8 @@ from . import gluon
 from . import kvstore
 from . import kvstore as kv
 from . import module
+from . import visualization
+from . import visualization as viz
 from . import model
 from . import callback
 from . import numpy as np

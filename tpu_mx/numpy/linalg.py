@@ -18,7 +18,10 @@ def _wrap(name):
     return op
 
 
-_WRAPPED = ["cholesky", "det", "eigh", "eigvalsh", "inv", "lstsq",
+# NB: eig/eigvals are CPU-only in XLA (nonsymmetric eigendecomposition);
+# on a TPU runtime they raise jax's backend error - DIVERGENCES.md #18
+_WRAPPED = ["cholesky", "cond", "det", "eig", "eigh", "eigvals",
+            "eigvalsh", "inv", "lstsq",
             "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv",
             "qr", "slogdet", "solve", "svd", "tensorinv", "tensorsolve"]
 for _name in _WRAPPED:

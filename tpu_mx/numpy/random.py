@@ -12,7 +12,8 @@ from ..ndarray import NDArray
 
 __all__ = ["uniform", "normal", "randn", "rand", "randint", "choice",
            "shuffle", "permutation", "multinomial", "beta", "gamma",
-           "exponential", "seed"]
+           "exponential", "seed",
+           "poisson", "binomial", "chisquare", "geometric", "gumbel", "laplace", "logistic", "lognormal", "pareto", "power", "rayleigh", "weibull"]
 
 
 def seed(s):
@@ -108,3 +109,83 @@ def exponential(scale=1.0, size=None, dtype=None, ctx=None):
     key = _random.take_key()
     return NDArray(jax.random.exponential(
         key, _shape(size), dtype or _jnp.float32) * scale)
+
+
+# ---------------------------------------------------------------------------
+# round-3 widening: the remaining heavily-used numpy.random samplers
+# (REF:src/operator/random/sampler.h families).  Each draw consumes one key
+# from the framework stream (seeded by mx.random.seed), so results are
+# reproducible and trace-safe like the rest of this module.
+# ---------------------------------------------------------------------------
+
+def poisson(lam=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.poisson(key, lam, _shape(size)).astype(
+        dtype or _jnp.int32))
+
+
+def binomial(n, p, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.binomial(key, n, p, _shape(size)).astype(
+        dtype or _jnp.int32))
+
+
+def chisquare(df, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.chisquare(key, df, _shape(size),
+                                        dtype or _jnp.float32))
+
+
+def geometric(p, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.geometric(key, p, _shape(size)).astype(
+        dtype or _jnp.int32))
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(loc + scale * jax.random.gumbel(
+        key, _shape(size), dtype or _jnp.float32))
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(loc + scale * jax.random.laplace(
+        key, _shape(size), dtype or _jnp.float32))
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(loc + scale * jax.random.logistic(
+        key, _shape(size), dtype or _jnp.float32))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(_jnp.exp(mean + sigma * jax.random.normal(
+        key, _shape(size), dtype or _jnp.float32)))
+
+
+def pareto(a, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.pareto(key, a, _shape(size),
+                                     dtype or _jnp.float32) - 1.0)
+
+
+def power(a, size=None, dtype=None, ctx=None):
+    # X = U^(1/a): numpy's power distribution
+    key = _random.take_key()
+    u = jax.random.uniform(key, _shape(size), dtype or _jnp.float32)
+    return NDArray(u ** (1.0 / a))
+
+
+def rayleigh(scale=1.0, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.rayleigh(key, scale, _shape(size),
+                                       dtype or _jnp.float32))
+
+
+def weibull(a, size=None, dtype=None, ctx=None):
+    key = _random.take_key()
+    return NDArray(jax.random.weibull_min(key, 1.0, a, _shape(size),
+                                          dtype or _jnp.float32))

@@ -1,0 +1,110 @@
+"""mx.viz — network visualization (REF:python/mxnet/visualization.py:
+print_summary + plot_network).
+
+`print_summary` walks the Symbol DAG in topological order and prints the
+reference's table: layer name, op, output shape (via `infer_shape_partial`
+on the provided input shapes), parameter count per layer and totals.
+`plot_network` emits a graphviz Digraph when the `graphviz` package is
+present and raises a clear pointer otherwise (this image ships without
+it — the textual summary is the supported path).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .symbol.symbol import _topo
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_output_shapes(sym, shape_kwargs):
+    """name -> output shape for every internal output, best-effort."""
+    internals = sym.get_internals()
+    try:
+        _, out_shapes, _ = internals.infer_shape_partial(**shape_kwargs)
+    except Exception:
+        out_shapes = None
+    if out_shapes is None:  # partial inference gave up entirely
+        return {}
+    shapes = {}
+    for s, shp in zip(internals, out_shapes):
+        if shp is not None:
+            shapes.setdefault(s.name, tuple(int(v) for v in shp))
+    return shapes
+
+
+def print_summary(symbol, shape=None, line_length=98, positions=None):
+    """Print the layer table (REF visualization.py:print_summary).
+
+    shape: dict of input name -> shape, e.g. {"data": (1, 3, 224, 224)} —
+    needed for output shapes and parameter counts; without it the topology
+    still prints with blanks.  Returns the total parameter count."""
+    shape = shape or {}
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    cols = [int(line_length * p) for p in positions]
+    shapes = _node_output_shapes(symbol, shape) if shape else {}
+    # param shapes via full inference on the arguments
+    arg_shapes = {}
+    if shape:
+        try:
+            a_shapes, _, aux_shapes = symbol.infer_shape_partial(**shape)
+            arg_shapes = dict(zip(symbol.list_arguments(), a_shapes))
+            arg_shapes.update(zip(symbol.list_auxiliary_states(), aux_shapes))
+        except Exception:
+            pass
+
+    def row(fields):
+        line = ""
+        for text, stop in zip(fields, cols):
+            line = (line + str(text))[:stop].ljust(stop)
+        print(line)
+
+    print("=" * line_length)
+    row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
+    print("=" * line_length)
+    total = 0
+    inputs_of = {}
+    order = _topo(symbol._entries)
+    for n in order:
+        if n.is_variable():
+            continue
+        prev = ",".join(c.name for c, _ in n.inputs if not c.is_variable())
+        params = 0
+        for (child, _i) in n.inputs:
+            if child.is_variable() and child.name in arg_shapes and \
+                    child.name not in shape:
+                shp = arg_shapes[child.name]
+                if shp:
+                    params += int(_np.prod(shp))
+        total += params
+        out = shapes.get(n.name, "")
+        row([f"{n.name} ({n.op})", out, params, prev])
+        inputs_of[n.name] = prev
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", shape=None, node_attrs=None,
+                 save_format="pdf"):
+    """Graphviz rendering of the Symbol DAG (REF visualization.py:
+    plot_network).  Requires the optional `graphviz` package; this
+    environment does not ship it, so the error points to print_summary."""
+    try:
+        import graphviz
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network needs the 'graphviz' package, which is not "
+            "installed in this environment; use "
+            "tpu_mx.viz.print_summary(sym, shape=...) for the textual "
+            "summary") from e
+    dot = graphviz.Digraph(name=title, format=save_format)
+    node_attrs = node_attrs or {"shape": "box", "fontsize": "10"}
+    for n in _topo(symbol._entries):
+        label = n.name if n.is_variable() else f"{n.name}\n{n.op}"
+        dot.node(n.name, label=label, **node_attrs)
+        for child, _ in n.inputs:
+            dot.edge(child.name, n.name)
+    return dot
