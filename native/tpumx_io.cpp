@@ -675,6 +675,7 @@ long tmx_im2rec(const char* lst_path, const char* root,
   std::mutex mu;
   std::condition_variable cv_done, cv_room;
   size_t write_pos = 0;
+  std::atomic<bool> abort_flag{false};
   std::atomic<size_t> next{0};
   int nw = nthreads > 0 ? nthreads : 4;
   std::vector<std::thread> workers;
@@ -687,7 +688,15 @@ long tmx_im2rec(const char* lst_path, const char* root,
         {
           // bound memory: don't run ahead of the writer by > window
           std::unique_lock<std::mutex> lk(mu);
-          cv_room.wait(lk, [&] { return i < write_pos + window; });
+          cv_room.wait(lk, [&] {
+            return abort_flag.load() || i < write_pos + window;
+          });
+        }
+        if (abort_flag.load()) {  // writer died: stop burning CPU
+          std::lock_guard<std::mutex> lk(mu);
+          done[i] = 1;
+          cv_done.notify_all();
+          continue;
         }
         PackOne(root_s, resize, quality, upscale, jobs[i], &results[i]);
         {
@@ -731,8 +740,14 @@ long tmx_im2rec(const char* lst_path, const char* root,
                 static_cast<unsigned long long>(off)) < 0) {
       io_err = "write failed (disk full?) at record " +
                std::to_string(i);
-      // drain remaining results so workers can finish, then bail
-      write_pos = jobs.size();
+      // stop the pool: abort_flag makes workers skip remaining decodes,
+      // and the write_pos store happens under the mutex the waiters'
+      // predicate reads under (no data race)
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        abort_flag.store(true);
+        write_pos = jobs.size();
+      }
       cv_room.notify_all();
       break;
     }

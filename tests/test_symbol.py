@@ -214,3 +214,46 @@ def test_print_summary_and_plot_network(capsys):
     if not has_gv:
         with pytest.raises(mx.MXNetError, match="print_summary"):
             mx.viz.plot_network(out)
+
+
+def test_attr_scope_and_name_prefix():
+    """mx.AttrScope attaches attrs to nodes created in scope (the
+    group2ctx annotation surface); mx.name.Prefix prefixes auto names."""
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):
+        a = mx.sym.Variable("a")
+        fc = mx.sym.FullyConnected(a, num_hidden=4)
+    assert fc.attr("ctx_group") == "dev1"
+    assert fc.attr("lr_mult") == "0.1"
+    # the scope annotates VARIABLES too (the group2ctx/lr_mult pattern
+    # targets parameter variables), incl. auto-created weight/bias
+    assert a.attr("ctx_group") == "dev1"
+    attr_map = fc.attr_dict()
+    wname = [k for k in fc.list_arguments() if k.endswith("_weight")][0]
+    assert attr_map.get(wname, {}).get("ctx_group") == "dev1"
+    # nesting: inner wins
+    with mx.AttrScope(ctx_group="dev1"):
+        with mx.AttrScope(ctx_group="dev2"):
+            fc2 = mx.sym.FullyConnected(mx.sym.Variable("b"), num_hidden=2)
+    assert fc2.attr("ctx_group") == "dev2"
+    # outside scope: no attrs
+    fc3 = mx.sym.FullyConnected(mx.sym.Variable("c"), num_hidden=2)
+    assert fc3.attr("ctx_group") is None
+
+    with mx.name.Prefix("stage1_"):
+        s = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
+    assert s.name.startswith("stage1_activation")
+
+
+def test_util_np_scope():
+    import tpu_mx.util as util
+    assert not util.is_np_array()
+    with util.np_array():
+        assert util.is_np_array()
+    assert not util.is_np_array()
+
+    @util.use_np
+    def inner():
+        return util.is_np_array()
+    assert inner() is True
+    assert mx.lr_scheduler is not None and hasattr(mx.lr_scheduler,
+                                                   "FactorScheduler")

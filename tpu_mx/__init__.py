@@ -32,6 +32,13 @@ from . import metric
 from . import gluon
 from . import kvstore
 from . import kvstore as kv
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from . import util
+from .optimizer import lr_scheduler
+from . import executor
+from . import libinfo
 from . import module
 from . import visualization
 from . import visualization as viz

@@ -1,0 +1,45 @@
+"""mx.attribute — AttrScope (REF:python/mxnet/attribute.py).
+
+`with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):` attaches the given
+attributes to every Symbol node created inside the scope — the mechanism
+behind the reference's `group2ctx` manual model parallelism (the TPU
+analog consumes `__ctx_group__` via sharding rules instead of device
+copies, but the annotation surface is identical).  Scopes nest; inner
+values win."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def current_attrs():
+    """Merged attribute dict of the active scopes (inner wins)."""
+    merged = {}
+    for frame in _stack():
+        merged.update(frame)
+    return merged
+
+
+class AttrScope:
+    def __init__(self, **attrs):
+        # the reference stores every attr value as a string and prefixes
+        # user keys with __...__ at consumption time; keep values as given
+        # but stringify for .attr() parity
+        self._attrs = {k: str(v) for k, v in attrs.items()}
+
+    def __enter__(self):
+        _stack().append(self._attrs)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False

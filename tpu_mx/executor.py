@@ -1,0 +1,6 @@
+"""mx.executor (REF:python/mxnet/executor.py): re-export of the Executor
+that `Symbol.bind`/`simple_bind` return — kept as its own module for
+reference import-path parity (`from mxnet.executor import Executor`)."""
+from .symbol.symbol import Executor
+
+__all__ = ["Executor"]

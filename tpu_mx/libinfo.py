@@ -1,0 +1,9 @@
+"""mx.libinfo (REF:src/libinfo.cc features surface): thin alias over
+tpu_mx.runtime's live-probed feature list."""
+from .runtime import Features, feature_list
+
+__version__ = "1.0.0-tpu"
+
+__all__ = ["Features", "feature_list", "features", "__version__"]
+
+features = feature_list()

@@ -1,0 +1,69 @@
+"""mx.util (REF:python/mxnet/util.py): numpy-semantics toggles and the
+small decorator helpers reference code imports from here."""
+from __future__ import annotations
+
+import functools
+
+from . import npx as _npx
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "use_np",
+           "use_np_array", "use_np_shape", "np_array", "np_shape",
+           "getenv", "setenv"]
+
+is_np_array = _npx.is_np_array
+is_np_shape = _npx.is_np_shape
+set_np = _npx.set_np
+reset_np = _npx.reset_np
+
+
+class _NpScope:
+    """Context manager/decorator flipping the np flags (REF util.py
+    np_shape/np_array): the unified NDArray already carries numpy
+    semantics (DIVERGENCES #6), so this records intent and restores."""
+
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        self._prev = _npx.is_np_array()
+        _npx.set_np(array=self._active)
+        return self
+
+    def __exit__(self, *exc):
+        _npx.set_np(array=self._prev)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with type(self)(self._active):
+                return fn(*a, **kw)
+        return wrapped
+
+
+np_array = _NpScope
+np_shape = _NpScope
+
+
+def use_np_array(fn):
+    return _NpScope(True)(fn)
+
+
+def use_np_shape(fn):
+    return _NpScope(True)(fn)
+
+
+def use_np(fn):
+    """Decorator: run fn under numpy semantics (REF util.py:use_np)."""
+    return _NpScope(True)(fn)
+
+
+def getenv(name):
+    import os
+    v = os.environ.get(name)
+    return int(v) if v is not None and v.isdigit() else v
+
+
+def setenv(name, value):
+    import os
+    os.environ[name] = str(value)

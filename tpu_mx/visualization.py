@@ -64,7 +64,6 @@ def print_summary(symbol, shape=None, line_length=98, positions=None):
     row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
     print("=" * line_length)
     total = 0
-    inputs_of = {}
     order = _topo(symbol._entries)
     for n in order:
         if n.is_variable():
@@ -80,7 +79,6 @@ def print_summary(symbol, shape=None, line_length=98, positions=None):
         total += params
         out = shapes.get(n.name, "")
         row([f"{n.name} ({n.op})", out, params, prev])
-        inputs_of[n.name] = prev
     print("=" * line_length)
     print(f"Total params: {total}")
     print("=" * line_length)
