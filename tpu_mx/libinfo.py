@@ -1,9 +1,17 @@
 """mx.libinfo (REF:src/libinfo.cc features surface): thin alias over
-tpu_mx.runtime's live-probed feature list."""
+tpu_mx.runtime's live-probed feature list.  `features` is computed
+LAZILY (module __getattr__): probing touches the jax backend, which must
+not happen at import time (it would foreclose pre-init jax config like
+jax.distributed.initialize)."""
 from .runtime import Features, feature_list
-
-__version__ = "1.0.0-tpu"
 
 __all__ = ["Features", "feature_list", "features", "__version__"]
 
-features = feature_list()
+
+def __getattr__(name):
+    if name == "features":
+        return feature_list()
+    if name == "__version__":
+        from . import __version__ as v
+        return v
+    raise AttributeError(name)

@@ -122,10 +122,12 @@ class CompiledTrainStep:
         self._n_loss_args = n_loss_args
         if accum_steps < 1:
             raise ValueError("accum_steps must be >= 1")
-        if accum_steps > 1 and gradient_compression:
-            raise ValueError("accum_steps does not compose with "
-                             "gradient_compression yet (compress once per "
-                             "applied update is the right design; pick one)")
+        # accum × compression composes as compress-ONCE-per-applied-update:
+        # microbatch grads accumulate per-device (dp-sharded local buffers,
+        # no collective), and the single quantized psum happens in the
+        # apply step on the accumulated mean — one quantization error per
+        # update, exactly one compressed reduction (closes DIVERGENCES'
+        # former #12 rejection)
         self._accum = int(accum_steps)
         self._micro = 0
         self._gacc = None     # lazy f32 grad-accumulation buffers
@@ -227,7 +229,27 @@ class CompiledTrainStep:
                 return jnp.mean(l), updates
             return lfn
 
-        def compressed_grads(diff_vals, const_vals, efs, key, batch):
+        def shard_dspecs(batch):
+            return self._data_specs or tuple(P("dp")
+                                             for _ in range(len(batch)))
+
+        def shard_fwd_grads(dv, cv, key, b_local):
+            """Shared per-shard preamble of the compressed accumulate AND
+            apply programs: per-device key fold, forward+grad on the local
+            batch shard, loss/BN-updates pmean'd.  Keeping it single-copy
+            keeps the two programs numerically in lockstep (the compress-
+            once equivalence depends on it)."""
+            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            dat, lar = b_local[:-n_loss], b_local[-n_loss:]
+            (loss, updates), grads = jax.value_and_grad(
+                make_lfn(cv, key, dat, lar), has_aux=True)(dv)
+            loss = jax.lax.pmean(loss, "dp")
+            updates = {uk: jax.lax.pmean(uv, "dp")
+                       for uk, uv in updates.items()}
+            return loss, updates, grads
+
+        def compressed_grads(diff_vals, const_vals, efs, key, batch,
+                             gacc=None):
             """shard_map over dp: each device takes partial grads on its
             batch shard, quantizes them with its own error feedback, and
             the reduction is a psum of the QUANTIZED values (the EQuARX-
@@ -240,18 +262,19 @@ class CompiledTrainStep:
             ndp = mesh.shape["dp"]
             ctype = compression["type"]
             threshold = float(compression.get("threshold", 0.5))
-            dspecs = self._data_specs or tuple(
-                P("dp") for _ in range(len(batch)))
+            dspecs = shard_dspecs(batch)
 
-            def per_shard(dv, cv, efs_l, key, *b_local):
-                # decorrelate per-shard dropout/augment draws
-                key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
-                dat, lar = b_local[:-n_loss], b_local[-n_loss:]
-                (loss, updates), grads = jax.value_and_grad(
-                    make_lfn(cv, key, dat, lar), has_aux=True)(dv)
+            def per_shard(dv, cv, efs_l, gacc_l, key, *b_local):
+                loss, updates, grads = shard_fwd_grads(dv, cv, key, b_local)
                 red, new_efs = {}, {}
                 for k in diff_keys:
                     g = grads[k].astype(jnp.float32)
+                    if gacc is not None:
+                        # compress-once-per-update: fold the final
+                        # microbatch into the LOCAL accumulated mean; the
+                        # quantized psum below is the update's only
+                        # collective and only quantization
+                        g = g / K + gacc_l[k][0]
                     ef = efs_l[k][0]
                     if ctype == "2bit":
                         deq, new_ef = quantize_2bit_core(g, ef, threshold)
@@ -259,16 +282,16 @@ class CompiledTrainStep:
                         deq, new_ef = quantize_int8_core(g, ef)
                     red[k] = jax.lax.psum(deq, "dp") / ndp
                     new_efs[k] = new_ef[None]
-                loss = jax.lax.pmean(loss, "dp")
-                updates = {uk: jax.lax.pmean(uv, "dp")
-                           for uk, uv in updates.items()}
                 return loss, red, new_efs, updates
 
+            gacc_arg = gacc if gacc is not None else \
+                {k: jnp.zeros((ndp,) + (1,) * diff_vals[k].ndim,
+                              jnp.float32) for k in diff_keys}
             fn = shard_map(
                 per_shard, mesh=mesh,
-                in_specs=(P(), P(), P("dp"), P()) + tuple(dspecs),
+                in_specs=(P(), P(), P("dp"), P("dp"), P()) + tuple(dspecs),
                 out_specs=(P(), P(), P("dp"), P()), check_rep=False)
-            return fn(diff_vals, const_vals, efs, key, *batch)
+            return fn(diff_vals, const_vals, efs, gacc_arg, key, *batch)
 
         K = self._accum
 
@@ -295,7 +318,8 @@ class CompiledTrainStep:
                 const_vals = {k: v for k, v in values.items()
                               if k not in set(diff_keys)}
                 loss, grads, new_efs, updates = compressed_grads(
-                    diff_vals, const_vals, efs, key, batch)
+                    diff_vals, const_vals, efs, key, batch,
+                    gacc=gacc if K > 1 else None)
                 aux_vals = dict(values)
                 for k, v in updates.items():
                     if k in aux_vals:
@@ -303,10 +327,13 @@ class CompiledTrainStep:
             else:
                 loss, grads, aux_vals = grads_and_updates(values, key, batch)
                 new_efs = efs
-            if K > 1:
+            if K > 1 and not compression:
                 # fold the final microbatch into the accumulated mean
                 grads = {k: grads[k].astype(jnp.float32) / K + gacc[k]
                          for k in diff_keys}
+                new_gacc = {k: jnp.zeros_like(v) for k, v in gacc.items()}
+            elif K > 1:
+                # compression already folded gacc inside the shard_map
                 new_gacc = {k: jnp.zeros_like(v) for k, v in gacc.items()}
             else:
                 new_gacc = gacc
@@ -342,10 +369,44 @@ class CompiledTrainStep:
                         for k in diff_keys}
             return new_vals, new_gacc, loss
 
+        def compressed_accum_fn(values, gacc, key, *batch):
+            """Microbatch accumulate under compression: per-shard LOCAL
+            grads/K into dp-sharded (ndp, ...) buffers — NO collective and
+            NO quantization here; both happen exactly once in the apply
+            step (compress-once-per-update).  BN aux updates are pmean'd
+            and applied every microbatch as usual."""
+            from jax.experimental.shard_map import shard_map
+            diff_vals = {k: values[k] for k in diff_keys}
+            const_vals = {k: v for k, v in values.items()
+                          if k not in set(diff_keys)}
+            dspecs = shard_dspecs(batch)
+
+            def per_shard(dv, cv, gacc_l, key, *b_local):
+                loss, updates, grads = shard_fwd_grads(dv, cv, key, b_local)
+                new_gacc = {
+                    k: gacc_l[k] + grads[k].astype(jnp.float32)[None] / K
+                    for k in diff_keys}
+                return loss, new_gacc, updates
+
+            sm = shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(), P(), P("dp"), P()) + tuple(dspecs),
+                out_specs=(P(), P("dp"), P()), check_rep=False)
+            loss, new_gacc, updates = sm(diff_vals, const_vals, gacc, key,
+                                         *batch)
+            new_vals = dict(values)
+            for k, v in updates.items():
+                if k in new_vals:
+                    new_vals[k] = v.astype(new_vals[k].dtype)
+            return new_vals, new_gacc, loss
+
         def alloc_gacc(shardings=None):
             if K <= 1 or self._gacc is not None:
                 return
-            shapes = {k: self.values[k].shape for k in self._diff_keys}
+            lead = (mesh.shape["dp"],) if (compression and mesh is not None) \
+                else ()
+            shapes = {k: lead + self.values[k].shape
+                      for k in self._diff_keys}
             self._gacc = jax.jit(
                 lambda: {k: jnp.zeros(s, jnp.float32)
                          for k, s in shapes.items()},
@@ -365,7 +426,11 @@ class CompiledTrainStep:
         master_sh = {k: sharding_for(self.mesh, self._specs[k])
                      for k in self._mp_keys}
         efs_sh = {k: sharding_for(self.mesh, P("dp")) for k in self._efs}
-        gacc_sh = {k: sharding_for(self.mesh, self._specs[k])
+        # under compression the accumulation buffers are per-device LOCAL
+        # rows, dp-sharded on their leading axis (like the error feedback)
+        gacc_spec = P("dp") if compression else None
+        gacc_sh = {k: sharding_for(self.mesh,
+                                   gacc_spec or self._specs[k])
                    for k in (self._diff_keys if K > 1 else [])}
         in_sh = (self._value_shardings(), master_sh, self._state_shardings(),
                  efs_sh, gacc_sh, repl, repl, repl) + batch_sh
@@ -376,7 +441,7 @@ class CompiledTrainStep:
             donate_argnums=donate)
         if K > 1:
             self._accum_jit = jax.jit(
-                accum_fn,
+                compressed_accum_fn if compression else accum_fn,
                 in_shardings=(self._value_shardings(), gacc_sh, repl)
                 + batch_sh,
                 out_shardings=(self._value_shardings(), gacc_sh, repl),

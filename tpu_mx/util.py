@@ -34,6 +34,18 @@ class _NpScope:
         return False
 
     def __call__(self, fn):
+        import inspect
+        if inspect.isclass(fn):
+            # the reference's canonical usage is @use_np on a Block CLASS:
+            # keep it a class (subclassable, isinstance-able) and wrap the
+            # methods that execute user math
+            for meth in ("__init__", "forward", "hybrid_forward",
+                         "__call__"):
+                if meth in vars(fn):
+                    setattr(fn, meth, type(self)(self._active)(
+                        vars(fn)[meth]))
+            return fn
+
         @functools.wraps(fn)
         def wrapped(*a, **kw):
             with type(self)(self._active):
