@@ -481,3 +481,94 @@ def test_longtail_parity_ops():
         l = ops.smooth_l1(g).sum()
     l.backward()
     np.testing.assert_allclose(g.grad.asnumpy(), [0.3], rtol=1e-5)
+
+
+class TestRound3LongTail:
+    def test_activations_and_special(self):
+        x = nd.array(np.array([-2.0, 0.0, 1.5]))
+        np.testing.assert_allclose(
+            nd.log_sigmoid(x).asnumpy(),
+            np.log(1 / (1 + np.exp(-np.array([-2.0, 0.0, 1.5])))),
+            rtol=1e-5)
+        m = nd.mish(x).asnumpy()
+        xs = np.array([-2.0, 0.0, 1.5])
+        np.testing.assert_allclose(
+            m, xs * np.tanh(np.log1p(np.exp(xs))), rtol=1e-5)
+        hs = nd.hard_swish(nd.array(np.array([-4.0, 0.0, 3.0])))
+        np.testing.assert_allclose(hs.asnumpy(), [0.0, 0.0, 3.0], atol=1e-6)
+        import scipy.special as sp
+        np.testing.assert_allclose(
+            nd.digamma(nd.array(np.array([1.0, 2.5]))).asnumpy(),
+            sp.digamma([1.0, 2.5]), rtol=1e-5)
+        np.testing.assert_allclose(
+            nd.polygamma(1, nd.array(np.array([1.0, 2.0]))).asnumpy(),
+            sp.polygamma(1, [1.0, 2.0]), rtol=1e-4)
+        np.testing.assert_allclose(
+            nd.gammainc(nd.array(np.array([2.0])),
+                        nd.array(np.array([1.5]))).asnumpy(),
+            sp.gammainc(2.0, 1.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            nd.erfcinv(nd.array(np.array([0.5]))).asnumpy(),
+            sp.erfcinv(0.5), rtol=1e-5)
+
+    def test_moments_and_all_finite(self):
+        x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+        mu, var = nd.moments(x, axes=(1,))
+        np.testing.assert_allclose(mu.asnumpy(), [1.5, 5.5, 9.5])
+        np.testing.assert_allclose(var.asnumpy(), [1.25] * 3)
+        good = nd.multi_all_finite(x, nd.ones((2,)))
+        assert float(good.asnumpy()[0]) == 1.0
+        bad = nd.multi_all_finite(x, nd.array(np.array([np.inf])))
+        assert float(bad.asnumpy()[0]) == 0.0
+
+    def test_khatri_rao(self):
+        a = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]]))
+        b = nd.array(np.array([[1.0, 0.0], [0.0, 1.0], [2.0, 2.0]]))
+        out = nd.khatri_rao(a, b).asnumpy()
+        assert out.shape == (6, 2)
+        # column k = kron(a[:,k], b[:,k])
+        np.testing.assert_allclose(out[:, 0],
+                                   np.kron([1.0, 3.0], [1.0, 0.0, 2.0]))
+        np.testing.assert_allclose(out[:, 1],
+                                   np.kron([2.0, 4.0], [0.0, 1.0, 2.0]))
+
+    def test_masked_softmax(self):
+        x = nd.array(np.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]]))
+        mask = nd.array(np.array([[1, 1, 0], [0, 0, 0]], np.int32))
+        p = nd.masked_softmax(x, mask).asnumpy()
+        np.testing.assert_allclose(p[0, :2],
+                                   np.exp([1.0, 2.0]) /
+                                   np.exp([1.0, 2.0]).sum(), rtol=1e-5)
+        assert p[0, 2] == 0.0 and (p[1] == 0.0).all()
+        lp = nd.masked_log_softmax(x, mask).asnumpy()
+        np.testing.assert_allclose(np.exp(lp[0, :2]), p[0, :2], rtol=1e-5)
+
+    def test_im2col_col2im_roundtrip(self):
+        x = nd.array(np.random.RandomState(0).rand(2, 3, 6, 6)
+                     .astype(np.float32))
+        cols = nd.im2col(x, kernel=(3, 3), stride=(1, 1))
+        assert cols.shape == (2, 27, 16)
+        # col2im of im2col counts each pixel once per window covering it
+        back = nd.col2im(cols, (6, 6), kernel=(3, 3), stride=(1, 1))
+        counts = nd.col2im(nd.ones_like(cols), (6, 6), kernel=(3, 3),
+                           stride=(1, 1))
+        np.testing.assert_allclose(
+            (back / counts).asnumpy(), x.asnumpy(), rtol=1e-5)
+
+    def test_indexing_helpers_and_lrn(self):
+        l = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+        r = nd.array(np.array([0, 2, 1, 0], np.float32))
+        picked = nd.choose_element_0index(l, r).asnumpy()
+        np.testing.assert_allclose(picked, [0, 5, 7, 9])
+        filled = nd.fill_element_0index(
+            l, nd.array(np.full((4,), -1.0, np.float32)), r).asnumpy()
+        assert (filled[np.arange(4), [0, 2, 1, 0]] == -1).all()
+
+        x = nd.array(np.random.RandomState(1).rand(1, 5, 4, 4)
+                     .astype(np.float32))
+        y = nd.LRN(x, nsize=3).asnumpy()
+        # manual channel-window normalization for channel 2
+        sq = np.square(x.asnumpy())
+        acc = sq[:, 1] + sq[:, 2] + sq[:, 3]
+        ref = x.asnumpy()[:, 2] / (2.0 + 1e-4 * acc / 3) ** 0.75
+        np.testing.assert_allclose(y[:, 2], ref, rtol=1e-4)

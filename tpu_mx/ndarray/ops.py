@@ -1492,3 +1492,180 @@ def Crop(data, *like, offset=(0, 0), h_w=(0, 0), center_crop=False, **kw):
 
 Reshape = reshape
 astype = cast
+
+
+# ----------------------------------------------------------------------------
+# round-3 long tail (REF:src/operator/{tensor,nn,contrib}/** families)
+# ----------------------------------------------------------------------------
+log_sigmoid = _unary(jax.nn.log_sigmoid, "log_sigmoid")
+mish = _unary(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+hard_swish = _unary(lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0,
+                    "hard_swish")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+# erfcinv via ndtri, NOT erfinv(1-x): the subtraction cancels
+# catastrophically in f32 for small x (erfcinv(1e-8) would return inf)
+erfcinv = _unary(
+    lambda x: -jax.scipy.special.ndtri(x.astype(jnp.float32) / 2.0)
+    / jnp.sqrt(2.0).astype(jnp.float32), "erfcinv")
+
+
+def polygamma(n, data, **kw):
+    """REF:src/operator/tensor/elemwise_unary_op: polygamma(n, x)."""
+    return _apply(lambda x: jax.scipy.special.polygamma(int(n), x), [data],
+                  "polygamma")
+
+
+def gammainc(a, x, **kw):
+    """Regularized lower incomplete gamma (REF unary family)."""
+    return _apply(jax.scipy.special.gammainc, [a, x], "gammainc")
+
+
+def nextafter(lhs, rhs, **kw):
+    return _apply(jnp.nextafter, [lhs, rhs], "nextafter", nondiff=True)
+
+
+def moments(data, axes=None, keepdims=False, **kw):
+    """(mean, variance) in one pass (REF:src/operator/nn/moments.cc)."""
+    def f(x):
+        ax = tuple(axes) if axes is not None else tuple(range(x.ndim))
+        mu = jnp.mean(x, axis=ax, keepdims=keepdims)
+        mu_b = mu if keepdims else jnp.expand_dims(
+            mu, ax) if ax else mu
+        var = jnp.mean(jnp.square(x - mu_b), axis=ax, keepdims=keepdims)
+        return mu, var
+
+    return _apply(f, [data], "moments")
+
+
+def khatri_rao(*matrices, **kw):
+    """Column-wise Kronecker product (REF:src/operator/contrib/krprod.cc):
+    inputs (r, c_i) … -> (r? no: prod over rows) — reference semantics:
+    for matrices with the SAME number of columns k, output has
+    prod(rows_i) rows and k columns."""
+    def f(*ms):
+        out = ms[0]
+        for m in ms[1:]:
+            out = jnp.einsum("ik,jk->ijk", out, m).reshape(
+                -1, out.shape[-1])
+        return out
+
+    return _apply(f, list(matrices), "khatri_rao")
+
+
+def multi_all_finite(*arrays, num_arrays=None, init_output=True, **kw):
+    """1 iff every element of every input is finite
+    (REF:src/operator/contrib/all_finite.cc — the AMP overflow probe)."""
+    def f(*xs):
+        ok = jnp.ones((1,), jnp.float32)
+        for x in xs:
+            ok = ok * jnp.isfinite(x.astype(jnp.float32)).all().astype(
+                jnp.float32)
+        return ok
+
+    return _apply(f, list(arrays), "multi_all_finite", nondiff=True)
+
+
+all_finite = multi_all_finite
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0, **kw):
+    """softmax over positions where mask!=0; fully-masked rows -> 0
+    (REF:src/operator/nn/softmax.cc masked_softmax [ver>=1.8-era])."""
+    def f(x, m):
+        neg = jnp.finfo(jnp.float32).min
+        z = jnp.where(m != 0, x.astype(jnp.float32) / temperature, neg)
+        p = jax.nn.softmax(z, axis=axis)
+        return jnp.where(m != 0, p, 0.0).astype(x.dtype)
+
+    return _apply(f, [data, mask], "masked_softmax")
+
+
+def masked_log_softmax(data, mask, axis=-1, temperature=1.0, **kw):
+    def f(x, m):
+        neg = jnp.finfo(jnp.float32).min
+        z = jnp.where(m != 0, x.astype(jnp.float32) / temperature, neg)
+        p = jax.nn.log_softmax(z, axis=axis)
+        return jnp.where(m != 0, p, -jnp.inf).astype(x.dtype)
+
+    return _apply(f, [data, mask], "masked_log_softmax")
+
+
+def _im2col_params(kernel, stride, dilate, pad):
+    kh, kw_ = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+    sh, sw = _pair(stride, 2) if stride else (1, 1)
+    dh, dw = _pair(dilate, 2) if dilate else (1, 1)
+    ph, pw = _pair(pad, 2) if pad else (0, 0)
+    return kh, kw_, sh, sw, dh, dw, ph, pw
+
+
+def _patches(x, kh, kw_, sh, sw, dh, dw, ph, pw):
+    """The ONE patch-extraction both im2col and col2im's vjp use —
+    col2im is exact only while they share this code."""
+    p = lax.conv_general_dilated_patches(
+        x, (kh, kw_), (sh, sw), [(ph, ph), (pw, pw)],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return p.reshape(x.shape[0], x.shape[1] * kh * kw_, -1)
+
+
+def im2col(data, kernel, stride=None, dilate=None, pad=None, **kw):
+    """Sliding-window patches as columns (REF:src/operator/nn/im2col.h):
+    (N, C, H, W) -> (N, C*kh*kw, L) with L output positions."""
+    prm = _im2col_params(kernel, stride, dilate, pad)
+    return _apply(lambda x: _patches(x, *prm), [data], "im2col")
+
+
+def col2im(data, output_size, kernel, stride=None, dilate=None, pad=None,
+           **kw):
+    """Inverse of im2col: scatter-add columns back to the image
+    (REF:src/operator/nn/im2col.h col2im) — implemented as the exact vjp
+    of the im2col patch extraction, which IS the scatter-add."""
+    prm = _im2col_params(kernel, stride, dilate, pad)
+    kh, kw_ = prm[0], prm[1]
+    oh, ow = tuple(output_size)
+
+    def f(cols):
+        n = cols.shape[0]
+        c = cols.shape[1] // (kh * kw_)
+        zeros = jnp.zeros((n, c, oh, ow), cols.dtype)
+        _, vjp = jax.vjp(lambda img: _patches(img, *prm), zeros)
+        return vjp(cols)[0]
+
+    return _apply(f, [data], "col2im")
+
+
+def fill_element_0index(lhs, mhs, rhs, **kw):
+    """lhs[i, rhs[i]] = mhs[i] (REF:src/operator/tensor/
+    fill_element_0index — the bucketing trick for masking outputs)."""
+    def f(l, m, r):
+        idx = r.astype(jnp.int32)
+        return l.at[jnp.arange(l.shape[0]), idx].set(m)
+
+    return _apply(f, [lhs, mhs, rhs], "fill_element_0index")
+
+
+def choose_element_0index(lhs, rhs, **kw):
+    """out[i] = lhs[i, rhs[i]] (REF tensor family; pick's ancestor)."""
+    def f(l, r):
+        return l[jnp.arange(l.shape[0]), r.astype(jnp.int32)]
+
+    return _apply(f, [lhs, rhs], "choose_element_0index")
+
+
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    """Local response normalization across channels
+    (REF:src/operator/nn/lrn.cc — AlexNet-era)."""
+    def f(x):
+        sq = jnp.square(x.astype(jnp.float32))
+        half = nsize // 2
+        # windowed channel sum via padding + cumulative slicing
+        padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+        # NB: module-level `sum` is the reduction OP; use the builtin
+        acc = _sum(padded[:, i:i + x.shape[1]] for i in range(nsize))
+        norm = (knorm + alpha * acc / nsize) ** beta
+        return (x.astype(jnp.float32) / norm).astype(x.dtype)
+
+    return _apply(f, [data], "LRN")
+
+
+broadcast_axes = broadcast_axis
