@@ -125,3 +125,72 @@ def test_imglist_iter(tmp_path):
     batch = next(it)
     assert batch.data[0].shape == (2, 3, 16, 16)
     np.testing.assert_allclose(batch.label[0].asnumpy(), [0, 1])
+
+
+def test_round3_augmenters():
+    """Hue/Lighting/RandomGray/RandomOrder/Sequential/RandomSizedCrop +
+    CreateAugmenter(rand_resize/pca_noise/rand_gray) wiring."""
+    from tpu_mx import image as img, nd
+    rng = np.random.RandomState(0)
+    src = nd.array((rng.rand(32, 48, 3) * 255).astype(np.float32))
+
+    out, (x0, y0, w, h) = img.random_size_crop(src, (20, 20), (0.3, 0.9),
+                                               (0.8, 1.25))
+    assert out.shape == (20, 20, 3)
+    assert 0 <= x0 and x0 + w <= 48 and 0 <= y0 and y0 + h <= 32
+
+    hue = img.HueJitterAug(0.3)(src)
+    assert hue.shape == src.shape
+    assert not np.allclose(hue.asnumpy(), src.asnumpy())
+
+    light = img.LightingAug(0.1, np.ones(3, np.float32),
+                            np.eye(3, dtype=np.float32))(src)
+    assert light.shape == src.shape
+
+    gray = img.RandomGrayAug(1.0)(src).asnumpy()
+    # all channels equal after gray
+    np.testing.assert_allclose(gray[..., 0], gray[..., 1], rtol=1e-5)
+
+    seq = img.SequentialAug([img.CastAug(), img.HorizontalFlipAug(0.0)])
+    assert seq(src).shape == src.shape
+    order = img.RandomOrderAug([img.BrightnessJitterAug(0.1)])
+    assert order(src).shape == src.shape
+
+    augs = img.CreateAugmenter((3, 20, 20), rand_crop=True, rand_resize=True,
+                               rand_mirror=True, pca_noise=0.05,
+                               rand_gray=0.2, mean=True, std=True)
+    names = [type(a).__name__ for a in augs]
+    assert "RandomSizedCropAug" in names and "LightingAug" in names
+    assert "RandomGrayAug" in names
+    x = src
+    for a in augs:
+        x = a(x)
+    assert x.shape == (20, 20, 3)
+
+
+def test_vision_transforms_hue_and_colorjitter():
+    from tpu_mx.gluon.data.vision import transforms as T
+    x = (np.random.RandomState(3).rand(12, 12, 3) * 255).astype(np.uint8)
+    h = T.RandomHue(0.4).forward(x)
+    assert h.shape == x.shape
+    out = T.RandomColorJitter(0.2, 0.2, 0.2, 0.2).forward(x)
+    assert out.shape == x.shape and np.isfinite(out).all()
+    # Compose integration with the rest of the pipeline
+    pipe = T.Compose([T.RandomColorJitter(hue=0.1), T.ToTensor()])
+    y = pipe(x)
+    assert y.shape == (3, 12, 12)
+
+
+def test_crop_preserves_float_dtype_and_composite_dumps():
+    from tpu_mx import image as img, nd
+    x = nd.array(np.random.RandomState(0).rand(16, 16, 3)
+                 .astype(np.float32))  # float pixels in [0,1]
+    out = img.fixed_crop(x, 2, 2, 8, 8, size=(6, 6))
+    a = out.asnumpy()
+    assert a.dtype != np.uint8 and 0.0 < a.mean() < 1.0  # not truncated
+    c, _ = img.random_size_crop(x, (6, 6), (0.3, 0.9), (0.9, 1.1))
+    assert 0.0 < c.asnumpy().mean() < 1.0
+    d = img.SequentialAug([img.CastAug(), img.HorizontalFlipAug(0.5)]).dumps()
+    assert d[0] == "SequentialAug" and len(d[1]) == 2
+    augs = img.CreateAugmenter((3, 8, 8), hue=0.1)
+    assert any(type(a).__name__ == "HueJitterAug" for a in augs)

@@ -11,7 +11,7 @@ from ....ndarray import NDArray, array
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
-           "RandomLighting"]
+           "RandomLighting", "RandomHue", "RandomColorJitter"]
 
 
 def _as_np(x):
@@ -183,6 +183,45 @@ class RandomSaturation(_Transform):
         alpha = 1.0 + np.random.uniform(-self._s, self._s)
         gray = x.mean(axis=-1, keepdims=True)
         return np.clip(gray + alpha * (x - gray), 0, 255)
+
+
+class RandomHue(_Transform):
+    """REF transforms.py:RandomHue — YIQ-rotation hue jitter (same math
+    as image.HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        from ....image.image import HueJitterAug
+        out = HueJitterAug(self._h)(_as_np(x).astype(np.float32))
+        return np.clip(np.asarray(out.asnumpy()), 0, 255)
+
+
+class RandomColorJitter(_Transform):
+    """REF transforms.py:RandomColorJitter — brightness/contrast/
+    saturation/hue in one transform."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        # reference applies the jitters in RANDOM order per sample
+        ts = list(self._ts)
+        np.random.shuffle(ts)
+        for t in ts:
+            x = t.forward(_as_np(x))
+        return x
 
 
 class RandomLighting(_Transform):

@@ -20,7 +20,10 @@ from ..io.io import DataBatch, DataDesc, DataIter
 from ..ndarray import NDArray, array
 from .. import recordio as _recordio
 
-__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+__all__ = ["random_size_crop", "HueJitterAug", "LightingAug",
+           "RandomGrayAug", "RandomOrderAug", "SequentialAug",
+           "RandomSizedCropAug",
+           "imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "random_crop", "center_crop", "color_normalize", "HorizontalFlipAug",
            "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
            "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
@@ -63,8 +66,23 @@ def imread(filename, to_rgb=True, flag=1, **kw):
 
 
 def imresize(src, w, h, interp=1):
-    Image = _pil()
     arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    if arr.dtype != np.uint8:
+        # float pixels (post-Cast/normalize): resize WITHOUT truncating to
+        # uint8 — the reference preserves dtype through crops/resizes
+        try:
+            import cv2
+            flags = {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR,
+                     2: cv2.INTER_CUBIC, 3: cv2.INTER_LANCZOS4}
+            out = cv2.resize(arr.astype(np.float32), (int(w), int(h)),
+                             interpolation=flags.get(interp,
+                                                     cv2.INTER_LINEAR))
+            if out.ndim == 2:
+                out = out[:, :, None]
+            return array(out.astype(np.float32))
+        except ImportError:
+            pass  # fall through to the PIL uint8 path
+    Image = _pil()
     squeeze = arr.shape[-1] == 1
     img = Image.fromarray(arr.squeeze(-1).astype(np.uint8) if squeeze
                           else arr.astype(np.uint8))
@@ -88,9 +106,10 @@ def resize_short(src, size, interp=1):
 def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
     arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
     out = arr[y0:y0 + h, x0:x0 + w]
+    dtype = "uint8" if arr.dtype == np.uint8 else None  # preserve floats
     if size is not None and (w, h) != tuple(size):
-        return imresize(array(out, dtype="uint8"), size[0], size[1], interp)
-    return array(out, dtype="uint8")
+        return imresize(array(out, dtype=dtype), size[0], size[1], interp)
+    return array(out, dtype=dtype)
 
 
 def random_crop(src, size, interp=1):
@@ -109,6 +128,29 @@ def center_crop(src, size, interp=1):
     y0 = (h - new_h) // 2
     out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
     return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=1):
+    """Random area/aspect crop (REF image.py:random_size_crop — the
+    Inception-style crop): `area` is (min,max) fraction (scalar = min),
+    `ratio` the (min,max) aspect range; falls back to center_crop when no
+    candidate fits in 10 draws, like the reference."""
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if not isinstance(area, (list, tuple)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target = _pyrandom.uniform(area[0], area[1]) * src_area
+        log_r = (np.log(ratio[0]), np.log(ratio[1]))
+        ar = float(np.exp(_pyrandom.uniform(*log_r)))
+        new_w = int(round(np.sqrt(target * ar)))
+        new_h = int(round(np.sqrt(target / ar)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
 
 
 def color_normalize(src, mean, std=None):
@@ -265,16 +307,132 @@ class ColorJitterAug(Augmenter):
         return src
 
 
+class HueJitterAug(Augmenter):
+    """REF image.py:HueJitterAug — rotate hue via the YIQ linear approx
+    the reference uses (no HSV conversion on the hot path)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        wv = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -wv],
+                       [0.0, wv, u]], np.float32)
+        t = (self.ityiq @ bt @ self.tyiq).T
+        arr = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        return array(arr @ t)
+
+
+class LightingAug(Augmenter):
+    """REF image.py:LightingAug — AlexNet-style PCA noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(
+            np.float32)
+        rgb = self.eigvec @ (alpha * self.eigval)
+        arr = (src.asnumpy() if isinstance(src, NDArray)
+               else np.asarray(src)).astype(np.float32)
+        return array(arr + rgb)
+
+
+class RandomGrayAug(Augmenter):
+    """REF image.py:RandomGrayAug — grayscale with probability p."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.array([[0.21, 0.21, 0.21],
+                             [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]], np.float32)
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = (src.asnumpy() if isinstance(src, NDArray)
+                   else np.asarray(src)).astype(np.float32)
+            return array(arr @ self.mat)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """REF image.py:RandomOrderAug — apply children in random order."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def dumps(self):  # recurse like the reference's composite dumps
+        return [type(self).__name__, [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class SequentialAug(Augmenter):
+    """REF image.py:SequentialAug — apply children in order."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def dumps(self):
+        return [type(self).__name__, [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomSizedCropAug(Augmenter):
+    """REF image.py:RandomSizedCropAug over random_size_crop."""
+
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
-                    inter_method=2):
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
     """REF:python/mxnet/image/image.py CreateAugmenter — same flag set."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
@@ -283,6 +441,17 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(
+            pca_noise,
+            np.array([55.46, 4.794, 1.148], np.float32),
+            np.array([[-0.5675, 0.7192, 0.4009],
+                      [-0.5808, -0.0045, -0.8140],
+                      [-0.5836, -0.6948, 0.4203]], np.float32)))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
