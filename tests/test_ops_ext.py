@@ -572,3 +572,46 @@ class TestRound3LongTail:
         acc = sq[:, 1] + sq[:, 2] + sq[:, 3]
         ref = x.asnumpy()[:, 2] / (2.0 + 1e-4 * acc / 3) ** 0.75
         np.testing.assert_allclose(y[:, 2], ref, rtol=1e-4)
+
+
+def test_round3_optimizers_converge():
+    """DCASGD/SGLD/Adamax/Nadam/FTML minimize a quadratic through the
+    Updater path (REF optimizer families)."""
+    from tpu_mx import autograd, nd
+    from tpu_mx.optimizer import Updater
+    lrs = {"dcasgd": 0.05, "sgld": 0.05, "adamax": 0.1, "nadam": 0.05,
+           "ftml": 0.5}
+    for name, lr in lrs.items():
+        mx.random.seed(0)
+        w = nd.array(np.array([5.0, -3.0], np.float32))
+        w.attach_grad()
+        upd = Updater(mx.optimizer.create(name, learning_rate=lr))
+        for t in range(250):
+            with autograd.record():
+                loss = (w * w).sum()
+            loss.backward()
+            upd(0, w.grad, w)
+        final = float((w.asnumpy() ** 2).sum())
+        # SGLD carries injected noise ~ sqrt(lr): a loose bowl is the pass
+        bound = 1.0 if name != "sgld" else 2.0
+        assert final < bound, (name, w.asnumpy())
+
+
+def test_round3_optimizers_in_compiled_step():
+    """The new optimizers' update_core traces into CompiledTrainStep."""
+    from tpu_mx import gluon, nd
+    from tpu_mx.gluon import nn
+    from tpu_mx.parallel import CompiledTrainStep
+    for name in ("adamax", "nadam", "ftml"):
+        np.random.seed(1)
+        net = nn.Dense(2, in_units=4)
+        net.initialize()
+        net(nd.ones((1, 4)))
+        step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 mx.optimizer.create(name,
+                                                     learning_rate=0.05))
+        x = nd.array(np.random.rand(8, 4).astype(np.float32))
+        y = nd.array(np.random.randint(0, 2, (8,)).astype(np.float32))
+        losses = [float(np.asarray(step.step(x, y)._data))
+                  for _ in range(12)]
+        assert losses[-1] < losses[0], (name, losses)

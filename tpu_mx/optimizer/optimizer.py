@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -437,3 +438,142 @@ class Updater:
 
 def get_updater(optimizer):
     return Updater(optimizer)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (REF:src/operator/optimizer_op
+    dcasgd; Zheng et al. 2016): the reference's async-worker staleness
+    compensation — kept for API parity (our dist is bulk-synchronous, so
+    the previous-weight term sees a 1-step-old copy)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        w = weight._data if hasattr(weight, "_data") else weight
+        return (jnp.zeros(w.shape, w.dtype), jnp.asarray(w))
+
+    def update_core(self, weight, grad, state, lr, wd, t):
+        g = self._preprocess(grad, weight, wd)
+        mom, prev_w = state
+        comp = g + self.lamda * g * g * (weight - prev_w)
+        mom = self.momentum * mom - lr * comp
+        new_w = weight + mom
+        return new_w, (mom, new_w)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (REF optimizer.py:SGLD):
+    SGD + sqrt(lr) gaussian noise — Bayesian posterior sampling."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update_core(self, weight, grad, state, lr, wd, t):
+        g = self._preprocess(grad, weight, wd)
+        # deterministic per-(t, shape) draw keyed off the framework stream
+        # contract: traced inside the step, keyed on the step counter
+        key = jax.random.fold_in(jax.random.PRNGKey(0),
+                                 jnp.asarray(t, jnp.int32))
+        noise = jax.random.normal(key, weight.shape, jnp.float32)
+        return (weight - 0.5 * lr * g +
+                jnp.sqrt(lr).astype(weight.dtype) *
+                noise.astype(weight.dtype)), None
+
+
+@register
+class Adamax(Optimizer):
+    """Adam with infinity norm (REF optimizer.py:Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        w = weight._data if hasattr(weight, "_data") else weight
+        return (jnp.zeros(w.shape, jnp.float32),
+                jnp.zeros(w.shape, jnp.float32))
+
+    def update_core(self, weight, grad, state, lr, wd, t):
+        g = self._preprocess(grad, weight, wd).astype(jnp.float32)
+        m, u = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        lr_t = lr / (1 - self.beta1 ** t)
+        new_w = weight - (lr_t * m / (u + 1e-8)).astype(weight.dtype)
+        return new_w, (m, u)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (REF optimizer.py:Nadam; Dozat 2016)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+
+    def create_state(self, index, weight):
+        w = weight._data if hasattr(weight, "_data") else weight
+        return (jnp.zeros(w.shape, jnp.float32),
+                jnp.zeros(w.shape, jnp.float32),
+                jnp.ones((), jnp.float32))  # m_schedule product
+
+    def update_core(self, weight, grad, state, lr, wd, t):
+        g = self._preprocess(grad, weight, wd).astype(jnp.float32)
+        m, v, m_sched = state
+        mu_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mu_t1 = self.beta1 * (1 - 0.5 * 0.96 **
+                              ((t + 1) * self.schedule_decay))
+        m_sched_new = m_sched * mu_t
+        g_prime = g / (1 - m_sched_new)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        m_prime = m / (1 - m_sched_new * mu_t1)
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        v_prime = v / (1 - self.beta2 ** t)
+        m_bar = (1 - mu_t) * g_prime + mu_t1 * m_prime
+        new_w = weight - (lr * m_bar /
+                          (jnp.sqrt(v_prime) + self.epsilon)).astype(
+                              weight.dtype)
+        return new_w, (m, v, m_sched_new)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the moving leader (REF ftml_update; Zheng & Kwok 2017)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        w = weight._data if hasattr(weight, "_data") else weight
+        # three DISTINCT buffers: donation rejects one buffer bound to
+        # several arguments (f(donate(a), donate(a)))
+        return (jnp.zeros(w.shape, jnp.float32),
+                jnp.zeros(w.shape, jnp.float32),
+                jnp.zeros(w.shape, jnp.float32))  # d, v, z
+
+    def update_core(self, weight, grad, state, lr, wd, t):
+        g = self._preprocess(grad, weight, wd).astype(jnp.float32)
+        d_prev, v_prev, z_prev = state
+        v = self.beta2 * v_prev + (1 - self.beta2) * g * g
+        d = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d - self.beta1 * d_prev
+        z = self.beta1 * z_prev + (1 - self.beta1) * g - sigma * weight
+        new_w = (-z / d).astype(weight.dtype)
+        return new_w, (d, v, z)
