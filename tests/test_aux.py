@@ -187,3 +187,35 @@ def test_persistent_compilation_cache(tmp_path):
         assert entries, "no cache entries written"
     finally:
         jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_mcc_and_nll_metrics():
+    import tpu_mx.metric as M
+    m = M.MCC()
+    m.update([np.array([1, 1, 0, 0])], [np.array([0.9, 0.8, 0.2, 0.6])])
+    assert abs(m.get()[1] - 2 / np.sqrt(12)) < 1e-6
+    m.reset()
+    assert m.get()[1] != m.get()[1] or m.num_inst == 0  # nan or empty
+    nll = M.NegativeLogLikelihood()
+    nll.update([np.array([0, 1])], [np.array([[0.9, 0.1], [0.4, 0.6]])])
+    assert abs(nll.get()[1] -
+               (-np.log(0.9) - np.log(0.6)) / 2) < 1e-6
+    # registry creation by name
+    assert mx.metric.create("mcc").name == "mcc"
+    assert mx.metric.create("nll-loss").name == "nll-loss"
+
+
+def test_mixed_and_load_initializers():
+    import tpu_mx.initializer as I
+    from tpu_mx.gluon import nn
+    from tpu_mx import nd
+    mix = I.Mixed([".*bias", ".*"], [I.Zero(), I.Constant(2.0)])
+    net = nn.Dense(3, in_units=2)
+    net.initialize(init=mix)
+    assert (net.bias.data().asnumpy() == 0).all()
+    assert (net.weight.data().asnumpy() == 2.0).all()
+    ld = I.Load({"w": np.arange(4.0)}, default_init=I.Zero())
+    assert (ld("w", (4,)) == np.arange(4.0)).all()
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="shape mismatch"):
+        ld("w", (5,))

@@ -76,6 +76,53 @@ class Constant(Initializer):
         return np.full(shape, self.value)
 
 
+class Mixed:
+    """Pattern-routed initializer (REF initializer.py:Mixed): first regex
+    matching the parameter name picks the initializer."""
+
+    def __init__(self, patterns, initializers):
+        import re as _re
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must pair up")
+        self._map = [(_re.compile(p), i if not isinstance(i, str)
+                      else registry.create(i))
+                     for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, shape, dtype="float32"):
+        for pat, init in self._map:
+            if pat.search(name):
+                return init(name, shape, dtype)
+        raise ValueError(f"no initializer pattern matches {name!r}; "
+                         "add a '.*' catch-all")
+
+
+class Load:
+    """Initialize from saved arrays (REF initializer.py:Load): dict or
+    .npz/.params path; falls back to default_init for absent names."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import load as nd_load
+            param = nd_load(param)
+        self._param = {k.split(":", 1)[-1]: v for k, v in param.items()}
+        self._default = default_init
+        self._verbose = verbose
+
+    def __call__(self, name, shape, dtype="float32"):
+        if name in self._param:
+            arr = self._param[name]
+            arr = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+            if tuple(arr.shape) != tuple(shape):
+                raise ValueError(
+                    f"Load: shape mismatch for {name}: saved "
+                    f"{arr.shape} vs wanted {tuple(shape)}")
+            return arr.astype(dtype)
+        if self._default is None:
+            raise ValueError(f"Load: {name!r} not in saved params and no "
+                             "default_init given")
+        return self._default(name, shape, dtype)
+
+
 def _fan(shape, factor_type):
     hw = int(np.prod(shape[2:])) if len(shape) > 2 else 1
     fan_in = shape[1] * hw if len(shape) > 1 else shape[0]

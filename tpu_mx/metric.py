@@ -228,6 +228,61 @@ class PearsonCorrelation(EvalMetric):
             self.num_inst += 1
 
 
+@registry.register(name="mcc")
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (REF metric.py:MCC) — binary
+    confusion-matrix correlation, the class-imbalance-robust F1 cousin."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self.reset_stats()
+
+    def reset_stats(self):
+        self._tp = self._tn = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self.reset_stats()
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            y = _as_np(label).flatten().astype(np.int64)
+            p = _as_np(pred)
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.reshape(-1, p.shape[-1]).argmax(axis=-1)
+            else:
+                p = (p.flatten() > 0.5)
+            p = p.astype(np.int64)
+            self._tp += float(((p == 1) & (y == 1)).sum())
+            self._tn += float(((p == 0) & (y == 0)).sum())
+            self._fp += float(((p == 1) & (y == 0)).sum())
+            self._fn += float(((p == 0) & (y == 1)).sum())
+        den = np.sqrt((self._tp + self._fp) * (self._tp + self._fn) *
+                      (self._tn + self._fp) * (self._tn + self._fn))
+        mcc = 0.0 if den == 0 else             (self._tp * self._tn - self._fp * self._fn) / den
+        self.sum_metric = mcc
+        self.num_inst = 1
+
+
+@registry.register(name="nll_loss", aliases=("nll-loss",))
+class NegativeLogLikelihood(EvalMetric):
+    """Mean NLL of the true class (REF metric.py:NegativeLogLikelihood)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = self._listify(labels, preds)
+        for label, pred in zip(labels, preds):
+            y = _as_np(label).flatten().astype(np.int64)
+            p = _as_np(pred).reshape(len(y), -1)
+            chosen = p[np.arange(len(y)), y]
+            self.sum_metric += float(-np.log(chosen + self.eps).sum())
+            self.num_inst += len(y)
+
+
 class CompositeEvalMetric(EvalMetric):
     def __init__(self, metrics=None, name="composite", **kwargs):
         super().__init__(name, **kwargs)
