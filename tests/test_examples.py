@@ -58,3 +58,8 @@ def test_long_context_example_smoke():
     out = _run("examples/long_context/train.py")
     m = re.search(r"ring_dispatches=(\d+)", out)
     assert m and int(m.group(1)) > 0, out[-300:]
+
+
+def test_estimator_example_smoke():
+    out = _run("examples/estimator/train.py")
+    assert "accuracy" in out and "checkpoints:" in out, out[-500:]
