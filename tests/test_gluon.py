@@ -391,3 +391,22 @@ def test_groupnorm_matches_torch_semantics():
     bad.initialize()
     with pytest.raises(mx.base.MXNetError, match="divisible"):
         bad(nd.array(x))
+
+
+def test_poisson_nll_loss():
+    from tpu_mx.gluon.loss import PoissonNLLLoss
+    pred = nd.array(np.array([[0.5], [1.0]]))  # log-rates
+    label = nd.array(np.array([[1.0], [2.0]]))
+    l = PoissonNLLLoss(from_logits=True)(pred, label)
+    ref = (np.exp([0.5, 1.0]) - np.array([1.0, 2.0]) *
+           np.array([0.5, 1.0])).mean()
+    np.testing.assert_allclose(float(l.asscalar()), ref, rtol=1e-5)
+    # rate-space path + grads
+    rate = nd.array(np.array([[2.0], [0.5]]))
+    rate.attach_grad()
+    with autograd.record():
+        l2 = PoissonNLLLoss(from_logits=False)(rate, label)
+    l2.backward()
+    assert np.isfinite(rate.grad.asnumpy()).all()
+    full = PoissonNLLLoss(from_logits=True, compute_full=True)(pred, label)
+    assert float(full.asscalar()) > float(l.asscalar())  # stirling adds

@@ -3,7 +3,7 @@ from __future__ import annotations
 
 from .block import HybridBlock
 
-__all__ = ["Loss", "L2Loss", "L1Loss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+__all__ = ["Loss", "L2Loss", "L1Loss", "PoissonNLLLoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss", "KLDivLoss",
            "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
            "TripletLoss", "CTCLoss", "CosineEmbeddingLoss"]
@@ -183,6 +183,33 @@ class LogisticLoss(Loss):
         ndim = len(loss.shape)
         return F.mean(loss, axis=tuple(i for i in range(ndim)
                                        if i != self._batch_axis))
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (REF gluon/loss.py:PoissonNLLLoss):
+    pred is the rate (or its log with from_logits=True); optional Stirling
+    term for the ln(label!) constant."""
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       epsilon=1e-08):
+        label = _reshape_like(F, label, pred)
+        if self._from_logits:
+            loss = F.exp(pred) - label * pred
+        else:
+            loss = pred - label * F.log(pred + epsilon)
+        if self._compute_full:
+            stirling = label * F.log(label + epsilon) - label +                 0.5 * F.log(2.0 * 3.14159265358979 * (label + epsilon))
+            stirling = F.where(label <= 1.0, F.zeros_like(stirling),
+                               stirling)
+            loss = loss + stirling
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss)
 
 
 class TripletLoss(Loss):
