@@ -615,3 +615,53 @@ def test_round3_optimizers_in_compiled_step():
         losses = [float(np.asarray(step.step(x, y)._data))
                   for _ in range(12)]
         assert losses[-1] < losses[0], (name, losses)
+
+
+def test_round3_ops_numeric_gradients():
+    """Finite-difference gradient checks for this round's differentiable
+    additions (the reference test strategy's core tool, SURVEY §4)."""
+    from tpu_mx.test_utils import check_numeric_gradient
+    rng = np.random.RandomState(0)
+    x34 = rng.rand(3, 4).astype(np.float32) + 0.1
+
+    check_numeric_gradient(lambda a: nd.mish(a[0]), [x34])
+    check_numeric_gradient(lambda a: nd.log_sigmoid(a[0]), [x34])
+    check_numeric_gradient(lambda a: nd.hard_swish(a[0]), [x34 + 1.0])
+    check_numeric_gradient(
+        lambda a: nd.masked_softmax(
+            a[0], nd.array(np.array([[1, 1, 0, 1]] * 3, np.int32))),
+        [x34])
+    m, v = None, None
+    check_numeric_gradient(lambda a: nd.moments(a[0], axes=(1,))[0], [x34])
+    check_numeric_gradient(lambda a: nd.moments(a[0], axes=(1,))[1], [x34])
+    check_numeric_gradient(lambda a: nd.khatri_rao(a[0], a[1]),
+                           [x34, rng.rand(2, 4).astype(np.float32)])
+    check_numeric_gradient(
+        lambda a: nd.im2col(a[0], kernel=(2, 2)),
+        [rng.rand(1, 2, 4, 4).astype(np.float32)])
+    check_numeric_gradient(
+        lambda a: nd.LRN(a[0], nsize=3),
+        [rng.rand(1, 4, 3, 3).astype(np.float32)])
+    # GroupNorm: finite differences are noise-dominated here (rsqrt of a
+    # small-group variance has high curvature; and sum(out) is constant in
+    # x), so check analytically against torch instead
+    import torch
+    from tpu_mx import autograd as ag
+    x = rng.rand(2, 4, 3, 3).astype(np.float32)
+    g = (rng.rand(2) + 0.5).astype(np.float32)
+    b = rng.rand(2).astype(np.float32)
+    xx, gg, bb = nd.array(x), nd.array(g), nd.array(b)
+    for a in (xx, gg, bb):
+        a.attach_grad()
+    with ag.record():
+        nd.GroupNorm(xx, gg, bb, num_groups=2).square().sum().backward()
+    tx = torch.tensor(x, requires_grad=True)
+    tg = torch.tensor(np.repeat(g, 2), requires_grad=True)
+    tb = torch.tensor(np.repeat(b, 2), requires_grad=True)
+    (torch.nn.functional.group_norm(tx, 2, tg, tb, eps=1e-5) ** 2) \
+        .sum().backward()
+    np.testing.assert_allclose(xx.grad.asnumpy(), tx.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gg.grad.asnumpy(),
+                               tg.grad.numpy().reshape(2, 2).sum(1),
+                               rtol=1e-4)
