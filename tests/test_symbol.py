@@ -257,3 +257,25 @@ def test_util_np_scope():
     assert inner() is True
     assert mx.lr_scheduler is not None and hasattr(mx.lr_scheduler,
                                                    "FactorScheduler")
+
+
+@pytest.mark.parametrize("op,kwargs,shape", [
+    ("mish", {}, (3, 4)),
+    ("log_sigmoid", {}, (3, 4)),
+    ("hard_swish", {}, (3, 4)),
+    ("LRN", {"nsize": 3}, (1, 4, 3, 3)),
+    ("im2col", {"kernel": (2, 2)}, (1, 2, 4, 4)),
+])
+def test_new_ops_nd_sym_parity(op, kwargs, shape):
+    """The symbol stubs auto-generated for round-3 ops must compute the
+    same values as the imperative path (the reference's nd/sym twin
+    contract)."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(*shape).astype(np.float32)
+    nd_out = getattr(mx.nd, op)(mx.nd.array(x), **kwargs).asnumpy()
+    data = mx.sym.Variable("data")
+    sym = getattr(mx.sym, op)(data, **kwargs)
+    ex = sym.simple_bind(mx.cpu(), data=shape)
+    ex.arg_dict["data"][:] = x
+    (y,) = ex.forward()
+    np.testing.assert_allclose(y.asnumpy(), nd_out, rtol=1e-5, atol=1e-6)

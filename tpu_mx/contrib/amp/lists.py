@@ -27,6 +27,9 @@ FP32_OPS = [
     "L2Normalization",
     "LayerNorm",
     "InstanceNorm",
+    "GroupNorm",
+    "masked_softmax",
+    "masked_log_softmax",
     "RMSNorm",
     "BatchNorm",
     "exp",
