@@ -437,7 +437,7 @@ def test_pipeline_trains():
     assert losses[-1] < 0.5 * losses[0], losses
 
 
-@pytest.mark.parametrize("ctype", ["2bit", "int8"])
+@pytest.mark.parametrize("ctype", ["2bit", "int8", "fp8"])
 def test_compressed_instep_allreduce(ctype):
     """Quantized in-step gradient psum (SURVEY §2.3 stretch / VERDICT r2
     ask#7): with error feedback the compressed run must track the
@@ -910,3 +910,65 @@ def test_compressed_accumulation_compress_once_per_update():
         out = step_b.step(nd.array(x2), nd.array(y2))
         losses.append(float(np.asarray(out._data)))
     assert losses[-1] < losses[0], losses
+
+
+def test_fsdp_rules_shard_params_and_match_replicated():
+    """fsdp_rules: params >= min_size shard over dp (XLA gathers in the
+    forward, reduce-scatters grads); training math must equal the
+    replicated run, and the live buffers must actually be dp-sharded."""
+    import jax
+    from tpu_mx.parallel import CompiledTrainStep, fsdp_rules
+
+    def build():
+        np.random.seed(31)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(64, in_units=16, activation="relu"),
+                nn.Dense(4, in_units=64))
+        net.initialize()
+        net(nd.ones((1, 16)))
+        return net
+
+    mesh = _mesh(dp=8)
+    x = nd.array(np.random.RandomState(0).rand(16, 16).astype(np.float32))
+    y = nd.array(np.random.RandomState(1).randint(0, 4, (16,))
+                 .astype(np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    losses = {}
+    for mode in ("replicated", "fsdp"):
+        net = build()
+        rules = None
+        if mode == "fsdp":
+            rules = fsdp_rules({k: p.data()
+                                for k, p in net.collect_params().items()},
+                               min_size=256, axis_size=8)
+            assert rules, "no params sharded"
+        opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+        step = CompiledTrainStep(net, loss_fn, opt, mesh=mesh, rules=rules)
+        losses[mode] = [float(np.asarray(step.step(x, y)._data))
+                        for _ in range(4)]
+        if mode == "fsdp":
+            # every large param must live dp-sharded on device
+            big = [k for k, v in step.values.items()
+                   if int(np.prod(v.shape)) >= 256]
+            for k in big:
+                spec = step.values[k].sharding.spec
+                assert any(ax == "dp" for ax in spec), (k, spec)
+    np.testing.assert_allclose(losses["replicated"], losses["fsdp"],
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_fsdp_rules_divisibility():
+    """Params with no axis divisible by the mesh size stay replicated
+    instead of producing invalid shardings."""
+    from tpu_mx.parallel import fsdp_rules, P
+    params = {"odd": np.zeros((100, 17)),     # no axis % 8 == 0
+              "even": np.zeros((64, 100)),    # 64 % 8 == 0
+              "tiny": np.zeros((4,))}
+    rules = fsdp_rules(params, min_size=64, axis_size=8)
+    names = [r[0] for r in rules]
+    assert any("even" in n for n in names)
+    assert not any("odd" in n or "tiny" in n for n in names)
+    spec = dict((r[0], r[1]) for r in rules)[[n for n in names
+                                              if "even" in n][0]]
+    assert spec == P("dp", None)

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from ..ndarray import NDArray
 
-__all__ = ["GradientCompression", "quantize_2bit_core", "quantize_int8_core"]
+__all__ = ["GradientCompression", "quantize_2bit_core", "quantize_int8_core", "quantize_fp8_core"]
 
 
 def quantize_2bit_core(grad, residual, threshold):
@@ -38,9 +38,9 @@ def quantize_int8_core(grad, residual):
 
 class GradientCompression:
     def __init__(self, type="2bit", threshold=0.5):
-        if type not in ("2bit", "int8"):
+        if type not in ("2bit", "int8", "fp8"):
             raise ValueError(f"unsupported compression type {type!r} "
-                             "(have: 2bit, int8)")
+                             "(have: 2bit, int8, fp8)")
         self.type = type
         self.threshold = float(threshold)
         self._residuals = {}
@@ -56,6 +56,8 @@ class GradientCompression:
         if self.type == "2bit":
             q, new_residual = quantize_2bit_core(raw, residual,
                                                  self.threshold)
+        elif self.type == "fp8":
+            q, new_residual = quantize_fp8_core(raw, residual)
         else:
             q, new_residual = quantize_int8_core(raw, residual)
         self._residuals[rkey] = new_residual
@@ -63,3 +65,17 @@ class GradientCompression:
 
     def get_params(self):
         return {"type": self.type, "threshold": self.threshold}
+
+
+def quantize_fp8_core(grad, residual):
+    """float8 (e4m3) per-tensor scaled quantization with error feedback:
+    returns (dequantized_grad, new_residual).  The wire value is
+    (acc/scale) cast to e4m3 (range ±448) with scale = max|acc|/448 —
+     4x fewer bytes than f32 on the reduction wire (EQuARX-style,
+    PAPERS.md; no reference analog, its kvstore wire had 2bit only)."""
+    acc = grad + residual
+    amax = jnp.max(jnp.abs(acc))
+    scale = jnp.where(amax > 0, amax / 448.0, 1.0)
+    wire = (acc / scale).astype(jnp.float8_e4m3fn)
+    deq = wire.astype(jnp.float32) * scale
+    return deq, acc - deq

@@ -4,4 +4,5 @@ from .mesh import Mesh, NamedSharding, P, hybrid_mesh, local_mesh, make_mesh
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import attention, local_flash_attention, ring_attention
 from .ulysses import get_sp_strategy, set_sp_strategy, ulysses_attention
-from .train_step import CompiledTrainStep, apply_rules, sharding_for
+from .train_step import (CompiledTrainStep, apply_rules, fsdp_rules,
+                         sharding_for)
