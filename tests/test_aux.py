@@ -219,3 +219,20 @@ def test_mixed_and_load_initializers():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="shape mismatch"):
         ld("w", (5,))
+
+
+def test_symbolic_check_helpers_and_tensorrt_stub():
+    import tpu_mx.test_utils as T
+    x = mx.sym.Variable("x")
+    y = x * 2.0 + 1.0
+    T.check_symbolic_forward(y, [np.array([1.0, 2.0], np.float32)],
+                             [np.array([3.0, 5.0], np.float32)])
+    T.check_symbolic_backward(y, [np.array([1.0, 2.0], np.float32)],
+                              [np.ones(2, np.float32)],
+                              [np.full(2, 2.0, np.float32)])
+    T.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    s2 = T.rand_shape_2d(5, 5)
+    assert len(s2) == 2 and all(1 <= v <= 5 for v in s2)
+    from tpu_mx.contrib import tensorrt
+    with pytest.raises(mx.MXNetError, match="StableHLO"):
+        tensorrt.optimize_graph(None)

@@ -4,3 +4,4 @@ from . import amp
 from . import quantization
 from . import text
 from . import onnx
+from . import tensorrt

@@ -1,0 +1,27 @@
+"""mx.contrib.tensorrt (REF:python/mxnet/contrib/tensorrt.py).
+
+DIVERGENCE, stated loudly: TensorRT is an NVIDIA inference runtime; the
+TPU deployment artifact here is a serialized StableHLO program
+(`HybridBlock.export()` -> `SymbolBlock.imports`), which is what XLA-AOT
+consumes.  Every entry point raises with that pointer instead of
+silently no-op'ing.
+"""
+from ..base import MXNetError
+
+__all__ = ["init_tensorrt_params", "optimize_graph", "get_optimized_symbol"]
+
+_MSG = ("TensorRT is CUDA-only; on TPU export the model with "
+        "HybridBlock.export() (StableHLO) and load it with "
+        "SymbolBlock.imports - see docs/migration.md")
+
+
+def init_tensorrt_params(*a, **k):
+    raise MXNetError(_MSG)
+
+
+def optimize_graph(*a, **k):
+    raise MXNetError(_MSG)
+
+
+def get_optimized_symbol(*a, **k):
+    raise MXNetError(_MSG)

@@ -137,3 +137,70 @@ def check_consistency(fn, inputs, ctx_list=None, grad=True, rtol=None, atol=None
         for g, rg in zip(grads, ref_grads):
             assert_almost_equal(g, rg, rtol, atol, names=(str(ctx), str(ctx_list[0])))
     return results
+
+
+def assert_exception(fn, exception_type, *args, **kwargs):
+    """REF test_utils.py:assert_exception."""
+    try:
+        fn(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(
+        f"{fn} did not raise {exception_type.__name__}")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def list_gpus():
+    """REF test_utils.py:list_gpus — here: indices of TPU devices."""
+    from . import context
+    return list(range(context.num_tpus()))
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-5, atol=1e-20,
+                           ctx=None):
+    """REF test_utils.py:check_symbolic_forward: bind `sym` with `inputs`
+    (list ordered like list_arguments) and compare outputs."""
+    from . import cpu
+    from .ndarray import array as nd_array
+    ctx = ctx or cpu()
+    args = sym.list_arguments()
+    shapes = {a: np.asarray(x).shape for a, x in zip(args, inputs)}
+    ex = sym.simple_bind(ctx, **shapes)
+    for a, x in zip(args, inputs):
+        ex.arg_dict[a][:] = np.asarray(x)
+    outs = ex.forward()
+    for out, exp in zip(outs, expected):
+        np.testing.assert_allclose(out.asnumpy(), np.asarray(exp),
+                                   rtol=rtol, atol=atol)
+    return outs
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected, rtol=1e-5,
+                            atol=1e-20, ctx=None):
+    """REF test_utils.py:check_symbolic_backward: forward+backward with
+    given head gradients, compare input gradients (ordered like
+    list_arguments)."""
+    from . import cpu
+    ctx = ctx or cpu()
+    args = sym.list_arguments()
+    shapes = {a: np.asarray(x).shape for a, x in zip(args, inputs)}
+    ex = sym.simple_bind(ctx, grad_req="write", **shapes)
+    for a, x in zip(args, inputs):
+        ex.arg_dict[a][:] = np.asarray(x)
+    ex.forward(is_train=True)
+    ex.backward([array(np.asarray(g).astype(np.float32))
+                 for g in out_grads])
+    for a, exp in zip(args, expected):
+        if exp is None:
+            continue
+        np.testing.assert_allclose(ex.grad_dict[a].asnumpy(),
+                                   np.asarray(exp), rtol=rtol, atol=atol)
+    return [ex.grad_dict[a] for a in args]
