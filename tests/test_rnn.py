@@ -159,3 +159,33 @@ def test_unroll_valid_length_masks_and_freezes_states():
                                 np.asarray(states2[0]._data)[1], rtol=1e-6)
     np.testing.assert_allclose(np.asarray(states[1]._data)[1],
                                 np.asarray(states2[1]._data)[1], rtol=1e-6)
+
+
+def test_bidirectional_cell_unroll():
+    """BidirectionalCell == forward-LSTM ++ reversed backward-LSTM
+    (REF rnn_cell.py:BidirectionalCell)."""
+    from tpu_mx.gluon import rnn as grnn
+    l, r = grnn.LSTMCell(4), grnn.LSTMCell(4)
+    bi = grnn.BidirectionalCell(l, r)
+    for c in (l, r):
+        pass
+    bi.initialize()
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(2, 5, 3).astype(np.float32))
+    outs, states = bi.unroll(5, x, layout="NTC")
+    assert outs.shape == (2, 5, 8)
+    assert len(states) == 4
+    # manual composition matches
+    lo, _ = l.unroll(5, x, layout="NTC", merge_outputs=False)
+    xs_rev = nd.flip(x, axis=1)
+    ro, _ = r.unroll(5, xs_rev, layout="NTC", merge_outputs=False)
+    ro = list(reversed(list(ro)))
+    for t in range(5):
+        np.testing.assert_allclose(
+            np.asarray(outs._data)[:, t, :4], np.asarray(lo[t]._data),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(outs._data)[:, t, 4:], np.asarray(ro[t]._data),
+            rtol=1e-5)
+    with pytest.raises(mx.MXNetError, match="unroll"):
+        bi(x, states)

@@ -1,5 +1,6 @@
 """gluon.rnn (REF:python/mxnet/gluon/rnn/)."""
-from .rnn_cell import (DropoutCell, GRUCell, LSTMCell, ModifierCell,
+from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
+                       HybridSequentialRNNCell, LSTMCell, ModifierCell,
                        RecurrentCell, ResidualCell, RNNCell,
                        SequentialRNNCell, ZoneoutCell)
 from .rnn_layer import GRU, LSTM, RNN

@@ -12,8 +12,10 @@ import numpy as np
 from ..block import HybridBlock
 from ... import initializer as init_mod
 
-__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
-           "DropoutCell", "ZoneoutCell", "ResidualCell"]
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "HybridSequentialRNNCell",
+           "BidirectionalCell", "ModifierCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -312,3 +314,67 @@ class ResidualCell(ModifierCell):
     def hybrid_forward(self, F, inputs, states):
         out, new_states = self.base_cell(inputs, states)
         return out + inputs, new_states
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Alias in this stack (REF rnn_cell.py keeps separate Hybrid/plain
+    containers; the single traceable cell protocol here collapses them)."""
+
+
+class BidirectionalCell(RecurrentCell):
+    """Run one cell forward and another backward over the sequence and
+    concatenate per-step outputs (REF rnn_cell.py:BidirectionalCell).
+    Only usable via `unroll` (a single step has no defined direction,
+    exactly the reference's restriction)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_", **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+        self._output_prefix = output_prefix
+
+    def state_info(self, batch_size=0):
+        return (self.l_cell.state_info(batch_size) +
+                self.r_cell.state_info(batch_size))
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return (self.l_cell.begin_state(batch_size, func=func, **kwargs) +
+                self.r_cell.begin_state(batch_size, func=func, **kwargs))
+
+    def __call__(self, *args, **kwargs):
+        from ...base import MXNetError
+        raise MXNetError("BidirectionalCell cannot be stepped one input "
+                         "at a time; use unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ...ndarray import ops as F
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            steps = list(inputs)
+        else:
+            steps = F.split(inputs, length, axis=axis, squeeze_axis=True)
+            steps = [steps] if length == 1 and not isinstance(steps, list) \
+                else list(steps)
+        n_l = len(self.l_cell.state_info())
+        if begin_state is not None:
+            l_states = begin_state[:n_l]
+            r_states = begin_state[n_l:]
+        else:
+            l_states = r_states = None
+        l_out, l_states = self.l_cell.unroll(
+            length, steps, begin_state=l_states, layout="TNC"
+            if False else layout, merge_outputs=False,
+            valid_length=valid_length)
+        r_out, r_states = self.r_cell.unroll(
+            length, list(reversed(steps)), begin_state=r_states,
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        outs = [F.concat(lo, ro, dim=-1)
+                for lo, ro in zip(l_out if isinstance(l_out, list)
+                                  else list(l_out),
+                                  list(reversed(r_out if isinstance(
+                                      r_out, list) else list(r_out))))]
+        if merge_outputs or merge_outputs is None:
+            outs = F.stack(*outs, axis=axis)
+        return outs, list(l_states) + list(r_states)
