@@ -244,3 +244,112 @@ def test_flash_dropout_rejected_off_tpu():
         with pytest.raises(ValueError, match="dropout"):
             flash_attention(q, k, v, dropout_rate=0.1,
                             dropout_seed=jnp.zeros((1,), jnp.int32))
+
+
+class TestFlashBias:
+    """In-kernel additive attention bias (ALiBi/relative-position):
+    fwd + all four grads vs the dense reference, every broadcast layout."""
+
+    def _dense(self, q, k, v, bias, causal):
+        import jax
+        import jax.numpy as jnp
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        s = s + bias
+        if causal:
+            t, tk = q.shape[2], k.shape[2]
+            m = jnp.arange(t)[:, None] >= jnp.arange(tk)[None, :]
+            s = jnp.where(m[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    @pytest.mark.parametrize("bias_shape,causal", [
+        ((2, 4, 128, 128), False), ((1, 4, 128, 128), False),
+        ((1, 1, 128, 128), False), ((2, 4, 128, 128), True),
+    ])
+    def test_bias_fwd_bwd_vs_dense(self, bias_shape, causal):
+        import jax
+        import jax.numpy as jnp
+        from tpu_mx.kernels.flash_attention import mha_flash_attention
+        rng = np.random.RandomState(0)
+        B, H, T, D = 2, 4, 128, 64
+        q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+                   for _ in range(3))
+        bias = jnp.asarray(rng.randn(*bias_shape).astype(np.float32))
+
+        def loss_flash(q, k, v, bias):
+            return jnp.sum(jnp.sin(mha_flash_attention(
+                q, k, v, causal=causal, bias=bias,
+                block_q=64, block_k=64)))
+
+        def loss_dense(q, k, v, bias):
+            return jnp.sum(jnp.sin(self._dense(q, k, v, bias, causal)))
+
+        out_f = mha_flash_attention(q, k, v, causal=causal, bias=bias,
+                                    block_q=64, block_k=64)
+        out_d = self._dense(q, k, v, bias, causal)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                                   rtol=2e-4, atol=2e-5)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for a, b, name in zip(gf, gd, "qkvb"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-5,
+                                       err_msg=f"d{name} {bias_shape}")
+
+    def test_bias_with_padding_mask(self):
+        import jax.numpy as jnp
+        from tpu_mx.kernels.flash_attention import mha_flash_attention
+        rng = np.random.RandomState(1)
+        B, H, T, D = 2, 2, 128, 32
+        q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+                   for _ in range(3))
+        bias = jnp.asarray(rng.randn(1, H, T, T).astype(np.float32))
+        vl = np.array([128, 64])
+        out = mha_flash_attention(q, k, v, valid_length=vl, bias=bias,
+                                  block_q=64, block_k=64)
+        # dense reference with key-padding mask
+        import jax
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D) + bias
+        km = (jnp.arange(T)[None, None, None, :] <
+              jnp.asarray(vl)[:, None, None, None])
+        s = jnp.where(km, s, -jnp.inf)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_bias_shape_validation(self):
+        import jax.numpy as jnp
+        from tpu_mx.kernels.flash_attention import flash_attention
+        q = jnp.ones((4, 128, 32), jnp.float32)
+        with pytest.raises(ValueError, match="bias shape"):
+            flash_attention(q, q, q, bias=jnp.ones((3, 128, 128)))
+
+
+def test_flash_bias_singleton_dims_and_ambiguity():
+    """(1,H,1,T) ALiBi-layout biases broadcast correctly through the
+    kernel path, and bare-divisor leading dims are rejected without
+    bias_groups."""
+    import jax.numpy as jnp
+    from tpu_mx.kernels.flash_attention import (flash_attention,
+                                               mha_flash_attention)
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 4, 128, 32
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+               for _ in range(3))
+    bias_row = jnp.asarray(rng.randn(1, H, 1, T).astype(np.float32))
+    out = mha_flash_attention(q, k, v, bias=bias_row, block_q=64,
+                              block_k=64)
+    import jax
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D) + bias_row
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    # divisor-without-groups is ambiguous -> rejected
+    qf = q.reshape(B * H, T, D)
+    with pytest.raises(ValueError, match="ambiguous"):
+        flash_attention(qf, qf, qf, bias=jnp.ones((2, T, T)))
+    # ...but explicit bias_groups makes it legal
+    out2 = flash_attention(qf, qf, qf, bias=jnp.zeros((2, T, T)),
+                           bias_groups=2, block_q=64, block_k=64)
+    assert out2.shape == qf.shape

@@ -104,9 +104,10 @@ def _run_cond(causal, valid, qi, ki, block_q, block_k):
 # ----------------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------------
-def _fwd_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
-    (q_ref, k_ref, v_ref), valid_ref, seed_ref, tail = _split_refs(
-        refs, 3, masked, rate)
+def _fwd_kernel(*refs, scale, causal, masked, rate, biased, block_q,
+                block_k):
+    (q_ref, k_ref, v_ref), bias_ref, valid_ref, seed_ref, tail = \
+        _split_refs(refs, 3, masked, rate, biased)
     o_ref, lse_ref, m_scr, l_scr, acc_scr = tail
 
     b = pl.program_id(0)
@@ -127,6 +128,8 @@ def _fwd_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
         v = v_ref[0].astype(jnp.float32)                      # (Bk, D)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if biased:
+            s = s + bias_ref[0].astype(jnp.float32)           # (Bq, Bk)
         s = _score_mask(s, valid, causal, qi, ki, block_q, block_k)
         m_prev = m_scr[:, 0]                                  # (Bq,)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -161,11 +164,16 @@ def _fwd_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
             jnp.float32)
 
 
-def _split_refs(refs, n_fixed, masked, rate):
-    """Unpack a kernel's ref list: (fixed input refs, valid_ref, seed_ref,
-    outputs+scratch tail).  The optional SMEM scalars sit between the fixed
-    inputs and the outputs, in (valid, seed) order."""
+def _split_refs(refs, n_fixed, masked, rate, biased=False):
+    """Unpack a kernel's ref list: (fixed input refs, bias_ref, valid_ref,
+    seed_ref, outputs+scratch tail).  The optional bias VMEM block comes
+    right after the fixed inputs; the optional SMEM scalars follow, in
+    (valid, seed) order."""
     i = n_fixed
+    bias_ref = None
+    if biased:
+        bias_ref = refs[i]
+        i += 1
     valid_ref = None
     if masked:
         valid_ref = refs[i]
@@ -174,7 +182,26 @@ def _split_refs(refs, n_fixed, masked, rate):
     if rate > 0.0:
         seed_ref = refs[i]
         i += 1
-    return refs[:n_fixed], valid_ref, seed_ref, refs[i:]
+    return refs[:n_fixed], bias_ref, valid_ref, seed_ref, refs[i:]
+
+
+def _bias_spec(bias, bh, bq, bk, swap=False):
+    """BlockSpec for the (BHB, T, Tk) bias: BHB may be BH (per-row), H
+    (shared across batch; picked via b %% H) or 1 (fully shared).  With
+    swap=True the grid is (b, kblk, qblk) — the dkv kernel's order."""
+    bhb = bias.shape[0]
+    if bhb == bh:
+        row = lambda b: b
+    elif bhb == 1:
+        row = lambda b: 0
+    else:  # per-head, shared over batch: fold index b = batch*H + h
+        h = bhb
+        row = lambda b: jax.lax.rem(b, h)
+    if swap:
+        return pl.BlockSpec((1, bq, bk), lambda b, j, i: (row(b), i, j),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, bq, bk), lambda b, i, j: (row(b), i, j),
+                        memory_space=pltpu.VMEM)
 
 
 def _extra_specs_and_args(kv_valid, seed):
@@ -192,18 +219,26 @@ def _extra_specs_and_args(kv_valid, seed):
     return specs, args
 
 
-def _fwd(q, k, v, kv_valid, seed, scale, causal, rate, block_q, block_k):
+def _fwd(q, k, v, kv_valid, seed, bias, scale, causal, rate, block_q,
+         block_k):
     bh, t, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, t)
     block_k = min(block_k, tk)
     grid = (bh, _cdiv(t, block_q), _cdiv(tk, block_k))
     masked = kv_valid is not None
+    biased = bias is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               masked=masked, rate=rate,
+                               masked=masked, rate=rate, biased=biased,
                                block_q=block_q, block_k=block_k)
+    bias_specs, bias_args = ([], [])
+    if biased:
+        bias_specs = [_bias_spec(bias, bh, block_q, block_k)]
+        bias_args = [bias]
     extra_specs, extra_args = _extra_specs_and_args(
         kv_valid, seed if rate > 0.0 else None)
+    extra_specs = bias_specs + extra_specs
+    extra_args = bias_args + extra_args
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -240,10 +275,16 @@ def _fwd(q, k, v, kv_valid, seed, scale, causal, rate, block_q, block_k):
 # ----------------------------------------------------------------------------
 # backward: dq kernel (grid k-innermost, accumulate dq over k blocks)
 # ----------------------------------------------------------------------------
-def _bwd_dq_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
-    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), valid_ref, seed_ref, \
-        tail = _split_refs(refs, 6, masked, rate)
-    dq_ref, dq_scr = tail
+def _bwd_dq_kernel(*refs, scale, causal, masked, rate, biased, block_q,
+                   block_k):
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), bias_ref, \
+        valid_ref, seed_ref, tail = _split_refs(refs, 6, masked, rate,
+                                                biased)
+    if biased:
+        dq_ref, db_ref, dq_scr = tail
+    else:
+        dq_ref, dq_scr = tail
+        db_ref = None
 
     b = pl.program_id(0)
     qi = pl.program_id(1)
@@ -255,6 +296,11 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
+    if biased:
+        # every (qi, ki) block of d_bias must be DEFINED even when the
+        # compute is skipped (causal/padding): zero first, overwrite below
+        db_ref[0] = jnp.zeros_like(db_ref[0])
+
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
@@ -264,6 +310,8 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
         delta = delta_ref[0][:, 0]                             # (Bq,)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if biased:
+            s = s + bias_ref[0].astype(jnp.float32)
         s = _score_mask(s, valid, causal, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse[:, None])                          # (Bq, Bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -273,7 +321,11 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
             # it is computed from the dropped forward output
             keep = _keep_mask(seed_ref, b, qi, ki, rate, block_q, block_k)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
-        ds = p * (dp - delta[:, None]) * scale
+        ds_raw = p * (dp - delta[:, None])
+        if biased:
+            # bias enters AFTER the qk scale: d_bias = p ∘ (dp − δ)
+            db_ref[0] = ds_raw.astype(db_ref.dtype)
+        ds = ds_raw * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -292,9 +344,11 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
 # ----------------------------------------------------------------------------
 # backward: dk/dv kernel (grid q-innermost, accumulate dk,dv over q blocks)
 # ----------------------------------------------------------------------------
-def _bwd_dkv_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
-    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), valid_ref, seed_ref, \
-        tail = _split_refs(refs, 6, masked, rate)
+def _bwd_dkv_kernel(*refs, scale, causal, masked, rate, biased, block_q,
+                    block_k):
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), bias_ref, \
+        valid_ref, seed_ref, tail = _split_refs(refs, 6, masked, rate,
+                                                biased)
     dk_ref, dv_ref, dk_scr, dv_scr = tail
 
     b = pl.program_id(0)
@@ -317,6 +371,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
         delta = delta_ref[0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if biased:
+            s = s + bias_ref[0].astype(jnp.float32)
         s = _score_mask(s, valid, causal, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse[:, None])                          # (Bq, Bk)
         if rate > 0.0:
@@ -352,16 +408,19 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, rate, block_q, block_k):
 
 
 def _bwd(scale, causal, rate, block_q, block_k, res, do):
-    q, k, v, kv_valid, seed, out, lse = res
+    q, k, v, kv_valid, seed, bias, out, lse = res
     bh, t, d = q.shape
     tk = k.shape[1]
     bq = min(block_q, t)
     bk = min(block_k, tk)
     masked = kv_valid is not None
+    biased = bias is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[..., None]                        # (BH, T, 1)
     extra_specs, extra_args = _extra_specs_and_args(
         kv_valid, seed if rate > 0.0 else None)
+    bias_specs = [_bias_spec(bias, bh, bq, bk)] if biased else []
+    bias_args = [bias] if biased else []
 
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
@@ -369,16 +428,42 @@ def _bwd(scale, causal, rate, block_q, block_k, res, do):
                          memory_space=pltpu.VMEM)
     rowq = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                         memory_space=pltpu.VMEM)
-    dq = pl.pallas_call(
+    # d_bias is emitted PER (b, qblk, kblk) at full (BH, T, Tk) and reduced
+    # to the caller's broadcast shape afterwards — the gradient of a
+    # materialized bias is inherently O(T²), same as the bias itself
+    out_specs = qspec
+    out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if biased:
+        dbspec = pl.BlockSpec((1, bq, bk), lambda b, i, j: (b, i, j),
+                              memory_space=pltpu.VMEM)
+        out_specs = [qspec, dbspec]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((bh, t, tk), jnp.float32)]
+    dq_out = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          masked=masked, rate=rate, block_q=bq, block_k=bk),
+                          masked=masked, rate=rate, biased=biased,
+                          block_q=bq, block_k=bk),
         grid=(bh, _cdiv(t, bq), _cdiv(tk, bk)),
-        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq] + extra_specs,
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq] + bias_specs
+        + extra_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta, *extra_args)
+    )(q, k, v, do, lse, delta, *bias_args, *extra_args)
+    if biased:
+        dq, db_full = dq_out
+        bhb = bias.shape[0]
+        if bhb == bh:
+            db = db_full
+        elif bhb == 1:
+            db = jnp.sum(db_full, axis=0, keepdims=True)
+        else:  # per-head bias shared over batch: sum the batch groups
+            db = jnp.sum(db_full.reshape(bh // bhb, bhb, t, tk), axis=0)
+        db = db.astype(bias.dtype)
+    else:
+        dq = dq_out
+        db = None
 
     # dk/dv: swap grid so q is innermost; index maps take (b, kblk, qblk)
     qspec2 = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
@@ -387,47 +472,50 @@ def _bwd(scale, causal, rate, block_q, block_k, res, do):
                           memory_space=pltpu.VMEM)
     rowq2 = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
                          memory_space=pltpu.VMEM)
+    bias_specs2 = [_bias_spec(bias, bh, bq, bk, swap=True)] if biased else []
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          masked=masked, rate=rate, block_q=bq, block_k=bk),
+                          masked=masked, rate=rate, biased=biased,
+                          block_q=bq, block_k=bk),
         grid=(bh, _cdiv(tk, bk), _cdiv(t, bq)),
         # the SMEM scalar index maps only use the leading batch axis, so the
         # same specs serve both backward grids
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2] + extra_specs,
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2]
+        + bias_specs2 + extra_specs,
         out_specs=[kspec2, kspec2],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta, *extra_args)
-    return dq, dk, dv, None, None
+    )(q, k, v, do, lse, delta, *bias_args, *extra_args)
+    return dq, dk, dv, None, None, db
 
 
 # ----------------------------------------------------------------------------
 # public entry
 # ----------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash_core(q, k, v, kv_valid, seed, scale, causal, rate,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_core(q, k, v, kv_valid, seed, bias, scale, causal, rate,
                 block_q, block_k):
-    out, _ = _fwd(q, k, v, kv_valid, seed, scale, causal, rate,
+    out, _ = _fwd(q, k, v, kv_valid, seed, bias, scale, causal, rate,
                   block_q, block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, kv_valid, seed, scale, causal, rate,
+def _flash_fwd_rule(q, k, v, kv_valid, seed, bias, scale, causal, rate,
                     block_q, block_k):
-    out, lse = _fwd(q, k, v, kv_valid, seed, scale, causal, rate,
+    out, lse = _fwd(q, k, v, kv_valid, seed, bias, scale, causal, rate,
                     block_q, block_k)
-    return out, (q, k, v, kv_valid, seed, out, lse)
+    return out, (q, k, v, kv_valid, seed, bias, out, lse)
 
 
 _flash_core.defvjp(_flash_fwd_rule, _bwd)
 
 
 def flash_attention(q, k, v, scale=None, causal=False, kv_valid=None,
-                    dropout_rate=0.0, dropout_seed=None,
-                    block_q=None, block_k=None):
+                    dropout_rate=0.0, dropout_seed=None, bias=None,
+                    bias_groups=None, block_q=None, block_k=None):
     """softmax(q·kᵀ·scale [+causal/padding mask])·v, blockwise.
     q/k/v: (BH, T, D).  scale defaults to 1/sqrt(D); blocks default to the
     tuned sizes.  T (for both q and k/v) must tile exactly by the chosen
@@ -437,7 +525,17 @@ def flash_attention(q, k, v, scale=None, causal=False, kv_valid=None,
     columns beyond it are masked out and whole K blocks beyond it skipped.
     dropout_rate/dropout_seed: attention-prob dropout inside the kernel
     (TPU only — the TPU PRNG has no interpret lowering); seed is a (1,)
-    int32 array, the mask is a pure function of it so fwd/bwd agree."""
+    int32 array, the mask is a pure function of it so fwd/bwd agree.
+    bias: optional additive attention bias (ALiBi, relative position) of
+    shape (BH, T, Tk), (1, T, Tk) fully shared, or (G, T, Tk) cycling
+    with period G — G MUST then be passed as bias_groups (the mha wrapper
+    passes H; a bare divisor would be ambiguous between per-head and
+    per-batch).  Streamed block-by-block.  The backward materializes a
+    (BH, T, Tk) f32 d_bias before reducing to the bias shape — the same
+    footprint the DENSE path pays for its probability matrix in the
+    forward (and keeps into backward), so the kernel path is never the
+    worse choice; it is simply the inherent cost of a materialized
+    O(T²) bias."""
     t, tk = q.shape[1], k.shape[1]
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
     block_q = block_q or _pick_block(t, 512)
@@ -469,8 +567,20 @@ def flash_attention(q, k, v, scale=None, causal=False, kv_valid=None,
         dropout_seed = None
     if kv_valid is not None:
         kv_valid = jnp.asarray(kv_valid, jnp.int32).reshape((q.shape[0],))
-    return _flash_core(q, k, v, kv_valid, dropout_seed, scale, causal,
-                       float(dropout_rate), block_q, block_k)
+    if bias is not None:
+        bh = q.shape[0]
+        ok_lead = (bias.shape[0] in (bh, 1) or
+                   (bias_groups is not None and
+                    bias.shape[0] == bias_groups and bh % bias_groups == 0))
+        if bias.ndim != 3 or bias.shape[1:] != (t, tk) or not ok_lead:
+            raise ValueError(
+                f"bias shape {bias.shape} must be (BH, {t}, {tk}), "
+                f"(1, {t}, {tk}), or (G, {t}, {tk}) with G passed as "
+                f"bias_groups and dividing BH={bh} — a bare divisor is "
+                "ambiguous between per-head and per-batch")
+    return _flash_core(q, k, v, kv_valid, dropout_seed, bias, scale,
+                       causal, float(dropout_rate), block_q, block_k)
+
 
 
 def _pick_block(t, prefer):
@@ -488,7 +598,7 @@ def _pick_block(t, prefer):
 
 
 def mha_flash_attention(q, k, v, causal=False, valid_length=None,
-                        dropout_rate=0.0, dropout_seed=None,
+                        dropout_rate=0.0, dropout_seed=None, bias=None,
                         block_q=None, block_k=None):
     """Multi-head wrapper: q/k/v are (B, H, T, D); collapses batch*heads,
     runs the Pallas kernel, restores the layout.  valid_length is per-batch
@@ -499,9 +609,29 @@ def mha_flash_attention(q, k, v, causal=False, valid_length=None,
     kv_valid = None
     if valid_length is not None:
         kv_valid = jnp.repeat(jnp.asarray(valid_length, jnp.int32), h)
+    kbias = None
+    bias_groups = None
+    if bias is not None:
+        # (B|1, H|1, Tq|1, Tk|1) -> kernel layout; singleton T dims are
+        # broadcast up front (the kernel streams full (T, Tk) planes)
+        tk = k.shape[2]
+        bb, bhh = bias.shape[0], bias.shape[1]
+        full_t = bias.shape[2:] == (t, tk)
+        if bb == b and bhh == h and full_t:
+            kbias = bias.reshape(b * h, t, tk)
+        elif bb == 1 and bhh == h and full_t:
+            kbias = bias.reshape(h, t, tk)
+            bias_groups = h
+        elif bb == 1 and bhh == 1 and full_t:
+            kbias = bias.reshape(1, t, tk)
+        else:
+            # singleton T/Tk dims or per-batch shared-head layouts:
+            # materialize the full fold (differentiable broadcast)
+            kbias = jnp.broadcast_to(bias, (b, h, t, tk)).reshape(
+                b * h, t, tk)
     out = flash_attention(fold(q), fold(k), fold(v), None, causal,
-                          kv_valid, dropout_rate, dropout_seed,
-                          block_q, block_k)
+                          kv_valid, dropout_rate, dropout_seed, kbias,
+                          bias_groups, block_q, block_k)
     return out.reshape(b, h, t, d)
 
 

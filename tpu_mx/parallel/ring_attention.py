@@ -243,22 +243,22 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
     """Single-device attention with the same numerics as the ring kernel.
     On TPU with tile-friendly shapes this runs the Pallas flash kernel
     (tpu_mx.kernels.flash_attention: blockwise online softmax, O(T) memory,
-    in-kernel padding mask and prob dropout); otherwise the XLA dense path.
-    An additive `bias` routes to the dense path (the Pallas kernel carries
-    masks and dropout but not arbitrary bias tensors)."""
+    in-kernel padding mask, prob dropout, and additive bias — ALiBi/
+    relative-position tensors stream block-by-block with a differentiable
+    d_bias); otherwise the XLA dense path."""
     from ..kernels import flash_attention as fa
     on_tpu = jax.default_backend() == "tpu"
     dropped = dropout_rate > 0.0 and dropout_key is not None
     rate = float(dropout_rate) if dropped else 0.0
-    if bias is None and on_tpu and \
-            fa.supported(q.shape, q.dtype, kv_len=k.shape[2],
-                         dropout_rate=rate):
+    if on_tpu and fa.supported(q.shape, q.dtype, kv_len=k.shape[2],
+                               dropout_rate=rate):
         _count("pallas_flash", f"shape={q.shape}")
         seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1,
                                    jnp.int32) if dropped else None)
         return fa.mha_flash_attention(q, k, v, causal=causal,
                                       valid_length=valid_length,
-                                      dropout_rate=rate, dropout_seed=seed)
+                                      dropout_rate=rate, dropout_seed=seed,
+                                      bias=bias)
     _count("xla_dense",
            f"shape={q.shape} dtype={q.dtype} kv_len={k.shape[2]}",
            warn=on_tpu)  # CPU dense path is expected; only warn on TPU
