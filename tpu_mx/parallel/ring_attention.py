@@ -98,8 +98,25 @@ def _merge(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
+def _ring_chunk(tb, prefer=1024):
+    """Static inner-chunk size for one ring step: the per-step score block
+    is (Tb, C), NOT (Tb, Tb) — this is what keeps device memory O(T/n·C)
+    at long context instead of O((T/n)²).  `prefer` is overridable per
+    call (ring_attention(step_chunk=...)); any value that doesn't divide
+    Tb falls down the power-of-two ladder."""
+    if tb <= prefer:
+        return tb
+    if tb % prefer == 0:
+        return prefer
+    for c in (512, 256, 128):
+        if c <= prefer and tb % c == 0:
+            return c
+    return tb
+
+
 def _ring_body(q, k, v, valid, seed, bias, *, axis_name, causal, scale,
-               rate, masked, dropped, biased, key_axes=()):
+               rate, masked, dropped, biased, key_axes=(),
+               step_chunk=None):
     """Runs inside shard_map: q/k/v are LOCAL blocks (B, H, Tb, D);
     valid (B,) global key counts (replicated over the ring) or a dummy;
     seed (1,) int32 or a dummy — staticness comes from masked/dropped;
@@ -122,30 +139,50 @@ def _ring_body(q, k, v, valid, seed, bias, *, axis_name, causal, scale,
         for ax in key_axes:
             base_key = jax.random.fold_in(base_key, lax.axis_index(ax))
 
-    def step(carry, i):
-        m, l, o, k_cur, v_cur = carry
-        k_idx = (my_idx - i) % n  # whose K block we currently hold
-        kpos = k_idx * Tb + jnp.arange(Tb)
+    C = _ring_chunk(Tb, step_chunk) if step_chunk else _ring_chunk(Tb)
+    nchunks = Tb // C
+    qpos = my_idx * Tb + jnp.arange(Tb)
+
+    def _sub_attn(m, l, o, k_idx, i, ci, k_sub, v_sub):
+        """One (Tb, C) sub-block of the current ring step: masks/bias/
+        dropout keys all derive from the GLOBAL key position of the
+        chunk, so chunking changes memory, not math (dropout draws are
+        keyed per (step, chunk) instead of per step — an equally valid
+        stream, noted in the docstring)."""
+        kpos = k_idx * Tb + ci * C + jnp.arange(C)
         mask = None
         if causal:
-            # global positions: q row r -> my_idx*Tb + r; k col c -> kpos[c]
-            qpos = my_idx * Tb + jnp.arange(Tb)
             mask = (qpos[:, None] >= kpos[None, :])[None, None]
         if masked:
-            # the padding mask rides the rotating K index: this k block's
-            # global columns are valid iff kpos < valid_length[b]
             km = kpos[None, None, None, :] < valid[:, None, None, None]
             mask = km if mask is None else jnp.logical_and(mask, km)
         b_blk = None
         if biased:
-            # bias columns for the K block currently held
-            b_blk = lax.dynamic_slice_in_dim(bias, k_idx * Tb, Tb, axis=3)
-        key_i = jax.random.fold_in(base_key, i) if dropped else None
-        bm, bl, bo = _block_attn(q, k_cur, v_cur, bias=b_blk, mask=mask,
+            b_blk = lax.dynamic_slice_in_dim(bias, k_idx * Tb + ci * C, C,
+                                             axis=3)
+        key_i = (jax.random.fold_in(base_key, i * nchunks + ci)
+                 if dropped else None)
+        bm, bl, bo = _block_attn(q, k_sub, v_sub, bias=b_blk, mask=mask,
                                  scale=scale,
                                  dropout_rate=rate if dropped else 0.0,
                                  dropout_key=key_i)
-        m, l, o = _merge(m, l, o, bm, bl, bo)
+        return _merge(m, l, o, bm, bl, bo)
+
+    def step(carry, i):
+        m, l, o, k_cur, v_cur = carry
+        k_idx = (my_idx - i) % n  # whose K block we currently hold
+        if nchunks == 1:
+            m, l, o = _sub_attn(m, l, o, k_idx, i, 0, k_cur, v_cur)
+        else:
+            def kchunk(c2, ci):
+                m2, l2, o2 = c2
+                k_sub = lax.dynamic_slice_in_dim(k_cur, ci * C, C, axis=2)
+                v_sub = lax.dynamic_slice_in_dim(v_cur, ci * C, C, axis=2)
+                return _sub_attn(m2, l2, o2, k_idx, i, ci, k_sub,
+                                 v_sub), None
+
+            (m, l, o), _ = lax.scan(kchunk, (m, l, o),
+                                    jnp.arange(nchunks))
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (m, l, o, k_nxt, v_nxt), None
@@ -157,7 +194,8 @@ def _ring_body(q, k, v, valid, seed, bias, *, axis_name, causal, scale,
 
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
                    q_spec=None, valid_length=None, dropout_rate=0.0,
-                   dropout_key=None, bias=None, batch_axes=("dp", "tp")):
+                   dropout_key=None, bias=None, batch_axes=("dp", "tp"),
+                   step_chunk=None):
     """Sequence-parallel attention.  q/k/v: GLOBAL (B, H, T, D) arrays whose
     T axis is sharded over `axis_name`.  Returns attention output with the
     same sharding.  `q_spec` overrides the default
@@ -206,7 +244,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
         functools.partial(_ring_body, axis_name=axis_name, causal=causal,
                           scale=scale, rate=float(dropout_rate),
                           masked=masked, dropped=dropped, biased=biased,
-                          key_axes=key_axes),
+                          key_axes=key_axes, step_chunk=step_chunk),
         mesh=mesh, in_specs=(spec, spec, spec, vspec, P(None), bspec),
         out_specs=spec, check_rep=False)
     return fn(q, k, v, valid, seed, bias_arr)
