@@ -410,3 +410,22 @@ def test_poisson_nll_loss():
     assert np.isfinite(rate.grad.asnumpy()).all()
     full = PoissonNLLLoss(from_logits=True, compute_full=True)(pred, label)
     assert float(full.asscalar()) > float(l.asscalar())  # stirling adds
+
+
+def test_reflectionpad_and_conv3dtranspose():
+    rp = nn.ReflectionPad2D(1)
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = rp(x)
+    ref = np.pad(np.asarray(x._data), ((0, 0), (0, 0), (1, 1), (1, 1)),
+                 mode="reflect")
+    np.testing.assert_array_equal(np.asarray(y._data), ref)
+    # grads flow through the pad
+    xx = nd.array(np.random.rand(1, 1, 4, 4).astype(np.float32))
+    xx.attach_grad()
+    with autograd.record():
+        rp(xx).square().sum().backward()
+    assert np.isfinite(np.asarray(xx.grad._data)).all()
+
+    ct = nn.Conv3DTranspose(4, 3, in_channels=2)
+    ct.initialize()
+    assert ct(nd.ones((1, 2, 4, 4, 4))).shape == (1, 4, 6, 6, 6)

@@ -7,7 +7,7 @@ from ... import autograd
 from ... import layout as _layout_mod
 from ..block import Block, HybridBlock
 
-__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm", "GroupNorm",
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm", "GroupNorm", "ReflectionPad2D",
            "LayerNorm", "InstanceNorm", "Embedding", "Flatten", "Activation",
            "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish", "Lambda",
            "HybridLambda"]
@@ -360,6 +360,26 @@ class Swish(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return x * F.sigmoid(self._beta * x)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H/W of NCHW input
+    (REF basic_layers.py:ReflectionPad2D)."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (padding,) * 4  # (left, right, top, bottom)
+        self._pad = tuple(int(p) for p in padding)
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        from ...ndarray import ops as O
+        l, r, t, b = self._pad
+        return O._apply(
+            lambda a: jnp.pad(a, ((0, 0), (0, 0), (t, b), (l, r)),
+                              mode="reflect"),
+            [x], "ReflectionPad2D")
 
 
 class Lambda(Block):

@@ -14,7 +14,7 @@ from ... import layout as _layout_mod
 from ..block import HybridBlock
 from .basic_layers import Activation
 
-__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
            "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
            "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
            "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D"]
@@ -143,6 +143,21 @@ class Conv2DTranspose(_Conv):
                  layout=None, in_channels=0, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", **kwargs):
         super().__init__(channels, _tuple(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    """REF conv_layers.py:Conv3DTranspose (NCDHW)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout=None, in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, _tuple(kernel_size, 3), strides, padding,
                          dilation, groups, layout, in_channels, activation,
                          use_bias, weight_initializer, bias_initializer,
                          transpose=True, output_padding=output_padding,
