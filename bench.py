@@ -416,29 +416,68 @@ def inner():
 # ---------------------------------------------------------------------------
 # outer: supervisor — no jax import, hard timeouts, retry, partial JSON
 # ---------------------------------------------------------------------------
+def _run_attempt(timeout, probe_timeout):
+    """Run one --inner child.  The child's stderr is teed through so the
+    stage log stays visible, and watched for the 'backend up' marker: a
+    wedged tunnel (jax.devices() hanging in a C call — observed for hours
+    in round 3) is killed after probe_timeout instead of burning the full
+    budget.  Returns (rc, stdout_lines, err_or_None)."""
+    import threading
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--inner"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    backend_up = threading.Event()
+
+    def tee():
+        for line in proc.stderr:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            if "backend up" in line:
+                backend_up.set()
+
+    t = threading.Thread(target=tee, daemon=True)
+    t.start()
+    start = time.monotonic()
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            break
+        elapsed = time.monotonic() - start
+        if not backend_up.is_set() and elapsed > probe_timeout:
+            proc.kill()
+            proc.wait()
+            return None, [], (f"backend probe did not come up within "
+                              f"{probe_timeout:.0f}s (tunnel wedged?)")
+        if elapsed > timeout:
+            proc.kill()
+            proc.wait()
+            return None, [], f"timed out after {timeout:.0f}s"
+        time.sleep(1.0)
+    out = (proc.stdout.read() or "").strip().splitlines()
+    return rc, out, None
+
+
 def outer():
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
     # two full workloads now compile+run in one attempt (~8-12 min on the
     # tunneled chip); 1500s keeps a slow-but-alive run from being killed
     timeout = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
     last_err = "unknown"
     for attempt in range(1, attempts + 1):
-        log(f"attempt {attempt}/{attempts} (timeout {timeout:.0f}s)")
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--inner"],
-                stdout=subprocess.PIPE, timeout=timeout, text=True)
-        except subprocess.TimeoutExpired:
-            last_err = f"attempt {attempt} timed out after {timeout:.0f}s"
+        log(f"attempt {attempt}/{attempts} (timeout {timeout:.0f}s, "
+            f"probe {probe_timeout:.0f}s)")
+        rc, out, err = _run_attempt(timeout, probe_timeout)
+        if err is not None:
+            last_err = f"attempt {attempt}: {err}"
             log(last_err + "; backing off 15s")
             time.sleep(15)
             continue
-        out = (proc.stdout or "").strip().splitlines()
         json_lines = [ln for ln in out if ln.startswith("{")]
-        if proc.returncode == 0 and json_lines:
+        if rc == 0 and json_lines:
             print(json_lines[-1], flush=True)
             return 0
-        last_err = (f"attempt {attempt} rc={proc.returncode}, "
+        last_err = (f"attempt {attempt} rc={rc}, "
                     f"stdout tail: {out[-3:] if out else '(empty)'}")
         log(last_err + "; backing off 15s")
         time.sleep(15)
