@@ -228,3 +228,13 @@ def dumps(reset=False):
         lines.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f" %
                      (name, n, tot * 1e3, tot / n * 1e3, mn * 1e3, mx * 1e3))
     return "\n".join(lines)
+
+
+# deprecated aliases kept for reference import parity
+# (REF:python/mxnet/profiler.py profiler_set_config/profiler_set_state)
+def profiler_set_config(**kwargs):
+    return set_config(**kwargs)
+
+
+def profiler_set_state(state="stop"):
+    return set_state(state)
