@@ -1018,3 +1018,23 @@ def test_ring_attention_chunked_step_matches_dense(causal):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-4, atol=3e-5)
+
+
+def test_ring_attention_long_seq_chunked():
+    """T=2048 over sp=8 with 128-sized inner chunks (Tb=256 -> 2 chunks):
+    the realistic long-context shape class, forward vs dense."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.parallel import ring_attention
+    mesh = _mesh(sp=8)
+    B, H, T, D = 1, 2, 2048, 16
+    rng = np.random.RandomState(5)
+    q, k, v = (jnp.asarray(rng.rand(B, H, T, D).astype(np.float32))
+               for _ in range(3))
+    out = ring_attention(q, k, v, mesh, causal=True, step_chunk=128)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    cm = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+    s = jnp.where(cm[None, None], s, -jnp.inf)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
