@@ -385,3 +385,29 @@ def test_native_im2rec_dct_downscale_still_resizes(tmp_path):
     _h, img_bytes = recordio.unpack(rec.read_idx(0))
     a = cv2.imdecode(np.frombuffer(img_bytes, np.uint8), cv2.IMREAD_COLOR)
     assert min(a.shape[:2]) == 64, a.shape
+
+
+def test_native_packed_rec_through_image_record_iter(tmp_path):
+    """A --native-packed .rec feeds mx.io.ImageRecordIter end-to-end (the
+    CLI drive's assertion, kept as a regression test)."""
+    import cv2
+    import tpu_mx as mx
+    from tpu_mx.lib.recordio_cpp import native_im2rec
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    rng = np.random.RandomState(2)
+    lines = []
+    for i in range(6):
+        img = (rng.rand(40, 50, 3) * 255).astype(np.uint8)
+        cv2.imwrite(str(imgdir / f"i{i}.jpg"), img)
+        lines.append(f"{i}\t{float(i % 2)}\ti{i}.jpg")
+    (tmp_path / "d.lst").write_text("\n".join(lines) + "\n")
+    n = native_im2rec(str(tmp_path / "d.lst"), str(imgdir),
+                      str(tmp_path / "d"), resize=32)
+    assert n == 6
+    it = mx.io.ImageRecordIter(path_imgrec=str(tmp_path / "d.rec"),
+                               data_shape=(3, 28, 28), batch_size=3,
+                               resize=28)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (3, 3, 28, 28)
+    assert batch.label[0].shape == (3,)
