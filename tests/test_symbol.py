@@ -279,3 +279,15 @@ def test_new_ops_nd_sym_parity(op, kwargs, shape):
     ex.arg_dict["data"][:] = x
     (y,) = ex.forward()
     np.testing.assert_allclose(y.asnumpy(), nd_out, rtol=1e-5, atol=1e-6)
+
+
+def test_attr_scope_survives_json_roundtrip(tmp_path):
+    with mx.AttrScope(ctx_group="dev1"):
+        s = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=4,
+                                  name="fc")
+    f = str(tmp_path / "s.json")
+    s.save(f)
+    s2 = mx.sym.load(f)
+    assert s2.attr("ctx_group") == "dev1"
+    wname = [k for k in s2.list_arguments() if k.endswith("_weight")][0]
+    assert s2.attr_dict().get(wname, {}).get("ctx_group") == "dev1"
