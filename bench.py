@@ -38,6 +38,12 @@ import subprocess
 import sys
 import time
 
+def _lastgood_path():
+    return os.environ.get(
+        "BENCH_LASTGOOD_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_LASTGOOD.json"))
+
 A100_RESNET50 = 2800.0   # img/s, BASELINE.md ballpark (AMP, 1×A100-80GB)
 A100_BERT_BASE = 245.0   # seq/s, BASELINE.md ballpark midpoint (phase-1 128)
 V5E_PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e chip
@@ -61,6 +67,75 @@ def bert_train_flops_per_seq(num_layers, units, hidden, vocab, seq_len,
 def log(msg):
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
           flush=True)
+
+
+def persist_lastgood(rec):
+    """Write the measurement to BENCH_LASTGOOD.json the moment it exists
+    (VERDICT r3 weak#2: round 3's official record was 0.0/error while a
+    real number measured 11 h earlier sat only in an interim note — every
+    good measurement must survive the process that produced it).  Atomic
+    via tmp+rename so a kill mid-write can't corrupt the last record.
+    Smoke-mode runs never persist: a CPU smoke number (whose metric name
+    may not say "smoke" — e.g. weak_scaling_efficiency_dp8) must never
+    mask a real-chip record.  The store is keyed by metric so a
+    BENCH_MODELS=bert (or scaling) run can never clobber the resnet
+    record.  Persist failures are logged, never raised: the resilience
+    layer must not be able to kill a successful measurement run."""
+    if os.environ.get("BENCH_SMOKE") == "1" or \
+            "smoke" in rec.get("metric", ""):
+        return
+    try:
+        path = _lastgood_path()
+        try:
+            with open(path) as f:
+                store = json.load(f)
+        except (OSError, ValueError):
+            store = {}
+        if not isinstance(store, dict):
+            store = {}
+        records = store.get("records")
+        if not isinstance(records, dict):
+            records = {}
+        records[rec["metric"]] = {
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "record": rec}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"records": records}, f, indent=1)
+        os.replace(tmp, path)
+    except Exception as e:
+        log(f"persist_lastgood failed (measurement still emitted): "
+            f"{type(e).__name__}: {e}")
+
+
+PRIMARY_METRIC = "resnet50_train_images_per_sec_per_chip"
+
+
+def load_lastgood():
+    """Best stored measurement: the primary resnet metric if present,
+    else the most recently measured other metric.  Returns (measured_at,
+    record) or (None, None).  Tolerates any malformed store content —
+    this is the outer supervisor's last-ditch path and must never raise
+    (the driver contract is 'ALWAYS emit a JSON line')."""
+    try:
+        with open(_lastgood_path()) as f:
+            store = json.load(f)
+        records = store.get("records", {})
+        entries = [v for v in records.values()
+                   if isinstance(v, dict) and isinstance(v.get("record"),
+                                                         dict)]
+        entries = [v for v in entries
+                   if isinstance(v["record"].get("value"), (int, float))
+                   and v["record"]["value"] > 0]
+        if not entries:
+            return None, None
+        for v in entries:
+            if v["record"].get("metric") == PRIMARY_METRIC:
+                return v.get("measured_at"), v["record"]
+        v = max(entries, key=lambda v: str(v.get("measured_at", "")))
+        return v.get("measured_at"), v["record"]
+    except Exception:
+        return None, None
 
 
 # ---------------------------------------------------------------------------
@@ -364,6 +439,13 @@ def inner():
     if smoke:
         jax.config.update("jax_platforms", "cpu")
 
+    if os.environ.get("BENCH_SIMULATE_WEDGE") == "1":
+        # test hook for the outer supervisor's wedge handling: behave like
+        # the round-3 tunnel (jax.devices() stuck in a C call, 'backend up'
+        # never printed) without needing a broken backend
+        log("probing backend (jax.devices)...")
+        time.sleep(3600)
+
     log("probing backend (jax.devices)...")
     t0 = time.perf_counter()
     devs = jax.devices()
@@ -381,10 +463,12 @@ def inner():
     if "resnet50" in models:
         rec = bench_resnet(smoke, layout, stem)
         if rec is not None:
-            # stream the primary record as soon as it exists: if a later
-            # sub-bench dies/hangs and the attempt is killed, the outer's
-            # next attempt can still narrow BENCH_MODELS from the logs
+            # stream + persist the primary record as soon as it exists: if
+            # a later sub-bench dies/hangs and the attempt is killed, the
+            # measurement still survives on disk (and the outer's next
+            # attempt can narrow BENCH_MODELS from the logs)
             log("resnet record: " + json.dumps(rec))
+            persist_lastgood(rec)
     bert_rec = scal_rec = None
     try:
         bert_rec = bench_bert(smoke) if "bert" in models else None
@@ -410,6 +494,7 @@ def inner():
         rec["bert"] = bert_rec
     if scal_rec is not None and rec is not scal_rec:
         rec["scaling"] = scal_rec
+    persist_lastgood(rec)
     print(json.dumps(rec), flush=True)
 
 
@@ -468,20 +553,29 @@ def outer():
         log(f"attempt {attempt}/{attempts} (timeout {timeout:.0f}s, "
             f"probe {probe_timeout:.0f}s)")
         rc, out, err = _run_attempt(timeout, probe_timeout)
-        if err is not None:
-            last_err = f"attempt {attempt}: {err}"
+        if err is None:
+            json_lines = [ln for ln in out if ln.startswith("{")]
+            if rc == 0 and json_lines:
+                print(json_lines[-1], flush=True)
+                return 0
+            err = f"rc={rc}, stdout tail: {out[-3:] if out else '(empty)'}"
+        last_err = f"attempt {attempt}: {err}"
+        if attempt < attempts:
             log(last_err + "; backing off 15s")
             time.sleep(15)
-            continue
-        json_lines = [ln for ln in out if ln.startswith("{")]
-        if rc == 0 and json_lines:
-            print(json_lines[-1], flush=True)
-            return 0
-        last_err = (f"attempt {attempt} rc={rc}, "
-                    f"stdout tail: {out[-3:] if out else '(empty)'}")
-        log(last_err + "; backing off 15s")
-        time.sleep(15)
-    # every attempt failed — still emit parseable JSON for the driver
+    # every attempt failed — emit the last in-session good measurement,
+    # clearly marked stale, instead of surrendering the round's record to
+    # a wedged tunnel (VERDICT r3 ask#8); 0.0 only if none ever existed
+    measured_at, lastgood = load_lastgood()
+    if lastgood is not None:
+        rec = dict(lastgood)
+        rec["stale"] = True
+        rec["measured_at"] = measured_at
+        rec["error"] = last_err
+        log(f"all attempts failed; emitting last good measurement "
+            f"from {measured_at} marked stale")
+        print(json.dumps(rec), flush=True)
+        return 0
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": 0.0,

@@ -1,0 +1,376 @@
+"""Real-chip validation sweep for the Pallas/kernel tail (VERDICT r3 ask#2).
+
+Round 3 landed in-kernel attention bias, ring inner chunking, the dropout
+seed-fold fix, and the BERT remat path AFTER the tunnel wedged — none of it
+has ever executed on TPU silicon, and round 2 proved interpret-mode green
+is not chip green (real Mosaic enforces PRNG limits the CPU interpreter
+does not).  This runner executes each of those paths on `jax.devices()[0]`
+of a real TPU backend and records a per-check pass/fail artifact
+(TPU_VALIDATION_r04.json) for the judge.
+
+Run via tools/tpu_watch.py the moment the tunnel is up, or by hand:
+    python tools/tpu_validate.py [--out PATH] [--skip-bert]
+
+Each check is isolated: one Mosaic rejection must not mask the others.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg):
+    print(f"[validate {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _dense_ref(q, k, v, causal=False, valid_length=None, bias=None):
+    """O(T²) reference attention in f32 — the oracle for every kernel
+    check (same contract as kernels.flash_attention)."""
+    import jax
+    import jax.numpy as jnp
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if bias is not None:
+        s = s + jnp.broadcast_to(bias, s.shape).astype(jnp.float32)
+    t, tk = s.shape[-2], s.shape[-1]
+    if causal:
+        s = jnp.where(jnp.arange(t)[:, None] >= jnp.arange(tk)[None, :],
+                      s, -1e30)
+    if valid_length is not None:
+        km = jnp.arange(tk)[None, None, None, :] < \
+            jnp.asarray(valid_length)[:, None, None, None]
+        s = jnp.where(km, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vf).astype(q.dtype)
+
+
+def _max_err(a, b):
+    import numpy as np
+    return float(np.max(np.abs(np.asarray(a, np.float32) -
+                               np.asarray(b, np.float32))))
+
+
+def check_flash_fwd_bwd_vs_dense():
+    """Flash kernel fwd+bwd vs dense oracle, f32 and bf16, causal and
+    not."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.kernels.flash_attention import mha_flash_attention
+    b, h, t, d = 2, 4, 512, 64
+    key = jax.random.PRNGKey(0)
+    qk, kk, vk = jax.random.split(key, 3)
+    results = {}
+    for dtype, tol in ((jnp.float32, 2e-3), (jnp.bfloat16, 4e-2)):
+        q = jax.random.normal(qk, (b, h, t, d), dtype)
+        k = jax.random.normal(kk, (b, h, t, d), dtype)
+        v = jax.random.normal(vk, (b, h, t, d), dtype)
+        for causal in (False, True):
+            f = lambda q, k, v: mha_flash_attention(
+                q, k, v, causal=causal).astype(jnp.float32).sum()
+            g = lambda q, k, v: _dense_ref(
+                q, k, v, causal=causal).astype(jnp.float32).sum()
+            out = mha_flash_attention(q, k, v, causal=causal)
+            ref = _dense_ref(q, k, v, causal=causal)
+            e_out = _max_err(out, ref)
+            gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+            gd = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+            e_grad = max(_max_err(a, b) for a, b in zip(gf, gd))
+            tag = f"{jnp.dtype(dtype).name}_causal={causal}"
+            results[tag] = {"out_err": e_out, "grad_err": e_grad}
+            # grad tolerance is looser: sum-of-T cotangents accumulate
+            if e_out > tol or e_grad > tol * 20:
+                raise AssertionError(f"{tag}: out_err={e_out} "
+                                     f"grad_err={e_grad} tol={tol}")
+    return results
+
+
+def check_flash_bias_layouts():
+    """All broadcast layouts of the additive attention bias (r3 commit
+    f1c476b, never chip-run): per-batch-head, shared-batch (G=H cycling),
+    fully shared, and singleton-T broadcast.  fwd vs dense + d_bias."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.kernels.flash_attention import mha_flash_attention
+    b, h, t, d = 2, 4, 256, 64
+    key = jax.random.PRNGKey(1)
+    qk, kk, vk, bk = jax.random.split(key, 4)
+    q = jax.random.normal(qk, (b, h, t, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, t, d), jnp.float32)
+    v = jax.random.normal(vk, (b, h, t, d), jnp.float32)
+    results = {}
+    for shape in ((b, h, t, t), (1, h, t, t), (1, 1, t, t), (b, 1, 1, t)):
+        bias = jax.random.normal(bk, shape, jnp.float32)
+        out = mha_flash_attention(q, k, v, bias=bias)
+        ref = _dense_ref(q, k, v, bias=bias)
+        e_out = _max_err(out, ref)
+        f = lambda bb: mha_flash_attention(q, k, v, bias=bb).sum()
+        g = lambda bb: _dense_ref(q, k, v, bias=bb).sum()
+        db_f = jax.grad(f)(bias)
+        db_d = jax.grad(g)(bias)
+        e_db = _max_err(db_f, db_d)
+        results[str(shape)] = {"out_err": e_out, "dbias_err": e_db}
+        if e_out > 2e-3 or e_db > 2e-2:
+            raise AssertionError(f"bias {shape}: out_err={e_out} "
+                                 f"dbias_err={e_db}")
+    return results
+
+
+def check_flash_dropout():
+    """In-kernel attention-prob dropout (TPU PRNG; r3 seed-fold fix,
+    never chip-run): determinism under the same seed, divergence across
+    seeds, keep-rate sanity, finite grads, and fwd/bwd mask agreement via
+    directional-derivative consistency."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.kernels.flash_attention import mha_flash_attention
+    b, h, t, d = 2, 4, 256, 64
+    rate = 0.25
+    key = jax.random.PRNGKey(2)
+    qk, kk, vk = jax.random.split(key, 3)
+    q = jax.random.normal(qk, (b, h, t, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, t, d), jnp.float32)
+    v = jax.random.normal(vk, (b, h, t, d), jnp.float32)
+    seed = jnp.array([1234], jnp.int32)
+    run = lambda s: mha_flash_attention(q, k, v, dropout_rate=rate,
+                                        dropout_seed=s)
+    o1, o2 = run(seed), run(seed)
+    if _max_err(o1, o2) != 0.0:
+        raise AssertionError("same seed produced different outputs")
+    o3 = run(jnp.array([999], jnp.int32))
+    if _max_err(o1, o3) == 0.0:
+        raise AssertionError("different seeds produced identical outputs")
+    # fwd/bwd mask agreement via directional derivative in v: with the
+    # mask and probs fixed, the output is LINEAR in v, so f = mean(O²) is
+    # quadratic and the central difference is exact up to rounding — any
+    # mismatch means the backward regenerated a different dropout mask
+    u = jax.random.normal(jax.random.PRNGKey(7), v.shape, jnp.float32)
+    f = lambda vv: (mha_flash_attention(q, k, vv, dropout_rate=rate,
+                                        dropout_seed=seed) ** 2).mean()
+    gv = jax.grad(f)(v)
+    if not bool(jnp.isfinite(gv).all()):
+        raise AssertionError("non-finite dropout grads")
+    eps = 3e-3
+    analytic = float((gv * u).sum())
+    numeric = float((f(v + eps * u) - f(v - eps * u)) / (2 * eps))
+    rel = abs(analytic - numeric) / max(abs(numeric), 1e-6)
+    if rel > 5e-2:
+        raise AssertionError(
+            f"fwd/bwd dropout masks disagree: directional derivative "
+            f"analytic={analytic:.6f} numeric={numeric:.6f} rel={rel:.4f}")
+    # keep-rate sanity: ratio of dropped-softmax mass ≈ keep probability
+    dense = _dense_ref(q, k, v)
+    ratio = float(np.mean(np.asarray(o1) != np.asarray(dense)))
+    return {"determinism": "ok", "grad_finite": True,
+            "dir_deriv_rel_err": rel, "fraction_changed": ratio}
+
+
+def check_flash_kv_valid():
+    """Ragged key-padding masks (kv_valid) vs dense mask oracle."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.kernels.flash_attention import mha_flash_attention
+    b, h, t, d = 4, 2, 512, 64
+    key = jax.random.PRNGKey(3)
+    qk, kk, vk = jax.random.split(key, 3)
+    q = jax.random.normal(qk, (b, h, t, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, t, d), jnp.float32)
+    v = jax.random.normal(vk, (b, h, t, d), jnp.float32)
+    vl = jnp.array([512, 300, 128, 17], jnp.int32)
+    out = mha_flash_attention(q, k, v, valid_length=vl)
+    ref = _dense_ref(q, k, v, valid_length=vl)
+    e = _max_err(out, ref)
+    if e > 2e-3:
+        raise AssertionError(f"kv_valid out_err={e}")
+    return {"out_err": e}
+
+
+def check_flash_t2048():
+    """T=2048 blockwise path (the long-context tile) fwd+bwd, bf16."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.kernels.flash_attention import mha_flash_attention
+    b, h, t, d = 1, 4, 2048, 64
+    key = jax.random.PRNGKey(4)
+    qk, kk, vk = jax.random.split(key, 3)
+    q = jax.random.normal(qk, (b, h, t, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
+    v = jax.random.normal(vk, (b, h, t, d), jnp.bfloat16)
+    out = mha_flash_attention(q, k, v, causal=True)
+    ref = _dense_ref(q, k, v, causal=True)
+    e = _max_err(out, ref)
+    g = jax.grad(lambda q, k, v: mha_flash_attention(
+        q, k, v, causal=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    finite = all(bool(jnp.isfinite(x.astype(jnp.float32)).all()) for x in g)
+    if e > 6e-2 or not finite:
+        raise AssertionError(f"T=2048: out_err={e} grads_finite={finite}")
+    return {"out_err": e, "grads_finite": finite}
+
+
+def check_ring_inner_chunking():
+    """Ring attention with O(T/n·C) inner chunking (r3 commit 75dab47,
+    never chip-run) at T=2048 on an sp=1 single-chip mesh: the full
+    shard_map ring body — scan, ppermute, chunked local attention —
+    compiles and matches dense numerics on real silicon."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    import numpy as np
+    from tpu_mx.parallel.ring_attention import ring_attention
+    b, h, t, d = 1, 4, 2048, 64
+    key = jax.random.PRNGKey(5)
+    qk, kk, vk = jax.random.split(key, 3)
+    q = jax.random.normal(qk, (b, h, t, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, t, d), jnp.float32)
+    v = jax.random.normal(vk, (b, h, t, d), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    out = ring_attention(q, k, v, mesh, causal=True, step_chunk=512)
+    ref = _dense_ref(q, k, v, causal=True)
+    e = _max_err(out, ref)
+    if e > 2e-3:
+        raise AssertionError(f"ring sp=1 T=2048 out_err={e}")
+    return {"out_err": e, "step_chunk": 512}
+
+
+def check_bert_remat_batch512():
+    """The full BERT-base remat train step at batch 512 — the exact config
+    that OOM'd pre-remat in round 3 (27 GB > 16 GB HBM).  Runs 3 steps and
+    records rough seq/s (the bench owns the official number)."""
+    import numpy as np
+    import tpu_mx as mx
+    from tpu_mx import gluon, nd
+    from tpu_mx.models.bert import BERTModel, bert_base_config
+    from tpu_mx.parallel import CompiledTrainStep
+    batch, seq_len = 512, 128
+    cfg = bert_base_config(max_len=seq_len)
+    net = BERTModel(cfg, dtype="bfloat16", remat=True)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(4, cfg["vocab_size"], (batch, seq_len)).astype(
+        np.int32)
+    types = np.zeros((batch, seq_len), np.int32)
+    n_masked = max(1, int(0.15 * seq_len))
+    positions = np.stack([rng.choice(seq_len, n_masked, replace=False)
+                          for _ in range(batch)]).astype(np.int32)
+    labels = np.take_along_axis(tokens, positions, axis=1)
+    net(nd.array(tokens[:1]), nd.array(types[:1]), None,
+        nd.array(positions[:1]))
+
+    class MLMLoss(gluon.loss.Loss):
+        def __init__(self, **kw):
+            super().__init__(weight=None, batch_axis=0, **kw)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, logits, labels):
+            vocab = logits.shape[-1]
+            return F.mean(self._ce(F.reshape(logits, shape=(-1, vocab)),
+                                   F.reshape(labels, shape=(-1,))))
+
+    opt = mx.optimizer.create("lamb", learning_rate=1e-4,
+                              multi_precision=True)
+    step = CompiledTrainStep(net, MLMLoss(), opt)
+    args = (nd.array(tokens), nd.array(types), None, nd.array(positions),
+            nd.array(labels))
+    fetch = lambda l: float(np.asarray(l._data).ravel()[0])
+    loss = step.step(*args)
+    first = fetch(loss)
+    t0 = time.perf_counter()
+    n = 3
+    for _ in range(n):
+        loss = step.step(*args)
+    last = fetch(loss)
+    dt = time.perf_counter() - t0
+    if not np.isfinite(first) or not np.isfinite(last):
+        raise AssertionError(f"non-finite loss: first={first} last={last}")
+    return {"batch": batch, "seq_len": seq_len, "remat": True,
+            "rough_seqs_per_sec": round(batch * n / dt, 1),
+            "loss_first": first, "loss_last": last}
+
+
+CHECKS = [
+    ("flash_fwd_bwd_vs_dense", check_flash_fwd_bwd_vs_dense),
+    ("flash_bias_layouts", check_flash_bias_layouts),
+    ("flash_dropout_inkernel", check_flash_dropout),
+    ("flash_kv_valid", check_flash_kv_valid),
+    ("flash_t2048", check_flash_t2048),
+    ("ring_inner_chunking_t2048", check_ring_inner_chunking),
+    ("bert_remat_batch512", check_bert_remat_batch512),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "TPU_VALIDATION_r04.json"))
+    ap.add_argument("--skip-bert", action="store_true")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated check names")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (env vars are too late "
+                         "under the environment's sitecustomize, which "
+                         "pins JAX_PLATFORMS=axon at interpreter startup; "
+                         "mirror tests/conftest.py and override via "
+                         "jax.config)")
+    args = ap.parse_args()
+
+    global jax
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    platform = devs[0].platform
+    record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+              "platform": platform, "n_devices": len(devs), "checks": {}}
+    if platform != "tpu":
+        record["skipped"] = True
+        record["reason"] = f"platform is {platform}, not tpu"
+        log(f"not a TPU backend ({platform}); writing skip record")
+    else:
+        record["skipped"] = False
+        only = set(args.only.split(",")) if args.only else None
+        for name, fn in CHECKS:
+            if only and name not in only:
+                continue
+            if args.skip_bert and name == "bert_remat_batch512":
+                record["checks"][name] = {"ok": None, "skipped": True}
+                continue
+            log(f"running {name}...")
+            t0 = time.perf_counter()
+            try:
+                detail = fn()
+                record["checks"][name] = {
+                    "ok": True, "seconds": round(time.perf_counter() - t0, 1),
+                    "detail": detail}
+                log(f"  {name}: OK ({record['checks'][name]['seconds']}s)")
+            except Exception as e:
+                record["checks"][name] = {
+                    "ok": False, "seconds": round(time.perf_counter() - t0, 1),
+                    "error": f"{type(e).__name__}: {e}"[:500],
+                    "traceback": traceback.format_exc()[-1500:]}
+                log(f"  {name}: FAIL {type(e).__name__}: {e}")
+            # persist after every check — a later hang must not lose
+            # earlier results (the bench lastgood lesson)
+            with open(args.out + ".tmp", "w") as f:
+                json.dump(record, f, indent=1)
+            os.replace(args.out + ".tmp", args.out)
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    ok = all(c.get("ok") in (True, None)
+             for c in record["checks"].values()) and not record["skipped"]
+    log(f"done: {args.out} (all_ok={ok})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""TPU tunnel watcher (VERDICT r3 ask#1: "probe the TPU; the moment it is
+up, run the full bench BEFORE building anything new — the tunnel has now
+eaten two round-ends").
+
+Runs forever in a side terminal.  Imports NO jax itself (a wedged backend
+hangs the importing process inside a C call); every probe is a subprocess
+with a hard timeout.  On the first successful probe it runs, in order:
+
+  1. tools/tpu_validate.py   — the real-chip kernel validation sweep
+                               (r3's never-chip-run Pallas tail), artifact
+                               TPU_VALIDATION_r04.json
+  2. python bench.py         — the full ResNet+BERT bench; its inner
+                               persists BENCH_LASTGOOD.json per sub-bench,
+                               so even a mid-run wedge keeps the number;
+                               final line lands in BENCH_WATCH_r04.json
+
+Both keep re-trying on later probes until they have succeeded once (the
+tunnel can die mid-run).  Probe results are appended to
+TPU_PROBE_LOG_r04.jsonl and a human-pollable summary is kept in
+TPU_WATCH_STATUS.json.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOGDIR = os.path.join(REPO, "watch_logs")
+PROBE_LOG = os.path.join(REPO, "TPU_PROBE_LOG_r04.jsonl")
+STATUS = os.path.join(REPO, "TPU_WATCH_STATUS.json")
+VALIDATION = os.path.join(REPO, "TPU_VALIDATION_r04.json")
+BENCH_OUT = os.path.join(REPO, "BENCH_WATCH_r04.json")
+
+PROBE_TIMEOUT = 120
+PROBE_INTERVAL_DOWN = 180      # probe cadence while the tunnel is down
+PROBE_INTERVAL_DONE = 1800     # cadence once all work has succeeded
+FAIL_BACKOFF = 300             # wait after a failed validate/bench attempt
+
+PROBE_SNIPPET = ("import jax, json; ds = jax.devices(); "
+                 "print(json.dumps({'platform': ds[0].platform, "
+                 "'n': len(ds)}))")
+
+
+def log(msg):
+    print(f"[watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def ts():
+    return time.strftime("%Y-%m-%dT%H:%M:%S")
+
+
+def probe():
+    """One backend probe in a subprocess.  Returns (up, detail)."""
+    try:
+        out = subprocess.run([sys.executable, "-c", PROBE_SNIPPET],
+                             capture_output=True, text=True,
+                             timeout=PROBE_TIMEOUT, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {PROBE_TIMEOUT}s"
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    if out.returncode == 0 and lines:
+        info = json.loads(lines[-1])
+        return info.get("platform") == "tpu", info
+    return False, f"rc={out.returncode} stderr={out.stderr[-200:]}"
+
+
+def run_logged(tag, cmd, timeout, env=None):
+    """Run cmd with stdout+stderr teed to a log file; returns (rc, stdout)
+    or (None, reason) on timeout."""
+    os.makedirs(LOGDIR, exist_ok=True)
+    path = os.path.join(LOGDIR, f"{tag}_{time.strftime('%H%M%S')}.log")
+    log(f"running {tag}: {' '.join(cmd)} (timeout {timeout}s, log {path})")
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    with open(path, "w") as f:
+        try:
+            out = subprocess.run(cmd, stdout=subprocess.PIPE,
+                                 stderr=f, text=True, timeout=timeout,
+                                 cwd=REPO, env=full_env)
+        except subprocess.TimeoutExpired:
+            return None, f"{tag} timed out after {timeout}s (log: {path})"
+    with open(path, "a") as f:
+        f.write(f"\n--- stdout ---\n{out.stdout}")
+    return out.returncode, out.stdout
+
+
+def validation_done():
+    """Done = ran on a real TPU and every executed check passed.  An
+    all-fail (or partial-fail) artifact keeps the watcher retrying on
+    later probes — the docstring contract is 'until they have SUCCEEDED
+    once'."""
+    try:
+        with open(VALIDATION) as f:
+            rec = json.load(f)
+        checks = rec.get("checks") or {}
+        return rec.get("skipped") is False and checks and \
+            all(c.get("ok") in (True, None) for c in checks.values())
+    except (OSError, ValueError, AttributeError):
+        return False
+
+
+def bench_done():
+    try:
+        with open(BENCH_OUT) as f:
+            rec = json.load(f)
+        return rec.get("value", 0) > 0 and not rec.get("stale")
+    except (OSError, ValueError):
+        return False
+
+
+def write_status(**kw):
+    kw["ts"] = ts()
+    with open(STATUS + ".tmp", "w") as f:
+        json.dump(kw, f, indent=1)
+    os.replace(STATUS + ".tmp", STATUS)
+
+
+def main():
+    n_probe = up_count = 0
+    last_fail = 0.0
+    log(f"watching for the TPU backend (probe every "
+        f"{PROBE_INTERVAL_DOWN}s while down)")
+    while True:
+        n_probe += 1
+        up, detail = probe()
+        with open(PROBE_LOG, "a") as f:
+            f.write(json.dumps({"ts": ts(), "up": up,
+                                "detail": detail}) + "\n")
+        if up:
+            up_count += 1
+        v_done, b_done = validation_done(), bench_done()
+        write_status(up=up, probes=n_probe, up_probes=up_count,
+                     validation_done=bool(v_done), bench_done=bool(b_done),
+                     detail=detail)
+        if up and not (v_done and b_done) and \
+                time.time() - last_fail > FAIL_BACKOFF:
+            log(f"TPU is UP ({detail}); validation_done={bool(v_done)} "
+                f"bench_done={bool(b_done)}")
+            ok = True
+            # bench FIRST (VERDICT r3 ask#1: capture the round's numbers
+            # before anything else — the tunnel can die again mid-sweep)
+            if not b_done:
+                rc, out = run_logged("bench", [sys.executable, "bench.py"],
+                                     5400)
+                log(f"bench rc={rc}")
+                lines = [ln for ln in (out or "").splitlines()
+                         if ln.startswith("{")]
+                if rc == 0 and lines:
+                    rec = json.loads(lines[-1])
+                    with open(BENCH_OUT + ".tmp", "w") as f:
+                        json.dump(rec, f, indent=1)
+                    os.replace(BENCH_OUT + ".tmp", BENCH_OUT)
+                    log(f"bench record: value={rec.get('value')} "
+                        f"stale={rec.get('stale', False)}")
+                    ok = ok and rec.get("value", 0) > 0 and \
+                        not rec.get("stale")
+                else:
+                    ok = False
+            if not v_done:
+                rc, out = run_logged(
+                    "validate",
+                    [sys.executable, "tools/tpu_validate.py"], 5400)
+                log(f"validate rc={rc}")
+                # artifact written per-check by the tool; rc None means
+                # timeout/wedge, rc 1 means some check failed — both
+                # leave validation_done() false and retry next cycle
+                ok = ok and rc == 0
+            if not ok:
+                last_fail = time.time()
+            write_status(up=up, probes=n_probe, up_probes=up_count,
+                         validation_done=bool(validation_done()),
+                         bench_done=bool(bench_done()), detail=detail)
+        done = validation_done() and bench_done()
+        time.sleep(PROBE_INTERVAL_DONE if done else PROBE_INTERVAL_DOWN)
+
+
+if __name__ == "__main__":
+    main()
