@@ -43,6 +43,10 @@ def main():
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--steps-per-epoch", type=int, default=20)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--data-train", default=None,
+                    help="det .rec file; fed through the native "
+                         "mx.io.ImageDetRecordIter (C++ decode + box-aware "
+                         "augment); synthetic boxes when omitted")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
     if args.smoke:
@@ -64,13 +68,31 @@ def main():
     box_loss = gluon.loss.HuberLoss()
     rng = np.random.RandomState(0)
 
+    det_iter = None
+    if args.data_train:
+        det_iter = mx.io.ImageDetRecordIter(
+            args.data_train, (3, size, size), args.batch_size,
+            shuffle=True, rand_crop=1, rand_mirror=True,
+            mean_r=123.68, mean_g=116.28, mean_b=103.53,
+            std_r=58.395, std_g=57.12, std_b=57.375)
+
+    def next_batch():
+        if det_iter is None:
+            xb, lb = synthetic_batch(rng, args.batch_size, size,
+                                     args.num_classes)
+            return nd.array(xb), nd.array(lb)
+        try:
+            batch = det_iter.next()
+        except StopIteration:
+            det_iter.reset()
+            batch = det_iter.next()
+        return batch.data[0], batch.label[0]
+
     first = last = None
     for epoch in range(args.epochs):
         tot, tic = 0.0, time.time()
         for _ in range(args.steps_per_epoch):
-            xb, lb = synthetic_batch(rng, args.batch_size, size,
-                                     args.num_classes)
-            x, labels = nd.array(xb), nd.array(lb)
+            x, labels = next_batch()
             with autograd.record():
                 anchors, cls_preds, box_preds = net(x)
                 with autograd.pause():

@@ -249,11 +249,15 @@ inline float HashUniform(uint64_t seed, uint64_t a, uint64_t b, uint64_t c) {
 // ---------------------------------------------------------------------------
 // pipeline
 // ---------------------------------------------------------------------------
-struct Pipe {
+// Shared threaded batch machinery: an epoch is a ticket sequence over
+// (shuffled) record positions; workers decode into prefetch ring slots,
+// the consumer (Python thread) drains completed batches in order.  The
+// classification Pipe and the detection DetPipe differ only in per-item
+// decode+augment and in output element counts — virtual-dispatch cost is
+// noise next to a JPEG decode.
+struct PipeBase {
   RecFile file;
-  int batch, C, H, W, resize, rand_crop, rand_mirror;
-  float mean[3], stdv[3];
-  int label_width;
+  int batch;
   int nthreads, prefetch;
   int shuffle;
   uint64_t seed;
@@ -279,8 +283,17 @@ struct Pipe {
   std::atomic<bool> failed{false};
   std::vector<std::thread> workers;
 
-  size_t ImgElems() const {
-    return static_cast<size_t>(C) * H * W;
+  virtual ~PipeBase() = default;
+  virtual bool DecodeOne(uint64_t pos, float* img_out, float* label_out) = 0;
+  virtual size_t DataElems() const = 0;   // per-item data floats
+  virtual size_t LabelElems() const = 0;  // per-item label floats
+
+  void AllocBufs() {
+    bufs = std::vector<BatchBuf>(prefetch);
+    for (auto& b : bufs) {
+      b.data.resize(static_cast<size_t>(batch) * DataElems());
+      b.label.resize(static_cast<size_t>(batch) * LabelElems());
+    }
   }
 
   void StartEpoch() {
@@ -314,7 +327,85 @@ struct Pipe {
     workers.clear();
   }
 
-  bool DecodeOne(uint64_t pos, float* img_out, float* label_out) {
+  void WorkerLoop() {
+    const uint64_t nrec = total_batches * batch;  // padded epoch length
+    for (;;) {
+      uint64_t pos = next_record.fetch_add(1);
+      if (pos >= nrec || stop || failed) return;
+      uint64_t bseq = pos / batch;
+      size_t slot = bseq % bufs.size();
+      BatchBuf& bb = bufs[slot];
+      {
+        // wait until this slot is free (its previous batch consumed)
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] {
+          return stop.load() || failed.load() || bseq < consumed + bufs.size();
+        });
+        if (stop || failed) return;
+        if (bb.seq != bseq) {
+          bb.seq = bseq;
+          bb.done = 0;
+        }
+      }
+      int in_batch = static_cast<int>(pos % batch);
+      float* img = bb.data.data() + static_cast<size_t>(in_batch) * DataElems();
+      float* lab = bb.label.data() +
+                   static_cast<size_t>(in_batch) * LabelElems();
+      if (!DecodeOne(pos, img, lab)) {
+        std::lock_guard<std::mutex> lk(mu);
+        failed = true;
+        error = "record decode failed at epoch position " +
+                std::to_string(pos);
+        cv_ready.notify_all();
+        cv_free.notify_all();
+        return;
+      }
+      if (bb.done.fetch_add(1) + 1 == batch) {
+        std::lock_guard<std::mutex> lk(mu);
+        cv_ready.notify_all();
+      }
+    }
+  }
+
+  // returns records delivered (batch), 0 at epoch end, -1 on failure
+  int Next(float* data_out, float* label_out) {
+    if (consumed >= total_batches) return 0;
+    uint64_t bseq = consumed;
+    size_t slot = bseq % bufs.size();
+    BatchBuf& bb = bufs[slot];
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_ready.wait(lk, [&] {
+        return failed.load() || (bb.seq == bseq && bb.done.load() == batch);
+      });
+      if (failed) return -1;
+    }
+    memcpy(data_out, bb.data.data(),
+           bb.data.size() * sizeof(float));
+    memcpy(label_out, bb.label.data(), bb.label.size() * sizeof(float));
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      consumed++;
+      cv_free.notify_all();
+    }
+    return batch;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// classification pipeline (REF:src/io/iter_image_recordio_2.cc)
+// ---------------------------------------------------------------------------
+struct Pipe : PipeBase {
+  int C, H, W, resize, rand_crop, rand_mirror;
+  float mean[3], stdv[3];
+  int label_width;
+
+  size_t DataElems() const override {
+    return static_cast<size_t>(C) * H * W;
+  }
+  size_t LabelElems() const override { return label_width; }
+
+  bool DecodeOne(uint64_t pos, float* img_out, float* label_out) override {
     uint32_t rec_idx = order[pos % order.size()];
     // per-thread scratch: no per-record heap churn in the hot loop
     static thread_local std::vector<uint8_t> raw;
@@ -409,68 +500,186 @@ struct Pipe {
     return true;
   }
 
-  void WorkerLoop() {
-    const uint64_t nrec = total_batches * batch;  // padded epoch length
-    for (;;) {
-      uint64_t pos = next_record.fetch_add(1);
-      if (pos >= nrec || stop || failed) return;
-      uint64_t bseq = pos / batch;
-      size_t slot = bseq % bufs.size();
-      BatchBuf& bb = bufs[slot];
-      {
-        // wait until this slot is free (its previous batch consumed)
-        std::unique_lock<std::mutex> lk(mu);
-        cv_free.wait(lk, [&] {
-          return stop.load() || failed.load() || bseq < consumed + bufs.size();
-        });
-        if (stop || failed) return;
-        if (bb.seq != bseq) {
-          bb.seq = bseq;
-          bb.done = 0;
-        }
-      }
-      int in_batch = static_cast<int>(pos % batch);
-      float* img = bb.data.data() + static_cast<size_t>(in_batch) * ImgElems();
-      float* lab = bb.label.data() +
-                   static_cast<size_t>(in_batch) * label_width;
-      if (!DecodeOne(pos, img, lab)) {
-        std::lock_guard<std::mutex> lk(mu);
-        failed = true;
-        error = "record decode failed at epoch position " +
-                std::to_string(pos);
-        cv_ready.notify_all();
-        cv_free.notify_all();
-        return;
-      }
-      if (bb.done.fetch_add(1) + 1 == batch) {
-        std::lock_guard<std::mutex> lk(mu);
-        cv_ready.notify_all();
-      }
-    }
+};
+
+// ---------------------------------------------------------------------------
+// detection pipeline (REF:src/io/iter_image_det_recordio.cc +
+// image_det_aug_default.cc).  Per-record label is a flat
+// [cls,x1,y1,x2,y2]*m float block (normalized corners, the ImageDetIter
+// contract); the output label is a fixed (max_objects, 5) block padded
+// with -1 — the static-shape input MultiBoxTarget wants on TPU.
+// Augments (same order as image/detection.py CreateDetAugmenter):
+// IoU-constrained random crop → horizontal flip (boxes transformed) →
+// force-resize to (W, H) → mean/std normalize → CHW.  All randomness is
+// counter-based (HashUniform) so epochs replay deterministically
+// regardless of thread schedule.
+// ---------------------------------------------------------------------------
+struct DetPipe : PipeBase {
+  int C, H, W, max_objects;
+  int rand_crop, rand_mirror;
+  float mean[3], stdv[3];
+  float min_cover, area_lo, area_hi, ratio_lo, ratio_hi;
+  int max_attempts;
+
+  size_t DataElems() const override {
+    return static_cast<size_t>(C) * H * W;
+  }
+  size_t LabelElems() const override {
+    return static_cast<size_t>(max_objects) * 5;
   }
 
-  // returns records delivered (batch), 0 at epoch end, -1 on failure
-  int Next(float* data_out, float* label_out) {
-    if (consumed >= total_batches) return 0;
-    uint64_t bseq = consumed;
-    size_t slot = bseq % bufs.size();
-    BatchBuf& bb = bufs[slot];
-    {
-      std::unique_lock<std::mutex> lk(mu);
-      cv_ready.wait(lk, [&] {
-        return failed.load() || (bb.seq == bseq && bb.done.load() == batch);
-      });
-      if (failed) return -1;
+  bool DecodeOne(uint64_t pos, float* img_out, float* label_out) override {
+    uint32_t rec_idx = order[pos % order.size()];
+    static thread_local std::vector<uint8_t> raw;
+    if (!file.Read(rec_idx, &raw) || raw.size() < 24) return false;
+    uint32_t flag;
+    memcpy(&flag, raw.data(), 4);
+    const uint8_t* payload = raw.data() + 24;
+    size_t payload_len = raw.size() - 24;
+    // size_t math: a corrupt header's flag*4 must not wrap in uint32 and
+    // sneak a huge label block past the bounds check
+    size_t label_bytes = static_cast<size_t>(flag) * 4;
+    if (flag == 0 || flag % 5 || payload_len < label_bytes) {
+      return false;  // det records must carry [cls,x1,y1,x2,y2]*m labels
     }
-    memcpy(data_out, bb.data.data(),
-           bb.data.size() * sizeof(float));
-    memcpy(label_out, bb.label.data(), bb.label.size() * sizeof(float));
-    {
-      std::lock_guard<std::mutex> lk(mu);
-      consumed++;
-      cv_free.notify_all();
+    int m = static_cast<int>(flag / 5);
+    static thread_local std::vector<float> boxes;  // (m, 5)
+    boxes.resize(flag);
+    memcpy(boxes.data(), payload, label_bytes);
+    payload += label_bytes;
+    payload_len -= label_bytes;
+
+    static thread_local std::vector<uint8_t> rgb;
+    int ih = 0, iw = 0;
+    if (!DecodeJpeg(payload, payload_len, &rgb, &ih, &iw, 0)) return false;
+
+    // --- IoU-constrained random crop in normalized coords --------------
+    float cx0 = 0.0f, cy0 = 0.0f, cw = 1.0f, ch = 1.0f;
+    bool cropped = false;
+    if (rand_crop) {
+      for (int a = 0; a < max_attempts && !cropped; ++a) {
+        uint64_t c0 = 16 + static_cast<uint64_t>(a) * 4;
+        float scale = area_lo +
+            HashUniform(seed, epoch, pos, c0) * (area_hi - area_lo);
+        float ratio = ratio_lo +
+            HashUniform(seed, epoch, pos, c0 + 1) * (ratio_hi - ratio_lo);
+        float tw = std::sqrt(scale * ratio);
+        float th = std::sqrt(scale / ratio);
+        if (tw > 1.0f) tw = 1.0f;
+        if (th > 1.0f) th = 1.0f;
+        float tx0 = HashUniform(seed, epoch, pos, c0 + 2) * (1.0f - tw);
+        float ty0 = HashUniform(seed, epoch, pos, c0 + 3) * (1.0f - th);
+        // any valid box covered enough?
+        for (int i = 0; i < m; ++i) {
+          const float* b = boxes.data() + i * 5;
+          if (b[0] < 0) continue;
+          float ix1 = b[1] > tx0 ? b[1] : tx0;
+          float iy1 = b[2] > ty0 ? b[2] : ty0;
+          float ix2 = b[3] < tx0 + tw ? b[3] : tx0 + tw;
+          float iy2 = b[4] < ty0 + th ? b[4] : ty0 + th;
+          float inter = (ix2 > ix1 ? ix2 - ix1 : 0.0f) *
+                        (iy2 > iy1 ? iy2 - iy1 : 0.0f);
+          float area = (b[3] - b[1]) * (b[4] - b[2]);
+          if (area > 0 && inter / area >= min_cover) {
+            cx0 = tx0;
+            cy0 = ty0;
+            cw = tw;
+            ch = th;
+            cropped = true;
+            break;
+          }
+        }
+      }
     }
-    return batch;
+
+    bool mirror =
+        rand_mirror && HashUniform(seed, epoch, pos, 3) < 0.5f;
+
+    // --- labels: remap surviving boxes, pad with -1 ---------------------
+    for (int i = 0; i < max_objects * 5; ++i) label_out[i] = -1.0f;
+    int out_rows = 0;
+    for (int i = 0; i < m && out_rows < max_objects; ++i) {
+      const float* b = boxes.data() + i * 5;
+      if (b[0] < 0) continue;
+      float x1 = b[1], y1 = b[2], x2 = b[3], y2 = b[4];
+      if (cropped) {
+        float ix1 = x1 > cx0 ? x1 : cx0;
+        float iy1 = y1 > cy0 ? y1 : cy0;
+        float ix2 = x2 < cx0 + cw ? x2 : cx0 + cw;
+        float iy2 = y2 < cy0 + ch ? y2 : cy0 + ch;
+        float inter = (ix2 > ix1 ? ix2 - ix1 : 0.0f) *
+                      (iy2 > iy1 ? iy2 - iy1 : 0.0f);
+        float area = (x2 - x1) * (y2 - y1);
+        if (!(area > 0) || inter / area < min_cover) continue;  // dropped
+        auto clip01 = [](float v) {
+          return v < 0.0f ? 0.0f : (v > 1.0f ? 1.0f : v);
+        };
+        x1 = clip01((x1 - cx0) / cw);
+        y1 = clip01((y1 - cy0) / ch);
+        x2 = clip01((x2 - cx0) / cw);
+        y2 = clip01((y2 - cy0) / ch);
+      }
+      if (mirror) {
+        float ox1 = x1;
+        x1 = 1.0f - x2;
+        x2 = 1.0f - ox1;
+      }
+      float* dst = label_out + out_rows * 5;
+      dst[0] = b[0];
+      dst[1] = x1;
+      dst[2] = y1;
+      dst[3] = x2;
+      dst[4] = y2;
+      out_rows++;
+    }
+
+    // --- pixels: crop rect → contiguous → resize (W, H) -----------------
+    int px0 = static_cast<int>(cx0 * iw);
+    int py0 = static_cast<int>(cy0 * ih);
+    int px1 = static_cast<int>((cx0 + cw) * iw);
+    int py1 = static_cast<int>((cy0 + ch) * ih);
+    if (px1 > iw) px1 = iw;
+    if (py1 > ih) py1 = ih;
+    if (px1 - px0 < 1) px1 = px0 + 1;
+    if (py1 - py0 < 1) py1 = py0 + 1;
+    int sw = px1 - px0, sh = py1 - py0;
+    static thread_local std::vector<uint8_t> crop_buf, resized;
+    const uint8_t* src = rgb.data();
+    if (cropped) {
+      crop_buf.resize(static_cast<size_t>(sh) * sw * 3);
+      for (int y = 0; y < sh; ++y) {
+        memcpy(crop_buf.data() + static_cast<size_t>(y) * sw * 3,
+               rgb.data() + ((static_cast<size_t>(py0 + y) * iw) + px0) * 3,
+               static_cast<size_t>(sw) * 3);
+      }
+      src = crop_buf.data();
+    } else {
+      sh = ih;
+      sw = iw;
+    }
+    resized.resize(static_cast<size_t>(H) * W * 3);
+    ResizeBilinear(src, sh, sw, resized.data(), H, W);
+
+    // mirror + normalize + HWC->CHW in one pass
+    for (int c = 0; c < C && c < 3; ++c) {
+      float mu_ = mean[c], inv = 1.0f / stdv[c];
+      float* dst = img_out + static_cast<size_t>(c) * H * W;
+      for (int yy = 0; yy < H; ++yy) {
+        const uint8_t* row =
+            resized.data() + static_cast<size_t>(yy) * W * 3 + c;
+        float* drow = dst + static_cast<size_t>(yy) * W;
+        if (mirror) {
+          for (int xx = 0; xx < W; ++xx) {
+            drow[xx] = (row[(W - 1 - xx) * 3] - mu_) * inv;
+          }
+        } else {
+          for (int xx = 0; xx < W; ++xx) {
+            drow[xx] = (row[xx * 3] - mu_) * inv;
+          }
+        }
+      }
+    }
+    return true;
   }
 };
 
@@ -798,35 +1007,78 @@ void* tmx_pipe_create(const char* rec_path, int batch, int C, int H, int W,
   p->label_width = label_width < 1 ? 1 : label_width;
   p->order.resize(p->file.records.size());
   for (size_t i = 0; i < p->order.size(); ++i) p->order[i] = i;
-  p->bufs = std::vector<Pipe::BatchBuf>(p->prefetch);
-  for (auto& b : p->bufs) {
-    b.data.resize(static_cast<size_t>(batch) * p->ImgElems());
-    b.label.resize(static_cast<size_t>(batch) * p->label_width);
-  }
+  p->AllocBufs();
   p->StartEpoch();
-  return p;
+  return static_cast<PipeBase*>(p);
 }
 
+void* tmx_det_pipe_create(const char* rec_path, int batch, int C, int H,
+                          int W, int max_objects, int rand_crop,
+                          int rand_mirror, const float* mean,
+                          const float* stdv, float min_cover, float area_lo,
+                          float area_hi, float ratio_lo, float ratio_hi,
+                          int max_attempts, int threads, int prefetch,
+                          int shuffle, uint64_t seed, char* err,
+                          int errlen) {
+  auto* p = new DetPipe();
+  std::string e;
+  if (!p->file.Open(rec_path, &e) || p->file.records.empty()) {
+    if (e.empty()) e = "empty recordio file";
+    snprintf(err, errlen, "%s", e.c_str());
+    delete p;
+    return nullptr;
+  }
+  p->batch = batch;
+  p->C = C;
+  p->H = H;
+  p->W = W;
+  p->max_objects = max_objects < 1 ? 1 : max_objects;
+  p->rand_crop = rand_crop;
+  p->rand_mirror = rand_mirror;
+  for (int i = 0; i < 3; ++i) {
+    p->mean[i] = mean[i];
+    p->stdv[i] = stdv[i] == 0.0f ? 1.0f : stdv[i];
+  }
+  p->min_cover = min_cover;
+  p->area_lo = area_lo;
+  p->area_hi = area_hi;
+  p->ratio_lo = ratio_lo;
+  p->ratio_hi = ratio_hi;
+  p->max_attempts = max_attempts < 1 ? 1 : max_attempts;
+  p->nthreads = threads < 1 ? 1 : threads;
+  p->prefetch = prefetch < 2 ? 2 : prefetch;
+  p->shuffle = shuffle;
+  p->seed = seed;
+  p->order.resize(p->file.records.size());
+  for (size_t i = 0; i < p->order.size(); ++i) p->order[i] = i;
+  p->AllocBufs();
+  p->StartEpoch();
+  return static_cast<PipeBase*>(p);
+}
+
+// the remaining entry points operate on the shared machinery and serve
+// both pipe kinds: the classification binding passes a Pipe*, the
+// detection binding a DetPipe* (both created above as their real type)
 long long tmx_pipe_size(void* h) {
-  return static_cast<Pipe*>(h)->file.records.size();
+  return static_cast<PipeBase*>(h)->file.records.size();
 }
 
 int tmx_pipe_next(void* h, float* data, float* label) {
-  return static_cast<Pipe*>(h)->Next(data, label);
+  return static_cast<PipeBase*>(h)->Next(data, label);
 }
 
 void tmx_pipe_reset(void* h) {
-  Pipe* p = static_cast<Pipe*>(h);
+  PipeBase* p = static_cast<PipeBase*>(h);
   p->epoch++;
   p->StartEpoch();
 }
 
 const char* tmx_pipe_error(void* h) {
-  return static_cast<Pipe*>(h)->error.c_str();
+  return static_cast<PipeBase*>(h)->error.c_str();
 }
 
 void tmx_pipe_destroy(void* h) {
-  Pipe* p = static_cast<Pipe*>(h);
+  PipeBase* p = static_cast<PipeBase*>(h);
   p->StopWorkers();
   delete p;
 }

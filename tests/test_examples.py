@@ -6,6 +6,10 @@ import re
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # end-to-end example smokes (~4 min together)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -50,6 +50,7 @@ def test_flash_forward_bf16():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_flash_backward_matches_dense(causal):
     q, k, v = make_qkv(bh=1, t=256, d=64)
     scale = 1.0 / math.sqrt(q.shape[-1])

@@ -13,6 +13,8 @@ from tpu_mx import nd
 from tpu_mx.gluon import nn
 from tpu_mx.layout import default_layout
 
+pytestmark = pytest.mark.slow  # full-model NHWC train smokes (~3 min together)
+
 
 def _to_nhwc(x):
     return np.transpose(x, (0, 2, 3, 1))

@@ -344,6 +344,7 @@ def test_attention_sp_strategy_typo_raises():
         attention(q, q, q, mesh=mesh, sp_strategy="ulyses")
 
 
+@pytest.mark.slow
 def test_np_random_samplers_distribution_means():
     """Round-3 sampler widening: each new distribution's sample mean lands
     near its analytic mean (seeded, n=4000)."""

@@ -127,6 +127,7 @@ class TestVisionOps:
                             ).asnumpy()
         assert out[0, 0, 0, 0] == 9.0 and out[0, 0, 1, 1] == 0.0
 
+    @pytest.mark.slow
     def test_roialign_uniform_and_grad(self):
         x = np.full((1, 3, 8, 8), 2.5, np.float32)
         rois = np.array([[0, 1.0, 1.0, 6.0, 6.0]], np.float32)
@@ -174,6 +175,7 @@ class TestVisionOps:
         assert up.shape == (1, 1, 8, 8)
         np.testing.assert_allclose(up[0, 0, :2, :2], x[0, 0, 0, 0])
 
+    @pytest.mark.slow
     def test_proposal_shapes_and_validity(self):
         N, A, Hf, Wf = 1, 3, 4, 4
         cls = rs.rand(N, 2 * A, Hf, Wf).astype(np.float32)
@@ -201,6 +203,7 @@ class TestCTC:
             torch.tensor(lab_lens), blank=blank, reduction="none",
             zero_infinity=False).numpy()
 
+    @pytest.mark.slow
     def test_matches_torch_blank_first(self):
         T, N, C, L = 10, 3, 6, 4
         acts = rs.rand(T, N, C).astype(np.float32) * 2
@@ -229,6 +232,7 @@ class TestCTC:
                               lab_lens, blank=C - 1)
         np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
 
+    @pytest.mark.slow
     def test_gluon_ctc_loss_and_grad(self):
         from tpu_mx import autograd, gluon
         T, N, C = 8, 2, 5
@@ -407,6 +411,7 @@ class TestSamplers:
         p = nd.sample_poisson(lam, shape=4000).asnumpy()
         np.testing.assert_allclose(p.mean(1), [0.5, 4.0], rtol=0.2)
 
+    @pytest.mark.slow
     def test_negative_binomial_mean(self):
         k = nd.array(np.array([4.0], np.float32))
         p = nd.array(np.array([0.5], np.float32))
@@ -574,6 +579,7 @@ class TestRound3LongTail:
         np.testing.assert_allclose(y[:, 2], ref, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_round3_optimizers_converge():
     """DCASGD/SGLD/Adamax/Nadam/FTML minimize a quadratic through the
     Updater path (REF optimizer families)."""
@@ -617,6 +623,7 @@ def test_round3_optimizers_in_compiled_step():
         assert losses[-1] < losses[0], (name, losses)
 
 
+@pytest.mark.slow
 def test_round3_ops_numeric_gradients():
     """Finite-difference gradient checks for this round's differentiable
     additions (the reference test strategy's core tool, SURVEY §4)."""
@@ -665,3 +672,124 @@ def test_round3_ops_numeric_gradients():
     np.testing.assert_allclose(gg.grad.asnumpy(),
                                tg.grad.numpy().reshape(2, 2).sum(1),
                                rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# r4 long-tail parity ops (REF:src/operator/contrib/**, svm_output.cc)
+# ---------------------------------------------------------------------------
+class TestR4LongTail:
+    def test_argmax_channel(self):
+        x = rs.rand(4, 7).astype(np.float32)
+        out = nd.argmax_channel(nd.array(x))
+        np.testing.assert_array_equal(out.asnumpy(),
+                                      np.argmax(x, axis=1).astype(np.float32))
+
+    def test_svm_output_l2_grad(self):
+        from tpu_mx import autograd
+        x = rs.randn(3, 5).astype(np.float32)
+        y = np.array([0, 2, 4], np.float32)
+        xx = nd.array(x)
+        xx.attach_grad()
+        with autograd.record():
+            out = nd.SVMOutput(xx, nd.array(y), margin=1.0,
+                               regularization_coefficient=0.5)
+            out.backward()
+        np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)  # identity fwd
+        g = xx.grad.asnumpy()
+        # hand gradient: j!=y: 2*lam*max(0, m + x_j - x_y); y: -sum
+        for i in range(3):
+            yi = int(y[i])
+            h = np.maximum(0.0, 1.0 + x[i] - x[i, yi])
+            ref = 2 * 0.5 * h
+            ref[yi] = 0.0
+            ref_y = -ref.sum()
+            np.testing.assert_allclose(g[i, yi], ref_y, rtol=1e-5)
+            mask = np.arange(5) != yi
+            np.testing.assert_allclose(g[i, mask], ref[mask], rtol=1e-5)
+
+    def test_quadratic_and_div_sqrt_dim(self):
+        x = rs.rand(3, 4).astype(np.float32)
+        out = nd.contrib.quadratic(nd.array(x), a=2.0, b=-1.0, c=0.5)
+        np.testing.assert_allclose(out.asnumpy(), 2 * x * x - x + 0.5,
+                                   rtol=1e-6)
+        out = nd.contrib.div_sqrt_dim(nd.array(x))
+        np.testing.assert_allclose(out.asnumpy(), x / np.sqrt(4.0),
+                                   rtol=1e-6)
+
+    def test_arange_like(self):
+        x = nd.ones((2, 3))
+        out = nd.contrib.arange_like(x)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.arange(6, dtype=np.float32)
+                                   .reshape(2, 3))
+        out = nd.contrib.arange_like(x, axis=1, start=5.0, step=2.0)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.array([5.0, 7.0, 9.0], np.float32))
+
+    def test_allclose_op(self):
+        a = nd.ones((3,))
+        b = nd.array(np.array([1.0, 1.0, 1.0 + 1e-7], np.float32))
+        assert float(nd.contrib.allclose(a, b).asnumpy()) == 1.0
+        c = nd.array(np.array([1.0, 2.0, 1.0], np.float32))
+        assert float(nd.contrib.allclose(a, c).asnumpy()) == 0.0
+
+    def test_index_copy_and_index_array(self):
+        old = nd.zeros((5, 3))
+        new = nd.ones((2, 3))
+        idx = nd.array(np.array([1, 3], np.float32))
+        out = nd.contrib.index_copy(old, idx, new)
+        ref = np.zeros((5, 3), np.float32)
+        ref[[1, 3]] = 1.0
+        np.testing.assert_array_equal(out.asnumpy(), ref)
+
+        ia = nd.contrib.index_array(nd.ones((2, 3)))
+        assert ia.shape == (2, 3, 2)
+        np.testing.assert_array_equal(ia.asnumpy()[1, 2], [1, 2])
+        ia1 = nd.contrib.index_array(nd.ones((2, 3)), axes=(1,))
+        np.testing.assert_array_equal(ia1.asnumpy()[..., 0],
+                                      [[0, 1, 2], [0, 1, 2]])
+
+    def test_gradientmultiplier_scales_grad(self):
+        from tpu_mx import autograd
+        x = nd.array(rs.rand(4).astype(np.float32))
+        x.attach_grad()
+        with autograd.record():
+            y = nd.contrib.gradientmultiplier(x, scalar=-0.5)
+            y.sum().backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), -0.5 * np.ones(4),
+                                   rtol=1e-6)
+
+    def test_fft_ifft_roundtrip(self):
+        x = rs.rand(2, 8).astype(np.float32)
+        f = nd.contrib.fft(nd.array(x))
+        assert f.shape == (2, 16)
+        ref = np.fft.fft(x, axis=-1)
+        np.testing.assert_allclose(f.asnumpy()[:, 0::2], ref.real.astype(
+            np.float32), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(f.asnumpy()[:, 1::2], ref.imag.astype(
+            np.float32), rtol=1e-4, atol=1e-4)
+        # unnormalized inverse (reference cuFFT contract): /n recovers x
+        back = nd.contrib.ifft(f)
+        np.testing.assert_allclose(back.asnumpy() / 8.0, x, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_adaptive_avg_pooling(self):
+        x = rs.rand(2, 3, 6, 8).astype(np.float32)
+        out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x), output_size=2)
+        assert out.shape == (2, 3, 2, 2)
+        ref = x.reshape(2, 3, 2, 3, 2, 4).mean(axis=(3, 5))
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+        # non-divisible output size still averages disjoint-ish bins
+        out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x),
+                                              output_size=(3, 5))
+        assert out.shape == (2, 3, 3, 5)
+        np.testing.assert_allclose(out.asnumpy().mean(), x.mean(axis=(2, 3),
+                                   keepdims=True).mean(), rtol=0.05)
+
+    def test_bipartite_matching(self):
+        s = np.array([[[0.9, 0.1], [0.8, 0.7], [0.1, 0.6]]], np.float32)
+        row, col = nd.contrib.bipartite_matching(nd.array(s),
+                                                 threshold=0.5)
+        # greedy: (0,0)=0.9 first, then (1,1)=0.7; row 2 unmatched
+        np.testing.assert_array_equal(row.asnumpy(), [[0, 1, -1]])
+        np.testing.assert_array_equal(col.asnumpy(), [[0, 1]])

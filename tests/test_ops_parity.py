@@ -1,0 +1,375 @@
+"""Op-parity audit enforcement (VERDICT r3 ask#6).
+
+Three contracts against tools/ops_parity.py's curated upstream registry:
+1. OPS_PARITY.md is the rendered registry (no silent drift);
+2. every `yes` row with a concrete `nd.*` impl resolves to a callable;
+3. every such op EXECUTES on tiny inputs — by-name template, else the
+   generic unary/binary cascade.  An op nobody can invoke is not
+   "implemented".
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import ops_parity  # noqa: E402
+
+
+def _resolvable(impl):
+    import re
+    return bool(re.fullmatch(r"nd\.[A-Za-z_][\w.]*", impl))
+
+
+def _resolve(impl):
+    obj = mx
+    for part in impl.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def yes_rows():
+    for fam, rows in ops_parity.ROWS.items():
+        for name, status, impl, note in rows:
+            if status == "yes" and _resolvable(impl):
+                yield name, impl
+
+
+def test_markdown_in_sync():
+    with open(os.path.join(REPO, "OPS_PARITY.md")) as f:
+        on_disk = f.read()
+    assert on_disk.strip() == ops_parity.render().strip(), \
+        "OPS_PARITY.md is stale — regenerate: python tools/ops_parity.py > OPS_PARITY.md"
+
+
+def test_every_implemented_row_resolves():
+    missing = []
+    for name, impl in yes_rows():
+        try:
+            obj = _resolve(impl)
+            if not callable(obj):
+                missing.append(f"{name} -> {impl} (not callable)")
+        except AttributeError:
+            missing.append(f"{name} -> {impl} (missing)")
+    assert not missing, missing
+
+
+# ---------------------------------------------------------------------------
+# smoke invocation
+# ---------------------------------------------------------------------------
+RS = np.random.RandomState(0)
+
+
+def X(*s):
+    return nd.array((RS.rand(*s) * 0.8 + 0.1).astype(np.float32))
+
+
+def XI(*s, n=8):
+    return nd.array(RS.randint(0, n, s).astype(np.int32))
+
+
+def NCHW():
+    return X(1, 3, 8, 8)
+
+
+# by-op invocation templates; everything else goes through the generic
+# unary→binary cascade
+TEMPLATES = {
+    "Activation": lambda f: f(X(2, 3), act_type="relu"),
+    "BatchNorm": lambda f: f(NCHW(), X(3), X(3), X(3), X(3)),
+    "Convolution": lambda f: f(NCHW(), X(4, 3, 3, 3), X(4),
+                               kernel=(3, 3), num_filter=4),
+    "Deconvolution": lambda f: f(NCHW(), X(3, 4, 3, 3), X(4),
+                                 kernel=(3, 3), num_filter=4),
+    "Dropout": lambda f: f(X(2, 3), p=0.5),
+    "Dropout (axes=)": lambda f: f(X(2, 3, 4), p=0.5, axes=(1,)),
+    "Embedding": lambda f: f(XI(2, 3), X(8, 4), input_dim=8,
+                             output_dim=4),
+    "FullyConnected": lambda f: f(X(2, 6), X(4, 6), X(4), num_hidden=4),
+    "GridGenerator": lambda f: f(X(1, 6), transform_type="affine",
+                                 target_shape=(4, 4)),
+    "GroupNorm": lambda f: f(X(1, 4, 8, 8), X(2), X(2), num_groups=2),
+    "InstanceNorm": lambda f: f(NCHW(), X(3), X(3)),
+    "L2Normalization": lambda f: f(X(2, 3)),
+    "LRN": lambda f: f(NCHW(), nsize=3),
+    "LayerNorm": lambda f: f(X(2, 6), X(6), X(6)),
+    "LeakyReLU": lambda f: f(X(2, 3)),
+    "MakeLoss": lambda f: f(X(2, 3)),
+    "Pad": lambda f: f(NCHW(), mode="constant",
+                       pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+    "pad": lambda f: f(NCHW(), mode="constant",
+                       pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+    "Pooling": lambda f: f(NCHW(), kernel=(2, 2), pool_type="max"),
+    "RNN": lambda f: f(X(4, 2, 3),
+                       X(int(nd.rnn_param_size("rnn_tanh", 3, 5, 1))),
+                       X(1, 2, 5), state_size=5, num_layers=1,
+                       mode="rnn_tanh"),
+    "ROIPooling": lambda f: f(NCHW(), X(1, 5), pooled_size=(2, 2),
+                              spatial_scale=1.0),
+    "SVMOutput": lambda f: f(X(2, 5), nd.array(np.array([0., 1.],
+                                                        np.float32))),
+    "SequenceLast": lambda f: f(X(4, 2, 3)),
+    "SequenceMask": lambda f: f(X(4, 2, 3)),
+    "SequenceReverse": lambda f: f(X(4, 2, 3)),
+    "SliceChannel": lambda f: f(X(2, 6), num_outputs=2),
+    "SoftmaxActivation": lambda f: f(X(2, 3)),
+    "SoftmaxOutput": lambda f: f(X(2, 5),
+                                 nd.array(np.array([0., 1.], np.float32))),
+    "SpatialTransformer": lambda f: f(
+        NCHW(), X(1, 6), transform_type="affine", sampler_type="bilinear",
+        target_shape=(4, 4)),
+    "SwapAxis": lambda f: f(X(2, 3, 4), dim1=0, dim2=1),
+    "UpSampling": lambda f: f(NCHW(), scale=2, sample_type="nearest"),
+    "BilinearSampler": lambda f: f(NCHW(), X(1, 2, 4, 4)),
+    "CTCLoss": lambda f: f(X(6, 2, 5), nd.array(
+        np.array([[1, 2], [3, 4]], np.float32))),
+    "BlockGrad": lambda f: f(X(2, 3)),
+    "Custom": lambda f: True,  # needs a registered op; test_custom_op.py owns it
+    "Crop": lambda f: f(NCHW(), h_w=(4, 4)),
+    "LinearRegressionOutput": lambda f: f(X(2, 3), X(2, 3)),
+    "LogisticRegressionOutput": lambda f: f(X(2, 3), X(2, 3)),
+    "MAERegressionOutput": lambda f: f(X(2, 3), X(2, 3)),
+    # unary domain specials
+    "arccosh": lambda f: f(nd.array(1.0 + RS.rand(2, 3).astype(
+        np.float32))),
+    "logical_not": lambda f: f(X(2, 3)),
+    # shape/layout specials
+    "Reshape": lambda f: f(X(2, 6), shape=(3, 4)),
+    "reshape_like": lambda f: f(X(2, 6), X(3, 4)),
+    "expand_dims": lambda f: f(X(2, 3), axis=0),
+    "Concat": lambda f: f(X(2, 3), X(2, 3), dim=1),
+    "stack": lambda f: f(X(2, 3), X(2, 3)),
+    "split": lambda f: f(X(2, 6), num_outputs=2, axis=1),
+    "slice": lambda f: f(X(4, 4), begin=(1, 0), end=(3, 2)),
+    "slice_axis": lambda f: f(X(4, 4), axis=0, begin=1, end=3),
+    "slice_like": lambda f: f(X(4, 4), X(2, 2)),
+    "clip": lambda f: f(X(2, 3), a_min=0.2, a_max=0.8),
+    "repeat": lambda f: f(X(2, 3), repeats=2),
+    "tile": lambda f: f(X(2, 3), reps=(2, 1)),
+    "flip": lambda f: f(X(2, 3), axis=0),
+    "reverse": lambda f: f(X(2, 3), axis=0),
+    "depth_to_space": lambda f: f(X(1, 4, 2, 2), block_size=2),
+    "space_to_depth": lambda f: f(X(1, 1, 4, 4), block_size=2),
+    "Cast": lambda f: f(X(2, 3), dtype="float32"),
+    "amp_cast": lambda f: f(X(2, 3), dtype="float32"),
+    "amp_multicast": lambda f: f(X(2, 3), X(2, 3), num_outputs=2),
+    "khatri_rao": lambda f: f(X(2, 3), X(4, 3)),
+    "im2col": lambda f: f(NCHW(), kernel=(3, 3)),
+    "col2im": lambda f: f(nd.im2col(NCHW(), kernel=(3, 3)),
+                          output_size=(8, 8), kernel=(3, 3)),
+    "one_hot": lambda f: f(XI(4), depth=8),
+    "take": lambda f: f(X(5, 3), XI(2, n=5)),
+    "batch_take": lambda f: f(X(3, 4), XI(3, n=4)),
+    "gather_nd": lambda f: f(X(4, 4), XI(2, 3, n=4)),
+    "scatter_nd": lambda f: f(X(3), XI(2, 3, n=4), shape=(4, 4)),
+    "ravel_multi_index": lambda f: f(XI(2, 3, n=4), shape=(4, 4)),
+    "unravel_index": lambda f: f(XI(3, n=15), shape=(4, 4)),
+    "choose_element_0index": lambda f: f(X(3, 4), XI(3, n=4)),
+    "fill_element_0index": lambda f: f(X(3, 4), X(3), XI(3, n=4)),
+    "where": lambda f: f(nd.greater(X(2, 3), 0.5), X(2, 3), X(2, 3)),
+    "pick": lambda f: f(X(3, 4), XI(3, n=4)),
+    "topk": lambda f: f(X(3, 6), k=2),
+    "diag": lambda f: f(X(4, 4)),
+    "shape_array": lambda f: f(X(2, 3)),
+    "size_array": lambda f: f(X(2, 3)),
+    "norm": lambda f: f(X(2, 3)),
+    "moments": lambda f: f(X(2, 3), axes=(0,)),
+    "multi_all_finite": lambda f: f(X(2, 3), X(2, 3), num_arrays=2),
+    "cumsum": lambda f: f(X(2, 3), axis=1),
+    "broadcast_like": lambda f: f(X(1, 3), X(4, 3)),
+    "broadcast_to": lambda f: f(X(1, 3), shape=(4, 3)),
+    "broadcast_axis": lambda f: f(X(1, 3), axis=0, size=4),
+    "broadcast_axes": lambda f: f(X(1, 3), axis=0, size=4),
+    "add_n": lambda f: f(X(2, 3), X(2, 3), X(2, 3)),
+    # matrix
+    "dot": lambda f: f(X(2, 3), X(3, 4)),
+    "batch_dot": lambda f: f(X(2, 3, 4), X(2, 4, 5)),
+    "linalg_gemm": lambda f: f(X(3, 3), X(3, 3), X(3, 3)),
+    "linalg_gemm2": lambda f: f(X(3, 3), X(3, 3)),
+    "linalg_potrf": lambda f: f(nd.array(np.eye(3, dtype=np.float32) * 2)),
+    "linalg_potri": lambda f: f(nd.array(np.eye(3, dtype=np.float32) * 2)),
+    "linalg_trmm": lambda f: f(nd.array(np.tril(np.eye(3) + 0.1).astype(
+        np.float32)), X(3, 3)),
+    "linalg_trsm": lambda f: f(nd.array(np.tril(np.eye(3) + 0.1).astype(
+        np.float32)), X(3, 3)),
+    "linalg_sumlogdiag": lambda f: f(nd.array(
+        np.eye(3, dtype=np.float32) * 2)),
+    "linalg_syrk": lambda f: f(X(3, 4)),
+    "linalg_gelqf": lambda f: f(X(3, 4)),
+    "linalg_syevd": lambda f: f(nd.array(
+        (lambda a: ((a + a.T) / 2).astype(np.float32))(RS.rand(3, 3)))),
+    "linalg_inverse": lambda f: f(nd.array(
+        np.eye(3, dtype=np.float32) * 2)),
+    "linalg_det": lambda f: f(X(3, 3)),
+    "linalg_slogdet": lambda f: f(nd.array(
+        np.eye(3, dtype=np.float32) * 2)),
+    "linalg_extractdiag": lambda f: f(X(3, 3)),
+    "linalg_makediag": lambda f: f(X(3)),
+    "linalg_extracttrian": lambda f: f(X(3, 3)),
+    "linalg_maketrian": lambda f: f(X(6)),
+    # random
+    "random_uniform": lambda f: f(0.0, 1.0, shape=(2, 3)),
+    "random_normal": lambda f: f(0.0, 1.0, shape=(2, 3)),
+    "random_gamma": lambda f: f(2.0, 1.0, shape=(2, 3)),
+    "random_exponential": lambda f: f(1.0, shape=(2, 3)),
+    "random_poisson": lambda f: f(2.0, shape=(2, 3)),
+    "random_randint": lambda f: f(0, 5, shape=(2, 3)),
+    "sample_uniform": lambda f: f(X(3), X(3) + 1.0),
+    "sample_normal": lambda f: f(X(3), X(3)),
+    "sample_gamma": lambda f: f(X(3) + 1, X(3) + 1),
+    "sample_exponential": lambda f: f(X(3) + 1),
+    "sample_poisson": lambda f: f(X(3) + 1),
+    "sample_negative_binomial": lambda f: f(XI(3, n=4) + 1, X(3) * 0.5),
+    "sample_generalized_negative_binomial": lambda f: f(X(3) + 1,
+                                                        X(3) * 0.5),
+    "sample_multinomial": lambda f: f(nd.softmax(X(2, 5))),
+    "random_negative_binomial": lambda f: f(k=2, p=0.4, shape=(2,)),
+    "random_generalized_negative_binomial": lambda f: f(mu=2.0, alpha=0.5,
+                                                        shape=(2,)),
+    "randn": lambda f: f(2, 3),
+    "normal": lambda f: f(0.0, 1.0, shape=(2, 3)),
+    "uniform": lambda f: f(0.0, 1.0, shape=(2, 3)),
+    "shuffle": lambda f: f(X(4, 3)),
+    # optimizer kernels
+    "sgd_update": lambda f: f(X(3), X(3), lr=0.1),
+    "sgd_mom_update": lambda f: f(X(3), X(3), X(3), lr=0.1, momentum=0.9),
+    "mp_sgd_update": lambda f: f(X(3), X(3), X(3), lr=0.1),
+    "mp_sgd_mom_update": lambda f: f(X(3), X(3), X(3), X(3), lr=0.1),
+    "adam_update": lambda f: f(X(3), X(3), X(3), X(3), lr=0.1),
+    "nag_mom_update": lambda f: f(X(3), X(3), X(3), lr=0.1),
+    "mp_nag_mom_update": lambda f: f(X(3), X(3), X(3), X(3), lr=0.1),
+    "rmsprop_update": lambda f: f(X(3), X(3), X(3), lr=0.1),
+    "rmspropalex_update": lambda f: f(X(3), X(3), X(3), X(3), X(3),
+                                      lr=0.1),
+    "ftrl_update": lambda f: f(X(3), X(3), X(3), X(3), lr=0.1),
+    "ftml_update": lambda f: f(X(3), X(3), X(3), X(3), X(3), lr=0.1, t=1),
+    "signsgd_update": lambda f: f(X(3), X(3), lr=0.1),
+    "signum_update": lambda f: f(X(3), X(3), X(3), lr=0.1),
+    "lamb_update_phase1": lambda f: f(X(3), X(3), X(3), X(3)),
+    "lamb_update_phase2": lambda f: f(
+        X(3), X(3), nd.array(np.float32(1.5)), nd.array(np.float32(2.0)),
+        lr=0.1),
+    "adamw_update": lambda f: f(X(3), X(3), X(3), X(3), 1.0, lr=0.1),
+    "mp_adamw_update": lambda f: f(X(3), X(3), X(3), X(3), X(3), 1.0,
+                                   lr=0.1),
+    # contrib detection
+    "MultiBoxPrior": lambda f: f(NCHW(), sizes=(0.5,), ratios=(1.0,)),
+    "MultiBoxTarget": lambda f: f(
+        nd.contrib.MultiBoxPrior(NCHW(), sizes=(0.5,), ratios=(1.0,)),
+        nd.array(np.array([[[0, 0.1, 0.1, 0.4, 0.4]]], np.float32)),
+        nd.softmax(X(1, 2, 64))),
+    "MultiBoxDetection": lambda f: f(
+        nd.softmax(X(1, 2, 64)), X(1, 256),
+        nd.contrib.MultiBoxPrior(NCHW(), sizes=(0.5,), ratios=(1.0,))),
+    "box_nms": lambda f: f(X(1, 4, 6)),
+    "box_iou": lambda f: f(X(2, 4), X(3, 4)),
+    "bipartite_matching": lambda f: f(X(1, 3, 4), threshold=0.1),
+    "Proposal": lambda f: f(nd.softmax(X(1, 2, 4, 4), axis=1),
+                            X(1, 4, 4, 4), nd.array(
+                                np.array([[8, 8, 1.0]], np.float32)),
+                            feature_stride=2, scales=(4,), ratios=(1.0,),
+                            rpn_pre_nms_top_n=8, rpn_post_nms_top_n=4),
+    "MultiProposal": lambda f: f(nd.softmax(X(2, 2, 4, 4), axis=1),
+                                 X(2, 4, 4, 4), nd.array(
+                                     np.tile([8, 8, 1.0], (2, 1)).astype(
+                                         np.float32)),
+                                 feature_stride=2, scales=(4,),
+                                 ratios=(1.0,), rpn_pre_nms_top_n=8,
+                                 rpn_post_nms_top_n=4),
+    "ROIAlign": lambda f: f(NCHW(), X(1, 5), pooled_size=(2, 2),
+                            spatial_scale=1.0),
+    "DeformableConvolution": lambda f: f(
+        NCHW(), X(1, 18, 6, 6), X(4, 3, 3, 3), X(4), kernel=(3, 3),
+        num_filter=4),
+    "BilinearResize2D": lambda f: f(NCHW(), height=4, width=4),
+    "AdaptiveAvgPooling2D": lambda f: f(NCHW(), output_size=2),
+    # contrib misc
+    "count_sketch": lambda f: f(X(2, 8), XI(8, n=4),
+                                nd.sign(X(8) - 0.5), out_dim=4),
+    "fft": lambda f: f(X(2, 8)),
+    "ifft": lambda f: f(X(2, 16)),
+    "quadratic": lambda f: f(X(2, 3), a=1.0, b=1.0, c=1.0),
+    "allclose": lambda f: f(X(2, 3), X(2, 3)),
+    "arange_like": lambda f: f(X(2, 3)),
+    "div_sqrt_dim": lambda f: f(X(2, 3)),
+    "index_copy": lambda f: f(X(4, 3), XI(2, n=4), X(2, 3)),
+    "index_array": lambda f: f(X(2, 3)),
+    "boolean_mask": lambda f: f(X(4, 3), nd.array(
+        np.array([1, 0, 1, 1], np.float32))),
+    "gradientmultiplier": lambda f: f(X(2, 3), scalar=0.5),
+    "cond": lambda f: f(nd.ones((1,)), lambda: nd.ones((2,)),
+                        lambda: nd.zeros((2,))),
+    "foreach": lambda f: f(lambda x, s: (x + s[0], [x + s[0]]),
+                           X(3, 2), [nd.zeros((2,))]),
+    "while_loop": lambda f: f(
+        lambda i, s: nd.lesser(i, 3), lambda i, s: (i + 1, (i + 1, s)),
+        (nd.zeros(()), nd.ones(())), max_iterations=4),
+    "quantize": lambda f: f(X(2, 3)),
+    "quantize_v2": lambda f: f(X(2, 3)),
+    "dequantize": lambda f: True,  # needs a quantized triple; test_rtc_quant owns it
+    "requantize": lambda f: True,  # same
+    "quantized_conv": lambda f: True,   # test_rtc_quant owns the int8 paths
+    "quantized_fully_connected": lambda f: True,
+    "quantized_flatten": lambda f: True,
+    "quantized_pooling": lambda f: f(
+        nd.cast(XI(1, 2, 4, 4, n=100), "int8"),
+        nd.array(np.float32(-1.0)), nd.array(np.float32(1.0)),
+        kernel=(2, 2), pool_type="max", stride=(2, 2)),
+    # sparse
+    "cast_storage": lambda f: f(X(3, 4), "csr"),
+    "sparse dot (csr)": lambda f: f(
+        mx.nd.sparse.cast_storage(X(3, 4), "csr"), X(4, 2)),
+    "sparse elemwise_add": lambda f: f(
+        mx.nd.sparse.cast_storage(X(3, 4), "row_sparse"),
+        mx.nd.sparse.cast_storage(X(3, 4), "row_sparse")),
+    "retain": lambda f: f(
+        mx.nd.sparse.cast_storage(X(3, 4), "row_sparse"),
+        nd.array(np.array([0, 2], np.float32))),
+    "row_sparse_array": lambda f: f(
+        (X(2, 4), nd.array(np.array([0, 2], np.float32))), shape=(3, 4)),
+    "csr_matrix": lambda f: f(
+        (nd.array(np.array([1.0, 2.0], np.float32)),
+         nd.array(np.array([1, 3], np.float32)),
+         nd.array(np.array([0, 1, 2], np.float32))), shape=(2, 4)),
+}
+# rows whose impl isn't an nd.* path never reach the smoke loop; rows
+# mapped to `True` above are owned by dedicated test files (asserted to
+# exist below)
+OWNED_ELSEWHERE = {
+    "Custom": "test_custom_op.py",
+    "dequantize": "test_rtc_quant.py",
+    "requantize": "test_rtc_quant.py",
+    "quantized_conv": "test_rtc_quant.py",
+    "quantized_fully_connected": "test_rtc_quant.py",
+    "quantized_flatten": "test_rtc_quant.py",
+    "quantized_pooling": "test_rtc_quant.py",
+}
+
+
+def test_owned_elsewhere_files_exist():
+    here = os.path.dirname(os.path.abspath(__file__))
+    for op, fname in OWNED_ELSEWHERE.items():
+        assert os.path.exists(os.path.join(here, fname)), (op, fname)
+
+
+@pytest.mark.slow  # ~2 min for the full 280-op sweep; audit tier
+@pytest.mark.parametrize("name,impl", list(yes_rows()),
+                         ids=[n for n, _ in yes_rows()])
+def test_smoke_invoke(name, impl):
+    fn = _resolve(impl)
+    tmpl = TEMPLATES.get(name)
+    if tmpl is not None:
+        out = tmpl(fn)
+        assert out is not None
+        return
+    # generic cascade: unary, then binary
+    try:
+        out = fn(X(2, 3))
+    except TypeError:
+        out = fn(X(2, 3), X(2, 3))
+    assert out is not None

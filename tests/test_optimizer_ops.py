@@ -1,0 +1,196 @@
+"""Raw optimizer update ops (REF:src/operator/optimizer_op.cc surface):
+formula checks against independent NumPy oracles + the reference's
+in-place mutation contract (states rebound, out=weight idiom)."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+
+RS = np.random.RandomState(3)
+
+
+def arrs(*shapes):
+    return [RS.randn(*s).astype(np.float32) for s in shapes]
+
+
+def as_nd(*xs):
+    return [nd.array(x) for x in xs]
+
+
+def test_sgd_mom_update_matches_numpy_and_mutates_mom():
+    w0, g, m0 = arrs((4, 3), (4, 3), (4, 3))
+    w, gg, m = as_nd(w0, g, m0)
+    out = nd.sgd_mom_update(w, gg, m, lr=0.1, momentum=0.9, wd=0.01,
+                            out=w)
+    m_ref = 0.9 * m0 - 0.1 * (g + 0.01 * w0)
+    w_ref = w0 + m_ref
+    np.testing.assert_allclose(out.asnumpy(), w_ref, rtol=1e-6)
+    np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-6)
+    assert out is w  # in-place idiom returns the out handle
+
+
+def test_sgd_mom_matches_optimizer_class_trajectory():
+    w0, g1, g2 = arrs((6,), (6,), (6,))
+    # raw-op trajectory
+    w, m = as_nd(w0, np.zeros(6, np.float32))
+    for g in (g1, g2):
+        nd.sgd_mom_update(w, nd.array(g), m, lr=0.05, momentum=0.9,
+                          wd=0.001, out=w)
+    # optimizer-class trajectory
+    opt = mx.optimizer.create("sgd", learning_rate=0.05, momentum=0.9,
+                              wd=0.001)
+    w2 = nd.array(w0)
+    state = opt.create_state(0, w2)
+    for g in (g1, g2):
+        state = opt.update(0, w2, nd.array(g), state)
+    np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_adam_update_no_bias_correction():
+    w0, g, m0 = arrs((5,), (5,), (5,))
+    v0 = np.abs(arrs((5,))[0])
+    w, gg, m, v = as_nd(w0, g, m0, v0)
+    nd.adam_update(w, gg, m, v, lr=0.01, beta1=0.9, beta2=0.99,
+                   epsilon=1e-8, wd=0.1, out=w)
+    gp = g + 0.1 * w0
+    m_ref = 0.9 * m0 + 0.1 * gp
+    v_ref = 0.99 * v0 + 0.01 * gp ** 2
+    w_ref = w0 - 0.01 * m_ref / (np.sqrt(v_ref) + 1e-8)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-6)
+    np.testing.assert_allclose(v.asnumpy(), v_ref, rtol=1e-6)
+
+
+def test_nag_mom_update():
+    w0, g, m0 = arrs((4,), (4,), (4,))
+    w, gg, m = as_nd(w0, g, m0)
+    nd.nag_mom_update(w, gg, m, lr=0.1, momentum=0.8, wd=0.01, out=w)
+    gp = g + 0.01 * w0
+    m_ref = 0.8 * m0 + gp
+    w_ref = w0 - 0.1 * (gp + 0.8 * m_ref)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-6)
+    np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-6)
+
+
+def test_rmsprop_update():
+    w0, g = arrs((4,), (4,))
+    n0 = np.abs(arrs((4,))[0])
+    w, gg, n = as_nd(w0, g, n0)
+    nd.rmsprop_update(w, gg, n, lr=0.01, gamma1=0.9, epsilon=1e-8,
+                      wd=0.0, out=w)
+    n_ref = 0.9 * n0 + 0.1 * g ** 2
+    w_ref = w0 - 0.01 * g / (np.sqrt(n_ref) + 1e-8)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5)
+    np.testing.assert_allclose(n.asnumpy(), n_ref, rtol=1e-6)
+
+
+def test_rmspropalex_update_centered():
+    w0, g, gm0, d0 = arrs((4,), (4,), (4,), (4,))
+    n0 = np.abs(arrs((4,))[0]) + 1.0
+    w, gg, n, gm, d = as_nd(w0, g, n0, gm0, d0)
+    nd.rmspropalex_update(w, gg, n, gm, d, lr=0.01, gamma1=0.95,
+                          gamma2=0.9, epsilon=1e-4, out=w)
+    n_ref = 0.95 * n0 + 0.05 * g ** 2
+    g_ref = 0.95 * gm0 + 0.05 * g
+    d_ref = 0.9 * d0 - 0.01 * g / np.sqrt(n_ref - g_ref ** 2 + 1e-4)
+    np.testing.assert_allclose(w.asnumpy(), w0 + d_ref, rtol=1e-5)
+    np.testing.assert_allclose(n.asnumpy(), n_ref, rtol=1e-6)
+    np.testing.assert_allclose(gm.asnumpy(), g_ref, rtol=1e-6)
+    np.testing.assert_allclose(d.asnumpy(), d_ref, rtol=1e-5)
+
+
+def test_ftrl_update_sparsifies():
+    w0, g = arrs((6,), (6,))
+    z0 = np.zeros(6, np.float32)
+    n0 = np.zeros(6, np.float32)
+    w, gg, z, n = as_nd(w0, g, z0, n0)
+    nd.ftrl_update(w, gg, z, n, lr=0.1, lamda1=1e4, beta=1.0, out=w)
+    # with huge l1 strength the first step zeroes every weight
+    assert np.all(w.asnumpy() == 0.0)
+    np.testing.assert_allclose(n.asnumpy(), g ** 2, rtol=1e-6)
+
+
+def test_ftml_update():
+    w0, g = arrs((4,), (4,))
+    d0 = np.zeros(4, np.float32)
+    v0 = np.zeros(4, np.float32)
+    z0 = np.zeros(4, np.float32)
+    w, gg, d, v, z = as_nd(w0, g, d0, v0, z0)
+    nd.ftml_update(w, gg, d, v, z, lr=0.1, t=1, beta1=0.6, beta2=0.999,
+                   epsilon=1e-8, out=w)
+    v_ref = 0.001 * g ** 2
+    d_t = (1 - 0.6) / 0.1 * (np.sqrt(v_ref / 0.001) + 1e-8)
+    sigma = d_t - 0.6 * d0
+    z_ref = 0.4 * g - sigma * w0
+    np.testing.assert_allclose(w.asnumpy(), -z_ref / d_t, rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), v_ref, rtol=1e-5)
+
+
+def test_sign_ops():
+    w0, g, m0 = arrs((5,), (5,), (5,))
+    w, gg = as_nd(w0, g)
+    nd.signsgd_update(w, gg, lr=0.1, wd=0.01, out=w)
+    np.testing.assert_allclose(
+        w.asnumpy(), (1 - 0.1 * 0.01) * w0 - 0.1 * np.sign(g), rtol=1e-6)
+
+    w, gg, m = as_nd(w0, g, m0)
+    nd.signum_update(w, gg, m, lr=0.1, momentum=0.9, wd=0.05, wd_lh=0.02,
+                     out=w)
+    m_ref = 0.9 * m0 - 0.1 * (g + 0.05 * w0)
+    np.testing.assert_allclose(
+        w.asnumpy(), (1 - 0.1 * 0.02) * w0 + 0.1 * np.sign(m_ref),
+        rtol=1e-6)
+    np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-6)
+
+
+def test_lamb_two_phase():
+    w0, g, m0 = arrs((8,), (8,), (8,))
+    v0 = np.abs(arrs((8,))[0])
+    w, gg, m, v = as_nd(w0, g, m0, v0)
+    gdir = nd.lamb_update_phase1(w, gg, m, v, beta1=0.9, beta2=0.99,
+                                 epsilon=1e-6, t=2, wd=0.01)
+    m_ref = 0.9 * m0 + 0.1 * g
+    v_ref = 0.99 * v0 + 0.01 * g ** 2
+    mhat = m_ref / (1 - 0.9 ** 2)
+    vhat = v_ref / (1 - 0.99 ** 2)
+    gdir_ref = mhat / (np.sqrt(vhat) + 1e-6) + 0.01 * w0
+    np.testing.assert_allclose(gdir.asnumpy(), gdir_ref, rtol=1e-5)
+    np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-6)
+
+    r1 = nd.array(np.array(np.linalg.norm(w0), np.float32))
+    r2 = nd.array(np.array(np.linalg.norm(gdir_ref), np.float32))
+    nd.lamb_update_phase2(w, gdir, r1, r2, lr=0.01, out=w)
+    ratio = np.linalg.norm(w0) / np.linalg.norm(gdir_ref)
+    np.testing.assert_allclose(w.asnumpy(), w0 - 0.01 * ratio * gdir_ref,
+                               rtol=1e-5)
+
+
+def test_mp_sgd_update_master_weights():
+    w32_0, g = arrs((6,), (6,))
+    w16 = nd.cast(nd.array(w32_0), "bfloat16")
+    w32 = nd.array(w32_0)
+    g16 = nd.cast(nd.array(g), "bfloat16")
+    out = nd.mp_sgd_update(w16, g16, w32, lr=0.1, wd=0.01, out=w16)
+    w32_ref = w32_0 - 0.1 * (np.asarray(g16.asnumpy(), np.float32)
+                             + 0.01 * w32_0)
+    np.testing.assert_allclose(w32.asnumpy(), w32_ref, rtol=1e-6)
+    # low-precision weight is the cast of the f32 master
+    np.testing.assert_allclose(out.asnumpy(),
+                               w32_ref.astype(np.float32), rtol=1e-2)
+    assert out.dtype == np.dtype("bfloat16") or str(out.dtype) == "bfloat16"
+
+
+def test_adamw_update_decoupled_wd():
+    """Upstream adamw.cc kernel contract: decoupled wd, NO in-kernel bias
+    correction (the Python driver pre-scales lr, as with adam_update)."""
+    w0, g, m0 = arrs((5,), (5,), (5,))
+    v0 = np.abs(arrs((5,))[0])
+    w, gg, m, v = as_nd(w0, g, m0, v0)
+    nd.adamw_update(w, gg, m, v, rescale_grad=1.0, lr=0.01, beta1=0.9,
+                    beta2=0.99, epsilon=1e-8, wd=0.1, eta=1.0, out=w)
+    m_ref = 0.9 * m0 + 0.1 * g
+    v_ref = 0.99 * v0 + 0.01 * g ** 2
+    w_ref = w0 - (0.01 * m_ref / (np.sqrt(v_ref) + 1e-8) + 0.1 * w0)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5)

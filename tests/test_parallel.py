@@ -7,6 +7,8 @@ import tpu_mx as mx
 from tpu_mx import gluon, nd
 from tpu_mx.gluon import nn
 
+pytestmark = pytest.mark.slow  # 8-device virtual-mesh compiles (~4 min together)
+
 
 def _mesh(**axes):
     from tpu_mx.parallel import make_mesh

@@ -71,3 +71,116 @@ def test_quantize_net_end_to_end():
     scale = np.abs(ref).max() + 1e-8
     assert np.abs(out - ref).max() / scale < 0.12, \
         f"int8 divergence {np.abs(out - ref).max() / scale}"
+
+
+# ---------------------------------------------------------------------------
+# INT8 conv inference (VERDICT r3 ask#5: quantized conv + pool/activation
+# passthrough; REF:src/operator/quantization/quantized_conv.cc,
+# REF:src/operator/subgraph/mkldnn/)
+# ---------------------------------------------------------------------------
+def _train_small_cnn(steps=40):
+    """Tiny CNN trained on linearly-separable synthetic images so the
+    accuracy-drop contract (<=1%) is measurable, not vacuous."""
+    import tpu_mx as mx
+    from tpu_mx import autograd, gluon
+    from tpu_mx.gluon import nn
+    rs = np.random.RandomState(0)
+    n, classes = 256, 4
+    ys = rs.randint(0, classes, n)
+    xs = rs.rand(n, 1, 12, 12).astype(np.float32) * 0.3
+    for i, y in enumerate(ys):          # class-dependent bright quadrant
+        r, c = divmod(int(y), 2)
+        xs[i, 0, r * 6:(r + 1) * 6, c * 6:(c + 1) * 6] += 1.0
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(pool_size=2),
+            nn.Conv2D(16, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(pool_size=2),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(classes))
+    net.initialize(init="xavier")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    xb, yb = nd.array(xs), nd.array(ys.astype(np.float32))
+    for _ in range(steps):
+        with autograd.record():
+            loss = loss_fn(net(xb), yb)
+            loss.backward()
+        trainer.step(n)
+    return net, xs, ys
+
+
+def test_quantized_cnn_accuracy_drop_under_1pct():
+    from tpu_mx.contrib.quantization import quantize_net
+    net, xs, ys = _train_small_cnn()
+    xb = nd.array(xs)
+    float_pred = np.argmax(net(xb).asnumpy(), axis=1)
+    float_acc = float(np.mean(float_pred == ys))
+    assert float_acc > 0.9  # the float net actually learned the task
+
+    qnet = quantize_net(net, calib_data=xb)
+    q_pred = np.argmax(qnet(xb).asnumpy(), axis=1)
+    q_acc = float(np.mean(q_pred == ys))
+    assert float_acc - q_acc <= 0.01, (float_acc, q_acc)
+    # convs actually run int8 (not just the Dense tail)
+    from tpu_mx.contrib.quantization import QuantizedConv, _named_quantizable
+    n_conv = sum(isinstance(q, QuantizedConv)
+                 for q in qnet._qmap.values())
+    assert n_conv == 2
+
+
+def test_quantized_resnet_block_residual_structure():
+    """Residual/branchy blocks keep their control flow under quantization
+    (the leaf-patching design): int8 output stays close to float."""
+    from tpu_mx.gluon.model_zoo.vision.resnet import BasicBlockV1
+    from tpu_mx.contrib.quantization import quantize_net
+    rs = np.random.RandomState(1)
+    blk = BasicBlockV1(8, stride=1, in_channels=8)
+    blk.initialize(init="xavier")
+    x = nd.array(rs.rand(2, 8, 8, 8).astype(np.float32))
+    ref = blk(x).asnumpy()
+
+    qblk = quantize_net(blk, calib_data=x)
+    out = qblk(x).asnumpy()
+    rel = np.linalg.norm(out - ref) / max(np.linalg.norm(ref), 1e-8)
+    assert rel < 0.1, rel
+    corr = np.corrcoef(out.ravel(), ref.ravel())[0, 1]
+    assert corr > 0.99
+
+
+def test_quantized_net_not_bypassed_by_hybridize():
+    """A hybridized net's cached float program must not silently serve
+    quantized calls — the wrapper forces the eager (patched) path."""
+    from tpu_mx.contrib.quantization import quantize_net
+    net, xs, _ = _train_small_cnn(steps=5)
+    xb = nd.array(xs[:16])
+    q_eager = quantize_net(net, calib_data=xb)(xb).asnumpy()
+
+    net.hybridize()
+    _ = net(xb)   # build the float jit cache
+    q_hybrid = quantize_net(net, calib_data=xb)(xb).asnumpy()
+    np.testing.assert_allclose(q_hybrid, q_eager, rtol=1e-5, atol=1e-6)
+    # and hybridization is restored afterwards
+    assert net._active
+
+
+def test_quantized_net_with_shared_layer():
+    """A layer registered under two names (weight sharing) is patched and
+    unpatched exactly once — no AttributeError in the unpatch path."""
+    from tpu_mx.contrib.quantization import quantize_net
+    from tpu_mx.gluon import nn
+    shared = nn.Dense(6, activation="relu")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, in_units=6), shared, shared, nn.Dense(3))
+    net.initialize(init="xavier")
+    x = nd.array(np.random.RandomState(0).rand(4, 6).astype(np.float32))
+    ref = net(x).asnumpy()
+    qnet = quantize_net(net, calib_data=x)
+    out = qnet(x).asnumpy()     # must not crash
+    assert np.isfinite(out).all()
+    rel = np.linalg.norm(out - ref) / max(np.linalg.norm(ref), 1e-8)
+    assert rel < 0.1
+    # net restored: float path unchanged afterwards
+    np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-6)

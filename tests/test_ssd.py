@@ -27,6 +27,7 @@ def test_ssd_forward_shapes():
     assert box_preds.shape == (2, A * 4)
 
 
+@pytest.mark.slow
 def test_ssd_train_step():
     net = _tiny_ssd()
     net.initialize(mx.init.Xavier())
@@ -86,6 +87,7 @@ def test_ssd_hybridize_consistency():
                                atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ssd_512_config():
     net = mx.models.ssd_512(num_classes=20)
     net.initialize(mx.init.Xavier())

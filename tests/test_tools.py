@@ -75,6 +75,7 @@ def test_launch_local_spmd(tmp_path):
     assert ranks == [("0", "2"), ("1", "2")], out.stdout
 
 
+@pytest.mark.slow
 def test_elastic_barrier_detects_dead_rank(tmp_path):
     """A killed rank in a 2-process run produces a clean WorkerFailure within
     the timeout instead of an indefinite hang (SURVEY §5.3)."""
@@ -233,6 +234,7 @@ def test_bandwidth_tool():
     assert all(r["devices"] == 8 for r in recs)
 
 
+@pytest.mark.slow
 def test_bench_scaling_mode():
     """BENCH_MODELS=scaling measures weak-scaling efficiency on the
     virtual mesh (the BASELINE metric-3 harness)."""
@@ -248,3 +250,38 @@ def test_bench_scaling_mode():
                        if l.startswith("{")][-1])
     assert rec["metric"].startswith("weak_scaling_efficiency")
     assert 0 < rec["value"] <= 1.5
+
+
+def test_parse_log_table():
+    """tools/parse_log.py (REF:tools/parse_log.py analog): Speedometer +
+    fit log lines -> per-epoch table."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "parse_log", os.path.join(REPO, "tools", "parse_log.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    lines = [
+        "INFO Epoch[0] Batch [20]\tSpeed: 100.00 samples/sec\taccuracy=0.5",
+        "INFO Epoch[0] Batch [40]\tSpeed: 140.00 samples/sec\taccuracy=0.6",
+        "INFO Epoch[0] Train-accuracy=0.612000",
+        "INFO Epoch[0] Time cost=12.500",
+        "INFO Epoch[0] Validation-accuracy=0.580000",
+        "INFO Epoch[1] Batch [20]\tSpeed: 150.00 samples/sec\taccuracy=0.7",
+        "INFO Epoch[1] Train-accuracy=0.713000",
+        "INFO Epoch[1] Time cost=11.000",
+        "unrelated noise line",
+    ]
+    rows = mod.parse(lines)
+    assert len(rows) == 2
+    assert rows[0]["epoch"] == 0
+    assert rows[0]["speed_mean"] == 120.0
+    assert rows[0]["train-accuracy"] == 0.612
+    assert rows[0]["val-accuracy"] == 0.58
+    assert rows[0]["time_s"] == 12.5
+    assert rows[1]["speed_mean"] == 150.0
+    md = mod.render(rows, "markdown")
+    assert "| epoch |" in md and "120.0" in md
+    csv = mod.render(rows, "csv")
+    assert csv.splitlines()[0].startswith("epoch,")
+    import json as _json
+    assert _json.loads(mod.render(rows, "json"))[1]["epoch"] == 1

@@ -1,0 +1,403 @@
+"""Op-parity audit (VERDICT r3 ask#6): the upstream MXNet 1.x public op
+registry enumerated against this framework, one row per op.
+
+The registry below is the curated public `mx.nd.*` surface of upstream
+Apache MXNet 1.x (REF:src/operator/** registrations as exposed through
+the Python stubs — the reference mount is empty, so this is the upstream
+1.x documented API, the same source SURVEY.md §2.1 used).  Internal
+`_backward_*`/`_np_*` registrations are excluded: JAX autodiff subsumes
+the former wholesale and `tpu_mx.np` mirrors the latter.
+
+Statuses:
+  yes         — implemented; `impl` names the callable (smoke-invoked by
+                tests/test_ops_parity.py via the SMOKE templates here)
+  divergent   — capability provided through a documented TPU-native
+                design divergence (see docs/DIVERGENCES.md); `impl`
+                points at the replacement
+  not-planned — deliberately absent; `note` says why
+
+Regenerate the markdown after editing ROWS:
+    python tools/ops_parity.py > OPS_PARITY.md
+tests/test_ops_parity.py asserts OPS_PARITY.md is in sync, every `yes`
+row resolves, and every smoke template executes.
+"""
+from __future__ import annotations
+
+# (op, status, impl, note)
+ROWS = {}
+
+ROWS["Neural network (REF:src/operator/nn, *.cc at src/operator/)"] = [
+    ("Activation", "yes", "nd.Activation", ""),
+    ("BatchNorm", "yes", "nd.BatchNorm", "fused via XLA; batch_norm_core"),
+    ("BatchNorm_v1", "not-planned", "", "deprecated upstream alias of BatchNorm"),
+    ("Convolution", "yes", "nd.Convolution", "lax.conv_general_dilated; NHWC default layout"),
+    ("Convolution_v1", "not-planned", "", "deprecated upstream alias"),
+    ("Correlation", "not-planned", "", "FlowNet-specific cost-volume op; niche, no north-star workload uses it"),
+    ("Deconvolution", "yes", "nd.Deconvolution", "conv_transpose"),
+    ("Dropout", "yes", "nd.Dropout", "PRNG via random.key_scope"),
+    ("Embedding", "yes", "nd.Embedding", "take; dense grad (divergence #5 covers row_sparse)"),
+    ("FullyConnected", "yes", "nd.FullyConnected", ""),
+    ("GridGenerator", "yes", "nd.GridGenerator", ""),
+    ("GroupNorm", "yes", "nd.GroupNorm", ""),
+    ("IdentityAttachKLSparseReg", "not-planned", "", "deprecated sparse-activation regularizer, unused in 1.x examples"),
+    ("InstanceNorm", "yes", "nd.InstanceNorm", ""),
+    ("L2Normalization", "yes", "nd.L2Normalization", ""),
+    ("LRN", "yes", "nd.LRN", ""),
+    ("LayerNorm", "yes", "nd.LayerNorm", ""),
+    ("LeakyReLU", "yes", "nd.LeakyReLU", "incl. prelu/elu/selu/gelu act types"),
+    ("MakeLoss", "yes", "nd.MakeLoss", ""),
+    ("Pad", "yes", "nd.Pad", ""),
+    ("Pooling", "yes", "nd.Pooling", "max/avg/sum/lp, global, NHWC/NCHW"),
+    ("Pooling_v1", "not-planned", "", "deprecated upstream alias"),
+    ("RNN", "yes", "nd.RNN", "fused multi-layer LSTM/GRU/vanilla via lax.scan (the cuDNN-RNN analog)"),
+    ("ROIPooling", "yes", "nd.ROIPooling", ""),
+    ("SVMOutput", "yes", "nd.SVMOutput", "L1/L2 hinge output head"),
+    ("SequenceLast", "yes", "nd.SequenceLast", ""),
+    ("SequenceMask", "yes", "nd.SequenceMask", ""),
+    ("SequenceReverse", "yes", "nd.SequenceReverse", ""),
+    ("SliceChannel", "yes", "nd.SliceChannel", ""),
+    ("Softmax", "not-planned", "", "deprecated 0.x alias; nd.softmax / SoftmaxActivation cover it"),
+    ("SoftmaxActivation", "yes", "nd.SoftmaxActivation", ""),
+    ("SoftmaxOutput", "yes", "nd.SoftmaxOutput", "custom-vjp injected CE gradient"),
+    ("SpatialTransformer", "yes", "nd.SpatialTransformer", ""),
+    ("SwapAxis", "yes", "nd.SwapAxis", ""),
+    ("UpSampling", "yes", "nd.UpSampling", "nearest + bilinear"),
+    ("BilinearSampler", "yes", "nd.BilinearSampler", ""),
+    ("CTCLoss", "yes", "nd.CTCLoss", "log-semiring scan; torch-checked"),
+    ("BlockGrad", "yes", "nd.BlockGrad", "stop_gradient"),
+    ("Custom", "yes", "nd.Custom", "CustomOp/CustomOpProp registry (operator.py)"),
+    ("Crop", "yes", "nd.Crop", ""),
+    ("LinearRegressionOutput", "yes", "nd.LinearRegressionOutput", ""),
+    ("LogisticRegressionOutput", "yes", "nd.LogisticRegressionOutput", ""),
+    ("MAERegressionOutput", "yes", "nd.MAERegressionOutput", ""),
+    ("Dropout (axes=)", "yes", "nd.Dropout", "structured dropout via axes param"),
+]
+
+_UNARY = [
+    "abs", "arccos", "arccosh", "arcsin", "arcsinh", "arctan", "arctanh",
+    "cbrt", "ceil", "cos", "cosh", "degrees", "erf", "erfinv", "exp",
+    "expm1", "fix", "floor", "gamma", "gammaln", "log", "log10", "log1p",
+    "log2", "logical_not", "negative", "radians", "rcbrt", "reciprocal",
+    "relu", "rint", "round", "rsqrt", "sigmoid", "sign", "sin", "sinh",
+    "softsign", "sqrt", "square", "tan", "tanh", "trunc",
+]
+ROWS["Elementwise unary (REF:src/operator/tensor/elemwise_unary_op*)"] = [
+    (n, "yes", f"nd.{n}", "") for n in _UNARY
+] + [
+    ("erfcinv", "yes", "nd.erfcinv", ""),
+    ("digamma", "yes", "nd.digamma", ""),
+    ("hard_sigmoid", "yes", "nd.hard_sigmoid", ""),
+    ("softrelu", "yes", "nd.softrelu", "also Activation act_type"),
+    ("gelu", "yes", "nd.gelu", "upstream via LeakyReLU act_type='gelu'; first-class here"),
+    ("smooth_l1", "yes", "nd.smooth_l1", ""),
+    ("make_loss", "yes", "nd.make_loss", ""),
+    ("shuffle", "yes", "nd.shuffle", ""),
+]
+
+_BCAST = [
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_mod", "broadcast_power", "broadcast_maximum",
+    "broadcast_minimum", "broadcast_hypot", "broadcast_equal",
+    "broadcast_not_equal", "broadcast_greater", "broadcast_greater_equal",
+    "broadcast_lesser", "broadcast_lesser_equal", "broadcast_logical_and",
+    "broadcast_logical_or", "broadcast_logical_xor",
+]
+ROWS["Binary / broadcast (REF:src/operator/tensor/elemwise_binary*_op*, broadcast_reduce_op*)"] = [
+    (n, "yes", f"nd.{n}", "") for n in _BCAST
+] + [
+    ("broadcast_plus", "yes", "nd.broadcast_plus", "alias"),
+    ("broadcast_minus", "yes", "nd.broadcast_minus", "alias"),
+    ("broadcast_like", "yes", "nd.broadcast_like", ""),
+    ("broadcast_to", "yes", "nd.broadcast_to", ""),
+    ("broadcast_axis", "yes", "nd.broadcast_axis", ""),
+    ("broadcast_axes", "yes", "nd.broadcast_axes", "alias"),
+    ("elemwise_add", "yes", "nd.elemwise_add", ""),
+    ("elemwise_sub", "yes", "nd.elemwise_sub", ""),
+    ("elemwise_mul", "yes", "nd.elemwise_mul", ""),
+    ("elemwise_div", "yes", "nd.elemwise_div", ""),
+    ("add_n", "yes", "nd.add_n", ""),
+    ("maximum", "yes", "nd.maximum", ""),
+    ("minimum", "yes", "nd.minimum", ""),
+    ("hypot", "yes", "nd.hypot", ""),
+    ("equal", "yes", "nd.equal", ""),
+    ("not_equal", "yes", "nd.not_equal", ""),
+    ("greater", "yes", "nd.greater", ""),
+    ("greater_equal", "yes", "nd.greater_equal", ""),
+    ("lesser", "yes", "nd.lesser", ""),
+    ("lesser_equal", "yes", "nd.lesser_equal", ""),
+    ("logical_and", "yes", "nd.logical_and", ""),
+    ("logical_or", "yes", "nd.logical_or", ""),
+    ("logical_xor", "yes", "nd.logical_xor", ""),
+    ("arctan2", "yes", "nd.arctan2", ""),
+    ("nextafter", "yes", "nd.nextafter", ""),
+]
+
+ROWS["Reductions / ordering / indexing (REF:src/operator/tensor/{broadcast_reduce_op_value,ordering_op,indexing_op}*)"] = [
+    ("sum", "yes", "nd.sum", ""),
+    ("sum_axis", "yes", "nd.sum_axis", "alias"),
+    ("mean", "yes", "nd.mean", ""),
+    ("prod", "yes", "nd.prod", ""),
+    ("nansum", "yes", "nd.nansum", ""),
+    ("nanprod", "yes", "nd.nanprod", ""),
+    ("max", "yes", "nd.max", ""),
+    ("max_axis", "yes", "nd.max_axis", "alias"),
+    ("min", "yes", "nd.min", ""),
+    ("min_axis", "yes", "nd.min_axis", "alias"),
+    ("norm", "yes", "nd.norm", "ord 1/2, axis"),
+    ("argmax", "yes", "nd.argmax", ""),
+    ("argmin", "yes", "nd.argmin", ""),
+    ("argmax_channel", "yes", "nd.argmax_channel", ""),
+    ("pick", "yes", "nd.pick", ""),
+    ("topk", "yes", "nd.topk", "ret_typ value/indices/mask/both"),
+    ("sort", "yes", "nd.sort", ""),
+    ("argsort", "yes", "nd.argsort", ""),
+    ("take", "yes", "nd.take", "clip/wrap modes"),
+    ("batch_take", "yes", "nd.batch_take", ""),
+    ("one_hot", "yes", "nd.one_hot", ""),
+    ("gather_nd", "yes", "nd.gather_nd", ""),
+    ("scatter_nd", "yes", "nd.scatter_nd", ""),
+    ("ravel_multi_index", "yes", "nd.ravel_multi_index", ""),
+    ("unravel_index", "yes", "nd.unravel_index", ""),
+    ("choose_element_0index", "yes", "nd.choose_element_0index", ""),
+    ("fill_element_0index", "yes", "nd.fill_element_0index", ""),
+    ("where", "yes", "nd.where", ""),
+]
+
+ROWS["Shape / layout / casting (REF:src/operator/tensor/matrix_op*)"] = [
+    ("Reshape", "yes", "nd.Reshape", "incl. 0/-1/-2/-3/-4 special codes"),
+    ("reshape_like", "yes", "nd.reshape_like", ""),
+    ("Flatten", "yes", "nd.Flatten", ""),
+    ("expand_dims", "yes", "nd.expand_dims", ""),
+    ("squeeze", "yes", "nd.squeeze", ""),
+    ("Concat", "yes", "nd.Concat", ""),
+    ("stack", "yes", "nd.stack", ""),
+    ("split", "yes", "nd.split", ""),
+    ("slice", "yes", "nd.slice", "begin/end/step"),
+    ("slice_axis", "yes", "nd.slice_axis", ""),
+    ("slice_like", "yes", "nd.slice_like", ""),
+    ("clip", "yes", "nd.clip", ""),
+    ("repeat", "yes", "nd.repeat", ""),
+    ("tile", "yes", "nd.tile", ""),
+    ("pad", "yes", "nd.pad", ""),
+    ("transpose", "yes", "nd.transpose", ""),
+    ("swapaxes", "yes", "nd.swapaxes", ""),
+    ("flip", "yes", "nd.flip", ""),
+    ("reverse", "yes", "nd.reverse", ""),
+    ("depth_to_space", "yes", "nd.depth_to_space", ""),
+    ("space_to_depth", "yes", "nd.space_to_depth", "also the s2d ResNet stem"),
+    ("diag", "yes", "nd.diag", ""),
+    ("shape_array", "yes", "nd.shape_array", ""),
+    ("size_array", "yes", "nd.size_array", ""),
+    ("Cast", "yes", "nd.Cast", ""),
+    ("amp_cast", "yes", "nd.amp_cast", ""),
+    ("amp_multicast", "yes", "nd.amp_multicast", ""),
+    ("zeros_like", "yes", "nd.zeros_like", ""),
+    ("ones_like", "yes", "nd.ones_like", ""),
+    ("khatri_rao", "yes", "nd.khatri_rao", ""),
+    ("im2col", "yes", "nd.im2col", ""),
+    ("col2im", "yes", "nd.col2im", ""),
+    ("moments", "yes", "nd.moments", ""),
+    ("all_finite", "yes", "nd.all_finite", ""),
+    ("multi_all_finite", "yes", "nd.multi_all_finite", ""),
+    ("cumsum", "yes", "nd.cumsum", ""),
+]
+
+ROWS["Matrix compute (REF:src/operator/tensor/{dot,la_op}*)"] = [
+    ("dot", "yes", "nd.dot", "transpose_a/b; sparse via nd.sparse.dot"),
+    ("batch_dot", "yes", "nd.batch_dot", ""),
+    ("linalg_gemm", "yes", "nd.linalg_gemm", ""),
+    ("linalg_gemm2", "yes", "nd.linalg_gemm2", ""),
+    ("linalg_potrf", "yes", "nd.linalg_potrf", ""),
+    ("linalg_potri", "yes", "nd.linalg_potri", ""),
+    ("linalg_trmm", "yes", "nd.linalg_trmm", ""),
+    ("linalg_trsm", "yes", "nd.linalg_trsm", ""),
+    ("linalg_sumlogdiag", "yes", "nd.linalg_sumlogdiag", ""),
+    ("linalg_syrk", "yes", "nd.linalg_syrk", ""),
+    ("linalg_gelqf", "yes", "nd.linalg_gelqf", ""),
+    ("linalg_syevd", "yes", "nd.linalg_syevd", ""),
+    ("linalg_inverse", "yes", "nd.linalg_inverse", ""),
+    ("linalg_det", "yes", "nd.linalg_det", ""),
+    ("linalg_slogdet", "yes", "nd.linalg_slogdet", ""),
+    ("linalg_extractdiag", "yes", "nd.linalg_extractdiag", ""),
+    ("linalg_makediag", "yes", "nd.linalg_makediag", ""),
+    ("linalg_extracttrian", "yes", "nd.linalg_extracttrian", ""),
+    ("linalg_maketrian", "yes", "nd.linalg_maketrian", ""),
+]
+
+ROWS["Random / sampling (REF:src/operator/random/)"] = [
+    ("random_uniform", "yes", "nd.random_uniform", ""),
+    ("random_normal", "yes", "nd.random_normal", ""),
+    ("random_gamma", "yes", "nd.random_gamma", ""),
+    ("random_exponential", "yes", "nd.random_exponential", ""),
+    ("random_poisson", "yes", "nd.random_poisson", ""),
+    ("random_negative_binomial", "yes", "nd.random_negative_binomial", ""),
+    ("random_generalized_negative_binomial", "yes",
+     "nd.random_generalized_negative_binomial", ""),
+    ("random_randint", "yes", "nd.random_randint", ""),
+    ("sample_uniform", "yes", "nd.sample_uniform", "per-row distribution params"),
+    ("sample_normal", "yes", "nd.sample_normal", ""),
+    ("sample_gamma", "yes", "nd.sample_gamma", ""),
+    ("sample_exponential", "yes", "nd.sample_exponential", ""),
+    ("sample_poisson", "yes", "nd.sample_poisson", ""),
+    ("sample_negative_binomial", "yes", "nd.sample_negative_binomial", ""),
+    ("sample_generalized_negative_binomial", "yes",
+     "nd.sample_generalized_negative_binomial", ""),
+    ("sample_multinomial", "yes", "nd.sample_multinomial", ""),
+    ("randn", "yes", "nd.randn", ""),
+    ("normal", "yes", "nd.normal", "alias"),
+    ("uniform", "yes", "nd.uniform", "alias"),
+]
+
+ROWS["Optimizer update kernels (REF:src/operator/optimizer_op.cc, contrib/adamw.cc)"] = [
+    ("sgd_update", "yes", "nd.sgd_update", ""),
+    ("sgd_mom_update", "yes", "nd.sgd_mom_update", "state rebound in place"),
+    ("mp_sgd_update", "yes", "nd.mp_sgd_update", "f32 master weights"),
+    ("mp_sgd_mom_update", "yes", "nd.mp_sgd_mom_update", ""),
+    ("adam_update", "yes", "nd.adam_update", "upstream contract: no bias correction in the kernel"),
+    ("nag_mom_update", "yes", "nd.nag_mom_update", ""),
+    ("mp_nag_mom_update", "yes", "nd.mp_nag_mom_update", ""),
+    ("rmsprop_update", "yes", "nd.rmsprop_update", ""),
+    ("rmspropalex_update", "yes", "nd.rmspropalex_update", "centered"),
+    ("ftrl_update", "yes", "nd.ftrl_update", ""),
+    ("ftml_update", "yes", "nd.ftml_update", ""),
+    ("signsgd_update", "yes", "nd.signsgd_update", ""),
+    ("signum_update", "yes", "nd.signum_update", ""),
+    ("lamb_update_phase1", "yes", "nd.lamb_update_phase1", ""),
+    ("lamb_update_phase2", "yes", "nd.lamb_update_phase2", ""),
+    ("adamw_update", "yes", "nd.adamw_update", "tensor rescale_grad accepted"),
+    ("mp_adamw_update", "yes", "nd.mp_adamw_update", ""),
+    ("multi_sgd_update", "divergent", "gluon.Trainer.step_all",
+     "fused multi-tensor updates run inside the compiled train step / Trainer step_all; the interleaved-varargs kernel signature is not reproduced"),
+    ("multi_sgd_mom_update", "divergent", "gluon.Trainer.step_all", "same"),
+    ("multi_mp_sgd_update", "divergent", "gluon.Trainer.step_all", "same"),
+    ("multi_mp_sgd_mom_update", "divergent", "gluon.Trainer.step_all", "same"),
+    ("preloaded_multi_sgd_*", "divergent", "gluon.Trainer.step_all", "same (4 variants)"),
+    ("multi_lars", "divergent", "optimizer.LBSGD", "LARS trust ratios computed per-layer inside LBSGD.update_core"),
+    ("lars_multi_sgd_update", "divergent", "optimizer.LBSGD", "same (4 variants)"),
+]
+
+ROWS["Contrib — detection / vision (REF:src/operator/contrib/)"] = [
+    ("MultiBoxPrior", "yes", "nd.contrib.MultiBoxPrior", ""),
+    ("MultiBoxTarget", "yes", "nd.contrib.MultiBoxTarget", ""),
+    ("MultiBoxDetection", "yes", "nd.contrib.MultiBoxDetection", ""),
+    ("box_nms", "yes", "nd.contrib.box_nms", "fixed-capacity padded TPU formulation"),
+    ("box_iou", "yes", "nd.contrib.box_iou", ""),
+    ("bipartite_matching", "yes", "nd.contrib.bipartite_matching", ""),
+    ("Proposal", "yes", "nd.Proposal", ""),
+    ("MultiProposal", "yes", "nd.MultiProposal", ""),
+    ("ROIAlign", "yes", "nd.ROIAlign", ""),
+    ("DeformableConvolution", "yes", "nd.contrib.DeformableConvolution",
+     "bilinear-gather formulation"),
+    ("DeformablePSROIPooling", "not-planned", "",
+     "R-FCN-specific; no north-star workload; ROIAlign covers the modern path"),
+    ("PSROIPooling", "not-planned", "", "same"),
+    ("BilinearResize2D", "yes", "nd.BilinearResize2D", ""),
+    ("AdaptiveAvgPooling2D", "yes", "nd.contrib.AdaptiveAvgPooling2D",
+     "averaging-matrix einsum formulation (MXU-friendly)"),
+]
+
+ROWS["Contrib — misc (REF:src/operator/contrib/)"] = [
+    ("count_sketch", "yes", "nd.contrib.count_sketch", ""),
+    ("fft", "yes", "nd.contrib.fft", "XLA fft; interleaved re/im layout preserved"),
+    ("ifft", "yes", "nd.contrib.ifft", "unnormalized like cuFFT"),
+    ("quadratic", "yes", "nd.contrib.quadratic", ""),
+    ("allclose", "yes", "nd.contrib.allclose", ""),
+    ("arange_like", "yes", "nd.contrib.arange_like", ""),
+    ("div_sqrt_dim", "yes", "nd.contrib.div_sqrt_dim", ""),
+    ("index_copy", "yes", "nd.contrib.index_copy", ""),
+    ("index_array", "yes", "nd.contrib.index_array", ""),
+    ("boolean_mask", "yes", "nd.contrib.boolean_mask", ""),
+    ("gradientmultiplier", "yes", "nd.contrib.gradientmultiplier", ""),
+    ("cond", "yes", "nd.contrib.cond", "lax.cond when traced"),
+    ("foreach", "yes", "nd.contrib.foreach", "lax.scan when traced"),
+    ("while_loop", "yes", "nd.contrib.while_loop", "lax.while_loop when traced"),
+    ("interleaved_matmul_selfatt_qk", "divergent", "kernels.flash_attention",
+     "the 1.6 interleaved attention matmuls are subsumed by the fused flash-attention Pallas kernel (better than the reference's unfused pair)"),
+    ("interleaved_matmul_selfatt_valatt", "divergent", "kernels.flash_attention", "same"),
+    ("interleaved_matmul_encdec_qk", "divergent", "kernels.flash_attention", "same"),
+    ("interleaved_matmul_encdec_valatt", "divergent", "kernels.flash_attention", "same"),
+    ("hawkesll", "not-planned", "", "Hawkes point-process likelihood; niche, no workload"),
+    ("dgl_csr_neighbor_uniform_sample", "not-planned", "",
+     "DGL graph-sampling family (6 ops): graph workloads out of scope per SURVEY"),
+    ("edge_id", "not-planned", "", "DGL family"),
+    ("getnnz", "divergent", "nd.sparse",
+     "CSR indptr[-1] IS the nnz; no separate kernel needed"),
+    ("quantize", "yes", "nd.quantize_v2", "v2 entry is the documented one"),
+    ("quantize_v2", "yes", "nd.quantize_v2", ""),
+    ("dequantize", "yes", "nd.dequantize", ""),
+    ("requantize", "yes", "nd.requantize", ""),
+    ("quantized_conv", "yes", "nd.quantized_conv", "int8 lax.conv"),
+    ("quantized_fully_connected", "yes", "nd.quantized_fully_connected", ""),
+    ("quantized_flatten", "yes", "nd.quantized_flatten", ""),
+    ("quantized_pooling", "yes", "nd.quantized_pooling", "int8 passthrough pooling"),
+    ("amp_cast (contrib→core in 1.5)", "yes", "nd.amp_cast", ""),
+]
+
+ROWS["Sparse (REF:src/operator/tensor/{cast_storage,dot,elemwise*}-inl.h sparse paths)"] = [
+    ("cast_storage", "yes", "nd.sparse.cast_storage", "divergence #5: compact gather/segment-sum formulation"),
+    ("sparse dot (csr)", "yes", "nd.sparse.dot", ""),
+    ("sparse elemwise_add", "yes", "nd.sparse.elemwise_add", ""),
+    ("retain", "yes", "nd.sparse.retain", ""),
+    ("row_sparse_array", "yes", "nd.sparse.row_sparse_array", ""),
+    ("csr_matrix", "yes", "nd.sparse.csr_matrix", ""),
+]
+
+ROWS["Internal registrations (blanket rows)"] = [
+    ("_backward_* (~300 registrations)", "divergent", "jax.vjp",
+     "every backward kernel is derived by JAX autodiff from the forward; no hand-written backward registry exists or is needed"),
+    ("_np_* / _npi_* (numpy namespace)", "yes", "tpu_mx.np",
+     "211-symbol np namespace mirrors the 1.6+ numpy API"),
+    ("_contrib_*AMP loss-scale helpers", "yes", "contrib.amp",
+     "LossScaler + cast lists"),
+    ("_image_* (image ops)", "yes", "image.image / gluon.data.vision.transforms",
+     "resize/crop/flip/normalize etc."),
+    ("_sparse_* storage-fallback registrations", "divergent", "nd.sparse",
+     "dense-fallback is automatic (jnp); explicit storage types only where they pay"),
+]
+
+
+def counts():
+    total = impl = div = np_ = 0
+    for fam in ROWS.values():
+        for _, status, _, _ in fam:
+            total += 1
+            impl += status == "yes"
+            div += status == "divergent"
+            np_ += status == "not-planned"
+    return total, impl, div, np_
+
+
+def render():
+    total, impl, div, np_ = counts()
+    out = [
+        "# OPS_PARITY — upstream MXNet 1.x op registry vs tpu_mx",
+        "",
+        "Generated by `python tools/ops_parity.py > OPS_PARITY.md` — edit",
+        "`tools/ops_parity.py`, not this file.  Checked by",
+        "`tests/test_ops_parity.py`: the table must be in sync, every",
+        "`yes` row must resolve to a callable, and every smoke template",
+        "must execute.",
+        "",
+        f"**Coverage: {impl} implemented + {div} divergent (documented "
+        f"TPU-native replacement) + {np_} not-planned = {total} rows.**",
+        "",
+        "Statuses: `yes` = implemented (smoke-invoked in CI); `divergent`",
+        "= capability delivered through a documented TPU-native design",
+        "(docs/DIVERGENCES.md); `not-planned` = deliberately absent with",
+        "reason.",
+        "",
+    ]
+    for fam, rows in ROWS.items():
+        out.append(f"## {fam}")
+        out.append("")
+        out.append("| op | status | tpu_mx | note |")
+        out.append("|---|---|---|---|")
+        for name, status, impl_, note in rows:
+            out.append(f"| `{name}` | {status} | "
+                       f"{f'`{impl_}`' if impl_ else '—'} | {note} |")
+        out.append("")
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    print(render())

@@ -257,9 +257,34 @@ class HybridBlock(Block):
                           static_shape=static_shape, **kwargs)
 
     def infer_shape(self, *args):
-        """Finalize deferred-init params from example inputs (per-layer hook)."""
-        for child in self._children.values():
-            pass  # layers override; containers propagate via forward
+        """Finalize deferred-init parameter shapes from example inputs
+        (REF:python/mxnet/gluon/block.py HybridBlock.infer_shape).
+
+        Leaf layers override this with closed-form rules (Dense, Conv,
+        RNN cells, …).  The base implementation covers the two remaining
+        cases:
+
+        - a container whose CHILDREN hold the deferred params: one
+          predict-mode forward over the example inputs finalizes every
+          child (TPU-native divergence: the reference runs symbolic
+          inference over the NNVM graph; here the eager forward IS the
+          shape-inference pass — each layer's own infer_shape fires as
+          the data reaches it);
+        - a custom block with its OWN deferred params and no override:
+          an explicit error (arbitrary Python forwards have no
+          closed-form shape rule; the silent no-op this used to be
+          surfaced later as a confusing uninitialized-parameter error).
+        """
+        own_incomplete = [p.name for p in self._reg_params.values()
+                          if p._data is None and p._shape_incomplete()]
+        if own_incomplete:
+            raise MXNetError(
+                f"{type(self).__name__} has deferred-shape parameters "
+                f"{own_incomplete} but no infer_shape override; declare "
+                "full shapes (in_units/in_channels/...) or override "
+                "infer_shape(self, *args) with the block's shape rule")
+        with autograd.predict_mode():
+            self.forward(*args)
 
     def _uninitialized(self):
         return [p for p in self.collect_params().values() if p._data is None]

@@ -2,8 +2,8 @@
 the C++ iterators of REF:src/io/).  See ``tpu_mx/io/io.py``."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, MNISTIter, CSVIter, ImageRecordIter,
-                 LibSVMIter)
+                 ImageDetRecordIter, LibSVMIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
-           "LibSVMIter"]
+           "ImageDetRecordIter", "LibSVMIter"]

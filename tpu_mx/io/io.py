@@ -697,3 +697,139 @@ class ImageRecordIter(DataIter):
 
     def getpad(self):
         return self._pad
+
+
+class ImageDetRecordIter(DataIter):
+    """Detection RecordIO pipeline (REF:src/io/iter_image_det_recordio.cc +
+    REF:src/io/image_det_aug_default.cc): threaded JPEG decode +
+    box-aware augmentation (IoU-constrained random crop, flip with box
+    transform, force-resize) + batching into (data (B,C,H,W),
+    label (B, max_objects, 5)) — the SSD training input pair, with labels
+    padded to the fixed width MultiBoxTarget wants on TPU.
+
+    The hot path is the native C++ pipeline (native/tpumx_io.cpp
+    DetPipe); ``use_native=False`` (or an unbuildable lib) falls back to
+    the Python ``image.detection.ImageDetIter`` augmenters, which share
+    the same label contract."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, max_objects=None,
+                 shuffle=False, rand_crop=0, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, min_object_covered=0.3, area_range=(0.3, 1.0),
+                 aspect_ratio_range=(0.75, 1.33), max_attempts=20,
+                 preprocess_threads=4, prefetch_buffer=4, seed=0,
+                 use_native=None, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        check(len(self.data_shape) == 3, "data_shape must be (C,H,W)")
+        self.max_objects = max_objects or self._scan_max_objects(path_imgrec)
+        self._pad = 0
+        self._native = None
+        if use_native is not False:
+            try:
+                from ..lib.recordio_cpp import NativeDetPipe
+                self._native = NativeDetPipe(
+                    path_imgrec, batch_size=batch_size,
+                    data_shape=self.data_shape,
+                    max_objects=self.max_objects,
+                    rand_crop=bool(rand_crop), rand_mirror=rand_mirror,
+                    mean=(mean_r, mean_g, mean_b),
+                    std=(std_r, std_g, std_b),
+                    min_object_covered=min_object_covered,
+                    area_range=area_range,
+                    aspect_ratio_range=aspect_ratio_range,
+                    max_attempts=max_attempts,
+                    preprocess_threads=preprocess_threads,
+                    prefetch_buffer=prefetch_buffer, shuffle=shuffle,
+                    seed=seed)
+            except Exception as e:
+                if use_native:
+                    raise
+                import warnings
+                warnings.warn(f"native det io unavailable ({e}); "
+                              "using the Python pipeline")
+        if self._native is not None:
+            n = len(self._native)
+            self._nat_batches = (n + batch_size - 1) // batch_size
+            self._nat_pad = self._nat_batches * batch_size - n
+            self._nat_seen = 0
+            return
+        # Python fallback: the image.detection iterator (same label layout)
+        from ..image.detection import ImageDetIter
+        mean = None
+        std = None
+        if mean_r or mean_g or mean_b:
+            mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        if std_r != 1.0 or std_g != 1.0 or std_b != 1.0:
+            std = np.array([std_r, std_g, std_b], np.float32)
+        self._py = ImageDetIter(
+            batch_size, self.data_shape, path_imgrec=path_imgrec,
+            shuffle=shuffle, max_objects=self.max_objects,
+            rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean,
+            std=std, min_object_covered=min_object_covered,
+            area_range=area_range, aspect_ratio_range=aspect_ratio_range,
+            max_attempts=max_attempts, **kwargs)
+
+    @staticmethod
+    def _scan_max_objects(path_imgrec):
+        """One header-only pass over the .rec (no image decode): widest
+        label block, in boxes."""
+        from ..recordio import MXRecordIO, unpack
+        widest = 1
+        r = MXRecordIO(path_imgrec, "r")
+        try:
+            while True:
+                raw = r.read()
+                if raw is None:
+                    break
+                header, _ = unpack(raw)
+                if header.flag:
+                    widest = max(widest, int(header.flag) // 5)
+        finally:
+            r.close()
+        return widest
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label",
+                         (self.batch_size, self.max_objects, 5))]
+
+    def reset(self):
+        if self._native is not None:
+            self._native.reset()
+            self._nat_seen = 0
+            self._pad = 0
+        else:
+            self._py.reset()
+
+    def iter_next(self):
+        if self._native is not None:
+            out = self._native.next_batch()
+            if out is None:
+                return False
+            self._data, self._label = out
+            self._nat_seen += 1
+            self._pad = self._nat_pad if self._nat_seen == self._nat_batches \
+                else 0
+            return True
+        try:
+            batch = self._py.next()
+        except StopIteration:
+            return False
+        self._data = batch.data[0].asnumpy()
+        self._label = batch.label[0].asnumpy()
+        self._pad = batch.pad or 0
+        return True
+
+    def getdata(self):
+        return [nd.array(self._data)]
+
+    def getlabel(self):
+        return [nd.array(self._label)]
+
+    def getpad(self):
+        return self._pad

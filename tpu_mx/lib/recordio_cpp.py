@@ -13,7 +13,7 @@ import numpy as np
 
 from . import ensure_built
 
-__all__ = ["NativeImagePipe", "native_im2rec"]
+__all__ = ["NativeImagePipe", "NativeDetPipe", "native_im2rec"]
 
 _lib = None
 
@@ -30,6 +30,15 @@ def _load():
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.tmx_det_pipe_create.restype = ctypes.c_void_p
+        lib.tmx_det_pipe_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
         ]
         lib.tmx_pipe_next.restype = ctypes.c_int
         lib.tmx_pipe_next.argtypes = [
@@ -90,6 +99,72 @@ class NativeImagePipe:
         if n == 0:
             return None
         return data, label[:, 0] if self.label_width == 1 else label
+
+    def reset(self):
+        self._lib.tmx_pipe_reset(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.tmx_pipe_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeDetPipe:
+    """Threaded RecordIO→JPEG→det-augment→(NCHW, (max_objects,5)) pipeline
+    in C++ (native/tpumx_io.cpp DetPipe — the
+    REF:src/io/iter_image_det_recordio.cc analog).  Labels come back as
+    the fixed-width padded box blocks MultiBoxTarget wants."""
+
+    def __init__(self, path_imgrec, batch_size, data_shape, max_objects,
+                 rand_crop=False, rand_mirror=False, mean=(0.0, 0.0, 0.0),
+                 std=(1.0, 1.0, 1.0), min_object_covered=0.3,
+                 area_range=(0.3, 1.0), aspect_ratio_range=(0.75, 1.33),
+                 max_attempts=20, preprocess_threads=4, prefetch_buffer=4,
+                 shuffle=False, seed=0):
+        lib = _load()
+        c, h, w = data_shape
+        mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
+        std_arr = (ctypes.c_float * 3)(*[float(s) for s in std])
+        err = ctypes.create_string_buffer(1024)
+        self._h = lib.tmx_det_pipe_create(
+            path_imgrec.encode(), batch_size, c, h, w, int(max_objects),
+            int(bool(rand_crop)), int(bool(rand_mirror)), mean_arr, std_arr,
+            float(min_object_covered), float(area_range[0]),
+            float(area_range[1]), float(aspect_ratio_range[0]),
+            float(aspect_ratio_range[1]), int(max_attempts),
+            int(preprocess_threads), int(prefetch_buffer),
+            int(bool(shuffle)), int(seed), err, len(err))
+        if not self._h:
+            raise IOError("NativeDetPipe: %s" %
+                          err.value.decode(errors="replace"))
+        self._lib = lib
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.max_objects = int(max_objects)
+
+    def __len__(self):
+        return int(self._lib.tmx_pipe_size(self._h))
+
+    def next_batch(self):
+        data = np.empty((self.batch_size,) + self.data_shape, np.float32)
+        label = np.empty((self.batch_size, self.max_objects, 5), np.float32)
+        n = self._lib.tmx_pipe_next(
+            self._h,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n < 0:
+            raise IOError("NativeDetPipe: %s" %
+                          self._lib.tmx_pipe_error(self._h).decode(
+                              errors="replace"))
+        if n == 0:
+            return None
+        return data, label
 
     def reset(self):
         self._lib.tmx_pipe_reset(self._h)

@@ -579,3 +579,178 @@ def cond(pred, then_func, else_func):
 
 
 __all__ += ["foreach", "while_loop", "cond"]
+
+
+# ----------------------------------------------------------------------------
+# long-tail contrib ops (REF:src/operator/contrib/**) — r4 parity sweep
+# ----------------------------------------------------------------------------
+def quadratic(data, a=0.0, b=0.0, c=0.0, **kw):
+    """a·x² + b·x + c (REF:contrib/quadratic_op.cc — upstream's tutorial
+    op; kept for parity)."""
+    return _apply(lambda x: a * jnp.square(x) + b * x + c, [data],
+                  "quadratic")
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **kw):
+    """arange shaped like `data` (REF:contrib/arange_like — position-id
+    helper for transformer embeddings): axis=None → data's full shape,
+    else a 1-D range of that axis' length."""
+    def fn(x):
+        if axis is None:
+            n = int(np.prod(x.shape))
+            out = jnp.arange(n, dtype=x.dtype) * step + start
+            return jnp.repeat(out, repeat)[:n].reshape(x.shape) \
+                if repeat != 1 else out.reshape(x.shape)
+        n = x.shape[axis]
+        out = jnp.arange(n, dtype=x.dtype) * step + start
+        return jnp.repeat(out, repeat)[:n] if repeat != 1 else out
+    return _apply(fn, [data], "arange_like", nondiff=True)
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False, **kw):
+    """Scalar 1.0/0.0 closeness test (REF:contrib/allclose_op.cc)."""
+    return _apply(lambda x, y: jnp.allclose(
+        x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+        .astype(jnp.float32), [a, b], "allclose", nondiff=True)
+
+
+def div_sqrt_dim(data, **kw):
+    """data / √(last-dim size) (REF:contrib/transformer.cc div_sqrt_dim
+    — the attention-score scaling helper)."""
+    return _apply(lambda x: x / jnp.sqrt(jnp.asarray(
+        float(x.shape[-1]), x.dtype)), [data], "div_sqrt_dim")
+
+
+def index_copy(old, index, new, **kw):
+    """Copy rows of `new` into `old` at `index` along axis 0
+    (REF:contrib/index_copy.cc).  Functional: returns the updated array
+    (the reference mutates out-of-place too unless out=old)."""
+    return _apply(lambda o, i, n: o.at[i.astype(jnp.int32)].set(n),
+                  [old, index, new], "index_copy")
+
+
+def index_array(data, axes=None, **kw):
+    """Per-element index coordinates (REF:contrib/index_array.cc):
+    output shape data.shape + (len(axes) or ndim,), int64→int32 here
+    (TPU-native: int32 index space)."""
+    def fn(x):
+        axs = tuple(range(x.ndim)) if axes is None else tuple(axes)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in x.shape],
+                             indexing="ij")
+        return jnp.stack([grids[a] for a in axs], axis=-1).astype(
+            jnp.int32)
+    return _apply(fn, [data], "index_array", nondiff=True)
+
+
+def gradientmultiplier(data, scalar=1.0, **kw):
+    """Identity forward, gradient scaled by `scalar` on backward
+    (REF:contrib/gradient_multiplier_op.cc — gradient-reversal layers
+    use scalar=-lambda)."""
+    @jax.custom_vjp
+    def gm(x):
+        return x
+
+    def gm_fwd(x):
+        return x, None
+
+    def gm_bwd(_, g):
+        return (g * scalar,)
+
+    gm.defvjp(gm_fwd, gm_bwd)
+    return _apply(gm, [data], "gradientmultiplier")
+
+
+def fft(data, compute_size=128, **kw):
+    """FFT over the last axis (REF:contrib/fft.cc, cuFFT upstream —
+    XLA-native here).  Real input (..., n) → interleaved re/im output
+    (..., 2n), matching the reference's layout."""
+    def fn(x):
+        f = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+        return jnp.stack([f.real, f.imag], axis=-1).reshape(
+            *x.shape[:-1], 2 * x.shape[-1]).astype(jnp.float32)
+    return _apply(fn, [data], "fft")
+
+
+def ifft(data, compute_size=128, **kw):
+    """Inverse FFT of the interleaved re/im layout (..., 2n) → real
+    (..., n).  UNNORMALIZED like the reference's cuFFT path — callers
+    divide by n (REF:contrib/ifft.cc docs)."""
+    def fn(x):
+        n = x.shape[-1] // 2
+        c = x.reshape(*x.shape[:-1], n, 2)
+        z = c[..., 0] + 1j * c[..., 1]
+        return (jnp.fft.ifft(z, axis=-1).real * n).astype(jnp.float32)
+    return _apply(fn, [data], "ifft")
+
+
+def AdaptiveAvgPooling2D(data, output_size=1, **kw):
+    """NCHW adaptive average pooling (REF:contrib/adaptive_avg_pooling.cc).
+    TPU-native formulation: the variable-size bin averages are expressed
+    as two small averaging matrices (P_h · X · P_wᵀ via einsum) — dense
+    MXU work instead of ragged windows."""
+    os_ = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def pool_matrix(n_in, n_out):
+        m = np.zeros((n_out, n_in), np.float32)
+        for i in range(n_out):
+            s = int(np.floor(i * n_in / n_out))
+            e = int(np.ceil((i + 1) * n_in / n_out))
+            m[i, s:e] = 1.0 / (e - s)
+        return m
+
+    def fn(x):
+        ph = jnp.asarray(pool_matrix(x.shape[2], os_[0]), x.dtype)
+        pw = jnp.asarray(pool_matrix(x.shape[3], os_[1]), x.dtype)
+        return jnp.einsum("oh,nchw,pw->ncop", ph, x, pw)
+    return _apply(fn, [data], "AdaptiveAvgPooling2D")
+
+
+def bipartite_matching(data, is_ascend=False, threshold=None, topk=-1,
+                       **kw):
+    """Greedy bipartite matching on a (B, N, M) score matrix
+    (REF:src/operator/contrib/bounding_box.cc bipartite_matching — the
+    anchor-assignment primitive under MultiBoxTarget).  Returns
+    (row_assignments (B, N), col_assignments (B, M)) with -1 for
+    unmatched.  Fixed min(N, M) (or topk) rounds of masked argmax —
+    static shapes, lax.fori_loop, vmapped over batch."""
+    if threshold is None:
+        raise ValueError("bipartite_matching requires threshold")
+
+    def one(s):
+        n, m = s.shape
+        rounds = min(n, m) if topk < 0 else min(topk, n, m)
+        big = jnp.asarray(np.finfo(np.float32).max, jnp.float32)
+        sc = s.astype(jnp.float32)
+        if is_ascend:
+            sc = -sc
+            thr = -threshold
+        else:
+            thr = threshold
+
+        def body(_, carry):
+            sc, row, col = carry
+            flat = jnp.argmax(sc)
+            i, j = flat // m, flat % m
+            ok = sc[i, j] >= thr
+            row = jnp.where(ok, row.at[i].set(j), row)
+            col = jnp.where(ok, col.at[j].set(i), col)
+            sc = jnp.where(ok, sc.at[i, :].set(-big).at[:, j].set(-big),
+                           sc)
+            return sc, row, col
+
+        row0 = jnp.full((n,), -1.0, jnp.float32)
+        col0 = jnp.full((m,), -1.0, jnp.float32)
+        _, row, col = jax.lax.fori_loop(0, rounds, body, (sc, row0, col0))
+        return row, col
+
+    def fn(x):
+        return jax.vmap(one)(x)
+
+    res = _apply(fn, [data], "bipartite_matching", nondiff=True)
+    return res
+
+
+__all__ += ["quadratic", "arange_like", "allclose", "div_sqrt_dim",
+            "index_copy", "index_array", "gradientmultiplier", "fft",
+            "ifft", "AdaptiveAvgPooling2D", "bipartite_matching"]

@@ -350,6 +350,14 @@ def argmin(data, axis=None, keepdims=False, **kw):
                   [data], "argmin", nondiff=True)
 
 
+def argmax_channel(data, **kw):
+    """Argmax over the channel axis (axis 1), returned as float
+    (REF:src/operator/tensor/broadcast_reduce_op_index.cc
+    argmax_channel — the metric/accuracy helper)."""
+    return _apply(lambda x: jnp.argmax(x, axis=1).astype(jnp.float32),
+                  [data], "argmax_channel", nondiff=True)
+
+
 def norm(data, ord=2, axis=None, keepdims=False, **kw):
     ax = _norm_axis(axis)
 
@@ -1292,6 +1300,32 @@ def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1.0,
 
     return _apply(_output_head(fwd, grad, "SoftmaxOutput"), [data, label],
                   "SoftmaxOutput")
+
+
+def SVMOutput(data, label, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False, **kw):
+    """Hinge-loss output layer (REF:src/operator/svm_output.cc): forward
+    is identity (scores pass through), backward injects the L2-SVM (or
+    L1 with use_linear) subgradient — for j≠y: λ·h (L1) or 2λ·h (L2)
+    with h = max(0, margin + x_j − x_y); for j=y the negative sum."""
+
+    def fwd(x, y):
+        return x
+
+    def grad(out, x, y):
+        yi = y.astype(jnp.int32)
+        n_class = x.shape[-1]
+        xy = jnp.take_along_axis(x, yi[..., None], axis=-1)     # (..., 1)
+        h = jnp.maximum(0.0, margin + x - xy)                   # (..., C)
+        lam = regularization_coefficient
+        g = jnp.where(h > 0, lam, 0.0) if use_linear else 2.0 * lam * h
+        oh = jax.nn.one_hot(yi, n_class, dtype=x.dtype)
+        g = g * (1 - oh)                       # j≠y terms
+        g = g - oh * jnp.sum(g, axis=-1, keepdims=True)  # j=y pulls down
+        return g.astype(x.dtype)
+
+    return _apply(_output_head(fwd, grad, "SVMOutput"), [data, label],
+                  "SVMOutput")
 
 
 def _regression_head(link, residual, name):

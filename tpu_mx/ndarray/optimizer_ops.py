@@ -1,0 +1,387 @@
+"""Raw optimizer update ops — the reference's fused-kernel surface
+(REF:src/operator/optimizer_op.cc, REF:src/operator/contrib/adamw.cc).
+
+Upstream exposes each optimizer's update math as a standalone `mx.nd.*` op
+(`sgd_mom_update`, `adam_update`, `rmsprop_update`, …) with
+`FMutateInputs` on the state tensors: callers pass `out=weight` and the
+op rewrites states in place.  The Python `mx.optimizer` classes are thin
+drivers over these kernels.  Here the relationship is inverted — the
+`tpu_mx.optimizer` classes own the (jit-fused) math — but the raw op
+surface is preserved for reference-habit users and kvstore
+server-side-update parity:
+
+- state arguments (`mom`, `mean`, `var`, `n`, `z`, …) are NDArrays and
+  are REBOUND in place (the engine-var version bump, reference style);
+- the updated weight goes to `out` (returned; pass `out=weight` for the
+  upstream in-place idiom);
+- all ops are non-differentiable (optimizer steps are not part of any
+  autograd tape, matching the reference's kernels).
+
+Formulas follow upstream 1.x exactly — notably `adam_update` does NOT
+bias-correct (the upstream Python Adam pre-scales the learning rate;
+`tpu_mx.optimizer.Adam` folds correction into the fused core instead,
+which is the documented internal divergence).
+
+Inside a functional trace (hybridize / CompiledTrainStep) the ops return
+raw `(new_weight, *new_states)` tuples — in-place rebinding has no
+meaning on tracers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+from .ops import _apply
+
+__all__ = [
+    "sgd_mom_update", "mp_sgd_update", "mp_sgd_mom_update", "adam_update",
+    "nag_mom_update", "mp_nag_mom_update", "rmsprop_update",
+    "rmspropalex_update", "ftrl_update", "ftml_update", "signsgd_update",
+    "signum_update", "lamb_update_phase1", "lamb_update_phase2",
+    "adamw_update", "mp_adamw_update",
+]
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+def _cg(clip_gradient):
+    return clip_gradient if clip_gradient and clip_gradient > 0 else None
+
+
+def _finish(res, states, out):
+    """res = (new_weight, *new_states).  Rebind states in place, deliver
+    the weight to `out` (or a fresh NDArray).  Functional traces get the
+    raw tuple back."""
+    if not isinstance(res, (list, tuple)):
+        return res
+    if not isinstance(res[0], NDArray):
+        return tuple(res)  # functional trace: raw arrays
+    new_w, new_states = res[0], res[1:]
+    for s, ns in zip(states, new_states):
+        s._rebind(ns._data.astype(s.dtype))
+    if out is not None:
+        out._rebind(new_w._data.astype(out.dtype))
+        return out
+    return new_w
+
+
+# ---------------------------------------------------------------------------
+# SGD family
+# ---------------------------------------------------------------------------
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1, lazy_update=True,
+                   out=None, **kw):
+    """mom = momentum·mom − lr·(g + wd·w);  w += mom
+    (REF optimizer_op-inl.h SGDMomKernel)."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m):
+        gp = _prep(g, rescale_grad, cg)
+        new_m = momentum * m - lr * (gp + wd * w)
+        return w + new_m, new_m
+
+    return _finish(_apply(core, [weight, grad, mom], "sgd_mom_update",
+                          nondiff=True), [mom], out)
+
+
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1, lazy_update=True, out=None, **kw):
+    """Mixed-precision SGD: the f32 master weight is updated, the
+    low-precision weight output is a cast of it."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, w32):
+        gp = _prep(g.astype(jnp.float32), rescale_grad, cg)
+        new_w32 = w32 - lr * (gp + wd * w32)
+        return new_w32.astype(w.dtype), new_w32
+
+    return _finish(_apply(core, [weight, grad, weight32], "mp_sgd_update",
+                          nondiff=True), [weight32], out)
+
+
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1,
+                      lazy_update=True, out=None, **kw):
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m, w32):
+        gp = _prep(g.astype(jnp.float32), rescale_grad, cg)
+        new_m = momentum * m - lr * (gp + wd * w32)
+        new_w32 = w32 + new_m
+        return new_w32.astype(w.dtype), new_m, new_w32
+
+    return _finish(_apply(core, [weight, grad, mom, weight32],
+                          "mp_sgd_mom_update", nondiff=True),
+                   [mom, weight32], out)
+
+
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1, out=None, **kw):
+    """Nesterov momentum: mom = momentum·mom + (g + wd·w);
+    w −= lr·(g + wd·w + momentum·mom)  (REF NAGMomKernel)."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m):
+        gp = _prep(g, rescale_grad, cg) + wd * w
+        new_m = momentum * m + gp
+        return w - lr * (gp + momentum * new_m), new_m
+
+    return _finish(_apply(core, [weight, grad, mom], "nag_mom_update",
+                          nondiff=True), [mom], out)
+
+
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1,
+                      out=None, **kw):
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m, w32):
+        gp = _prep(g.astype(jnp.float32), rescale_grad, cg) + wd * w32
+        new_m = momentum * m + gp
+        new_w32 = w32 - lr * (gp + momentum * new_m)
+        return new_w32.astype(w.dtype), new_m, new_w32
+
+    return _finish(_apply(core, [weight, grad, mom, weight32],
+                          "mp_nag_mom_update", nondiff=True),
+                   [mom, weight32], out)
+
+
+# ---------------------------------------------------------------------------
+# Adam / AdamW / LAMB
+# ---------------------------------------------------------------------------
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1,
+                lazy_update=True, out=None, **kw):
+    """Upstream adam_update: NO bias correction (the reference's Python
+    Adam pre-scales lr by √(1−β2ᵗ)/(1−β1ᵗ) before calling the kernel)."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m, v):
+        gp = _prep(g, rescale_grad, cg) + wd * w
+        new_m = beta1 * m + (1 - beta1) * gp
+        new_v = beta2 * v + (1 - beta2) * jnp.square(gp)
+        return (w - lr * new_m / (jnp.sqrt(new_v) + epsilon),
+                new_m, new_v)
+
+    return _finish(_apply(core, [weight, grad, mean, var], "adam_update",
+                          nondiff=True), [mean, var], out)
+
+
+def adamw_update(weight, grad, mean, var, rescale_grad, lr, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1, out=None, **kw):
+    """AdamW with decoupled weight decay (REF:src/operator/contrib/
+    adamw.cc): w −= eta·(lr·m/(√v+ε) + wd·w).  Like the upstream kernel
+    — and like adam_update above — there is NO in-kernel bias correction;
+    the Python optimizer driver pre-scales lr.  `rescale_grad` is a
+    tensor argument upstream (the AMP loss-scale rides in it) — scalar or
+    NDArray accepted."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m, v, rs):
+        gp = g * rs
+        if cg is not None:
+            gp = jnp.clip(gp, -cg, cg)
+        new_m = beta1 * m + (1 - beta1) * gp
+        new_v = beta2 * v + (1 - beta2) * jnp.square(gp)
+        new_w = w - eta * (lr * new_m / (jnp.sqrt(new_v) + epsilon)
+                           + wd * w)
+        return new_w, new_m, new_v
+
+    return _finish(_apply(core, [weight, grad, mean, var, rescale_grad],
+                          "adamw_update", nondiff=True), [mean, var], out)
+
+
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, lr,
+                    beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                    clip_gradient=-1, out=None, **kw):
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m, v, w32, rs):
+        gp = g.astype(jnp.float32) * rs
+        if cg is not None:
+            gp = jnp.clip(gp, -cg, cg)
+        new_m = beta1 * m + (1 - beta1) * gp
+        new_v = beta2 * v + (1 - beta2) * jnp.square(gp)
+        new_w32 = w32 - eta * (lr * new_m / (jnp.sqrt(new_v) + epsilon)
+                               + wd * w32)
+        return new_w32.astype(w.dtype), new_m, new_v, new_w32
+
+    return _finish(_apply(core, [weight, grad, mean, var, weight32,
+                                 rescale_grad],
+                          "mp_adamw_update", nondiff=True),
+                   [mean, var, weight32], out)
+
+
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1, **kw):
+    """LAMB phase 1 (REF optimizer_op.cc lamb_update_phase1): returns the
+    raw update direction g' = m̂/(√v̂+ε) + wd·w; mean/var rebound in
+    place."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m, v):
+        gp = _prep(g, rescale_grad, cg)
+        new_m = beta1 * m + (1 - beta1) * gp
+        new_v = beta2 * v + (1 - beta2) * jnp.square(gp)
+        mhat, vhat = new_m, new_v
+        if bias_correction:
+            mhat = new_m / (1 - beta1 ** t)
+            vhat = new_v / (1 - beta2 ** t)
+        return mhat / (jnp.sqrt(vhat) + epsilon) + wd * w, new_m, new_v
+
+    res = _apply(core, [weight, grad, mean, var], "lamb_update_phase1",
+                 nondiff=True)
+    if isinstance(res, (list, tuple)) and isinstance(res[0], NDArray):
+        mean._rebind(res[1]._data)
+        var._rebind(res[2]._data)
+        return res[0]
+    return res
+
+
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0, out=None, **kw):
+    """LAMB phase 2: w −= lr·(r1/r2)·g with the trust ratio r1/r2 from
+    the norms computed between phases (r1=‖w‖, r2=‖g‖), optionally
+    clipping r1 into [lower_bound, upper_bound]."""
+
+    def core(w, gg, r1v, r2v):
+        r1c = r1v
+        if lower_bound > 0:
+            r1c = jnp.maximum(r1c, lower_bound)
+        if upper_bound > 0:
+            r1c = jnp.minimum(r1c, upper_bound)
+        ratio = jnp.where((r1c > 0) & (r2v > 0), r1c / r2v, 1.0)
+        return w - lr * ratio * gg
+
+    res = _apply(core, [weight, g, r1, r2], "lamb_update_phase2",
+                 nondiff=True)
+    if isinstance(res, NDArray) and out is not None:
+        out._rebind(res._data.astype(out.dtype))
+        return out
+    return res
+
+
+# ---------------------------------------------------------------------------
+# RMSProp / Ftrl / FTML
+# ---------------------------------------------------------------------------
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1, clip_weights=-1,
+                   out=None, **kw):
+    """Tieleman & Hinton RMSProp (non-centered)."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, nn):
+        gp = _prep(g, rescale_grad, cg) + wd * w
+        new_n = gamma1 * nn + (1 - gamma1) * jnp.square(gp)
+        new_w = w - lr * gp / (jnp.sqrt(new_n) + epsilon)
+        if clip_weights and clip_weights > 0:
+            new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+        return new_w, new_n
+
+    return _finish(_apply(core, [weight, grad, n], "rmsprop_update",
+                          nondiff=True), [n], out)
+
+
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1, clip_weights=-1, out=None, **kw):
+    """Graves' centered RMSProp (REF RMSPropAlexKernel): tracks the
+    gradient mean too; update via momentum buffer delta."""
+    cg = _cg(clip_gradient)
+
+    def core(w, gr, nn, gm, d):
+        gp = _prep(gr, rescale_grad, cg) + wd * w
+        new_n = gamma1 * nn + (1 - gamma1) * jnp.square(gp)
+        new_g = gamma1 * gm + (1 - gamma1) * gp
+        new_d = gamma2 * d - lr * gp / jnp.sqrt(
+            new_n - jnp.square(new_g) + epsilon)
+        new_w = w + new_d
+        if clip_weights and clip_weights > 0:
+            new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+        return new_w, new_n, new_g, new_d
+
+    return _finish(_apply(core, [weight, grad, n, g, delta],
+                          "rmspropalex_update", nondiff=True),
+                   [n, g, delta], out)
+
+
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1, out=None, **kw):
+    """FTRL-proximal (REF FtrlKernel / McMahan et al.)."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, zz, nn):
+        gp = _prep(g, rescale_grad, cg)
+        new_z = zz + gp - (jnp.sqrt(nn + jnp.square(gp)) - jnp.sqrt(nn)) \
+            / lr * w
+        new_n = nn + jnp.square(gp)
+        new_w = jnp.where(
+            jnp.abs(new_z) > lamda1,
+            (jnp.sign(new_z) * lamda1 - new_z) /
+            ((beta + jnp.sqrt(new_n)) / lr + wd),
+            0.0)
+        return new_w, new_z, new_n
+
+    return _finish(_apply(core, [weight, grad, z, n], "ftrl_update",
+                          nondiff=True), [z, n], out)
+
+
+def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1, out=None, **kw):
+    """FTML (Zheng & Kwok 2017; REF FTMLKernel)."""
+    cg = _cg(clip_grad)
+
+    def core(w, g, dd, vv, zz):
+        gp = _prep(g, rescale_grad, cg) + wd * w
+        new_v = beta2 * vv + (1 - beta2) * jnp.square(gp)
+        d_t = (1 - beta1 ** t) / lr * (
+            jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+        sigma = d_t - beta1 * dd
+        new_z = beta1 * zz + (1 - beta1) * gp - sigma * w
+        return -new_z / d_t, d_t, new_v, new_z
+
+    return _finish(_apply(core, [weight, grad, d, v, z], "ftml_update",
+                          nondiff=True), [d, v, z], out)
+
+
+# ---------------------------------------------------------------------------
+# sign-based
+# ---------------------------------------------------------------------------
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1, out=None, **kw):
+    """signSGD (Bernstein et al.): w = (1−lr·wd)·w − lr·sign(g)."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g):
+        gp = _prep(g, rescale_grad, cg)
+        return (1 - lr * wd) * w - lr * jnp.sign(gp)
+
+    res = _apply(core, [weight, grad], "signsgd_update", nondiff=True)
+    if isinstance(res, NDArray) and out is not None:
+        out._rebind(res._data.astype(out.dtype))
+        return out
+    return res
+
+
+def signum_update(weight, grad, mom, lr, momentum=0.9, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1, wd_lh=0.0, out=None,
+                  **kw):
+    """Signum: sign of the momentum buffer; wd folded into the gradient,
+    wd_lh applied decoupled (REF SignumKernel)."""
+    cg = _cg(clip_gradient)
+
+    def core(w, g, m):
+        gp = _prep(g, rescale_grad, cg)
+        new_m = momentum * m - (1 - momentum) * (gp + wd * w)
+        return (1 - lr * wd_lh) * w + lr * jnp.sign(new_m), new_m
+
+    return _finish(_apply(core, [weight, grad, mom], "signum_update",
+                          nondiff=True), [mom], out)

@@ -19,7 +19,7 @@ from .ops import _apply
 
 __all__ = ["quantize_v2", "dequantize", "requantize",
            "quantized_fully_connected", "quantized_conv",
-           "quantized_flatten"]
+           "quantized_flatten", "quantized_pooling"]
 
 _INT8_RANGE = 127.0
 
@@ -116,13 +116,15 @@ def quantized_fully_connected(data, weight, bias, min_data, max_data,
 
 def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
                    max_weight, kernel=None, stride=None, pad=None,
-                   num_filter=None, no_bias=True, layout="NCHW", **kw):
+                   dilate=None, num_filter=None, num_group=1, no_bias=True,
+                   layout="NCHW", **kw):
     """int8 conv with int32 accumulation
     (REF:quantization/quantized_conv.cc).  Same (out, min, max) contract as
     quantized_fully_connected."""
     nd_ = len(kernel)
     strides = stride or (1,) * nd_
     padding = [(p_, p_) for p_ in (pad or (0,) * nd_)]
+    dilation = dilate or (1,) * nd_
     spatial = "DHW"[-nd_:]
     if layout is None:
         layout = "NC" + spatial
@@ -134,7 +136,8 @@ def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
         mnd, mxd, mnw, mxw = rest[:4]
         y = lax.conv_general_dilated(
             x.astype(jnp.int8), w.astype(jnp.int8), window_strides=strides,
-            padding=padding, dimension_numbers=dn,
+            padding=padding, rhs_dilation=dilation,
+            feature_group_count=num_group, dimension_numbers=dn,
             preferred_element_type=jnp.int32)
         amax_d = jnp.maximum(jnp.abs(mnd), jnp.abs(mxd))
         amax_w = jnp.maximum(jnp.abs(mnw), jnp.abs(mxw))
@@ -143,6 +146,54 @@ def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
 
     args = [data, weight] + [min_data, max_data, min_weight, max_weight]
     return _apply(f, args, "quantized_conv", nondiff=True)
+
+
+def quantized_pooling(data, min_data, max_data, kernel=None,
+                      pool_type="max", stride=None, pad=None,
+                      global_pool=False, layout="NCHW", **kw):
+    """int8 pooling with range passthrough
+    (REF:quantization/quantized_pooling.cc).  max pools directly on int8;
+    avg accumulates in int32 and rounds back (the reference's MKLDNN
+    contract).  Returns (out, min, max) — ranges are unchanged because
+    both pool types are order/scale-preserving."""
+    channels_last = layout.endswith("C")
+    nd_ = len(layout) - 2
+
+    def f(x, mn, mx):
+        if global_pool:
+            axes = tuple(range(1, 1 + nd_)) if channels_last else \
+                tuple(range(2, 2 + nd_))
+            if pool_type == "max":
+                y = x.max(axis=axes, keepdims=True)
+            else:
+                y = jnp.round(
+                    x.astype(jnp.int32).mean(axis=axes, keepdims=True))
+                y = jnp.clip(y, -127, 127).astype(jnp.int8)
+            return y, mn, mx
+        strides = stride or (1,) * nd_
+        pads = pad or (0,) * nd_
+        if channels_last:
+            window = (1,) + tuple(kernel) + (1,)
+            wstride = (1,) + tuple(strides) + (1,)
+            padding = [(0, 0)] + [(p_, p_) for p_ in pads] + [(0, 0)]
+        else:
+            window = (1, 1) + tuple(kernel)
+            wstride = (1, 1) + tuple(strides)
+            padding = [(0, 0), (0, 0)] + [(p_, p_) for p_ in pads]
+        if pool_type == "max":
+            y = lax.reduce_window(x, jnp.int8(-128), lax.max, window,
+                                  wstride, padding)
+        else:
+            s = lax.reduce_window(x.astype(jnp.int32), jnp.int32(0),
+                                  lax.add, window, wstride, padding)
+            cnt = 1
+            for k_ in kernel:
+                cnt *= k_
+            y = jnp.clip(jnp.round(s / cnt), -127, 127).astype(jnp.int8)
+        return y, mn, mx
+
+    return _apply(f, [data, min_data, max_data], "quantized_pooling",
+                  nondiff=True)
 
 
 def quantized_flatten(data, min_data, max_data, **kw):
