@@ -70,13 +70,14 @@ def main():
                               wd=1e-4, multi_precision=True)
     step = CompiledTrainStep(net, loss_fn, opt)
 
-    it = data_iter(args)
+    # device-feed double buffering: the prefetch thread device_puts (and
+    # bf16-casts) batch k+1 while the chip runs batch k
+    it = mx.io.DevicePrefetchIter(data_iter(args), cast_data="bfloat16")
     for epoch in range(args.epochs):
         it.reset()
         tic, n, last_loss = time.time(), 0, float("nan")
         for i, batch in enumerate(it):
-            data = nd.cast(batch.data[0], "bfloat16")
-            last_loss = step.step(data, batch.label[0])
+            last_loss = step.step(batch.data[0], batch.label[0])
             n += args.batch_size
             if (i + 1) % args.disp_batches == 0:
                 print(f"epoch {epoch} batch {i + 1}: "

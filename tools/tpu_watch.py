@@ -33,6 +33,7 @@ PROBE_LOG = os.path.join(REPO, "TPU_PROBE_LOG_r04.jsonl")
 STATUS = os.path.join(REPO, "TPU_WATCH_STATUS.json")
 VALIDATION = os.path.join(REPO, "TPU_VALIDATION_r04.json")
 BENCH_OUT = os.path.join(REPO, "BENCH_WATCH_r04.json")
+MFU_OUT = os.path.join(REPO, "MFU_PROBE_r04.json")
 
 PROBE_TIMEOUT = 120
 PROBE_INTERVAL_DOWN = 180      # probe cadence while the tunnel is down
@@ -112,6 +113,26 @@ def bench_done():
         return False
 
 
+MFU_EXPECTED = ("resnet:512", "resnet:256", "bert:512", "bert:256")
+
+
+def mfu_done():
+    """Done = the probe RAN TO COMPLETION (every expected config has a
+    record — success or a legitimate per-config failure like OOM) with at
+    least one success.  A mid-run wedge leaves configs missing, so the
+    watcher keeps retrying; a completed run with one OOM rung does not
+    retry forever."""
+    try:
+        with open(MFU_OUT) as f:
+            rec = json.load(f)
+        configs = rec.get("configs", {})
+        return rec.get("skipped") is False and \
+            all(k in configs for k in MFU_EXPECTED) and \
+            any("error" not in c for c in configs.values())
+    except (OSError, ValueError, AttributeError):
+        return False
+
+
 def write_status(**kw):
     kw["ts"] = ts()
     with open(STATUS + ".tmp", "w") as f:
@@ -132,11 +153,11 @@ def main():
                                 "detail": detail}) + "\n")
         if up:
             up_count += 1
-        v_done, b_done = validation_done(), bench_done()
+        v_done, b_done, m_done = validation_done(), bench_done(), mfu_done()
         write_status(up=up, probes=n_probe, up_probes=up_count,
                      validation_done=bool(v_done), bench_done=bool(b_done),
-                     detail=detail)
-        if up and not (v_done and b_done) and \
+                     mfu_done=bool(m_done), detail=detail)
+        if up and not (v_done and b_done and m_done) and \
                 time.time() - last_fail > FAIL_BACKOFF:
             log(f"TPU is UP ({detail}); validation_done={bool(v_done)} "
                 f"bench_done={bool(b_done)}")
@@ -169,12 +190,18 @@ def main():
                 # timeout/wedge, rc 1 means some check failed — both
                 # leave validation_done() false and retry next cycle
                 ok = ok and rc == 0
+            if not mfu_done():
+                rc, out = run_logged(
+                    "mfu", [sys.executable, "tools/mfu_probe.py"], 5400)
+                log(f"mfu probe rc={rc}")
+                ok = ok and rc == 0
             if not ok:
                 last_fail = time.time()
             write_status(up=up, probes=n_probe, up_probes=up_count,
                          validation_done=bool(validation_done()),
-                         bench_done=bool(bench_done()), detail=detail)
-        done = validation_done() and bench_done()
+                         bench_done=bool(bench_done()),
+                         mfu_done=bool(mfu_done()), detail=detail)
+        done = validation_done() and bench_done() and mfu_done()
         time.sleep(PROBE_INTERVAL_DONE if done else PROBE_INTERVAL_DOWN)
 
 

@@ -1,9 +1,9 @@
 """Data iterators — the ``mx.io`` surface (REF:python/mxnet/io/io.py +
 the C++ iterators of REF:src/io/).  See ``tpu_mx/io/io.py``."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, MNISTIter, CSVIter, ImageRecordIter,
+                 PrefetchingIter, DevicePrefetchIter, MNISTIter, CSVIter, ImageRecordIter,
                  ImageDetRecordIter, LibSVMIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter",
+           "PrefetchingIter", "DevicePrefetchIter", "MNISTIter", "CSVIter", "ImageRecordIter",
            "ImageDetRecordIter", "LibSVMIter"]

@@ -290,7 +290,7 @@ class PrefetchingIter(DataIter):
                     batches = []
                     for it in self.iters:
                         batches.append(it.next())
-                    self._queue.put(batches)
+                    self._queue.put(self._transform(batches))
             except StopIteration:
                 self._queue.put(None)
             except Exception as e:  # surface errors on the consumer side
@@ -298,6 +298,11 @@ class PrefetchingIter(DataIter):
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+
+    def _transform(self, batches):
+        """Hook run on the prefetch thread before a batch is queued
+        (DevicePrefetchIter stages batches onto the device here)."""
+        return batches
 
     def reset(self):
         self._stop.set()
@@ -343,6 +348,55 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self._batches[0].pad
+
+
+class DevicePrefetchIter(PrefetchingIter):
+    """Device-feed double buffering: the prefetch thread eagerly
+    `jax.device_put`s every batch (optionally casting the data to the
+    compute dtype first) so the host→HBM transfer of batch k+1 overlaps
+    the device compute of batch k.  This is the H2D half of the
+    reference's prefetcher story (REF:src/io/iter_prefetcher.h fed
+    cpu_pinned buffers that the engine copied async) done the JAX way:
+    `device_put` is itself asynchronous, the win is ISSUING it a batch
+    early instead of on the training loop's critical path.
+
+        it = mx.io.DevicePrefetchIter(train_iter, cast_data="bfloat16")
+        for batch in it:           # batch.data already on-device, bf16
+            step.step(batch.data[0], batch.label[0])
+
+    `device` accepts a `jax.sharding.Sharding` too — REQUIRED when the
+    consuming step runs over a mesh: pass the step's batch sharding
+    (e.g. ``NamedSharding(mesh, P("dp"))``) so batches arrive already
+    laid out; the single-device default would otherwise commit every
+    batch to ``jax.devices()[0]`` and fight the meshed jit's
+    ``in_shardings``.
+    """
+
+    def __init__(self, iters, depth=2, device=None, cast_data=None):
+        self._device = device
+        self._cast = cast_data
+        super().__init__(iters, depth=depth)
+
+    def _transform(self, batches):
+        import jax
+        dev = self._device or jax.devices()[0]
+
+        def place(arr, cast):
+            x = arr._data if isinstance(arr, nd.NDArray) else arr
+            out = jax.device_put(x, dev)
+            if cast is not None:
+                out = out.astype(cast)  # on-device cast, still async
+            return nd.NDArray(out)
+
+        staged = []
+        for b in batches:
+            staged.append(DataBatch(
+                [place(d, self._cast) for d in b.data],
+                [place(l, None) for l in b.label],
+                pad=b.pad, index=b.index,
+                provide_data=b.provide_data,
+                provide_label=b.provide_label))
+        return staged
 
 
 def _read_idx_ubyte(path):
