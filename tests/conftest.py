@@ -11,12 +11,20 @@ multi-chip path the same way via __graft_entry__.dryrun_multichip).
 """
 import os
 
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                           " --xla_force_host_platform_device_count=8").strip()
+# TPUMX_TEST_TPU=1 skips the CPU pin so the on-chip tier can actually run:
+#   TPUMX_TEST_TPU=1 python -m pytest tests/ -m tpu
+# (one process only — the chip serializes; see docstring above)
+_TPU_TIER = os.environ.get("TPUMX_TEST_TPU") == "1"
+
+if not _TPU_TIER:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_TIER:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

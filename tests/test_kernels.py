@@ -354,3 +354,19 @@ def test_flash_bias_singleton_dims_and_ambiguity():
     out2 = flash_attention(qf, qf, qf, bias=jnp.zeros((2, T, T)),
                            bias_groups=2, block_q=64, block_k=64)
     assert out2.shape == qf.shape
+
+
+def test_attention_env_knob(monkeypatch):
+    """TPUMX_ATTENTION measurement knob: bad values rejected, 'dense'
+    always runs the XLA dense path."""
+    import numpy as np
+    import jax.numpy as jnp
+    from tpu_mx.parallel.ring_attention import local_flash_attention
+    q = jnp.asarray(np.random.RandomState(0).rand(1, 2, 128, 64),
+                    jnp.float32)
+    monkeypatch.setenv("TPUMX_ATTENTION", "bogus")
+    with pytest.raises(ValueError, match="TPUMX_ATTENTION"):
+        local_flash_attention(q, q, q)
+    monkeypatch.setenv("TPUMX_ATTENTION", "dense")
+    out = local_flash_attention(q, q, q)
+    assert out.shape == q.shape

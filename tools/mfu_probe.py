@@ -72,10 +72,38 @@ def _timed_steps(step, batch_args, warmup, iters):
 
 
 def probe_one(model, batch):
+    import contextlib
+
+    attn_override = None
+    if model == "bert_dense":
+        # A/B the attention path: at T=128 the single-block flash kernel
+        # vs XLA's fused dense attention is an empirical question.  The
+        # env knob is read at TRACE time, so it must span compile+timing.
+        model, attn_override = "bert", "dense"
+    with contextlib.ExitStack() as stack:
+        if attn_override:
+            prior = os.environ.get("TPUMX_ATTENTION")
+            os.environ["TPUMX_ATTENTION"] = attn_override
+
+            def restore():
+                if prior is None:
+                    os.environ.pop("TPUMX_ATTENTION", None)
+                else:
+                    os.environ["TPUMX_ATTENTION"] = prior
+
+            stack.callback(restore)
+        return _probe_one(model, batch)
+
+
+def _probe_one(model, batch):
     import hlo_inspect
     import bench as bench_mod
 
-    log(f"building {model} batch={batch}...")
+    # record what the trace will actually read, not what the caller
+    # thinks it set — a user-level TPUMX_ATTENTION pin applies to every
+    # rung and must show up in the artifact
+    attn_mode = os.environ.get("TPUMX_ATTENTION", "auto")
+    log(f"building {model} batch={batch} (attention={attn_mode})...")
     if model == "resnet":
         step, batch_args = hlo_inspect.build_resnet_step(False, batch)
         unit_flops = bench_mod.RESNET50_TRAIN_FLOPS_PER_IMG
@@ -114,6 +142,7 @@ def probe_one(model, batch):
     per_sec = batch / sec
     rec = {
         "model": model, "batch": batch,
+        "attention": attn_mode,
         "step_seconds": round(sec, 5),
         "throughput_per_sec": round(per_sec, 2),
         "mfu_analytic_model": round(per_sec * unit_flops / V5E_PEAK_FLOPS,
@@ -136,7 +165,8 @@ def main():
     ap.add_argument("--out", default=os.path.join(REPO,
                                                   "MFU_PROBE_r04.json"))
     ap.add_argument("--configs",
-                    default="resnet:512,resnet:256,bert:512,bert:256")
+                    default="resnet:512,resnet:256,bert:512,bert:256,"
+                            "bert_dense:256")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (harness smoke; mirrors conftest)")
     args = ap.parse_args()

@@ -113,7 +113,8 @@ def bench_done():
         return False
 
 
-MFU_EXPECTED = ("resnet:512", "resnet:256", "bert:512", "bert:256")
+MFU_EXPECTED = ("resnet:512", "resnet:256", "bert:512", "bert:256",
+                "bert_dense:256")
 
 
 def mfu_done():

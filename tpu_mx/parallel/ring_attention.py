@@ -25,6 +25,7 @@ from __future__ import annotations
 import functools
 import logging
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -288,8 +289,18 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
     on_tpu = jax.default_backend() == "tpu"
     dropped = dropout_rate > 0.0 and dropout_key is not None
     rate = float(dropout_rate) if dropped else 0.0
-    if on_tpu and fa.supported(q.shape, q.dtype, kv_len=k.shape[2],
-                               dropout_rate=rate):
+    # TPUMX_ATTENTION=dense|flash|auto (default auto): measurement knob —
+    # at short T (e.g. BERT's 128) the single-block Pallas kernel and
+    # XLA's fused dense attention are close enough that the winner should
+    # be benched, not assumed.  'flash' only forces the kernel where
+    # supported() holds; 'dense' always works.
+    mode = os.environ.get("TPUMX_ATTENTION", "auto")
+    if mode not in ("auto", "dense", "flash"):
+        raise ValueError(f"TPUMX_ATTENTION must be auto|dense|flash, "
+                         f"got {mode!r}")
+    want_flash = on_tpu and mode != "dense"
+    if want_flash and fa.supported(q.shape, q.dtype, kv_len=k.shape[2],
+                                   dropout_rate=rate):
         _count("pallas_flash", f"shape={q.shape}")
         seed = (jax.random.randint(dropout_key, (1,), 0, 2 ** 31 - 1,
                                    jnp.int32) if dropped else None)
@@ -297,9 +308,11 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
                                       valid_length=valid_length,
                                       dropout_rate=rate, dropout_seed=seed,
                                       bias=bias)
+    # CPU dense is expected, and a DELIBERATE dense pin (the A/B knob)
+    # must not fire the perf-regression warning it exists to enable
     _count("xla_dense",
            f"shape={q.shape} dtype={q.dtype} kv_len={k.shape[2]}",
-           warn=on_tpu)  # CPU dense path is expected; only warn on TPU
+           warn=on_tpu and mode != "dense")
     scale = 1.0 / math.sqrt(q.shape[-1])
     mask = _dense_mask(q.shape[2], k.shape[2], causal, valid_length)
     m, l, o = _block_attn(q, k, v, bias=bias, mask=mask, scale=scale,
