@@ -293,12 +293,16 @@ def _bert_once(smoke, batch):
         warmup, iters, repeats = 3, 20, 3
 
     remat = os.environ.get("BENCH_BERT_REMAT", "1") == "1"
+    # BENCH_BERT_REMAT_POLICY=dots_saveable keeps MXU outputs across the
+    # checkpoint boundary (less recompute, more HBM) — sweep on-chip
+    policy = os.environ.get("BENCH_BERT_REMAT_POLICY") or None
     log(f"building bert ({cfg['num_layers']}L u{cfg['units']}), "
-        f"batch={batch}, seq={seq_len}, remat={remat}")
+        f"batch={batch}, seq={seq_len}, remat={remat}, policy={policy}")
     # per-layer jax.checkpoint: batch 512 × seq 128 activations for 12
     # layers exceed the 16 GB HBM (measured 27 GB); remat trades ~1 extra
     # forward for O(1)-segment activation memory
-    net = BERTModel(cfg, dtype="bfloat16", remat=remat)
+    net = BERTModel(cfg, dtype="bfloat16", remat=remat,
+                    remat_policy=policy)
     net.initialize()
     rng = np.random.RandomState(0)
     tokens = rng.randint(4, cfg["vocab_size"], (batch, seq_len)).astype(

@@ -465,3 +465,48 @@ def test_infer_shape_custom_block_without_override_raises():
     c.initialize()
     with pytest.raises(MXNetError, match="infer_shape"):
         c(nd.ones((2, 5)))
+
+
+def test_bert_remat_policy_grads_match():
+    """remat_policy (save-dots vs recompute-all) changes memory/FLOPs,
+    never numerics: grads match the no-remat model."""
+    import jax
+    from tpu_mx.models.bert import BERTModel, bert_base_config
+    cfg = bert_base_config(vocab_size=64, max_len=32)
+    cfg.update(num_layers=2, units=32, hidden_size=64, num_heads=2)
+    toks = nd.array(np.random.RandomState(0).randint(4, 64, (2, 16)),
+                    dtype="int32")
+    types = nd.zeros((2, 16), dtype="int32")
+
+    def grads(**kw):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = BERTModel(cfg, **kw)
+        net.initialize(init="xavier")
+        net(toks, types)
+        keys = list(net.collect_params().keys())   # structural order
+        params = {k: net.collect_params()[k].data()._data for k in keys}
+        def loss(params):
+            out, _ = net._functional_call(params, jax.random.PRNGKey(0),
+                                          False, (toks, types))
+            return (out.astype("float32") ** 2).mean()
+        g = jax.grad(loss)(params)
+        # name-scope counters differ per instantiation AND jax sorts dict
+        # keys — align by the net's own collect_params (structural) order
+        return [(k, np.asarray(g[k], np.float32)) for k in keys]
+
+    g_plain = grads(remat=False)
+    g_dots = grads(remat=True, remat_policy="dots_saveable")
+    for (ka, va), (kb, vb) in zip(g_plain, g_dots):
+        np.testing.assert_allclose(va, vb, rtol=2e-3, atol=1e-5,
+                                   err_msg=f"{ka} vs {kb}")
+    with pytest.raises(ValueError, match="remat policy"):
+        BERTModel(cfg, remat=True, remat_policy="bogus_policy")
+
+
+def test_bert_remat_policy_without_remat_raises():
+    from tpu_mx.models.bert import BERTModel, bert_base_config
+    cfg = bert_base_config(vocab_size=64, max_len=32)
+    cfg.update(num_layers=1, units=32, hidden_size=64, num_heads=2)
+    with pytest.raises(ValueError, match="remat=True"):
+        BERTModel(cfg, remat=False, remat_policy="dots_saveable")

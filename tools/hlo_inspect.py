@@ -60,7 +60,9 @@ def build_bert_step(smoke, batch):
                            max_len=seq_len)
     if smoke:
         cfg.update(num_layers=2, units=128, hidden_size=512, num_heads=2)
-    net = BERTModel(cfg, dtype="bfloat16", remat=not smoke)
+    net = BERTModel(cfg, dtype="bfloat16", remat=not smoke,
+                    remat_policy=os.environ.get("BENCH_BERT_REMAT_POLICY")
+                    or None)
     net.initialize()
     rng = np.random.RandomState(0)
     tokens = rng.randint(4, cfg["vocab_size"], (batch, seq_len)).astype(

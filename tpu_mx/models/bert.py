@@ -37,6 +37,21 @@ def bert_base_config(vocab_size=30522, max_len=512):
                 vocab_size=vocab_size, max_length=max_len, dropout=0.1)
 
 
+def _resolve_remat_policy(policy):
+    """None, a jax.checkpoint_policies entry, or one of its names
+    ("dots_saveable", "dots_with_no_batch_dims_saveable",
+    "nothing_saveable", "everything_saveable", ...)."""
+    if policy is None or not isinstance(policy, str):
+        return policy
+    import jax
+    try:
+        return getattr(jax.checkpoint_policies, policy)
+    except AttributeError:
+        raise ValueError(
+            f"unknown remat policy {policy!r}; see jax.checkpoint_policies "
+            f"for valid names") from None
+
+
 def bert_sharding_rules():
     """Megatron TP layout (regex → PartitionSpec on (out, in) weights):
     column-parallel for qkv & FFN-in, row-parallel for the output mats."""
@@ -172,17 +187,26 @@ class BERTModel(HybridBlock):
     """Encoder + tied-embedding MLM head (pretraining objective)."""
 
     def __init__(self, config=None, mesh=None, dtype="float32", remat=False,
-                 **kwargs):
+                 remat_policy=None, **kwargs):
         super().__init__(**kwargs)
         cfg = config or bert_base_config()
         self._cfg = cfg
         self.encoder = BERTEncoder(mesh=mesh, dtype=dtype, **cfg)
+        # resolve up front: a typo'd policy (or one passed with remat off)
+        # must fail at construction, not silently skew a benchmark sweep
+        policy = _resolve_remat_policy(remat_policy)
+        if remat_policy is not None and not remat:
+            raise ValueError("remat_policy given but remat=False — pass "
+                             "remat=True (or drop the policy)")
         if remat:
             # checkpoint each transformer layer: activation HBM drops from
             # O(layers) to O(1) segments + per-layer boundaries, which is
-            # what lets BERT-base train at batch 512/seq 128 in 16 GB
+            # what lets BERT-base train at batch 512/seq 128 in 16 GB.
+            # remat_policy tunes the memory/FLOPs point: "dots_saveable"
+            # keeps MXU outputs (recompute only the cheap elementwise
+            # tail) — more HBM, less recompute; None recomputes all.
             for layer in self.encoder.layers._children.values():
-                layer.remat()
+                layer.remat(policy=policy)
         units = cfg["units"]
         self.mlm_dense = nn.Dense(units, flatten=False, in_units=units)
         self.mlm_ln = nn.LayerNorm(in_channels=units)
