@@ -793,3 +793,69 @@ class TestR4LongTail:
         # greedy: (0,0)=0.9 first, then (1,1)=0.7; row 2 unmatched
         np.testing.assert_array_equal(row.asnumpy(), [[0, 1, -1]])
         np.testing.assert_array_equal(col.asnumpy(), [[0, 1]])
+
+
+class TestCorrelation:
+    """FlowNet cost volume vs a naive NumPy oracle
+    (REF:src/operator/correlation.cc semantics)."""
+
+    @staticmethod
+    def _naive(x1, x2, K, md, s1, s2, pad, multiply):
+        b, c, h, w = x1.shape
+        kr = (K - 1) // 2
+        bd = md + kr
+        ph, pw = h + 2 * pad, w + 2 * pad
+        th = -(-(ph - 2 * bd) // s1)
+        tw = -(-(pw - 2 * bd) // s1)
+        p1 = np.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        p2 = np.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        disps = range(-(md // s2) * s2, md // s2 * s2 + 1, s2)
+        out = np.zeros((b, len(list(disps)) ** 2, th, tw), np.float32)
+        for bi in range(b):
+            for di, dy in enumerate(disps):
+                for dj, dx in enumerate(disps):
+                    for yi in range(th):
+                        for xi in range(tw):
+                            yc, xc = bd + yi * s1, bd + xi * s1
+                            acc = 0.0
+                            for oy in range(-kr, kr + 1):
+                                for ox in range(-kr, kr + 1):
+                                    a = p1[bi, :, yc + oy, xc + ox]
+                                    v = p2[bi, :, yc + oy + dy,
+                                           xc + ox + dx]
+                                    acc += float((a * v).sum() if multiply
+                                                 else np.abs(a - v).sum())
+                            out[bi, di * len(list(disps)) + dj, yi, xi] = \
+                                acc / (K * K * c)
+        return out
+
+    @pytest.mark.parametrize("cfg", [
+        dict(K=1, md=1, s1=1, s2=1, pad=1, multiply=True),
+        dict(K=3, md=2, s1=2, s2=2, pad=2, multiply=True),
+        dict(K=1, md=1, s1=1, s2=1, pad=1, multiply=False),
+    ])
+    def test_matches_naive(self, cfg):
+        x1 = rs.rand(2, 3, 8, 9).astype(np.float32)
+        x2 = rs.rand(2, 3, 8, 9).astype(np.float32)
+        out = nd.Correlation(nd.array(x1), nd.array(x2),
+                             kernel_size=cfg["K"],
+                             max_displacement=cfg["md"],
+                             stride1=cfg["s1"], stride2=cfg["s2"],
+                             pad_size=cfg["pad"],
+                             is_multiply=cfg["multiply"])
+        ref = self._naive(x1, x2, cfg["K"], cfg["md"], cfg["s1"],
+                          cfg["s2"], cfg["pad"], cfg["multiply"])
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_grads_flow(self):
+        from tpu_mx import autograd
+        x1 = nd.array(rs.rand(1, 2, 6, 6).astype(np.float32))
+        x2 = nd.array(rs.rand(1, 2, 6, 6).astype(np.float32))
+        x1.attach_grad(); x2.attach_grad()
+        with autograd.record():
+            nd.Correlation(x1, x2, max_displacement=1, pad_size=1
+                           ).sum().backward()
+        assert np.abs(x1.grad.asnumpy()).sum() > 0
+        assert np.abs(x2.grad.asnumpy()).sum() > 0

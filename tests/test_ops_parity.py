@@ -130,6 +130,8 @@ TEMPLATES = {
         np.array([[1, 2], [3, 4]], np.float32))),
     "BlockGrad": lambda f: f(X(2, 3)),
     "Custom": lambda f: True,  # needs a registered op; test_custom_op.py owns it
+    "Correlation": lambda f: f(NCHW(), NCHW(), max_displacement=1,
+                               pad_size=1),
     "Crop": lambda f: f(NCHW(), h_w=(4, 4)),
     "LinearRegressionOutput": lambda f: f(X(2, 3), X(2, 3)),
     "LogisticRegressionOutput": lambda f: f(X(2, 3), X(2, 3)),

@@ -32,7 +32,8 @@ ROWS["Neural network (REF:src/operator/nn, *.cc at src/operator/)"] = [
     ("BatchNorm_v1", "not-planned", "", "deprecated upstream alias of BatchNorm"),
     ("Convolution", "yes", "nd.Convolution", "lax.conv_general_dilated; NHWC default layout"),
     ("Convolution_v1", "not-planned", "", "deprecated upstream alias"),
-    ("Correlation", "not-planned", "", "FlowNet-specific cost-volume op; niche, no north-star workload uses it"),
+    ("Correlation", "yes", "nd.Correlation",
+     "cost volume as a static displacement loop of VPU products + window sums — no gather"),
     ("Deconvolution", "yes", "nd.Deconvolution", "conv_transpose"),
     ("Dropout", "yes", "nd.Dropout", "PRNG via random.key_scope"),
     ("Embedding", "yes", "nd.Embedding", "take; dense grad (divergence #5 covers row_sparse)"),

@@ -293,3 +293,60 @@ def Proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
 
 
 MultiProposal = Proposal  # batch-aware already (REF:contrib/multi_proposal.cc)
+
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True, **kw):
+    """FlowNet cost volume (REF:src/operator/correlation.cc).
+
+    out[b, d, y, x] = mean over the K×K kernel window and channels of
+    f1·shift(f2, d) for every displacement d in the
+    (2·⌊md/stride2⌋+1)² neighborhood.  TPU-native formulation: a STATIC
+    python loop over the D² displacements, each an elementwise
+    product + channel sum (VPU) and a K×K window sum (reduce_window) —
+    no gather/scatter, fully fused by XLA; stride1 subsamples the output
+    grid.  is_multiply=False uses |f1 − f2| (the 'subtract' variant)."""
+    if kernel_size % 2 != 1:
+        raise ValueError("Correlation kernel_size must be odd")
+
+    def f(x1, x2):
+        b, c, h, w = x1.shape
+        kr = (kernel_size - 1) // 2
+        bd = max_displacement + kr                 # border in padded coords
+        ph, pw = h + 2 * pad_size, w + 2 * pad_size
+        th = int(-(-(ph - 2 * bd) // stride1))     # ceil-div, upstream
+        tw = int(-(-(pw - 2 * bd) // stride1))
+        if th < 1 or tw < 1:
+            raise ValueError("Correlation: displacement/kernel larger "
+                             "than the padded input")
+        pads = [(0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)]
+        p1 = jnp.pad(x1.astype(jnp.float32), pads)
+        p2 = jnp.pad(x2.astype(jnp.float32), pads)
+        norm = float(kernel_size * kernel_size * c)
+        # f1's window is displacement-invariant: slice once.  All starts
+        # are static, so plain slicing (not dynamic_slice) suffices; the
+        # shifted f2 slices stay in bounds because |d| ≤ md ≤ border.
+        y0 = x0 = bd - kr
+        ext_h, ext_w = ph - 2 * (bd - kr), pw - 2 * (bd - kr)
+        s1 = p1[:, :, y0:y0 + ext_h, x0:x0 + ext_w]
+        r = max_displacement // stride2
+        disps = range(-r * stride2, r * stride2 + 1, stride2)
+        planes = []
+        for dy in disps:
+            for dx in disps:
+                s2 = p2[:, :, y0 + dy:y0 + dy + ext_h,
+                        x0 + dx:x0 + dx + ext_w]
+                prod = s1 * s2 if is_multiply else jnp.abs(s1 - s2)
+                csum = prod.sum(axis=1)            # (B, ext_h, ext_w)
+                win = lax.reduce_window(
+                    csum, 0.0, lax.add, (1, kernel_size, kernel_size),
+                    (1, 1, 1), "valid")  # (B, ph-2bd, pw-2bd): ext-K+1
+                # strided rows = ceil((ph-2bd)/stride1) = th exactly
+                planes.append(win[:, ::stride1, ::stride1])
+        out = jnp.stack(planes, axis=1) / norm     # (B, D², th, tw)
+        return out.astype(x1.dtype)
+
+    return _apply(f, [data1, data2], "Correlation")
+
+
+__all__ += ["Correlation"]
