@@ -1,0 +1,91 @@
+"""INT8 post-training quantization example (reference analog:
+REF:example/quantization/imagenet_gen_qsym_mkldnn.py — calibrate a trained
+float model, swap conv/dense compute to int8, compare accuracy).
+
+Trains a small CNN on synthetic separable data (or loads --params),
+calibrates with a few batches, quantizes conv+dense to int8
+(int8×int8→int32 on the MXU via `contrib.quantization.quantize_net`), and
+reports float vs int8 accuracy and agreement.
+
+    python examples/quantization/quantize_cnn.py [--smoke]
+"""
+import argparse
+import time
+
+import numpy as np
+
+import tpu_mx as mx
+from tpu_mx import autograd, gluon, nd
+from tpu_mx.contrib.quantization import quantize_net
+from tpu_mx.gluon import nn
+
+
+def make_data(n, classes, size, seed=0):
+    rs = np.random.RandomState(seed)
+    ys = rs.randint(0, classes, n)
+    xs = rs.rand(n, 1, size, size).astype(np.float32) * 0.3
+    half = size // 2
+    for i, y in enumerate(ys):
+        r, c = divmod(int(y), 2)
+        xs[i, 0, r * half:(r + 1) * half, c * half:(c + 1) * half] += 1.0
+    return xs, ys.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--size", type=int, default=16)
+    ap.add_argument("--train-steps", type=int, default=60)
+    ap.add_argument("--calib-batches", type=int, default=4)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.train_steps = 30
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(args.classes))
+    net.initialize(init="xavier")
+
+    xs, ys = make_data(512, args.classes, args.size)
+    xb, yb = nd.array(xs), nd.array(ys)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    for step in range(args.train_steps):
+        with autograd.record():
+            loss = loss_fn(net(xb), yb)
+            loss.backward()
+        trainer.step(len(xs))
+
+    xe, ye = make_data(256, args.classes, args.size, seed=1)
+    xeb = nd.array(xe)
+    float_pred = np.argmax(net(xeb).asnumpy(), axis=1)
+    float_acc = float((float_pred == ye).mean())
+
+    calib = [nd.array(xs[i * 64:(i + 1) * 64])
+             for i in range(args.calib_batches)]
+    qnet = quantize_net(net, calib_iter=calib)
+    tic = time.time()
+    q_pred = np.argmax(qnet(xeb).asnumpy(), axis=1)
+    q_time = time.time() - tic
+    q_acc = float((q_pred == ye).mean())
+    agree = float((q_pred == float_pred).mean())
+
+    print(f"float32 accuracy: {float_acc:.4f}")
+    print(f"int8    accuracy: {q_acc:.4f}  (drop {float_acc - q_acc:+.4f})")
+    print(f"int8/float argmax agreement: {agree:.4f}")
+    print(f"int8 eval time: {q_time * 1000:.1f} ms "
+          f"({len(xe) / max(q_time, 1e-9):.0f} img/s)")
+    if float_acc - q_acc > 0.02:
+        print("FAILED: int8 accuracy drop exceeded 2%")
+        raise SystemExit(1)
+    print("PASSED")
+
+
+if __name__ == "__main__":
+    main()

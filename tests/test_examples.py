@@ -67,3 +67,9 @@ def test_long_context_example_smoke():
 def test_estimator_example_smoke():
     out = _run("examples/estimator/train.py")
     assert "accuracy" in out and "checkpoints:" in out, out[-500:]
+
+
+def test_quantization_example_smoke():
+    # script asserts int8 accuracy drop <= 2% vs its trained float model
+    out = _run("examples/quantization/quantize_cnn.py")
+    assert "PASSED" in out and "int8    accuracy" in out, out[-500:]
