@@ -443,6 +443,14 @@ def inner():
     if smoke:
         jax.config.update("jax_platforms", "cpu")
 
+    # persistent compile cache: a tunnel window is precious — if a run
+    # dies mid-sweep, the retry must not pay the tens-of-seconds compiles
+    # again (BENCH_COMPILE_CACHE=0 disables; dir is repo-local)
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1" and not smoke:
+        from tpu_mx.runtime import set_compilation_cache
+        set_compilation_cache(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
     if os.environ.get("BENCH_SIMULATE_WEDGE") == "1":
         # test hook for the outer supervisor's wedge handling: behave like
         # the round-3 tunnel (jax.devices() stuck in a C call, 'backend up'

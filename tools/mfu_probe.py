@@ -174,6 +174,9 @@ def main():
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from tpu_mx.runtime import set_compilation_cache
+        set_compilation_cache(os.path.join(REPO, ".jax_cache"))
     platform = jax.devices()[0].platform
     record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
               "platform": platform, "peak_flops": V5E_PEAK_FLOPS,

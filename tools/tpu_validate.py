@@ -327,6 +327,11 @@ def main():
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # share the bench's persistent compile cache: retries after a
+        # mid-sweep wedge skip straight to execution
+        from tpu_mx.runtime import set_compilation_cache
+        set_compilation_cache(os.path.join(REPO, ".jax_cache"))
     devs = jax.devices()
     platform = devs[0].platform
     record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
