@@ -445,11 +445,10 @@ def inner():
 
     # persistent compile cache: a tunnel window is precious — if a run
     # dies mid-sweep, the retry must not pay the tens-of-seconds compiles
-    # again (BENCH_COMPILE_CACHE=0 disables; dir is repo-local)
-    if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1" and not smoke:
-        from tpu_mx.runtime import set_compilation_cache
-        set_compilation_cache(os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    # again (BENCH_COMPILE_CACHE=0 disables for all three on-chip tools)
+    if not smoke:
+        from tpu_mx.runtime import enable_shared_compilation_cache
+        enable_shared_compilation_cache()
 
     if os.environ.get("BENCH_SIMULATE_WEDGE") == "1":
         # test hook for the outer supervisor's wedge handling: behave like

@@ -274,3 +274,14 @@ def test_speedometer_and_do_checkpoint(tmp_path, caplog):
         or loaded_sym is not None
     for k in args:
         np.testing.assert_allclose(arg2[k].asnumpy(), args[k].asnumpy())
+
+
+def test_shared_compilation_cache_env_gate(monkeypatch, tmp_path):
+    """enable_shared_compilation_cache: one env knob disables the cache
+    for ALL on-chip tools; enabled path points at the repo .jax_cache."""
+    from tpu_mx import runtime
+    monkeypatch.setenv("BENCH_COMPILE_CACHE", "0")
+    assert runtime.enable_shared_compilation_cache() is None
+    monkeypatch.setenv("BENCH_COMPILE_CACHE", "1")
+    d = runtime.enable_shared_compilation_cache()
+    assert d is not None and d.endswith(".jax_cache")

@@ -175,8 +175,8 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     else:
-        from tpu_mx.runtime import set_compilation_cache
-        set_compilation_cache(os.path.join(REPO, ".jax_cache"))
+        from tpu_mx.runtime import enable_shared_compilation_cache
+        enable_shared_compilation_cache()
     platform = jax.devices()[0].platform
     record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
               "platform": platform, "peak_flops": V5E_PEAK_FLOPS,

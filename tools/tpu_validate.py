@@ -330,8 +330,8 @@ def main():
     else:
         # share the bench's persistent compile cache: retries after a
         # mid-sweep wedge skip straight to execution
-        from tpu_mx.runtime import set_compilation_cache
-        set_compilation_cache(os.path.join(REPO, ".jax_cache"))
+        from tpu_mx.runtime import enable_shared_compilation_cache
+        enable_shared_compilation_cache()
     devs = jax.devices()
     platform = devs[0].platform
     record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -104,6 +104,22 @@ def set_compilation_cache(directory, min_compile_time_secs=1.0):
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def enable_shared_compilation_cache():
+    """The bench/validate/mfu tools' shared opt-out-able cache: enables
+    the persistent cache at the repo-local `.jax_cache` unless
+    BENCH_COMPILE_CACHE=0 (one knob disables it for ALL three tools —
+    e.g. when the directory is corrupted/unwritable).  Returns the dir
+    or None when disabled."""
+    import os
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") != "1":
+        return None
+    directory = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache")
+    set_compilation_cache(directory)
+    return directory
+
+
 def clear_compilation_cache():
     """Drop the in-memory jit cache (the persistent dir is untouched)."""
     import jax
