@@ -1,0 +1,179 @@
+// Native unit tests for tpumx_io.cpp internals — the C++ test tier
+// (SURVEY §4: REF:tests/cpp/{engine,storage,operator} used googletest;
+// here plain asserts + a main(), compiled and run by
+// tests/test_native_io.py::test_native_cpp_unit_tier, keeping the image's
+// toolchain requirements at just g++).
+//
+// Units covered (the ones Python-level tests can only reach indirectly):
+// HashUniform (counter-based determinism + range), ResizeBilinear
+// (identity / constant preservation / known 2x upscale), RecordIO scan
+// (whole + split + corrupt), and the det label header bounds check
+// (uint32 overflow regression).
+#include "tpumx_io.cpp"
+
+#include <sys/resource.h>
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+
+namespace {
+
+int failures = 0;
+
+#define CHECK_TRUE(cond)                                                   \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);      \
+      failures++;                                                          \
+    }                                                                      \
+  } while (0)
+
+void TestHashUniform() {
+  // deterministic across calls, uniform-ish in [0, 1)
+  for (uint64_t a = 0; a < 4; ++a) {
+    float x = HashUniform(7, a, 13, 2);
+    float y = HashUniform(7, a, 13, 2);
+    CHECK_TRUE(x == y);
+    CHECK_TRUE(x >= 0.0f && x < 1.0f);
+  }
+  // distinct counters give distinct draws (overwhelmingly)
+  int distinct = 0;
+  for (int i = 0; i < 32; ++i) {
+    if (HashUniform(7, i, 0, 0) != HashUniform(7, i + 1, 0, 0)) distinct++;
+  }
+  CHECK_TRUE(distinct >= 30);
+  // crude mean check over many draws
+  double s = 0;
+  for (int i = 0; i < 4096; ++i) s += HashUniform(3, i, 1, 2);
+  CHECK_TRUE(std::fabs(s / 4096 - 0.5) < 0.05);
+}
+
+void TestResizeBilinear() {
+  // identity
+  std::vector<uint8_t> src(4 * 5 * 3);
+  for (size_t i = 0; i < src.size(); ++i) src[i] = i % 251;
+  std::vector<uint8_t> dst(src.size());
+  ResizeBilinear(src.data(), 4, 5, dst.data(), 4, 5);
+  CHECK_TRUE(src == dst);
+  // constant image stays constant at any size
+  std::fill(src.begin(), src.end(), 77);
+  std::vector<uint8_t> up(9 * 11 * 3);
+  ResizeBilinear(src.data(), 4, 5, up.data(), 9, 11);
+  for (uint8_t v : up) CHECK_TRUE(v == 77);
+  // 2x upscale of a 2-pixel gradient interpolates between endpoints
+  uint8_t grad[2 * 2 * 3] = {0, 0, 0, 100, 100, 100,
+                             0, 0, 0, 100, 100, 100};
+  uint8_t out[2 * 4 * 3];
+  ResizeBilinear(grad, 2, 2, out, 2, 4);
+  CHECK_TRUE(out[0] == 0);
+  CHECK_TRUE(out[9] >= 95);          // rightmost column ~100
+  CHECK_TRUE(out[3] > 0 && out[3] < 100);  // interior interpolated
+}
+
+std::string WriteTempRec(const std::vector<std::vector<uint8_t>>& payloads,
+                         bool corrupt_magic = false) {
+  char name[] = "/tmp/tpumx_io_test_XXXXXX";
+  int fd = mkstemp(name);
+  FILE* f = fdopen(fd, "wb");
+  for (const auto& p : payloads) {
+    uint32_t magic = corrupt_magic ? 0xDEADBEEF : kMagic;
+    uint32_t lenfield = static_cast<uint32_t>(p.size());  // cflag 0
+    fwrite(&magic, 4, 1, f);
+    fwrite(&lenfield, 4, 1, f);
+    fwrite(p.data(), 1, p.size(), f);
+    size_t padded = (p.size() + 3u) & ~3ull;
+    uint8_t zero[4] = {0, 0, 0, 0};
+    fwrite(zero, 1, padded - p.size(), f);
+  }
+  fclose(f);
+  return name;
+}
+
+void TestRecFileScan() {
+  std::vector<std::vector<uint8_t>> payloads = {
+      std::vector<uint8_t>(10, 1), std::vector<uint8_t>(33, 2),
+      std::vector<uint8_t>(7, 3)};
+  std::string path = WriteTempRec(payloads);
+  RecFile rf;
+  std::string err;
+  CHECK_TRUE(rf.Open(path.c_str(), &err));
+  CHECK_TRUE(rf.records.size() == 3);
+  std::vector<uint8_t> buf;
+  for (size_t i = 0; i < payloads.size(); ++i) {
+    CHECK_TRUE(rf.Read(i, &buf));
+    CHECK_TRUE(buf == payloads[i]);
+  }
+  remove(path.c_str());
+
+  std::string bad = WriteTempRec(payloads, /*corrupt_magic=*/true);
+  RecFile rf2;
+  CHECK_TRUE(!rf2.Open(bad.c_str(), &err));
+  CHECK_TRUE(err.find("magic") != std::string::npos);
+  remove(bad.c_str());
+}
+
+void TestDetLabelBoundsOverflow() {
+  // header flag = 0x40000006 (a true multiple of 5 — 0x40000005 is not!):
+  // flag*4 wraps to 24 in uint32, which would PASS a uint32 bounds check
+  // against the 64-byte payload and then run boxes.resize(flag) — a ~4 GB
+  // allocation (the memcpy uses the same wrapped count, so the hazard is
+  // the allocation, not OOB).  Under overcommit that allocation can
+  // quietly succeed, so the regression is made OBSERVABLE by capping the
+  // address space: with uint32 math the resize throws bad_alloc (and the
+  // worker contract would std::terminate); with the size_t fix the
+  // record is rejected before any allocation.
+  static_assert(0x40000006u % 5 == 0, "flag must pass the %5 guard");
+  static_assert(static_cast<uint32_t>(0x40000006u * 4u) == 24u,
+                "flag*4 must wrap below the payload size in uint32");
+  rlimit old{};
+  getrlimit(RLIMIT_AS, &old);
+  rlimit capped = old;
+  capped.rlim_cur = 1ull << 31;  // 2 GB — far below flag*sizeof(float)
+  setrlimit(RLIMIT_AS, &capped);
+  std::vector<uint8_t> rec(24 + 64, 0);
+  uint32_t flag = 0x40000006u;
+  memcpy(rec.data(), &flag, 4);
+  std::string path = WriteTempRec({rec});
+  DetPipe p;
+  std::string err;
+  CHECK_TRUE(p.file.Open(path.c_str(), &err));
+  p.batch = 1;
+  p.C = 3;
+  p.H = 8;
+  p.W = 8;
+  p.max_objects = 2;
+  p.rand_crop = p.rand_mirror = 0;
+  for (int i = 0; i < 3; ++i) {
+    p.mean[i] = 0;
+    p.stdv[i] = 1;
+  }
+  p.min_cover = 0.3f;
+  p.area_lo = 0.3f;
+  p.area_hi = 1.0f;
+  p.ratio_lo = 0.75f;
+  p.ratio_hi = 1.33f;
+  p.max_attempts = 1;
+  p.seed = 0;
+  p.order = {0};
+  std::vector<float> img(p.DataElems()), lab(p.LabelElems());
+  CHECK_TRUE(!p.DecodeOne(0, img.data(), lab.data()));
+  setrlimit(RLIMIT_AS, &old);
+  remove(path.c_str());
+}
+
+}  // namespace
+
+int main() {
+  TestHashUniform();
+  TestResizeBilinear();
+  TestRecFileScan();
+  TestDetLabelBoundsOverflow();
+  if (failures == 0) {
+    printf("tpumx_io_test: ALL PASS\n");
+    return 0;
+  }
+  printf("tpumx_io_test: %d FAILURES\n", failures);
+  return 1;
+}
