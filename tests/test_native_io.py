@@ -691,3 +691,20 @@ def test_native_cpp_unit_tier(tmp_path):
                          timeout=60)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "ALL PASS" in out.stdout
+
+
+def test_image_det_record_iter_python_fallback(det_rec_file):
+    """use_native=False path: same iterator contract (shapes, label
+    layout, epoch length) through the Python augmenters."""
+    path, _ = det_rec_file
+    it = mx.io.ImageDetRecordIter(path, (3, 48, 48), batch_size=4,
+                                  use_native=False)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (4, 3, 48, 48)
+    assert batches[0].label[0].shape == (4, 3, 5)
+    lab = batches[0].label[0].asnumpy()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+    it.reset()
+    assert len(list(it)) == 4

@@ -381,3 +381,52 @@ def test_np_linalg_eig_and_cond():
                                 atol=1e-5)
     c = np.linalg.cond(np.array([[2.0, 0.0], [0.0, 3.0]]))
     onp.testing.assert_allclose(float(c.asnumpy()), 1.5, rtol=1e-5)
+
+
+def test_np_r4_long_tail_names():
+    """allclose/array_split/divmod/frexp/logaddexp2/vander (r4 audit)."""
+    a = mx.np.array([1.0, 2.0, 3.0])
+    assert float(mx.np.allclose(a, a + 1e-9).asnumpy()) == 1.0
+    parts = mx.np.array_split(mx.np.arange(7), 3)
+    assert [int(p.size) for p in parts] == [3, 2, 2]
+    q, r = mx.np.divmod(mx.np.array([7.0, 9.0]), 4.0)
+    onp.testing.assert_allclose(q.asnumpy(), [1.0, 2.0])
+    onp.testing.assert_allclose(r.asnumpy(), [3.0, 1.0])
+    m, e = mx.np.frexp(mx.np.array([8.0, 0.5]))
+    onp.testing.assert_allclose(m.asnumpy() * 2.0 ** e.asnumpy(),
+                               [8.0, 0.5])
+    onp.testing.assert_allclose(
+        mx.np.logaddexp2(mx.np.array([1.0]), mx.np.array([1.0])).asnumpy(),
+        [2.0])
+    v = mx.np.vander(mx.np.array([1.0, 2.0]), 3)
+    onp.testing.assert_allclose(v.asnumpy(), [[1, 1, 1], [4, 2, 1]])
+
+
+def test_np_split_family_backward():
+    """List-returning jnp ops (array_split/split/hsplit) must backprop:
+    the pullback pytree is normalized at record time (r4 review fix)."""
+    x = mx.np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    x.attach_grad()
+    with autograd.record():
+        parts = mx.np.array_split(x, 2)      # sizes 3, 2
+        (parts[0] * 3.0).sum().backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3, 3, 3, 0, 0])
+    y = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    y.attach_grad()
+    with autograd.record():
+        a, b = mx.np.split(y, 2, axis=1)
+        (a * 2.0 + 0.0).sum().backward()
+    onp.testing.assert_allclose(y.grad.asnumpy(), [[2, 0], [2, 0]])
+
+
+def test_np_frexp_mantissa_gradient():
+    """Mixed float/int outputs stay on the tape: d(mantissa)/dx = 1/2^e,
+    not the silent zeros the all-inexact gate used to produce."""
+    x = mx.np.array([8.0, 0.75])
+    x.attach_grad()
+    with autograd.record():
+        m, e = mx.np.frexp(x)
+        (m * 2.0).sum().backward()
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(), 2.0 / 2.0 ** e.asnumpy().astype(onp.float32),
+        rtol=1e-6)

@@ -135,6 +135,16 @@ def _record_op(vjp_fn, inputs, outputs, name=""):
 # ----------------------------------------------------------------------------
 # backward
 # ----------------------------------------------------------------------------
+def _zero_ct(shape, dtype):
+    """Zero cotangent for an unused output.  Integer outputs (frexp's
+    exponent, argmax-style companions) have JAX cotangent type float0."""
+    import numpy as _np
+    if not jnp.issubdtype(dtype, jnp.inexact):
+        from jax.dtypes import float0
+        return _np.zeros(shape, dtype=float0)
+    return jnp.zeros(shape, dtype)
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Reverse-accumulate gradients from ``heads`` into every leaf with an
     attached grad buffer.  Matches reference semantics: default head gradient
@@ -165,7 +175,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         if all(c is None for c in outs_ct):
             continue
         full_ct = tuple(
-            c if c is not None else jnp.zeros(shape, dtype)
+            c if c is not None else _zero_ct(shape, dtype)
             for c, (shape, dtype) in zip(outs_ct, node.out_meta)
         )
         in_cts = node.vjp_fn(full_ct if len(full_ct) > 1 else full_ct[0])

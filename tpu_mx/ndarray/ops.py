@@ -71,16 +71,23 @@ def _apply(fn, args, name="op", nondiff=False):
             full = list(datas)
             for i, d in zip(diff_idx, diff_datas):
                 full[i] = d
-            return fn(*full)
+            out = fn(*full)
+            # normalize list outputs (jnp.split family) to tuples so the
+            # pullback's expected cotangent pytree matches what backward
+            # builds (a tuple)
+            return tuple(out) if isinstance(out, list) else out
 
         out_data, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
         multi = isinstance(out_data, (tuple, list))
         outs_raw = list(out_data) if multi else [out_data]
-        if all(jnp.issubdtype(o.dtype, jnp.inexact) for o in outs_raw):
+        if any(jnp.issubdtype(o.dtype, jnp.inexact) for o in outs_raw):
+            # record even MIXED-dtype outputs (frexp's mantissa/exponent):
+            # backward supplies float0 cotangents for the integer ones —
+            # dropping the whole op would silently zero real gradients
             outs = [NDArray(o) for o in outs_raw]
             autograd._record_op(vjp_fn, diff_inputs, outs, name=name)
             return outs if multi else outs[0]
-        # non-float output: fall through unrecorded
+        # all-integer output: fall through unrecorded
         out_data = tuple(outs_raw) if multi else outs_raw[0]
     else:
         out_data = fn(*datas)

@@ -122,23 +122,26 @@ def _wrap(jnp_name, public=None):
 
 # one generated wrapper per jnp routine; names follow numpy.  Keep sorted.
 _WRAPPED = [
-    "abs", "absolute", "add", "all", "amax", "amin", "any", "append",
+    "abs", "absolute", "add", "all", "allclose", "amax", "amin", "any",
+    "append",
     "arccos", "arccosh", "arcsin", "arcsinh", "arctan", "arctan2",
-    "arctanh", "argmax", "argmin", "argsort", "around", "atleast_1d",
+    "arctanh", "argmax", "argmin", "argsort", "around", "array_split",
+    "atleast_1d",
     "atleast_2d", "atleast_3d", "average", "bincount", "bitwise_and",
     "bitwise_or", "bitwise_xor", "broadcast_arrays", "broadcast_to",
     "cbrt", "ceil", "clip", "column_stack", "concatenate", "copysign",
     "cos", "cosh", "cross", "cumprod", "cumsum", "deg2rad", "degrees",
-    "delete", "diag", "diagflat", "diagonal", "diff", "divide", "dot",
-    "dsplit", "dstack",
+    "delete", "diag", "diagflat", "diagonal", "diff", "divide", "divmod",
+    "dot", "dsplit", "dstack",
     "ediff1d", "einsum", "equal", "exp", "exp2", "expand_dims", "expm1",
     "flatnonzero", "flip", "fliplr", "flipud", "floor", "floor_divide",
-    "fmax", "fmin", "fmod", "gcd", "greater", "greater_equal",
+    "fmax", "fmin", "fmod", "frexp", "gcd", "greater", "greater_equal",
     "histogram", "hsplit",
     "hstack", "hypot", "inner", "insert", "interp", "invert", "isclose",
     "isfinite", "isinf",
     "isnan", "isneginf", "isposinf", "kron", "lcm", "ldexp", "less",
     "less_equal", "log", "log10", "log1p", "log2", "logaddexp",
+    "logaddexp2",
     "logical_and", "logical_not", "logical_or", "logical_xor", "matmul",
     "max", "maximum", "mean", "median", "meshgrid", "min", "minimum",
     "mod", "moveaxis", "multiply", "nan_to_num", "nanmax", "nanmean",
@@ -152,7 +155,8 @@ _WRAPPED = [
     "subtract", "sum", "swapaxes", "take", "take_along_axis", "tan",
     "tanh", "tensordot",
     "tile", "trace", "transpose", "tril", "triu", "true_divide", "trunc",
-    "unique", "unravel_index", "var", "vdot", "vsplit", "vstack", "where",
+    "unique", "unravel_index", "vander", "var", "vdot", "vsplit",
+    "vstack", "where",
 ]
 for _name in _WRAPPED:
     globals()[_name] = _wrap(_name)
