@@ -6,7 +6,9 @@ snapshot-time check: `python tools/ci.py` exits nonzero with an
 unmissable banner when any test fails, and prints per-tier timing so the
 slowest tier stays visible.
 
-Tiers: unit (everything but examples) then the example smoke tier.
+Tiers: core (`-m "not slow"`, <5 min), slow (virtual-mesh parallelism,
+full-model layout trains, op-audit sweep, native C++ tier), then the
+example smokes.  `--core-only` runs just the first for a quick gate.
 """
 from __future__ import annotations
 
@@ -15,14 +17,23 @@ import sys
 import time
 
 TIERS = [
-    ("unit", ["tests/", "--deselect", "tests/test_examples.py"]),
+    ("core", ["tests/", "-m", "not slow",
+              "--deselect", "tests/test_examples.py"]),
+    ("slow", ["tests/", "-m", "slow",
+              "--deselect", "tests/test_examples.py"]),
     ("examples", ["tests/test_examples.py"]),
 ]
 
 
 def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--core-only", action="store_true",
+                    help="run just the <5 min core tier")
+    opts = ap.parse_args()  # unknown args fail fast, not silently run all
+    tiers = TIERS[:1] if opts.core_only else TIERS
     results = []
-    for name, args in TIERS:
+    for name, args in tiers:
         t0 = time.time()
         proc = subprocess.run([sys.executable, "-m", "pytest", "-q", *args])
         results.append((name, proc.returncode, time.time() - t0))
