@@ -859,3 +859,14 @@ class TestCorrelation:
                            ).sum().backward()
         assert np.abs(x1.grad.asnumpy()).sum() > 0
         assert np.abs(x2.grad.asnumpy()).sum() > 0
+
+
+def test_v1_deprecated_aliases_warn_and_forward():
+    import warnings
+    x = nd.array(rs.rand(1, 3, 8, 8).astype(np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = nd.Pooling_v1(x, kernel=(2, 2), pool_type="max")
+        assert any(issubclass(i.category, DeprecationWarning) for i in w)
+    ref = nd.Pooling(x, kernel=(2, 2), pool_type="max")
+    np.testing.assert_array_equal(out.asnumpy(), ref.asnumpy())

@@ -82,8 +82,11 @@ def NCHW():
 TEMPLATES = {
     "Activation": lambda f: f(X(2, 3), act_type="relu"),
     "BatchNorm": lambda f: f(NCHW(), X(3), X(3), X(3), X(3)),
+    "BatchNorm_v1": lambda f: f(NCHW(), X(3), X(3), X(3), X(3)),
     "Convolution": lambda f: f(NCHW(), X(4, 3, 3, 3), X(4),
                                kernel=(3, 3), num_filter=4),
+    "Convolution_v1": lambda f: f(NCHW(), X(4, 3, 3, 3), X(4),
+                                  kernel=(3, 3), num_filter=4),
     "Deconvolution": lambda f: f(NCHW(), X(3, 4, 3, 3), X(4),
                                  kernel=(3, 3), num_filter=4),
     "Dropout": lambda f: f(X(2, 3), p=0.5),
@@ -105,6 +108,7 @@ TEMPLATES = {
     "pad": lambda f: f(NCHW(), mode="constant",
                        pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
     "Pooling": lambda f: f(NCHW(), kernel=(2, 2), pool_type="max"),
+    "Pooling_v1": lambda f: f(NCHW(), kernel=(2, 2), pool_type="max"),
     "RNN": lambda f: f(X(4, 2, 3),
                        X(int(nd.rnn_param_size("rnn_tanh", 3, 5, 1))),
                        X(1, 2, 5), state_size=5, num_layers=1,

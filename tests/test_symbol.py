@@ -291,3 +291,21 @@ def test_attr_scope_survives_json_roundtrip(tmp_path):
     assert s2.attr("ctx_group") == "dev1"
     wname = [k for k in s2.list_arguments() if k.endswith("_weight")][0]
     assert s2.attr_dict().get(wname, {}).get("ctx_group") == "dev1"
+
+
+def test_symbol_v1_aliases_bind_with_auto_params():
+    """Deprecated 0.x aliases in the SYMBOL layer: auto-created
+    weight/bias/gamma Variables must appear (old symbol JSON loads)."""
+    import numpy as onp
+    data = mx.sym.Variable("data")
+    s = mx.sym.Convolution_v1(data, kernel=(3, 3), num_filter=4)
+    ex = s.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
+    out = ex.forward(is_train=False,
+                     data=onp.random.rand(1, 3, 8, 8).astype(onp.float32))
+    assert out[0].shape == (1, 4, 6, 6)
+    b = mx.sym.BatchNorm_v1(data)
+    ex2 = b.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
+    assert ex2.forward(
+        is_train=False,
+        data=onp.random.rand(1, 3, 8, 8).astype(onp.float32))[0].shape \
+        == (1, 3, 8, 8)

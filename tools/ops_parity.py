@@ -29,9 +29,9 @@ ROWS = {}
 ROWS["Neural network (REF:src/operator/nn, *.cc at src/operator/)"] = [
     ("Activation", "yes", "nd.Activation", ""),
     ("BatchNorm", "yes", "nd.BatchNorm", "fused via XLA; batch_norm_core"),
-    ("BatchNorm_v1", "not-planned", "", "deprecated upstream alias of BatchNorm"),
+    ("BatchNorm_v1", "yes", "nd.BatchNorm_v1", "deprecated alias; forwards with a DeprecationWarning"),
     ("Convolution", "yes", "nd.Convolution", "lax.conv_general_dilated; NHWC default layout"),
-    ("Convolution_v1", "not-planned", "", "deprecated upstream alias"),
+    ("Convolution_v1", "yes", "nd.Convolution_v1", "deprecated alias; forwards with a DeprecationWarning"),
     ("Correlation", "yes", "nd.Correlation",
      "cost volume as a static displacement loop of VPU products + window sums — no gather"),
     ("Deconvolution", "yes", "nd.Deconvolution", "conv_transpose"),
@@ -49,7 +49,7 @@ ROWS["Neural network (REF:src/operator/nn, *.cc at src/operator/)"] = [
     ("MakeLoss", "yes", "nd.MakeLoss", ""),
     ("Pad", "yes", "nd.Pad", ""),
     ("Pooling", "yes", "nd.Pooling", "max/avg/sum/lp, global, NHWC/NCHW"),
-    ("Pooling_v1", "not-planned", "", "deprecated upstream alias"),
+    ("Pooling_v1", "yes", "nd.Pooling_v1", "deprecated alias; forwards with a DeprecationWarning"),
     ("RNN", "yes", "nd.RNN", "fused multi-layer LSTM/GRU/vanilla via lax.scan (the cuDNN-RNN analog)"),
     ("ROIPooling", "yes", "nd.ROIPooling", ""),
     ("SVMOutput", "yes", "nd.SVMOutput", "L1/L2 hinge output head"),

@@ -1710,3 +1710,36 @@ def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
 
 
 broadcast_axes = broadcast_axis
+
+
+# ----------------------------------------------------------------------------
+# deprecated 0.x-era aliases (REF:src/operator/{batch_norm_v1,convolution_v1,
+# pooling_v1}.cc — upstream kept them registered for old symbol JSON; here
+# they forward to the current ops with a DeprecationWarning)
+# ----------------------------------------------------------------------------
+def _deprecated_v1(new_fn, old_name, ref_file):
+    import warnings as _warnings
+
+    @functools.wraps(new_fn)  # real signature: the symbol autogen stubs
+    def op(*args, **kw):      # classify by inspect.signature, and a bare
+        # (*args, **kw) would take the variadic path and skip the
+        # auto-created weight/bias/gamma Variables
+        _warnings.warn(
+            f"{old_name} is the deprecated 0.x alias of "
+            f"{new_fn.__name__}; it forwards with identical semantics",
+            DeprecationWarning, stacklevel=2)
+        return new_fn(*args, **kw)
+
+    op.__name__ = old_name
+    op.__qualname__ = old_name
+    op.__doc__ = (f"Deprecated alias of :func:`{new_fn.__name__}` "
+                  f"(REF:src/operator/{ref_file} kept old symbol JSON "
+                  "loadable).")
+    return op
+
+
+BatchNorm_v1 = _deprecated_v1(BatchNorm, "BatchNorm_v1",
+                              "batch_norm_v1.cc")
+Convolution_v1 = _deprecated_v1(Convolution, "Convolution_v1",
+                                "convolution_v1.cc")
+Pooling_v1 = _deprecated_v1(Pooling, "Pooling_v1", "pooling_v1.cc")
