@@ -300,3 +300,77 @@ def test_strict_kvstore_flag_raises_on_eager_dist(monkeypatch):
     kv.init("w", mx.nd.zeros((3,)))
     with pytest.raises(MXNetError, match="STRICT_KVSTORE"):
         kv.push("w", mx.nd.ones((3,)))
+
+
+@pytest.mark.slow
+def test_launch_two_process_compiled_train_step(tmp_path):
+    """Full multi-host SPMD path: TWO processes x 4 virtual devices form
+    one dp=8 mesh and run the SAME CompiledTrainStep — both ranks must
+    produce identical loss/weights, equal to a single-process dp=8 run
+    (SURVEY §2.3 'DP multi-host sync' beyond the kvstore-math check)."""
+    import numpy as np
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import numpy as np\n"
+        "import tpu_mx as mx\n"
+        "mx.kvstore.dist_init()\n"
+        "import jax\n"
+        "assert jax.device_count() == 8, jax.device_count()\n"
+        "from tpu_mx import gluon, nd\n"
+        "from tpu_mx.gluon import nn\n"
+        "from tpu_mx.parallel import CompiledTrainStep, make_mesh\n"
+        "np.random.seed(0)\n"
+        "mx.random.seed(0)\n"
+        "net = nn.HybridSequential()\n"
+        "net.add(nn.Dense(16, in_units=8, activation='relu'),\n"
+        "        nn.Dense(4, in_units=16))\n"
+        "net.initialize(init='xavier')\n"
+        "net(nd.ones((1, 8)))\n"
+        "mesh = make_mesh({'dp': 8}, devices=jax.devices())\n"
+        "step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),\n"
+        "                         mx.optimizer.create('sgd', learning_rate=0.1),\n"
+        "                         mesh=mesh)\n"
+        "x = np.random.RandomState(7).rand(16, 8).astype(np.float32)\n"
+        "y = np.random.RandomState(8).randint(0, 4, (16,)).astype(np.float32)\n"
+        "loss = None\n"
+        "for _ in range(3):\n"
+        "    loss = step.step(nd.array(x), nd.array(y))\n"
+        "print(f'RANK{jax.process_index()} "
+        "LOSS={float(np.asarray(loss._data)):.6f}', flush=True)\n")
+    env = _env_cpu()
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=4").strip()
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/launch.py"), "-n", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, env=env, timeout=400)
+    assert out.returncode == 0, out.stderr[-2000:]
+    import re
+    losses = {m.group(1): float(m.group(2)) for m in
+              re.finditer(r"RANK(\d) LOSS=([\d.]+)", out.stdout)}
+    assert set(losses) == {"0", "1"}, out.stdout
+    assert losses["0"] == losses["1"]  # equal to 6 printed decimals
+
+    # single-process dp=8 oracle (conftest's virtual mesh), same seeds
+    import jax
+    import tpu_mx as mx
+    from tpu_mx import gluon, nd
+    from tpu_mx.gluon import nn
+    from tpu_mx.parallel import CompiledTrainStep, make_mesh
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(init="xavier")
+    net(nd.ones((1, 8)))
+    step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mx.optimizer.create("sgd", learning_rate=0.1),
+                             mesh=make_mesh({"dp": 8},
+                                            devices=jax.devices()))
+    x = np.random.RandomState(7).rand(16, 8).astype(np.float32)
+    y = np.random.RandomState(8).randint(0, 4, (16,)).astype(np.float32)
+    for _ in range(3):
+        loss = step.step(nd.array(x), nd.array(y))
+    np.testing.assert_allclose(float(np.asarray(loss._data)),
+                               losses["0"], rtol=1e-5)
