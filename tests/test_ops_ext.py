@@ -870,3 +870,104 @@ def test_v1_deprecated_aliases_warn_and_forward():
         assert any(issubclass(i.category, DeprecationWarning) for i in w)
     ref = nd.Pooling(x, kernel=(2, 2), pool_type="max")
     np.testing.assert_array_equal(out.asnumpy(), ref.asnumpy())
+
+
+class TestPSROI:
+    """Position-sensitive ROI ops (REF:contrib/{psroi_pooling,
+    deformable_psroi_pooling}.cc + roi_align position_sensitive)."""
+
+    def _ps_data(self, D=2, g=3, H=9, W=9):
+        # channel c holds constant value c so the position-sensitive
+        # channel MAPPING is directly observable in the output
+        C = D * g * g
+        x = np.tile(np.arange(C, dtype=np.float32)[None, :, None, None],
+                    (1, 1, H, W))
+        return x, C
+
+    def test_psroi_pooling_channel_mapping(self):
+        D, g = 2, 3
+        x, C = self._ps_data(D, g)
+        rois = np.array([[0, 0, 0, 8, 8]], np.float32)  # whole image
+        out = nd.PSROIPooling(nd.array(x), nd.array(rois),
+                              spatial_scale=1.0, output_dim=D,
+                              pooled_size=g, group_size=g)
+        assert out.shape == (1, D, g, g)
+        ref = np.empty((D, g, g), np.float32)
+        for d in range(D):
+            for i in range(g):
+                for j in range(g):
+                    ref[d, i, j] = (d * g + i) * g + j
+        np.testing.assert_allclose(out.asnumpy()[0], ref, rtol=1e-6)
+
+    def test_psroi_pooling_averages_region(self):
+        # one output dim, k=g=1: plain average over the rounded ROI
+        H = W = 8
+        x = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+        rois = np.array([[0, 2, 2, 5, 5]], np.float32)
+        out = nd.PSROIPooling(nd.array(x), nd.array(rois),
+                              output_dim=1, pooled_size=1, group_size=1)
+        # rounded end = round(x2+1)*scale = 6 (exclusive): rows/cols 2..5
+        ref = x[0, 0, 2:6, 2:6].mean()
+        np.testing.assert_allclose(float(np.asarray(out.asnumpy()).ravel()[0]),
+                                   ref, rtol=0.05)
+
+    def test_deformable_psroi_no_trans_matches_zero_offsets(self):
+        D, g = 2, 3
+        x, C = self._ps_data(D, g)
+        rois = np.array([[0, 1, 1, 7, 7]], np.float32)
+        base = nd.DeformablePSROIPooling(
+            nd.array(x), nd.array(rois), no_trans=True, output_dim=D,
+            pooled_size=g, group_size=g, sample_per_part=2)
+        zero_t = nd.array(np.zeros((1, 2, g, g), np.float32))
+        with_zero = nd.DeformablePSROIPooling(
+            nd.array(x), nd.array(rois), zero_t, output_dim=D,
+            pooled_size=g, group_size=g, sample_per_part=2, trans_std=0.1)
+        np.testing.assert_allclose(base.asnumpy(), with_zero.asnumpy(),
+                                   rtol=1e-6)
+        assert base.shape == (1, D, g, g)
+        # constant-channel data: the channel mapping shows through exactly
+        ref = np.empty((D, g, g), np.float32)
+        for d in range(D):
+            for i in range(g):
+                for j in range(g):
+                    ref[d, i, j] = (d * g + i) * g + j
+        np.testing.assert_allclose(base.asnumpy()[0], ref, rtol=1e-6)
+
+    def test_deformable_psroi_offsets_shift_sampling(self):
+        # gradient image along x: positive dx offset must increase values
+        H = W = 12
+        x = np.tile(np.arange(W, dtype=np.float32)[None, None, None, :],
+                    (1, 1, H, 1))
+        rois = np.array([[0, 2, 2, 7, 7]], np.float32)
+        t0 = np.zeros((1, 2, 2, 2), np.float32)
+        tx = t0.copy()
+        tx[0, 1] = 1.0  # dx channel (odd index)
+        out0 = nd.DeformablePSROIPooling(
+            nd.array(x), nd.array(rois), nd.array(t0), output_dim=1,
+            pooled_size=2, group_size=1, part_size=2, trans_std=0.2)
+        outx = nd.DeformablePSROIPooling(
+            nd.array(x), nd.array(rois), nd.array(tx), output_dim=1,
+            pooled_size=2, group_size=1, part_size=2, trans_std=0.2)
+        assert (outx.asnumpy() > out0.asnumpy()).all()
+        # and grads flow into the offsets
+        from tpu_mx import autograd
+        tt = nd.array(tx)
+        tt.attach_grad()
+        with autograd.record():
+            nd.DeformablePSROIPooling(
+                nd.array(x), nd.array(rois), tt, output_dim=1,
+                pooled_size=2, group_size=1, part_size=2,
+                trans_std=0.2).sum().backward()
+        assert np.abs(tt.grad.asnumpy()).sum() > 0
+
+    def test_roi_align_position_sensitive(self):
+        D, ph = 2, 2
+        C = D * ph * ph
+        x = np.tile(np.arange(C, dtype=np.float32)[None, :, None, None],
+                    (1, 1, 8, 8))
+        rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+        out = nd.ROIAlign(nd.array(x), nd.array(rois), pooled_size=(ph, ph),
+                          position_sensitive=True)
+        assert out.shape == (1, D, ph, ph)
+        ref = np.arange(C, dtype=np.float32).reshape(D, ph, ph)
+        np.testing.assert_allclose(out.asnumpy()[0], ref, rtol=1e-6)

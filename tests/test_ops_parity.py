@@ -309,6 +309,11 @@ TEMPLATES = {
                                  rpn_post_nms_top_n=4),
     "ROIAlign": lambda f: f(NCHW(), X(1, 5), pooled_size=(2, 2),
                             spatial_scale=1.0),
+    "PSROIPooling": lambda f: f(X(1, 8, 8, 8), X(1, 5), output_dim=2,
+                                pooled_size=2, group_size=2),
+    "DeformablePSROIPooling": lambda f: f(
+        X(1, 8, 8, 8), X(1, 5), X(1, 2, 2, 2), output_dim=2,
+        pooled_size=2, group_size=2, part_size=2, trans_std=0.1),
     "DeformableConvolution": lambda f: f(
         NCHW(), X(1, 18, 6, 6), X(4, 3, 3, 3), X(4), kernel=(3, 3),
         num_filter=4),

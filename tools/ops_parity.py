@@ -296,9 +296,10 @@ ROWS["Contrib — detection / vision (REF:src/operator/contrib/)"] = [
     ("ROIAlign", "yes", "nd.ROIAlign", ""),
     ("DeformableConvolution", "yes", "nd.contrib.DeformableConvolution",
      "bilinear-gather formulation"),
-    ("DeformablePSROIPooling", "not-planned", "",
-     "R-FCN-specific; no north-star workload; ROIAlign covers the modern path"),
-    ("PSROIPooling", "not-planned", "", "same"),
+    ("DeformablePSROIPooling", "yes", "nd.DeformablePSROIPooling",
+     "bilinear-sampled, learned per-bin offsets; edge-clamp divergence noted in docstring"),
+    ("PSROIPooling", "yes", "nd.PSROIPooling",
+     "position-sensitive channel mapping, quantized-border averages; ROIAlign(position_sensitive=True) is the aligned variant"),
     ("BilinearResize2D", "yes", "nd.BilinearResize2D", ""),
     ("AdaptiveAvgPooling2D", "yes", "nd.contrib.AdaptiveAvgPooling2D",
      "averaging-matrix einsum formulation (MXU-friendly)"),

@@ -20,9 +20,10 @@ from jax import lax
 
 from .ops import _apply
 
-__all__ = ["ROIPooling", "ROIAlign", "BilinearSampler", "GridGenerator",
+__all__ = ["ROIPooling", "ROIAlign", "PSROIPooling",
+           "DeformablePSROIPooling", "BilinearSampler", "GridGenerator",
            "SpatialTransformer", "BilinearResize2D", "UpSampling",
-           "Proposal", "MultiProposal"]
+           "Proposal", "MultiProposal", "Correlation"]
 
 
 # ---------------------------------------------------------------------------
@@ -45,6 +46,37 @@ def _bilinear_gather(feat, ys, xs):
             + g(y0i, x1i) * (1 - wy1) * wx1
             + g(y1i, x0i) * wy1 * (1 - wx1)
             + g(y1i, x1i) * wy1 * wx1)
+
+
+def _bilinear_gather_chan(feat, chan, ys, xs):
+    """Channel-indexed bilinear sampling: feat (C, H, W); chan int32
+    broadcastable to ys/xs — each sample reads ONLY its own channel (the
+    position-sensitive ops' access pattern), so nothing bigger than the
+    sample grid is ever materialized.  Edge-clamped like
+    _bilinear_gather."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+    y1i = jnp.clip(y0i + 1, 0, H - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+    x1i = jnp.clip(x0i + 1, 0, W - 1)
+    g = lambda yi, xi: feat[chan, yi, xi]
+    return (g(y0i, x0i) * (1 - wy1) * (1 - wx1)
+            + g(y0i, x1i) * (1 - wy1) * wx1
+            + g(y1i, x0i) * wy1 * (1 - wx1)
+            + g(y1i, x1i) * wy1 * wx1)
+
+
+def _ps_chan(output_dim, k, g):
+    """(D, k, k) position-sensitive channel index: out dim d at bin
+    (i, j) reads input channel (d·g + gh)·g + gw (REF psroi mapping)."""
+    gh = jnp.clip((jnp.arange(k) * g) // k, 0, g - 1)
+    d = jnp.arange(output_dim)
+    return (d[:, None, None] * g + gh[None, :, None]) * g + \
+        gh[None, None, :]
 
 
 def ROIPooling(data, rois, pooled_size=None, spatial_scale=1.0, **kw):
@@ -104,14 +136,161 @@ def ROIAlign(data, rois, pooled_size=None, spatial_scale=1.0, sample_ratio=2,
                                (jnp.arange(S)[None, :] + 0.5) / S)   # (ph,S)
             gx = x1 + bin_w * (jnp.arange(pw)[:, None] +
                                (jnp.arange(S)[None, :] + 0.5) / S)   # (pw,S)
+            if position_sensitive:
+                # R-FCN mode (REF roi_align.cc position_sensitive=True):
+                # C = output_dim·ph·pw; out channel d at bin (i, j) reads
+                # ONLY input channel d·ph·pw + i·pw + j — gather that one
+                # channel per bin (not all C then discard ph·pw−1 of them)
+                out_dim = feat.shape[0] // (ph * pw)
+                d = jnp.arange(out_dim)
+                chan = (d[:, None, None] * ph * pw +
+                        jnp.arange(ph)[None, :, None] * pw +
+                        jnp.arange(pw)[None, None, :])     # (D, ph, pw)
+                ys = jnp.broadcast_to(
+                    gy[None, :, None, :, None],
+                    (out_dim, ph, pw, S, S))
+                xs = jnp.broadcast_to(
+                    gx[None, None, :, None, :],
+                    (out_dim, ph, pw, S, S))
+                vals = _bilinear_gather_chan(
+                    feat, chan[:, :, :, None, None], ys, xs)
+                return vals.mean(axis=(3, 4))              # (D, ph, pw)
             ys = jnp.broadcast_to(gy[:, :, None, None], (ph, S, pw, S))
             xs = jnp.broadcast_to(gx[None, None, :, :], (ph, S, pw, S))
             vals = _bilinear_gather(feat, ys, xs)           # (C, ph,S,pw,S)
-            return vals.mean(axis=(2, 4))
+            return vals.mean(axis=(2, 4))                   # (C, ph, pw)
 
         return jax.vmap(one_roi)(r)
 
     return _apply(f, [data, rois], "ROIAlign")
+
+
+def PSROIPooling(data, rois, spatial_scale=1.0, output_dim=None,
+                 pooled_size=None, group_size=0, **kw):
+    """Position-sensitive ROI pooling (REF:src/operator/contrib/
+    psroi_pooling.cc — R-FCN's head).  data: (N, output_dim·g·g, H, W);
+    rois: (R, 5) [batch_idx, x1, y1, x2, y2] in image coords; out:
+    (R, output_dim, k, k) with k = pooled_size.  Out channel d at bin
+    (i, j) AVERAGE-pools input channel (d·g + gh)·g + gw where
+    (gh, gw) = the bin's group cell — each spatial bin reads its own
+    score-map slice.  Static-shape formulation: dense S×S floor-sampled
+    grid per bin averaged (the reference's quantized-border average)."""
+    k = int(pooled_size)
+    g = int(group_size) or k
+
+    def f(x, r):
+        H, W = x.shape[-2:]
+
+        def one_roi(roi):
+            b = roi[0].astype(jnp.int32)
+            feat = x[b]                                    # (C, H, W)
+            # reference rounds ROI corners before scaling; end is +1
+            x1 = jnp.round(roi[1]) * spatial_scale
+            y1 = jnp.round(roi[2]) * spatial_scale
+            x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+            y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+            roi_h = jnp.maximum(y2 - y1, 0.1)
+            roi_w = jnp.maximum(x2 - x1, 0.1)
+            bin_h, bin_w = roi_h / k, roi_w / k
+            S = 4
+            gy = y1 + bin_h * (jnp.arange(k)[:, None] +
+                               (jnp.arange(S)[None, :] + 0.5) / S)
+            gx = x1 + bin_w * (jnp.arange(k)[:, None] +
+                               (jnp.arange(S)[None, :] + 0.5) / S)
+            yi = jnp.clip(jnp.floor(gy).astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(jnp.floor(gx).astype(jnp.int32), 0, W - 1)
+            # gather ONLY each bin's own channel (D, k, k, S, S)
+            chan = _ps_chan(output_dim, k, g)              # (D, k, k)
+            yi5 = jnp.broadcast_to(yi[None, :, None, :, None],
+                                   (output_dim, k, k, S, S))
+            xi5 = jnp.broadcast_to(xi[None, None, :, None, :],
+                                   (output_dim, k, k, S, S))
+            vals = feat[chan[:, :, :, None, None], yi5, xi5]
+            return vals.mean(axis=(3, 4))                  # (D, k, k)
+
+        return jax.vmap(one_roi)(r)
+
+    return _apply(f, [data, rois], "PSROIPooling")
+
+
+def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
+                           output_dim=None, group_size=1, pooled_size=None,
+                           part_size=0, sample_per_part=1, trans_std=0.0,
+                           no_trans=False, **kw):
+    """Deformable position-sensitive ROI pooling (REF:src/operator/
+    contrib/deformable_psroi_pooling.cc, Deformable ConvNets).  Like
+    PSROIPooling but each bin's sampling window is shifted by a learned
+    normalized offset from `trans` (R, 2·num_cls, part, part), scaled by
+    trans_std and the ROI size; samples are BILINEAR (the deformable
+    papers' sampler).  no_trans=True (or trans None) runs the undeformed
+    bilinear variant.  Divergence from the CUDA kernel: out-of-bounds
+    samples are edge-clamped rather than dropped from the average —
+    identical for interior ROIs."""
+    k = int(pooled_size)
+    g = int(group_size) or k
+    part = int(part_size) or k
+    S = max(int(sample_per_part), 1)
+    if not no_trans and trans is None:
+        raise ValueError(
+            "DeformablePSROIPooling: no_trans=False requires the `trans` "
+            "offset input (the reference errors too); pass no_trans=True "
+            "for the undeformed variant")
+    use_trans = not no_trans and trans is not None
+
+    def f(x, r, *maybe_trans):
+        t = maybe_trans[0] if use_trans else None
+
+        def one_roi(roi, troi):
+            b = roi[0].astype(jnp.int32)
+            feat = x[b]                                    # (C, H, W)
+            x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+            y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+            x2 = jnp.round(roi[3] + 1.0) * spatial_scale - 0.5
+            y2 = jnp.round(roi[4] + 1.0) * spatial_scale - 0.5
+            roi_h = jnp.maximum(y2 - y1, 0.1)
+            roi_w = jnp.maximum(x2 - x1, 0.1)
+            bin_h, bin_w = roi_h / k, roi_w / k
+            ii = jnp.arange(k)
+            # per-bin offsets from the (2·ncls, part, part) trans block
+            if use_trans:
+                ncls = troi.shape[0] // 2
+                ch_per_cls = max(output_dim // ncls, 1)
+                pi = jnp.clip((ii * part) // k, 0, part - 1)   # (k,)
+                dy = troi[0::2][:, pi[:, None], pi[None, :]]   # (ncls,k,k)
+                dx = troi[1::2][:, pi[:, None], pi[None, :]]
+                cls_of_d = jnp.arange(output_dim) // ch_per_cls
+                off_y = dy[cls_of_d] * trans_std * roi_h       # (D, k, k)
+                off_x = dx[cls_of_d] * trans_std * roi_w
+            else:
+                off_y = jnp.zeros((output_dim, k, k))
+                off_x = jnp.zeros((output_dim, k, k))
+            sub = (jnp.arange(S) + 0.5) / S
+            # sample coords per (D, bin_i, bin_j, si, sj)
+            base_y = y1 + ii[:, None] * bin_h + \
+                jnp.zeros((k, k))                              # (k, k)
+            base_x = x1 + ii[None, :] * bin_w + jnp.zeros((k, k))
+            ys = (base_y[None, :, :, None, None] +
+                  off_y[:, :, :, None, None] +
+                  bin_h * sub[None, None, None, :, None])
+            xs = (base_x[None, :, :, None, None] +
+                  off_x[:, :, :, None, None] +
+                  bin_w * sub[None, None, None, None, :])
+            # position-sensitive channel per (D, i, j): sample each bin
+            # from ONLY its own channel — no (D, k, k, H, W) intermediate
+            chan = _ps_chan(output_dim, k, g)                  # (D, k, k)
+            vals = _bilinear_gather_chan(
+                feat, chan[:, :, :, None, None], ys, xs)       # (D,k,k,S,S)
+            return vals.mean(axis=(3, 4))
+
+        if use_trans:
+            return jax.vmap(one_roi)(r, t)
+        dummy = jnp.zeros((r.shape[0], 2, part, part), x.dtype)
+        return jax.vmap(one_roi)(r, dummy)
+
+    args = [data, rois] + ([trans] if use_trans else [])
+    return _apply(f, args, "DeformablePSROIPooling")
+
+
 
 
 def GridGenerator(data, transform_type="affine", target_shape=None, **kw):
