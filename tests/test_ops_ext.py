@@ -971,3 +971,42 @@ class TestPSROI:
         assert out.shape == (1, D, ph, ph)
         ref = np.arange(C, dtype=np.float32).reshape(D, ph, ph)
         np.testing.assert_allclose(out.asnumpy()[0], ref, rtol=1e-6)
+
+
+def test_softmax_0x_alias_is_softmax_output():
+    """Upstream add_alias: nd.Softmax IS SoftmaxOutput (fwd softmax +
+    injected CE grad), not nd.softmax."""
+    import warnings
+    from tpu_mx import autograd
+    x = rs.randn(3, 5).astype(np.float32)
+    y = np.array([0, 2, 4], np.float32)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = nd.Softmax(nd.array(x), nd.array(y))
+        assert any(issubclass(i.category, DeprecationWarning) for i in w)
+    ref = nd.SoftmaxOutput(nd.array(x), nd.array(y))
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-6)
+    xx = nd.array(x)
+    xx.attach_grad()
+    with autograd.record():
+        nd.Softmax(xx, nd.array(y)).backward()
+    g = xx.grad.asnumpy()
+    p = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    oh = np.eye(5, dtype=np.float32)[y.astype(int)]
+    np.testing.assert_allclose(g, p - oh, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_border_extension_exact():
+    """OOB samples converge EXACTLY to the border value (clamp before
+    weights): a learned deformable offset pushing the window far outside
+    must read the edge, not a blend of edge and interior rows."""
+    H = W = 8
+    x = np.tile(np.arange(H, dtype=np.float32)[None, None, :, None],
+                (1, 1, 1, W))  # row r = value r
+    rois = np.array([[0, 2, 2, 5, 5]], np.float32)
+    t_up = np.zeros((1, 2, 1, 1), np.float32)
+    t_up[0, 0] = -100.0  # dy: far above the image
+    out = nd.DeformablePSROIPooling(
+        nd.array(x), nd.array(rois), nd.array(t_up), output_dim=1,
+        pooled_size=1, group_size=1, part_size=1, trans_std=1.0)
+    np.testing.assert_allclose(out.asnumpy().ravel(), [0.0], atol=1e-6)

@@ -124,6 +124,8 @@ TEMPLATES = {
     "SoftmaxActivation": lambda f: f(X(2, 3)),
     "SoftmaxOutput": lambda f: f(X(2, 5),
                                  nd.array(np.array([0., 1.], np.float32))),
+    "Softmax": lambda f: f(X(2, 5),
+                           nd.array(np.array([0., 1.], np.float32))),
     "SpatialTransformer": lambda f: f(
         NCHW(), X(1, 6), transform_type="affine", sampler_type="bilinear",
         target_shape=(4, 4)),

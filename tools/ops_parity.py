@@ -57,7 +57,8 @@ ROWS["Neural network (REF:src/operator/nn, *.cc at src/operator/)"] = [
     ("SequenceMask", "yes", "nd.SequenceMask", ""),
     ("SequenceReverse", "yes", "nd.SequenceReverse", ""),
     ("SliceChannel", "yes", "nd.SliceChannel", ""),
-    ("Softmax", "not-planned", "", "deprecated 0.x alias; nd.softmax / SoftmaxActivation cover it"),
+    ("Softmax", "yes", "nd.Softmax",
+     "upstream add_alias of SoftmaxOutput (NOT nd.softmax); forwards with a DeprecationWarning"),
     ("SoftmaxActivation", "yes", "nd.SoftmaxActivation", ""),
     ("SoftmaxOutput", "yes", "nd.SoftmaxOutput", "custom-vjp injected CE gradient"),
     ("SpatialTransformer", "yes", "nd.SpatialTransformer", ""),
@@ -299,7 +300,7 @@ ROWS["Contrib — detection / vision (REF:src/operator/contrib/)"] = [
     ("DeformablePSROIPooling", "yes", "nd.DeformablePSROIPooling",
      "bilinear-sampled, learned per-bin offsets; edge-clamp divergence noted in docstring"),
     ("PSROIPooling", "yes", "nd.PSROIPooling",
-     "position-sensitive channel mapping, quantized-border averages; ROIAlign(position_sensitive=True) is the aligned variant"),
+     "position-sensitive channel mapping; bins averaged over a fixed 4x4 sample grid (subsamples the reference's full quantized-cell average for bins wider than ~4 cells — documented in the docstring); ROIAlign(position_sensitive=True) is the aligned variant"),
     ("BilinearResize2D", "yes", "nd.BilinearResize2D", ""),
     ("AdaptiveAvgPooling2D", "yes", "nd.contrib.AdaptiveAvgPooling2D",
      "averaging-matrix einsum formulation (MXU-friendly)"),

@@ -1740,6 +1740,9 @@ def _deprecated_v1(new_fn, old_name, ref_file):
 
 BatchNorm_v1 = _deprecated_v1(BatchNorm, "BatchNorm_v1",
                               "batch_norm_v1.cc")
+# upstream: NNVM_REGISTER_OP(SoftmaxOutput).add_alias("Softmax") — the 0.x
+# name is the SAME OP (softmax fwd + injected CE grad), not nd.softmax
+Softmax = _deprecated_v1(SoftmaxOutput, "Softmax", "softmax_output.cc")
 Convolution_v1 = _deprecated_v1(Convolution, "Convolution_v1",
                                 "convolution_v1.cc")
 Pooling_v1 = _deprecated_v1(Pooling, "Pooling_v1", "pooling_v1.cc")

@@ -29,41 +29,32 @@ __all__ = ["ROIPooling", "ROIAlign", "PSROIPooling",
 # ---------------------------------------------------------------------------
 # bilinear interpolation helper: sample feature map at fractional coords
 # ---------------------------------------------------------------------------
-def _bilinear_gather(feat, ys, xs):
-    """feat: (C, H, W); ys/xs: (...) fractional pixel coords.  Out-of-range
-    samples clamp to the border (the reference's behavior for ROI ops)."""
+def _bilinear_gather(feat, ys, xs, chan=None):
+    """Bilinear sampling with true border extension: feat (C, H, W);
+    ys/xs fractional pixel coords of any shape.  Coordinates are CLAMPED
+    to the image box BEFORE the weights are computed, so an out-of-range
+    sample converges exactly to the border value (a blend of border and
+    interior rows with weights from the unclipped fractional part is
+    wrong — learned deformable offsets routinely leave the image).
+    `chan` (int32, broadcastable to ys/xs) switches to channel-indexed
+    gathering: each sample reads ONLY its own channel — the
+    position-sensitive ops' pattern, with nothing bigger than the sample
+    grid materialized."""
     H, W = feat.shape[-2:]
+    ys = jnp.clip(ys, 0.0, H - 1.0)
+    xs = jnp.clip(xs, 0.0, W - 1.0)
     y0 = jnp.floor(ys)
     x0 = jnp.floor(xs)
     wy1 = ys - y0
     wx1 = xs - x0
-    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+    y0i = y0.astype(jnp.int32)
     y1i = jnp.clip(y0i + 1, 0, H - 1)
-    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+    x0i = x0.astype(jnp.int32)
     x1i = jnp.clip(x0i + 1, 0, W - 1)
-    g = lambda yi, xi: feat[:, yi, xi]                      # (C, ...)
-    return (g(y0i, x0i) * (1 - wy1) * (1 - wx1)
-            + g(y0i, x1i) * (1 - wy1) * wx1
-            + g(y1i, x0i) * wy1 * (1 - wx1)
-            + g(y1i, x1i) * wy1 * wx1)
-
-
-def _bilinear_gather_chan(feat, chan, ys, xs):
-    """Channel-indexed bilinear sampling: feat (C, H, W); chan int32
-    broadcastable to ys/xs — each sample reads ONLY its own channel (the
-    position-sensitive ops' access pattern), so nothing bigger than the
-    sample grid is ever materialized.  Edge-clamped like
-    _bilinear_gather."""
-    H, W = feat.shape[-2:]
-    y0 = jnp.floor(ys)
-    x0 = jnp.floor(xs)
-    wy1 = ys - y0
-    wx1 = xs - x0
-    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
-    y1i = jnp.clip(y0i + 1, 0, H - 1)
-    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
-    x1i = jnp.clip(x0i + 1, 0, W - 1)
-    g = lambda yi, xi: feat[chan, yi, xi]
+    if chan is None:
+        g = lambda yi, xi: feat[:, yi, xi]                  # (C, ...)
+    else:
+        g = lambda yi, xi: feat[chan, yi, xi]
     return (g(y0i, x0i) * (1 - wy1) * (1 - wx1)
             + g(y0i, x1i) * (1 - wy1) * wx1
             + g(y1i, x0i) * wy1 * (1 - wx1)
@@ -152,8 +143,8 @@ def ROIAlign(data, rois, pooled_size=None, spatial_scale=1.0, sample_ratio=2,
                 xs = jnp.broadcast_to(
                     gx[None, None, :, None, :],
                     (out_dim, ph, pw, S, S))
-                vals = _bilinear_gather_chan(
-                    feat, chan[:, :, :, None, None], ys, xs)
+                vals = _bilinear_gather(
+                    feat, ys, xs, chan=chan[:, :, :, None, None])
                 return vals.mean(axis=(3, 4))              # (D, ph, pw)
             ys = jnp.broadcast_to(gy[:, :, None, None], (ph, S, pw, S))
             xs = jnp.broadcast_to(gx[None, None, :, :], (ph, S, pw, S))
@@ -173,8 +164,11 @@ def PSROIPooling(data, rois, spatial_scale=1.0, output_dim=None,
     (R, output_dim, k, k) with k = pooled_size.  Out channel d at bin
     (i, j) AVERAGE-pools input channel (d·g + gh)·g + gw where
     (gh, gw) = the bin's group cell — each spatial bin reads its own
-    score-map slice.  Static-shape formulation: dense S×S floor-sampled
-    grid per bin averaged (the reference's quantized-border average)."""
+    score-map slice.  Static-shape DIVERGENCE from the CUDA kernel: each
+    bin is averaged over a fixed S=4×4 floor-sampled grid rather than
+    every quantized cell, so bins spanning more than ~4 feature cells
+    are a subsample of the reference's average (exact for smaller bins,
+    the common R-FCN regime)."""
     k = int(pooled_size)
     g = int(group_size) or k
 
@@ -278,8 +272,9 @@ def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
             # position-sensitive channel per (D, i, j): sample each bin
             # from ONLY its own channel — no (D, k, k, H, W) intermediate
             chan = _ps_chan(output_dim, k, g)                  # (D, k, k)
-            vals = _bilinear_gather_chan(
-                feat, chan[:, :, :, None, None], ys, xs)       # (D,k,k,S,S)
+            vals = _bilinear_gather(
+                feat, ys, xs,
+                chan=chan[:, :, :, None, None])            # (D,k,k,S,S)
             return vals.mean(axis=(3, 4))
 
         if use_trans:
@@ -527,5 +522,3 @@ def Correlation(data1, data2, kernel_size=1, max_displacement=1,
 
     return _apply(f, [data1, data2], "Correlation")
 
-
-__all__ += ["Correlation"]
