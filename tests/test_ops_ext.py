@@ -1010,3 +1010,96 @@ def test_bilinear_border_extension_exact():
         nd.array(x), nd.array(rois), nd.array(t_up), output_dim=1,
         pooled_size=1, group_size=1, part_size=1, trans_std=1.0)
     np.testing.assert_allclose(out.asnumpy().ravel(), [0.0], atol=1e-6)
+
+
+class TestHawkesLL:
+    """hawkesll vs a brute-force oracle + the state-carry composition
+    property (REF:src/operator/contrib/hawkes_ll.cc)."""
+
+    @staticmethod
+    def _ref(mu, a, b, r0, times_marks, mt):
+        ll = 0.0
+        for idx, (ti, mi) in enumerate(times_marks):
+            lam = mu[mi] + a[mi] * b[mi] * (
+                r0[mi] * np.exp(-b[mi] * ti) +
+                sum(np.exp(-b[mi] * (ti - tj))
+                    for tj, mj in times_marks[:idx] if mj == mi))
+            ll += np.log(lam)
+        comp = 0.0
+        for k in range(len(a)):
+            comp += mu[k] * mt + a[k] * r0[k] * (1 - np.exp(-b[k] * mt))
+            comp += a[k] * sum(1 - np.exp(-b[k] * (mt - tj))
+                               for tj, mj in times_marks if mj == k)
+        return ll - comp
+
+    def _mk(self, seed=0, K=3, n=5, T=8, mt=6.0, r0=None):
+        r = np.random.RandomState(seed)
+        times = np.sort(r.uniform(0.2, mt - 0.5, n))
+        marks = r.randint(0, K, n)
+        lags = np.zeros(T, np.float32)
+        lags[:n] = np.diff(np.concatenate([[0.0], times])).astype(np.float32)
+        lags[n:] = 0.33  # padded garbage must be masked out
+        mk = np.zeros(T, np.int32)
+        mk[:n] = marks
+        mk[n:] = r.randint(0, K, T - n)
+        mu = r.uniform(0.2, 0.8, K).astype(np.float32)
+        a = r.uniform(0.1, 0.5, K).astype(np.float32)
+        b = r.uniform(0.5, 2.0, K).astype(np.float32)
+        r0 = np.zeros(K, np.float32) if r0 is None else r0
+        return mu, a, b, r0, times, marks, lags, mk, n, mt
+
+    def test_matches_bruteforce(self):
+        mu, a, b, r0, times, marks, lags, mk, n, mt = self._mk()
+        ll, state = nd.contrib.hawkesll(
+            nd.array(mu[None]), nd.array(a), nd.array(b),
+            nd.array(r0[None]), nd.array(lags[None]),
+            nd.array(mk[None].astype(np.float32)),
+            nd.array(np.array([n], np.float32)),
+            nd.array(np.array([mt], np.float32)))
+        ref = self._ref(mu, a, b, r0, list(zip(times, marks)), mt)
+        np.testing.assert_allclose(float(np.asarray(ll.asnumpy()).ravel()[0]),
+                                   ref, rtol=1e-4)
+        # state = per-mark excitation decayed to the horizon
+        state_ref = np.array(
+            [r0[k] * np.exp(-b[k] * mt) +
+             sum(np.exp(-b[k] * (mt - tj))
+                 for tj, mj in zip(times, marks) if mj == k)
+             for k in range(3)], np.float32)
+        np.testing.assert_allclose(state.asnumpy()[0], state_ref, rtol=1e-4)
+
+    def test_state_carry_composes(self):
+        """LL over [0, mt] == LL[0, s] + LL[s, mt] with the returned state
+        carried (the truncated-sequence contract)."""
+        mu, a, b, r0, times, marks, lags, mk, n, mt = self._mk(seed=3,
+                                                              mt=8.0)
+        split = 4.0
+        first = times <= split
+        t1, m1 = times[first], marks[first]
+        t2, m2 = times[~first], marks[~first]
+
+        def run(mu, a, b, r0, times, marks, t_origin, mt_win):
+            n = len(times)
+            T = max(n, 1) + 2
+            lags = np.zeros(T, np.float32)
+            prev = t_origin
+            for i, t in enumerate(times):
+                lags[i] = t - prev
+                prev = t
+            mkv = np.zeros(T, np.float32)
+            mkv[:n] = marks
+            return nd.contrib.hawkesll(
+                nd.array(mu[None]), nd.array(a), nd.array(b),
+                nd.array(r0[None]), nd.array(lags[None]),
+                nd.array(mkv[None]),
+                nd.array(np.array([n], np.float32)),
+                nd.array(np.array([mt_win], np.float32)))
+
+        ll_full, _ = run(mu, a, b, r0, times, marks, 0.0, mt)
+        ll1, s1 = run(mu, a, b, r0, t1, m1, 0.0, split)
+        ll2, _ = run(mu, a, b, s1.asnumpy()[0], t2 - split, m2, 0.0,
+                     mt - split)
+        total = float(np.asarray(ll1.asnumpy()).ravel()[0]) + \
+            float(np.asarray(ll2.asnumpy()).ravel()[0])
+        np.testing.assert_allclose(
+            float(np.asarray(ll_full.asnumpy()).ravel()[0]), total,
+            rtol=1e-4)

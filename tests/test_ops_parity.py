@@ -335,6 +335,10 @@ TEMPLATES = {
     "boolean_mask": lambda f: f(X(4, 3), nd.array(
         np.array([1, 0, 1, 1], np.float32))),
     "gradientmultiplier": lambda f: f(X(2, 3), scalar=0.5),
+    "hawkesll": lambda f: f(X(1, 2), X(2), X(2), X(1, 2), X(1, 4),
+                            nd.array(np.zeros((1, 4), np.float32)),
+                            nd.array(np.array([3.0], np.float32)),
+                            nd.array(np.array([5.0], np.float32))),
     "cond": lambda f: f(nd.ones((1,)), lambda: nd.ones((2,)),
                         lambda: nd.zeros((2,))),
     "foreach": lambda f: f(lambda x, s: (x + s[0], [x + s[0]]),

@@ -326,7 +326,8 @@ ROWS["Contrib — misc (REF:src/operator/contrib/)"] = [
     ("interleaved_matmul_selfatt_valatt", "divergent", "kernels.flash_attention", "same"),
     ("interleaved_matmul_encdec_qk", "divergent", "kernels.flash_attention", "same"),
     ("interleaved_matmul_encdec_valatt", "divergent", "kernels.flash_attention", "same"),
-    ("hawkesll", "not-planned", "", "Hawkes point-process likelihood; niche, no workload"),
+    ("hawkesll", "yes", "nd.contrib.hawkesll",
+     "lax.scan O(1)-per-event excitation recursion; brute-force-oracle and state-carry composition tested"),
     ("dgl_csr_neighbor_uniform_sample", "not-planned", "",
      "DGL graph-sampling family (6 ops): graph workloads out of scope per SURVEY"),
     ("edge_id", "not-planned", "", "DGL family"),

@@ -754,3 +754,77 @@ def bipartite_matching(data, is_ascend=False, threshold=None, topk=-1,
 __all__ += ["quadratic", "arange_like", "allclose", "div_sqrt_dim",
             "index_copy", "index_array", "gradientmultiplier", "fft",
             "ifft", "AdaptiveAvgPooling2D", "bipartite_matching"]
+
+
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time,
+             **kw):
+    """Log-likelihood of a multivariate Hawkes process with exponential
+    kernels (REF:src/operator/contrib/hawkes_ll.cc).
+
+    lda (N, K): background rates μ; alpha (K,): branching ratios;
+    beta (K,): decay rates; state (N, K): the per-mark excitation
+    recursion carried across calls (truncated sequences); lags (N, T):
+    INTER-ARRIVAL times; marks (N, T) int: event types; valid_length
+    (N,): events actually present; max_time (N,): the ABSOLUTE end of
+    the observation window measured from this call's origin (t=0) — NOT
+    a delta after the last event.  Returns (loglik (N,),
+    new_state (N, K)).
+
+    λ_k(t) = μ_k + α_k β_k Σ_{t_j<t, m_j=k} exp(−β_k (t−t_j)); the sum
+    rides the standard O(1) per-event recursion — a `lax.scan` over the
+    padded event axis (compiler-friendly: no data-dependent trip counts;
+    padded steps are masked by valid_length)."""
+
+    def f(lda_, alpha_, beta_, state_, lags_, marks_, vl_, mt_):
+        N, K = lda_.shape
+        T = lags_.shape[1]
+        a = alpha_.astype(jnp.float32)
+        b = beta_.astype(jnp.float32)
+        mu = lda_.astype(jnp.float32)
+
+        def seq_ll(mu_i, s0, lag_i, mark_i, vl_i, mt_i):
+            def step(carry, inp):
+                r, ll, t_ = carry            # r: (K,) excitation sums
+                lag, mark, idx = inp
+                valid = idx < vl_i
+                decay = jnp.exp(-b * lag)
+                r_dec = r * decay
+                lam = mu_i[mark] + a[mark] * b[mark] * r_dec[mark]
+                ll = ll + jnp.where(valid, jnp.log(jnp.maximum(lam, 1e-30)),
+                                    0.0)
+                r_new = r_dec.at[mark].add(1.0)
+                r = jnp.where(valid, r_new, r)
+                t_ = t_ + jnp.where(valid, lag, 0.0)
+                return (r, ll, t_), None
+
+            init = (s0.astype(jnp.float32), jnp.float32(0.0),
+                    jnp.float32(0.0))
+            (r, ll, t_last), _ = jax.lax.scan(
+                step, init,
+                (lag_i.astype(jnp.float32), mark_i.astype(jnp.int32),
+                 jnp.arange(T)))
+            # compensator: ∫_0^{mt} λ_k dt = μ_k·mt + α_k·(r0_k + n_k −
+            # r_k·e^{−β_k (mt − t_last)}) — each event (and the carried-in
+            # excitation r0) contributes α(1 − e^{−β(mt − t_i)}); the
+            # scan's r already holds Σ e^{−β(t_last − t_i)} including the
+            # decayed r0, so only the COUNT n_k needs separate masking
+            valid_mask = (jnp.arange(T) < vl_i).astype(jnp.float32)
+            n_k = (jax.nn.one_hot(mark_i.astype(jnp.int32), K,
+                                  dtype=jnp.float32) *
+                   valid_mask[:, None]).sum(axis=0)          # (K,)
+            tail = jnp.exp(-b * jnp.maximum(mt_i - t_last, 0.0))
+            comp = jnp.sum(mu_i * mt_i +
+                           a * (s0.astype(jnp.float32) + n_k - r * tail))
+            new_state = r * tail  # decay the carry to the horizon
+            return ll - comp, new_state
+
+        return jax.vmap(seq_ll)(mu, state_.astype(jnp.float32),
+                                lags_, marks_, vl_.astype(jnp.int32),
+                                mt_.astype(jnp.float32))
+
+    res = _apply(f, [lda, alpha, beta, state, lags, marks, valid_length,
+                     max_time], "hawkesll")
+    return res
+
+
+__all__ += ["hawkesll"]
