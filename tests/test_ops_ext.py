@@ -1103,3 +1103,80 @@ class TestHawkesLL:
         np.testing.assert_allclose(
             float(np.asarray(ll_full.asnumpy()).ravel()[0]), total,
             rtol=1e-4)
+
+
+def test_identity_attach_kl_sparse_reg():
+    """Identity fwd; backward adds penalty*KL'(rho||rho_hat) per unit;
+    moving_avg aux rebound in place with momentum."""
+    from tpu_mx import autograd
+    x = (rs.rand(8, 4) * 0.8 + 0.1).astype(np.float32)  # (0,1) acts
+    ma0 = np.full(4, 0.5, np.float32)
+    ma = nd.array(ma0)
+    xx = nd.array(x)
+    xx.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(xx, sparseness_target=0.2,
+                                           penalty=0.01, momentum=0.9,
+                                           moving_avg=ma)
+        out.sum().backward()
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)  # identity
+    rho_hat = np.clip(0.9 * ma0 + 0.1 * x.mean(0), 1e-6, 1 - 1e-6)
+    kl = 0.01 * (-0.2 / rho_hat + 0.8 / (1 - rho_hat))
+    np.testing.assert_allclose(xx.grad.asnumpy(),
+                               np.broadcast_to(1.0 + kl, (8, 4)),
+                               rtol=1e-5)
+    # aux rebound with momentum
+    np.testing.assert_allclose(ma.asnumpy(), 0.9 * ma0 + 0.1 * x.mean(0),
+                               rtol=1e-6)
+    # without moving_avg: batch mean alone
+    xx2 = nd.array(x)
+    xx2.attach_grad()
+    with autograd.record():
+        nd.IdentityAttachKLSparseReg(xx2, sparseness_target=0.2,
+                                     penalty=0.01).sum().backward()
+    rho_hat2 = np.clip(x.mean(0), 1e-6, 1 - 1e-6)
+    kl2 = 0.01 * (-0.2 / rho_hat2 + 0.8 / (1 - rho_hat2))
+    np.testing.assert_allclose(xx2.grad.asnumpy(),
+                               np.broadcast_to(1.0 + kl2, (8, 4)),
+                               rtol=1e-5)
+
+
+def test_identity_attach_kl_sparse_reg_aux_semantics():
+    """Aux updates only on TRAINING forwards; traces with moving_avg
+    error loudly instead of silently freezing the statistic."""
+    from tpu_mx import autograd
+    from tpu_mx.base import MXNetError
+    x = (rs.rand(8, 4) * 0.8 + 0.1).astype(np.float32)
+    ma0 = np.full(4, 0.5, np.float32)
+    ma = nd.array(ma0)
+    # inference forward: moving_avg untouched
+    nd.IdentityAttachKLSparseReg(nd.array(x), moving_avg=ma)
+    np.testing.assert_array_equal(ma.asnumpy(), ma0)
+    # training forward: updated with momentum
+    xx = nd.array(x)
+    xx.attach_grad()
+    with autograd.record():
+        nd.IdentityAttachKLSparseReg(xx, moving_avg=ma).sum().backward()
+    np.testing.assert_allclose(ma.asnumpy(), 0.9 * ma0 + 0.1 * x.mean(0),
+                               rtol=1e-6)
+    # hybridize trace with moving_avg: loud error (batch-mean mode works)
+    from tpu_mx.gluon import nn
+
+    class Net(mx.gluon.HybridBlock):
+        def __init__(self, ma=None):
+            super().__init__()
+            self._ma = ma
+
+        def hybrid_forward(self, F, x):
+            return F.IdentityAttachKLSparseReg(x, moving_avg=self._ma)
+
+    net = Net(ma)
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(MXNetError, match="moving_avg"):
+        net(nd.array(x))
+    net2 = Net(None)
+    net2.initialize()
+    net2.hybridize()
+    out = net2(nd.array(x))
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)

@@ -103,6 +103,7 @@ TEMPLATES = {
     "LayerNorm": lambda f: f(X(2, 6), X(6), X(6)),
     "LeakyReLU": lambda f: f(X(2, 3)),
     "MakeLoss": lambda f: f(X(2, 3)),
+    "IdentityAttachKLSparseReg": lambda f: f(X(4, 3)),
     "Pad": lambda f: f(NCHW(), mode="constant",
                        pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
     "pad": lambda f: f(NCHW(), mode="constant",

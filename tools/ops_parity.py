@@ -40,7 +40,8 @@ ROWS["Neural network (REF:src/operator/nn, *.cc at src/operator/)"] = [
     ("FullyConnected", "yes", "nd.FullyConnected", ""),
     ("GridGenerator", "yes", "nd.GridGenerator", ""),
     ("GroupNorm", "yes", "nd.GroupNorm", ""),
-    ("IdentityAttachKLSparseReg", "not-planned", "", "deprecated sparse-activation regularizer, unused in 1.x examples"),
+    ("IdentityAttachKLSparseReg", "yes", "nd.IdentityAttachKLSparseReg",
+     "identity fwd + injected KL sparsity grad; moving-average aux rebound in place"),
     ("InstanceNorm", "yes", "nd.InstanceNorm", ""),
     ("L2Normalization", "yes", "nd.L2Normalization", ""),
     ("LRN", "yes", "nd.LRN", ""),

@@ -1746,3 +1746,65 @@ Softmax = _deprecated_v1(SoftmaxOutput, "Softmax", "softmax_output.cc")
 Convolution_v1 = _deprecated_v1(Convolution, "Convolution_v1",
                                 "convolution_v1.cc")
 Pooling_v1 = _deprecated_v1(Pooling, "Pooling_v1", "pooling_v1.cc")
+
+
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
+                              momentum=0.9, moving_avg=None, **kw):
+    """Identity forward + KL sparsity-regularization gradient
+    (REF:src/operator/identity_attach_KL_sparse_reg.cc — the sparse-
+    autoencoder penalty).  The forward passes `data` through unchanged;
+    the backward ADDS penalty·KL'(ρ‖ρ̂) per hidden unit, where ρ is
+    `sparseness_target` and ρ̂ the (moving-average) mean activation of
+    that unit over the batch: d/da = penalty·(−ρ/ρ̂ + (1−ρ)/(1−ρ̂)).
+
+    `moving_avg` (units,) carries ρ̂ across calls with `momentum` and is
+    REBOUND in place (the op's aux state upstream — the FMutateInputs
+    idiom used by the raw optimizer kernels here); omit it to use the
+    current batch mean alone.  Activations are expected in (0, 1)
+    (post-sigmoid), as upstream assumes; ρ̂ is clamped away from {0, 1}."""
+    rho = float(sparseness_target)
+    pen = float(penalty)
+    mom = float(momentum)
+    use_ma = moving_avg is not None
+    if use_ma and _functional.active():
+        from ..base import MXNetError
+        raise MXNetError(
+            "IdentityAttachKLSparseReg: the moving_avg aux cannot be "
+            "updated inside a hybridize/compiled trace (the rebind would "
+            "silently freeze at the trace-time value); use the batch-mean "
+            "mode (moving_avg=None) under hybridize, or train this block "
+            "eagerly")
+    from .. import autograd as _ag
+    # aux semantics match upstream: ρ̂ updates only on TRAINING forwards
+    # (inference passes must not corrupt the training statistics), and
+    # the blend is computed exactly once
+    rho_hat_const = None
+    if use_ma:
+        x_now = _raw(data)
+        batch_mean = x_now.reshape(x_now.shape[0], -1).mean(axis=0)
+        ma_val = _raw(moving_avg)
+        new_ma = mom * ma_val.reshape(-1) + (1 - mom) * batch_mean
+        rho_hat_const = jnp.clip(new_ma, 1e-6, 1.0 - 1e-6)
+        if _ag.is_recording():
+            moving_avg._rebind(
+                new_ma.reshape(ma_val.shape).astype(moving_avg.dtype))
+
+    @jax.custom_vjp
+    def head(x):
+        return x
+
+    def head_fwd(x):
+        if rho_hat_const is not None:
+            rho_hat = rho_hat_const
+        else:
+            rho_hat = jnp.clip(x.reshape(x.shape[0], -1).mean(axis=0),
+                               1e-6, 1.0 - 1e-6)
+        return x, (x.shape, rho_hat)
+
+    def head_bwd(res, g):
+        shape, rho_hat = res
+        kl_grad = pen * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + kl_grad.reshape((1,) + shape[1:]),)
+
+    head.defvjp(head_fwd, head_bwd)
+    return _apply(head, [data], "IdentityAttachKLSparseReg")
