@@ -84,6 +84,11 @@ def persist_lastgood(rec):
     if os.environ.get("BENCH_SMOKE") == "1" or \
             "smoke" in rec.get("metric", ""):
         return
+    if rec.get("metric") == "weak_scaling_efficiency_dp1":
+        # single-device placeholder (trivially 1.0), not a measurement —
+        # it must never enter the store, where freshest-wins grafting
+        # would let it shadow a real multi-device scaling record
+        return
     try:
         path = _lastgood_path()
         try:
@@ -129,11 +134,60 @@ def load_lastgood():
                    and v["record"]["value"] > 0]
         if not entries:
             return None, None
+
+        def _graft_subs(v):
+            # the store holds bert/scaling under their own metric keys
+            # (always at least as fresh as any copy nested inside the
+            # primary record, since the same run writes both) — serve the
+            # per-key record of each alongside the primary.  Scaling keys
+            # are dynamic (weak_scaling_efficiency_dp{n}), hence the
+            # prefix match.
+            rec = dict(v["record"])
+            own = str(rec.get("metric") or "")
+
+            def _field_of(metric):
+                if metric == "bert_base_train_seqs_per_sec_per_chip":
+                    return "bert"
+                if metric.startswith("weak_scaling_efficiency"):
+                    # dynamic dp{n} key family — freshest wins, not
+                    # dict order
+                    return "scaling"
+                return None
+
+            own_field = _field_of(own)
+            best = {}  # field -> store entry; freshest measured_at wins
+            for key, sub in records.items():
+                if key == own or not (isinstance(sub, dict)
+                                      and isinstance(sub.get("record"),
+                                                     dict)):
+                    continue
+                # same validity bar as primary selection: a null/zero
+                # record must not be grafted either
+                if not isinstance(sub["record"].get("value"),
+                                  (int, float)) or sub["record"]["value"] <= 0:
+                    continue
+                field = _field_of(key)
+                # never graft a sibling of the primary's own family (a
+                # scaling primary carrying a staler scaling nested inside
+                # itself would be contradictory, not supplementary)
+                if field is None or field == own_field:
+                    continue
+                if field not in best or str(sub.get("measured_at", "")) > \
+                        str(best[field].get("measured_at", "")):
+                    best[field] = sub
+            for field, sub in best.items():
+                # carry the sub's own timestamp: it may come from a
+                # different run than the primary, and this harness exists
+                # because freshness misattribution cost round 3 its record
+                rec[field] = dict(sub["record"],
+                                  measured_at=sub.get("measured_at"))
+            return v.get("measured_at"), rec
+
         for v in entries:
             if v["record"].get("metric") == PRIMARY_METRIC:
-                return v.get("measured_at"), v["record"]
+                return _graft_subs(v)
         v = max(entries, key=lambda v: str(v.get("measured_at", "")))
-        return v.get("measured_at"), v["record"]
+        return _graft_subs(v)
     except Exception:
         return None, None
 
@@ -210,7 +264,10 @@ def _run_ladder(tag, ladder, once):
 
 
 def bench_resnet(smoke, layout, stem):
-    ladder = _batch_ladder("BENCH_BATCH", (8,) if smoke else (512, 256))
+    # 256-first: the r4 on-chip sweep measured 256 > 384 > 512
+    # (2379 / 2275 / 2254 img/s) — past ~256 the extra HBM pressure
+    # costs more than the MXU fill gains.
+    ladder = _batch_ladder("BENCH_BATCH", (8,) if smoke else (256, 128))
     return _run_ladder("resnet", ladder,
                        lambda b: _resnet_once(smoke, layout, stem, b))
 
@@ -501,6 +558,15 @@ def inner():
                     "error": f"{type(e).__name__}: {e}"[:300]}
     if rec is None:
         rec = bert_rec or scal_rec
+    # persist each sub-record under its OWN metric key too: the combined
+    # record is keyed by the resnet metric, so a later resnet-only run
+    # would otherwise clobber the nested bert/scaling measurements out of
+    # the store (exactly what the r4 batch sweep did to the first-ever
+    # hardware BERT number before this fix)
+    for sub in (bert_rec, scal_rec):
+        # persist_lastgood itself refuses smoke + dp1-placeholder records
+        if sub is not None and sub is not rec and "error" not in sub:
+            persist_lastgood(sub)
     if bert_rec is not None and rec is not bert_rec:
         rec["bert"] = bert_rec
     if scal_rec is not None and rec is not scal_rec:

@@ -75,9 +75,103 @@ def test_secondary_metric_never_clobbers_primary(tmp_path, monkeypatch):
     bench.persist_lastgood({"metric": "weak_scaling_efficiency_dp8",
                             "value": 1.0})
     ts, loaded = bench.load_lastgood()
-    assert loaded == resnet
+    # the primary stays the stale-emission choice, with the independently
+    # stored bert + scaling records grafted in (a resnet-only run must not
+    # cost the round its bert measurement — the r4 batch sweep did exactly
+    # that), each carrying its OWN measured_at (they may come from
+    # different runs than the primary)
+    assert loaded["value"] == 2400.75
+    assert loaded["bert"]["value"] == 150.0
+    assert loaded["scaling"]["value"] == 1.0
+    assert loaded["bert"]["measured_at"] and loaded["scaling"]["measured_at"]
     store = json.loads((tmp_path / "lg.json").read_text())
     assert len(store["records"]) == 3  # all three survive side by side
+
+
+def test_scaling_graft_freshest_wins_and_dp1_placeholder_skipped(
+        tmp_path, monkeypatch):
+    """The scaling key family is dynamic (weak_scaling_efficiency_dp{n});
+    the graft must pick the freshest by measured_at, not dict order, and
+    the single-device dp1 placeholder must never mask a real record."""
+    monkeypatch.setenv("BENCH_LASTGOOD_PATH", str(tmp_path / "lg.json"))
+    bench = _load_bench_module()
+    bench.persist_lastgood({"metric": bench.PRIMARY_METRIC, "value": 2400.0})
+    # hand-write two scaling entries with explicit timestamps (older dp8
+    # real record listed AFTER a newer-keyed entry to defeat dict order)
+    store = json.loads((tmp_path / "lg.json").read_text())
+    store["records"]["weak_scaling_efficiency_dp4"] = {
+        "measured_at": "2026-07-31T00:00:00+0000",
+        "record": {"metric": "weak_scaling_efficiency_dp4", "value": 0.93}}
+    store["records"]["weak_scaling_efficiency_dp8"] = {
+        "measured_at": "2026-07-30T00:00:00+0000",
+        "record": {"metric": "weak_scaling_efficiency_dp8", "value": 0.91}}
+    (tmp_path / "lg.json").write_text(json.dumps(store))
+    _, loaded = bench.load_lastgood()
+    assert loaded["scaling"]["value"] == 0.93  # freshest, not last-listed
+    # the dp1 placeholder is refused at the persist layer itself (it can
+    # reach persist_lastgood both via the sub-record loop and as the
+    # top-level record of a scaling-only run)
+    bench.persist_lastgood({"metric": "weak_scaling_efficiency_dp1",
+                            "value": 1.0})
+    store = json.loads((tmp_path / "lg.json").read_text())
+    assert "weak_scaling_efficiency_dp1" not in store["records"]
+
+
+def test_graft_skips_invalid_and_own_family_records(tmp_path, monkeypatch):
+    """A null/zero per-key record must not be grafted (same validity bar
+    as primary selection), and a scaling primary must not carry a staler
+    sibling scaling record nested inside itself."""
+    monkeypatch.setenv("BENCH_LASTGOOD_PATH", str(tmp_path / "lg.json"))
+    bench = _load_bench_module()
+    store = {"records": {
+        "weak_scaling_efficiency_dp4": {
+            "measured_at": "2026-07-31T00:00:00+0000",
+            "record": {"metric": "weak_scaling_efficiency_dp4",
+                       "value": 0.93}},
+        "weak_scaling_efficiency_dp8": {
+            "measured_at": "2026-07-30T00:00:00+0000",
+            "record": {"metric": "weak_scaling_efficiency_dp8",
+                       "value": 0.91}},
+        "bert_base_train_seqs_per_sec_per_chip": {
+            "measured_at": "2026-07-31T00:00:00+0000",
+            "record": {"metric": "bert_base_train_seqs_per_sec_per_chip",
+                       "value": None}},
+    }}
+    (tmp_path / "lg.json").write_text(json.dumps(store))
+    _, loaded = bench.load_lastgood()
+    # fallback primary = freshest entry (dp4); no sibling scaling nested,
+    # and the null bert record is not grafted
+    assert loaded["metric"] == "weak_scaling_efficiency_dp4"
+    assert "scaling" not in loaded and "bert" not in loaded
+
+
+def test_bert_only_store_never_self_nests(tmp_path, monkeypatch):
+    """When the only stored record IS the bert record, the graft must not
+    nest it inside itself."""
+    monkeypatch.setenv("BENCH_LASTGOOD_PATH", str(tmp_path / "lg.json"))
+    bench = _load_bench_module()
+    bert = {"metric": "bert_base_train_seqs_per_sec_per_chip",
+            "value": 150.0}
+    bench.persist_lastgood(bert)
+    ts, loaded = bench.load_lastgood()
+    assert loaded == bert and "bert" not in loaded
+
+
+def test_graft_prefers_per_key_record_over_nested_copy(tmp_path,
+                                                       monkeypatch):
+    """The per-metric key is written by the same run that measured it, so
+    it is always at least as fresh as a copy nested inside the primary —
+    a later bert-only run must win over the stale nested value."""
+    monkeypatch.setenv("BENCH_LASTGOOD_PATH", str(tmp_path / "lg.json"))
+    bench = _load_bench_module()
+    resnet = {"metric": bench.PRIMARY_METRIC, "value": 2400.0,
+              "bert": {"metric": "bert_base_train_seqs_per_sec_per_chip",
+                       "value": 456.0}}
+    bench.persist_lastgood(resnet)
+    bench.persist_lastgood({"metric": "bert_base_train_seqs_per_sec_per_chip",
+                            "value": 500.0})
+    _, loaded = bench.load_lastgood()
+    assert loaded["bert"]["value"] == 500.0
 
 
 def test_corrupt_store_never_raises(tmp_path, monkeypatch):
