@@ -79,7 +79,12 @@ def probe_one(model, batch):
         # A/B the attention path: at T=128 the single-block flash kernel
         # vs XLA's fused dense attention is an empirical question.  The
         # env knob is read at TRACE time, so it must span compile+timing.
+        # Since auto now RESOLVES to dense at short T (the measured r4
+        # winner), the flash arm needs an explicit pin — the plain 'bert'
+        # config measures what production auto picks.
         model, attn_override = "bert", "dense"
+    elif model == "bert_flash":
+        model, attn_override = "bert", "flash"
     with contextlib.ExitStack() as stack:
         if attn_override:
             prior = os.environ.get("TPUMX_ATTENTION")
@@ -165,8 +170,8 @@ def main():
     ap.add_argument("--out", default=os.path.join(REPO,
                                                   "MFU_PROBE_r04.json"))
     ap.add_argument("--configs",
-                    default="resnet:512,resnet:256,bert:512,bert:256,"
-                            "bert_dense:256")
+                    default="resnet:256,resnet:512,bert:512,bert:256,"
+                            "bert_flash:512")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (harness smoke; mirrors conftest)")
     args = ap.parse_args()

@@ -114,7 +114,7 @@ def _fwd_kernel(*refs, scale, causal, masked, rate, biased, block_q,
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
-    valid = valid_ref[0] if masked else None
+    valid = valid_ref[jax.lax.rem(b, _VALID_BLOCK)] if masked else None
 
     @pl.when(ki == 0)
     def _init():
@@ -204,14 +204,31 @@ def _bias_spec(bias, bh, bq, bk, swap=False):
                         memory_space=pltpu.VMEM)
 
 
+# SMEM block length for the per-batch valid-key vector.  Real Mosaic
+# requires rank-1 blocks to be the whole array or a multiple of the
+# 128-lane tiling (interpret mode accepts (1,) blocks, the r4 chip did
+# not) — so the (BH,) vector is padded to a 128 multiple, streamed in
+# (128,) blocks selected by b // 128, and indexed b % 128 in-kernel.
+_VALID_BLOCK = 128
+
+
+def _pad_valid(kv_valid):
+    bh = kv_valid.shape[0]
+    padded = _cdiv(bh, _VALID_BLOCK) * _VALID_BLOCK
+    if padded != bh:
+        kv_valid = jnp.pad(kv_valid, (0, padded - bh))
+    return kv_valid
+
+
 def _extra_specs_and_args(kv_valid, seed):
     """(in_specs tail, args tail) for the optional valid/seed SMEM scalars.
     Index maps ignore the grid position except the leading batch axis."""
     specs, args = [], []
     if kv_valid is not None:
-        specs.append(pl.BlockSpec((1,), lambda b, i, j: (b,),
+        specs.append(pl.BlockSpec((_VALID_BLOCK,),
+                                  lambda b, i, j: (b // _VALID_BLOCK,),
                                   memory_space=pltpu.SMEM))
-        args.append(kv_valid)
+        args.append(_pad_valid(kv_valid))
     if seed is not None:
         specs.append(pl.BlockSpec((1,), lambda b, i, j: (0,),
                                   memory_space=pltpu.SMEM))
@@ -290,7 +307,7 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, rate, biased, block_q,
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
-    valid = valid_ref[0] if masked else None
+    valid = valid_ref[jax.lax.rem(b, _VALID_BLOCK)] if masked else None
 
     @pl.when(ki == 0)
     def _init():
@@ -355,7 +372,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, rate, biased, block_q,
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
-    valid = valid_ref[0] if masked else None
+    valid = valid_ref[jax.lax.rem(b, _VALID_BLOCK)] if masked else None
 
     @pl.when(qi == 0)
     def _init():

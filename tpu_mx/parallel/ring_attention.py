@@ -42,6 +42,15 @@ _logger = logging.getLogger(__name__)
 # it is once per new shape/dtype — so a shape regression that silently drops
 # the Pallas kernel shows up exactly once, not once per step (VERDICT r1
 # weak#6).  Mirrored into profiler counters.
+def _dense_max_kv():
+    """Largest kv_len at which 'auto' prefers XLA dense attention over the
+    Pallas flash kernel (r4 on-chip A/B, see local_flash_attention); the
+    flash kernel's 128-row/col blocking means anything <=128 is a single
+    block where the kernel's grid overhead cannot amortize.  Read per call
+    (like TPUMX_ATTENTION) so probes can sweep the crossover at runtime."""
+    return int(os.environ.get("TPUMX_DENSE_MAX_KV", "128"))
+
+
 dispatch_counts = {"ring": 0, "ulysses": 0, "pallas_flash": 0,
                    "xla_dense": 0}
 _seen_signatures = set()
@@ -289,16 +298,20 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
     on_tpu = jax.default_backend() == "tpu"
     dropped = dropout_rate > 0.0 and dropout_key is not None
     rate = float(dropout_rate) if dropped else 0.0
-    # TPUMX_ATTENTION=dense|flash|auto (default auto): measurement knob —
-    # at short T (e.g. BERT's 128) the single-block Pallas kernel and
-    # XLA's fused dense attention are close enough that the winner should
-    # be benched, not assumed.  'flash' only forces the kernel where
-    # supported() holds; 'dense' always works.
+    # TPUMX_ATTENTION=dense|flash|auto (default auto): at short T the
+    # O(T²) score matrix is a single MXU tile and XLA's fused dense
+    # attention beats the Pallas kernel's grid/DMA overhead — measured on
+    # the r4 chip at T=128, BERT-base batch 512: dense 577 seq/s vs flash
+    # 454 (MFU_PROBE_r04.json).  'auto' therefore picks dense up to
+    # TPUMX_DENSE_MAX_KV (default 128) and flash beyond; 'flash'/'dense'
+    # pin the path
+    # ('flash' only where supported() holds; 'dense' always works).
     mode = os.environ.get("TPUMX_ATTENTION", "auto")
     if mode not in ("auto", "dense", "flash"):
         raise ValueError(f"TPUMX_ATTENTION must be auto|dense|flash, "
                          f"got {mode!r}")
-    want_flash = on_tpu and mode != "dense"
+    want_flash = on_tpu and mode != "dense" and \
+        not (mode == "auto" and k.shape[2] <= _dense_max_kv())
     if want_flash and fa.supported(q.shape, q.dtype, kv_len=k.shape[2],
                                    dropout_rate=rate):
         _count("pallas_flash", f"shape={q.shape}")
@@ -308,11 +321,12 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
                                       valid_length=valid_length,
                                       dropout_rate=rate, dropout_seed=seed,
                                       bias=bias)
-    # CPU dense is expected, and a DELIBERATE dense pin (the A/B knob)
-    # must not fire the perf-regression warning it exists to enable
+    # CPU dense is expected, and a DELIBERATE dense choice (the A/B pin,
+    # or auto's measured short-T preference) must not fire the
+    # perf-regression warning — it exists for wanted-but-unsupported flash
     _count("xla_dense",
            f"shape={q.shape} dtype={q.dtype} kv_len={k.shape[2]}",
-           warn=on_tpu and mode != "dense")
+           warn=want_flash)
     scale = 1.0 / math.sqrt(q.shape[-1])
     mask = _dense_mask(q.shape[2], k.shape[2], causal, valid_length)
     m, l, o = _block_attn(q, k, v, bias=bias, mask=mask, scale=scale,
