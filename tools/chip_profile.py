@@ -1,0 +1,157 @@
+"""Capture a real-chip profiler trace of a compiled train step and break
+the step time down by XLA op category (VERDICT r3 ask#3: find where the
+ResNet step's time actually goes before guessing at levers).
+
+Runs the step under jax.profiler.trace, then parses the newest
+vm.trace.json.gz chrome trace: device-track complete events ("ph":"X")
+are bucketed by op-name family (fusion / convolution / copy / ...) and
+written to PROFILE_STEP_r04.json with per-family total microseconds and
+the top individual ops.
+
+Usage (ONE jax process at a time — see .claude/skills/verify):
+    python tools/chip_profile.py [--model resnet|bert] [--batch N]
+        [--steps N] [--out PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(msg):
+    print(f"[profile {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+_FAMILY = re.compile(r"^([a-zA-Z_\-]+)")
+
+
+def family(name):
+    """'fusion.1234' -> 'fusion'; '%convolution.5' -> 'convolution'."""
+    m = _FAMILY.match(name.lstrip("%"))
+    return m.group(1).rstrip(".-_") if m else name
+
+
+def parse_trace(trace_dir, n_steps):
+    """Aggregate device-lane complete events from the newest chrome trace
+    under trace_dir.  Heuristic for device tracks: process names carrying
+    'TPU' / 'Device' (host python/threads are excluded); falls back to
+    every track if none match (CPU smoke)."""
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and "args" in e}
+    tid_names = {(e["pid"], e["tid"]): e["args"].get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"
+                 and "args" in e}
+    device_pids = {p for p, n in pid_names.items()
+                   if "TPU" in n or "Device" in n or "/device" in n.lower()}
+    if not device_pids:
+        device_pids = set(pid_names)
+    # per-op timings live on the 'XLA Ops' lane; the 'Steps' / 'XLA
+    # Modules' lanes are whole-step envelopes that would double-count
+    op_lanes = {k for k, n in tid_names.items()
+                if k[0] in device_pids and n == "XLA Ops"}
+    fam_us, op_us, op_count = {}, {}, {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if op_lanes:
+            if (e.get("pid"), e.get("tid")) not in op_lanes:
+                continue
+        elif e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "?")
+        dur = float(e.get("dur", 0.0))
+        fam = family(name)
+        fam_us[fam] = fam_us.get(fam, 0.0) + dur
+        op_us[name] = op_us.get(name, 0.0) + dur
+        op_count[name] = op_count.get(name, 0) + 1
+    per_step = {k: round(v / n_steps, 1) for k, v in fam_us.items()}
+    top = sorted(op_us.items(), key=lambda kv: -kv[1])[:25]
+    return {
+        "trace_file": paths[-1],
+        "families_us_per_step": dict(
+            sorted(per_step.items(), key=lambda kv: -kv[1])),
+        "total_device_us_per_step": round(sum(fam_us.values()) / n_steps, 1),
+        "top_ops": [{"name": n, "us_per_step": round(v / n_steps, 1),
+                     "calls_per_step": round(op_count[n] / n_steps, 1)}
+                    for n, v in top],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet",
+                    choices=["resnet", "bert"])
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "PROFILE_STEP_r04.json"))
+    ap.add_argument("--trace-dir", default="/tmp/tpumx_chip_trace")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from tpu_mx.runtime import enable_shared_compilation_cache
+        enable_shared_compilation_cache()
+    import numpy as np
+    import hlo_inspect
+
+    smoke = args.cpu
+    log(f"building {args.model} batch={args.batch}...")
+    if args.model == "resnet":
+        step, batch_args = hlo_inspect.build_resnet_step(smoke, args.batch)
+    else:
+        step, batch_args = hlo_inspect.build_bert_step(smoke, args.batch)
+    fetch = lambda l: float(np.asarray(l._data).ravel()[0])
+    log("compiling + warmup...")
+    fetch(step.step(*batch_args))
+    fetch(step.step(*batch_args))
+
+    log(f"tracing {args.steps} steps...")
+    os.makedirs(args.trace_dir, exist_ok=True)
+    with jax.profiler.trace(args.trace_dir):
+        loss = None
+        for _ in range(args.steps):
+            loss = step.step(*batch_args)
+        fetch(loss)
+
+    log("parsing trace...")
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "platform": jax.devices()[0].platform,
+           "model": args.model, "batch": args.batch, "steps": args.steps}
+    rec.update(parse_trace(args.trace_dir, args.steps))
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+    log(f"wrote {args.out}")
+    fams = rec["families_us_per_step"]
+    for k in list(fams)[:12]:
+        log(f"  {k:<28} {fams[k]:>10.1f} us/step")
+    log(f"  {'TOTAL(device)':<28} {rec['total_device_us_per_step']:>10.1f}"
+        f" us/step")
+
+
+if __name__ == "__main__":
+    main()

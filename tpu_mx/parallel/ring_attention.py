@@ -42,6 +42,10 @@ _logger = logging.getLogger(__name__)
 # it is once per new shape/dtype — so a shape regression that silently drops
 # the Pallas kernel shows up exactly once, not once per step (VERDICT r1
 # weak#6).  Mirrored into profiler counters.
+dispatch_counts = {"ring": 0, "ulysses": 0, "pallas_flash": 0,
+                   "xla_dense": 0}
+
+
 def _dense_max_kv():
     """Largest kv_len at which 'auto' prefers XLA dense attention over the
     Pallas flash kernel (r4 on-chip A/B, see local_flash_attention); the
@@ -51,8 +55,6 @@ def _dense_max_kv():
     return int(os.environ.get("TPUMX_DENSE_MAX_KV", "128"))
 
 
-dispatch_counts = {"ring": 0, "ulysses": 0, "pallas_flash": 0,
-                   "xla_dense": 0}
 _seen_signatures = set()
 
 
