@@ -25,9 +25,10 @@ set if every attempt died.
 
 Env knobs: BENCH_SMOKE=1 (CPU smoke, small shapes), BENCH_LAYOUT=NCHW
 (default NHWC), BENCH_STEM=classic (default s2d), BENCH_BATCH / BENCH_ITERS /
-BENCH_BERT_BATCH overrides, BENCH_MODELS ⊆ {resnet50, bert, scaling}
-(default resnet50,bert; scaling = weak-scaling efficiency over all visible
-devices, BASELINE metric 3),
+BENCH_BERT_BATCH / BENCH_LSTM_BATCH / BENCH_SSD_BATCH overrides,
+BENCH_MODELS ⊆ {resnet50, bert, scaling, lstm, ssd} (default resnet50,bert;
+scaling = weak-scaling efficiency over all visible devices, BASELINE
+metric 3; lstm/ssd = BASELINE workloads 3 and 5, no A100 comparator),
 BENCH_ATTEMPTS (default 3), BENCH_TIMEOUT seconds per attempt (default 900).
 """
 from __future__ import annotations
@@ -152,6 +153,10 @@ def load_lastgood():
                     # dynamic dp{n} key family — freshest wins, not
                     # dict order
                     return "scaling"
+                if metric == "lstm_ptb_train_tokens_per_sec_per_chip":
+                    return "lstm"
+                if metric == "ssd512_train_images_per_sec_per_chip":
+                    return "ssd"
                 return None
 
             own_field = _field_of(own)
@@ -434,6 +439,144 @@ def _bert_once(smoke, batch):
     return rec
 
 
+def bench_lstm(smoke):
+    """PTB word-level LSTM LM (BASELINE workload 3): medium config
+    (vocab 10k, 2×650, bptt 35), full compiled train step, tokens/s.
+    No A100 comparator ballpark exists in BASELINE.md for this workload,
+    so vs_baseline is null — the record stands as the framework's own
+    number."""
+    import numpy as np
+    import tpu_mx as mx
+    from tpu_mx import gluon, nd
+    from tpu_mx.gluon.block import HybridBlock
+    from tpu_mx.models.lstm_lm import RNNModel
+    from tpu_mx.parallel import CompiledTrainStep
+
+    if smoke:
+        vocab, emb, hid, layers, bptt, batch = 1000, 64, 64, 1, 8, 4
+        warmup, iters, repeats = 1, 3, 1
+    else:
+        vocab, emb, hid, layers, bptt, batch = 10000, 650, 650, 2, 35, 512
+        batch = int(os.environ.get("BENCH_LSTM_BATCH", batch))
+        warmup, iters, repeats = 3, 20, 3
+    iters = int(os.environ.get("BENCH_ITERS", iters))
+
+    log(f"building lstm ({layers}x{hid}, bptt={bptt}), batch={batch}")
+    model = RNNModel(mode="lstm", vocab_size=vocab, num_embed=emb,
+                     num_hidden=hid, num_layers=layers, dropout=0.0)
+    model.initialize(init="xavier")
+
+    class FlatCE(gluon.loss.Loss):
+        """CE over the flattened (T·B, V) logits — the word-LM target
+        layout (REF:example/gluon/word_language_model)."""
+
+        def __init__(self, **kw):
+            super().__init__(weight=None, batch_axis=0, **kw)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, logits, labels):
+            v = logits.shape[-1]
+            return self._ce(F.reshape(logits, shape=(-1, v)),
+                            F.reshape(labels, shape=(-1,)))
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, vocab, (bptt, batch)), dtype="float32")
+    y = nd.array(rng.randint(0, vocab, (bptt * batch,)), dtype="float32")
+    model(x)  # finalize deferred shapes (zero initial state)
+    opt = mx.optimizer.create("sgd", learning_rate=1.0)
+    step = CompiledTrainStep(model, FlatCE(), opt)
+    log("lstm: compiling full train step (first call)...")
+    tok_s = _run_timed(lambda: step.step(x, y), _fetch_loss, warmup, iters,
+                       repeats, batch * bptt, "lstm")
+    return {
+        "metric": "lstm_ptb_train_tokens_per_sec_per_chip"
+        if not smoke else "lstm_smoke_tokens_per_sec",
+        "value": round(tok_s, 2), "unit": "tok/s", "vs_baseline": None,
+        "batch": batch, "bptt": bptt, "hidden": hid, "layers": layers,
+    }
+
+
+def bench_ssd(smoke):
+    """SSD-512 detection training (BASELINE workload 5): anchors +
+    MultiBoxTarget matching with hard negative mining + CE/smooth-L1,
+    all inside ONE compiled train step (target generation included, under
+    stop_gradient — the reference runs it in the data/aux path).
+    vs_baseline is null: no comparator ballpark in BASELINE.md."""
+    import numpy as np
+    import tpu_mx as mx
+    from tpu_mx import gluon, nd
+    from tpu_mx.gluon.block import HybridBlock
+    from tpu_mx.models.ssd import SSD, SSDTrainingTargets, ssd_512
+    from tpu_mx.parallel import CompiledTrainStep
+
+    if smoke:
+        size, batch, classes = 64, 2, 3
+        warmup, iters, repeats = 1, 2, 1
+        net = SSD(classes, sizes=[[0.2, 0.35], [0.5, 0.7]],
+                  ratios=[[1, 2, 0.5]] * 2, base_filters=(8, 16))
+    else:
+        size, batch, classes = 512, 32, 20
+        batch = int(os.environ.get("BENCH_SSD_BATCH", batch))
+        warmup, iters, repeats = 3, 10, 3
+        net = ssd_512(classes)
+    iters = int(os.environ.get("BENCH_ITERS", iters))
+    targets = SSDTrainingTargets()
+
+    class SSDTrain(HybridBlock):
+        """forward(x, labels) -> per-sample loss (the tuple outputs of
+        SSD can't ride through the step's single-output contract, so the
+        loss lives in the forward; the step's loss_fn is a pass-through
+        mean)."""
+
+        def __init__(self, ssd_net, **kw):
+            super().__init__(**kw)
+            self.net = ssd_net
+            self._cls = gluon.loss.SoftmaxCrossEntropyLoss()
+            self._box = gluon.loss.HuberLoss()
+
+        def forward(self, x, labels):
+            from tpu_mx import autograd
+            anchors, cls_preds, box_preds = self.net(x)
+            with autograd.pause():
+                loc_t, loc_m, cls_t = targets(anchors, labels, cls_preds)
+            return self._cls(cls_preds, cls_t) + \
+                self._box(box_preds * loc_m, loc_t * loc_m)
+
+    class PassThrough(gluon.loss.Loss):
+        def __init__(self, **kw):
+            super().__init__(weight=None, batch_axis=0, **kw)
+
+        def hybrid_forward(self, F, loss_vec, _dummy):
+            return loss_vec
+
+    log(f"building ssd (size={size}, classes={classes}), batch={batch}")
+    wrapper = SSDTrain(net)
+    wrapper.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 3, size, size).astype(np.float32) * 0.1
+    labels = np.full((batch, 2, 5), -1.0, np.float32)
+    for b in range(batch):
+        cls = rng.randint(0, classes)
+        x0, y0 = rng.uniform(0.05, 0.5, 2)
+        x1, y1 = min(x0 + 0.3, 0.95), min(y0 + 0.3, 0.95)
+        labels[b, 0] = [cls, x0, y0, x1, y1]
+    x_nd, l_nd = nd.array(x), nd.array(labels)
+    wrapper(x_nd, l_nd)  # finalize deferred shapes
+    dummy = nd.array(np.zeros((1,), np.float32))
+    opt = mx.optimizer.create("sgd", learning_rate=0.01, momentum=0.9,
+                              wd=5e-4)
+    step = CompiledTrainStep(wrapper, PassThrough(), opt)
+    log("ssd: compiling full train step (first call)...")
+    img_s = _run_timed(lambda: step.step(x_nd, l_nd, dummy), _fetch_loss,
+                       warmup, iters, repeats, batch, "ssd")
+    return {
+        "metric": "ssd512_train_images_per_sec_per_chip"
+        if not smoke else "ssd_smoke_images_per_sec",
+        "value": round(img_s, 2), "unit": "img/s", "vs_baseline": None,
+        "batch": batch, "size": size,
+    }
+
+
 def bench_scaling(smoke):
     """Weak-scaling efficiency over all visible devices (BASELINE metric 3
     'scaling efficiency' — the full 8→256-chip number needs a pod slice;
@@ -497,7 +640,7 @@ def inner():
     models = [m.strip() for m in
               os.environ.get("BENCH_MODELS", "resnet50,bert").split(",")
               if m.strip()]
-    unknown = set(models) - {"resnet50", "bert", "scaling"}
+    unknown = set(models) - {"resnet50", "bert", "scaling", "lstm", "ssd"}
     if unknown or not models:
         raise SystemExit(f"BENCH_MODELS: unknown/empty model list {models}")
     log(f"inner start (smoke={smoke}, layout={layout}, stem={stem}, "
@@ -563,8 +706,31 @@ def inner():
         scal_rec = {"metric": "weak_scaling_efficiency", "value": 0.0,
                     "unit": "ratio", "vs_baseline": 0.0,
                     "error": f"{type(e).__name__}: {e}"[:300]}
+    # secondary workloads (BASELINE configs 3 and 5): never fatal to the
+    # primary record; persisted under their own metric keys and attached
+    # to the combined record for the session log
+    extra_recs = {}
+    extra_metrics = {"lstm": "lstm_ptb_train_tokens_per_sec_per_chip",
+                     "ssd": "ssd512_train_images_per_sec_per_chip"}
+    for name, fn_extra in (("lstm", bench_lstm), ("ssd", bench_ssd)):
+        if name not in models:
+            continue
+        try:
+            r = fn_extra(smoke)
+            log(f"{name} record: " + json.dumps(r))
+            persist_lastgood(r)
+            extra_recs[name] = r
+        except Exception as e:
+            log(f"{name} bench failed: {type(e).__name__}: {e}")
+            extra_recs[name] = {"metric": extra_metrics[name], "value": 0.0,
+                                "unit": "", "vs_baseline": None,
+                                "error": f"{type(e).__name__}: {e}"[:300]}
+    if rec is None and bert_rec is None and scal_rec is None and \
+            not any("error" not in r for r in extra_recs.values()):
+        raise SystemExit("every requested benchmark failed; see stderr")
     if rec is None:
-        rec = bert_rec or scal_rec
+        rec = bert_rec or scal_rec or next(
+            (r for r in extra_recs.values() if "error" not in r), None)
     # persist each sub-record under its OWN metric key too: the combined
     # record is keyed by the resnet metric, so a later resnet-only run
     # would otherwise clobber the nested bert/scaling measurements out of
@@ -578,6 +744,9 @@ def inner():
         rec["bert"] = bert_rec
     if scal_rec is not None and rec is not scal_rec:
         rec["scaling"] = scal_rec
+    for name, r in extra_recs.items():
+        if rec is not r:
+            rec[name] = r
     persist_lastgood(rec)
     print(json.dumps(rec), flush=True)
 

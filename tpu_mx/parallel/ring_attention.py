@@ -48,11 +48,16 @@ dispatch_counts = {"ring": 0, "ulysses": 0, "pallas_flash": 0,
 
 def _dense_max_kv():
     """Largest kv_len at which 'auto' prefers XLA dense attention over the
-    Pallas flash kernel (r4 on-chip A/B, see local_flash_attention); the
-    flash kernel's 128-row/col blocking means anything <=128 is a single
-    block where the kernel's grid overhead cannot amortize.  Read per call
-    (like TPUMX_ATTENTION) so probes can sweep the crossover at runtime."""
-    return int(os.environ.get("TPUMX_DENSE_MAX_KV", "128"))
+    Pallas flash kernel.  r4 on-chip A/B (fwd+bwd, causal, bf16, H=12,
+    D=64, constant token count): dense wins 34% at T=128, 25% at 256, 5%
+    at 512; flash wins 18% at 1024 and 31% at 2048 — the kernel's
+    grid/DMA overhead amortizes only once many 128-blocks are in flight.
+    The default stays at 512 rather than the ~768-1024 perf crossover
+    because dense materializes O(B·H·T²) probabilities in the backward,
+    and that memory cliff arrives before the perf one.  Read per call
+    (like TPUMX_ATTENTION) so probes can sweep the crossover at
+    runtime."""
+    return int(os.environ.get("TPUMX_DENSE_MAX_KV", "512"))
 
 
 _seen_signatures = set()
@@ -305,8 +310,8 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
     # attention beats the Pallas kernel's grid/DMA overhead — measured on
     # the r4 chip at T=128, BERT-base batch 512: dense 577 seq/s vs flash
     # 454 (MFU_PROBE_r04.json).  'auto' therefore picks dense up to
-    # TPUMX_DENSE_MAX_KV (default 128) and flash beyond; 'flash'/'dense'
-    # pin the path
+    # TPUMX_DENSE_MAX_KV (default 512 — see _dense_max_kv for the full
+    # crossover table) and flash beyond; 'flash'/'dense' pin the path
     # ('flash' only where supported() holds; 'dense' always works).
     mode = os.environ.get("TPUMX_ATTENTION", "auto")
     if mode not in ("auto", "dense", "flash"):
