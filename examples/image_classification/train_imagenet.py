@@ -21,18 +21,27 @@ from tpu_mx.gluon.model_zoo import vision
 from tpu_mx.parallel import CompiledTrainStep
 
 
+MEAN = (123.68, 116.78, 103.94)
+STD = (58.39, 57.12, 57.37)
+
+
 def data_iter(args):
     shape = (3, args.image_shape, args.image_shape)
     if args.data_train:
+        norm = {} if args.feed == "u8" else dict(
+            mean_r=MEAN[0], mean_g=MEAN[1], mean_b=MEAN[2],
+            std_r=STD[0], std_g=STD[1], std_b=STD[2])
         return mx.io.ImageRecordIter(
             path_imgrec=args.data_train, data_shape=shape,
             batch_size=args.batch_size, shuffle=True, rand_crop=True,
             rand_mirror=True, resize=args.image_shape + 32,
             preprocess_threads=args.data_nthreads,
-            mean_r=123.68, mean_g=116.78, mean_b=103.94,
-            std_r=58.39, std_g=57.12, std_b=57.37)
+            output_dtype="uint8" if args.feed == "u8" else "float32",
+            output_layout=args.layout, **norm)
     n = args.batch_size * (2 if args.smoke else 20)
     rng = np.random.RandomState(0)
+    if args.layout == "NHWC":
+        shape = (args.image_shape, args.image_shape, 3)
     x = rng.rand(n, *shape).astype(np.float32)
     y = rng.randint(0, args.num_classes, n).astype(np.float32)
     return mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True,
@@ -51,6 +60,13 @@ def main():
     ap.add_argument("--data-nthreads", type=int, default=8)
     ap.add_argument("--disp-batches", type=int, default=20)
     ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"],
+                    help="NHWC is the TPU-native layout (pairs with the "
+                         "s2d stem for the fast path)")
+    ap.add_argument("--stem", default="classic", choices=["classic", "s2d"])
+    ap.add_argument("--feed", default="f32", choices=["f32", "u8"],
+                    help="u8 ships raw pixels and normalizes on device: "
+                         "4x fewer host/interconnect bytes per batch")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
     if args.smoke:
@@ -58,11 +74,19 @@ def main():
         args.batch_size, args.image_shape = 8, 64
         args.lr = 0.02  # full-run lr diverges on the 16-sample smoke set
 
-    net = vision.get_model(args.network, classes=args.num_classes)
+    from tpu_mx.layout import default_layout
+    with default_layout(args.layout):
+        if args.stem != "classic":
+            # no silent fallback: an explicit --stem must be honored or fail
+            net = vision.get_model(args.network, classes=args.num_classes,
+                                   stem=args.stem)
+        else:
+            net = vision.get_model(args.network, classes=args.num_classes)
     net.initialize(init="xavier")
-    x0 = nd.array(np.zeros((args.batch_size, 3, args.image_shape,
-                            args.image_shape), np.float32))
-    net(x0)  # finalize deferred shapes
+    in_shape = (args.batch_size, args.image_shape, args.image_shape, 3) \
+        if args.layout == "NHWC" else (args.batch_size, 3,
+                                       args.image_shape, args.image_shape)
+    net(nd.array(np.zeros(in_shape, np.float32)))  # finalize deferred shapes
     net.cast("bfloat16")
 
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
@@ -72,7 +96,11 @@ def main():
 
     # device-feed double buffering: the prefetch thread device_puts (and
     # bf16-casts) batch k+1 while the chip runs batch k
-    it = mx.io.DevicePrefetchIter(data_iter(args), cast_data="bfloat16")
+    norm = dict(normalize=(MEAN, STD),
+                normalize_axis=-1 if args.layout == "NHWC" else 1) \
+        if (args.feed == "u8" and args.data_train) else {}
+    it = mx.io.DevicePrefetchIter(data_iter(args), cast_data="bfloat16",
+                                  **norm)
     for epoch in range(args.epochs):
         it.reset()
         tic, n, last_loss = time.time(), 0, float("nan")

@@ -271,7 +271,8 @@ struct PipeBase {
   uint64_t total_batches = 0;
 
   struct BatchBuf {
-    std::vector<float> data, label;
+    std::vector<uint8_t> data;  // raw bytes: batch * DataElems * ElemSize
+    std::vector<float> label;
     std::atomic<int> done{0};
     uint64_t seq = ~0ull;
   };
@@ -284,14 +285,18 @@ struct PipeBase {
   std::vector<std::thread> workers;
 
   virtual ~PipeBase() = default;
-  virtual bool DecodeOne(uint64_t pos, float* img_out, float* label_out) = 0;
-  virtual size_t DataElems() const = 0;   // per-item data floats
+  virtual bool DecodeOne(uint64_t pos, void* img_out, float* label_out) = 0;
+  virtual size_t DataElems() const = 0;   // per-item data elements
   virtual size_t LabelElems() const = 0;  // per-item label floats
+  // bytes per data element: 4 (float32, default) or 1 (uint8 feed —
+  // normalization then happens on device, and host+interconnect move 4x
+  // fewer bytes)
+  virtual size_t ElemSize() const { return 4; }
 
   void AllocBufs() {
     bufs = std::vector<BatchBuf>(prefetch);
     for (auto& b : bufs) {
-      b.data.resize(static_cast<size_t>(batch) * DataElems());
+      b.data.resize(static_cast<size_t>(batch) * DataElems() * ElemSize());
       b.label.resize(static_cast<size_t>(batch) * LabelElems());
     }
   }
@@ -348,7 +353,8 @@ struct PipeBase {
         }
       }
       int in_batch = static_cast<int>(pos % batch);
-      float* img = bb.data.data() + static_cast<size_t>(in_batch) * DataElems();
+      void* img = bb.data.data() +
+                  static_cast<size_t>(in_batch) * DataElems() * ElemSize();
       float* lab = bb.label.data() +
                    static_cast<size_t>(in_batch) * LabelElems();
       if (!DecodeOne(pos, img, lab)) {
@@ -368,7 +374,7 @@ struct PipeBase {
   }
 
   // returns records delivered (batch), 0 at epoch end, -1 on failure
-  int Next(float* data_out, float* label_out) {
+  int Next(void* data_out, float* label_out) {
     if (consumed >= total_batches) return 0;
     uint64_t bseq = consumed;
     size_t slot = bseq % bufs.size();
@@ -380,8 +386,7 @@ struct PipeBase {
       });
       if (failed) return -1;
     }
-    memcpy(data_out, bb.data.data(),
-           bb.data.size() * sizeof(float));
+    memcpy(data_out, bb.data.data(), bb.data.size());
     memcpy(label_out, bb.label.data(), bb.label.size() * sizeof(float));
     {
       std::lock_guard<std::mutex> lk(mu);
@@ -399,13 +404,18 @@ struct Pipe : PipeBase {
   int C, H, W, resize, rand_crop, rand_mirror;
   float mean[3], stdv[3];
   int label_width;
+  // TPU-feed variants: uint8 output (normalize moves on-device; 4x fewer
+  // host/interconnect bytes) and NHWC layout (the lane-friendly layout
+  // the TPU conv path wants — skips the host-side HWC->CHW transpose)
+  int out_u8 = 0, out_nhwc = 0;
 
   size_t DataElems() const override {
     return static_cast<size_t>(C) * H * W;
   }
   size_t LabelElems() const override { return label_width; }
+  size_t ElemSize() const override { return out_u8 ? 1 : 4; }
 
-  bool DecodeOne(uint64_t pos, float* img_out, float* label_out) override {
+  bool DecodeOne(uint64_t pos, void* img_out_v, float* label_out) override {
     uint32_t rec_idx = order[pos % order.size()];
     // per-thread scratch: no per-record heap churn in the hot loop
     static thread_local std::vector<uint8_t> raw;
@@ -477,22 +487,76 @@ struct Pipe : PipeBase {
     }
     if (rand_mirror) mirror = HashUniform(seed, epoch, pos, 2) < 0.5f;
 
-    // crop + mirror + normalize + HWC->CHW in one pass
-    for (int c = 0; c < C && c < 3; ++c) {
-      float m = mean[c], s = stdv[c];
-      float inv = 1.0f / s;
-      float* dst = img_out + static_cast<size_t>(c) * H * W;
+    // crop + mirror + output in one pass.  Four variants: {f32,u8} x
+    // {CHW,HWC}.  u8 skips normalization entirely (applied on device).
+    if (out_u8 && out_nhwc) {
+      uint8_t* out = static_cast<uint8_t*>(img_out_v);
       for (int yy = 0; yy < H; ++yy) {
         const uint8_t* row =
-            rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3 + c;
-        float* drow = dst + static_cast<size_t>(yy) * W;
+            rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3;
+        uint8_t* drow = out + static_cast<size_t>(yy) * W * 3;
         if (mirror) {
           for (int xx = 0; xx < W; ++xx) {
-            drow[xx] = (row[(W - 1 - xx) * 3] - m) * inv;
+            const uint8_t* px = row + (W - 1 - xx) * 3;
+            drow[xx * 3] = px[0];
+            drow[xx * 3 + 1] = px[1];
+            drow[xx * 3 + 2] = px[2];
           }
         } else {
-          for (int xx = 0; xx < W; ++xx) {
-            drow[xx] = (row[xx * 3] - m) * inv;
+          memcpy(drow, row, static_cast<size_t>(W) * 3);
+        }
+      }
+    } else if (out_u8) {
+      uint8_t* out = static_cast<uint8_t*>(img_out_v);
+      for (int c = 0; c < C && c < 3; ++c) {
+        uint8_t* dst = out + static_cast<size_t>(c) * H * W;
+        for (int yy = 0; yy < H; ++yy) {
+          const uint8_t* row =
+              rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3 + c;
+          uint8_t* drow = dst + static_cast<size_t>(yy) * W;
+          if (mirror) {
+            for (int xx = 0; xx < W; ++xx) drow[xx] = row[(W - 1 - xx) * 3];
+          } else {
+            for (int xx = 0; xx < W; ++xx) drow[xx] = row[xx * 3];
+          }
+        }
+      }
+    } else if (out_nhwc) {
+      float* out = static_cast<float*>(img_out_v);
+      float inv[3], m[3];
+      for (int c = 0; c < 3; ++c) {
+        m[c] = mean[c];
+        inv[c] = 1.0f / stdv[c];
+      }
+      for (int yy = 0; yy < H; ++yy) {
+        const uint8_t* row =
+            rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3;
+        float* drow = out + static_cast<size_t>(yy) * W * 3;
+        for (int xx = 0; xx < W; ++xx) {
+          const uint8_t* px = row + (mirror ? (W - 1 - xx) : xx) * 3;
+          drow[xx * 3] = (px[0] - m[0]) * inv[0];
+          drow[xx * 3 + 1] = (px[1] - m[1]) * inv[1];
+          drow[xx * 3 + 2] = (px[2] - m[2]) * inv[2];
+        }
+      }
+    } else {
+      float* img_out = static_cast<float*>(img_out_v);
+      for (int c = 0; c < C && c < 3; ++c) {
+        float m = mean[c], sd = stdv[c];
+        float inv = 1.0f / sd;
+        float* dst = img_out + static_cast<size_t>(c) * H * W;
+        for (int yy = 0; yy < H; ++yy) {
+          const uint8_t* row =
+              rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3 + c;
+          float* drow = dst + static_cast<size_t>(yy) * W;
+          if (mirror) {
+            for (int xx = 0; xx < W; ++xx) {
+              drow[xx] = (row[(W - 1 - xx) * 3] - m) * inv;
+            }
+          } else {
+            for (int xx = 0; xx < W; ++xx) {
+              drow[xx] = (row[xx * 3] - m) * inv;
+            }
           }
         }
       }
@@ -528,7 +592,8 @@ struct DetPipe : PipeBase {
     return static_cast<size_t>(max_objects) * 5;
   }
 
-  bool DecodeOne(uint64_t pos, float* img_out, float* label_out) override {
+  bool DecodeOne(uint64_t pos, void* img_out_v, float* label_out) override {
+    float* img_out = static_cast<float*>(img_out_v);
     uint32_t rec_idx = order[pos % order.size()];
     static thread_local std::vector<uint8_t> raw;
     if (!file.Read(rec_idx, &raw) || raw.size() < 24) return false;
@@ -976,12 +1041,20 @@ long tmx_im2rec(const char* lst_path, const char* root,
 
 extern "C" {
 
-void* tmx_pipe_create(const char* rec_path, int batch, int C, int H, int W,
-                      int resize, int rand_crop, int rand_mirror,
-                      const float* mean, const float* stdv, int threads,
-                      int prefetch, int shuffle, uint64_t seed,
-                      int label_width, char* err, int errlen) {
+void* tmx_pipe_create_v2(const char* rec_path, int batch, int C, int H,
+                         int W, int resize, int rand_crop, int rand_mirror,
+                         const float* mean, const float* stdv, int threads,
+                         int prefetch, int shuffle, uint64_t seed,
+                         int label_width, int out_u8, int out_nhwc,
+                         char* err, int errlen) {
+  if (out_nhwc && C != 3) {
+    snprintf(err, errlen,
+             "out_nhwc requires 3-channel data_shape (got C=%d)", C);
+    return nullptr;
+  }
   auto* p = new Pipe();
+  p->out_u8 = out_u8;
+  p->out_nhwc = out_nhwc;
   std::string e;
   if (!p->file.Open(rec_path, &e) || p->file.records.empty()) {
     if (e.empty()) e = "empty recordio file";
@@ -1010,6 +1083,18 @@ void* tmx_pipe_create(const char* rec_path, int batch, int C, int H, int W,
   p->AllocBufs();
   p->StartEpoch();
   return static_cast<PipeBase*>(p);
+}
+
+// legacy entry point: float32 NCHW output (the native test tier and any
+// older caller keep working unchanged)
+void* tmx_pipe_create(const char* rec_path, int batch, int C, int H, int W,
+                      int resize, int rand_crop, int rand_mirror,
+                      const float* mean, const float* stdv, int threads,
+                      int prefetch, int shuffle, uint64_t seed,
+                      int label_width, char* err, int errlen) {
+  return tmx_pipe_create_v2(rec_path, batch, C, H, W, resize, rand_crop,
+                            rand_mirror, mean, stdv, threads, prefetch,
+                            shuffle, seed, label_width, 0, 0, err, errlen);
 }
 
 void* tmx_det_pipe_create(const char* rec_path, int batch, int C, int H,
@@ -1063,7 +1148,7 @@ long long tmx_pipe_size(void* h) {
   return static_cast<PipeBase*>(h)->file.records.size();
 }
 
-int tmx_pipe_next(void* h, float* data, float* label) {
+int tmx_pipe_next(void* h, void* data, float* label) {
   return static_cast<PipeBase*>(h)->Next(data, label);
 }
 

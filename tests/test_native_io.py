@@ -708,3 +708,68 @@ def test_image_det_record_iter_python_fallback(det_rec_file):
     assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
     it.reset()
     assert len(list(it)) == 4
+
+
+def test_native_u8_nhwc_matches_f32_nchw(rec_file):
+    """The uint8/NHWC TPU-feed variant must make the SAME augment
+    decisions (counter-hash PRNG) and, normalized downstream, match the
+    f32/NCHW output to float rounding."""
+    path, _ = rec_file
+    mean, std = (10.0, 20.0, 30.0), (2.0, 3.0, 4.0)
+    kw = dict(batch_size=8, data_shape=(3, 32, 32), resize=40,
+              rand_crop=True, rand_mirror=True, mean=mean, std=std,
+              preprocess_threads=2, shuffle=True, seed=5)
+    p32 = _pipe(path, **kw)
+    pu8 = _pipe(path, output_dtype="uint8", output_layout="NHWC", **kw)
+    d1, l1 = p32.next_batch()
+    d2, l2 = pu8.next_batch()
+    assert d1.dtype == np.float32 and d1.shape == (8, 3, 32, 32)
+    assert d2.dtype == np.uint8 and d2.shape == (8, 32, 32, 3)
+    np.testing.assert_array_equal(l1, l2)
+    norm = (d2.astype(np.float32) - np.asarray(mean, np.float32)) \
+        / np.asarray(std, np.float32)
+    np.testing.assert_allclose(d1, norm.transpose(0, 3, 1, 2), atol=1e-5)
+    p32.close()
+    pu8.close()
+
+
+def test_image_record_iter_output_flags(rec_file):
+    """mx.io.ImageRecordIter surfaces the TPU-feed flags on both the
+    native and the Python-fallback paths, with matching provide_data."""
+    path, _ = rec_file
+    for use_native in (True, False):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=path, data_shape=(3, 32, 32), batch_size=8,
+            resize=40, use_native=use_native, output_dtype="uint8",
+            output_layout="NHWC", seed=3)
+        assert it.provide_data[0].shape == (8, 32, 32, 3)
+        b = it.next()
+        arr = b.data[0].asnumpy()
+        assert arr.shape == (8, 32, 32, 3)
+        assert arr.dtype == np.uint8 or arr.max() > 1.5  # raw pixel range
+        # raw pixels: no normalization applied
+        assert arr.min() >= 0 and arr.max() <= 255
+
+
+def test_device_prefetch_iter_normalizes_on_device(rec_file):
+    """DevicePrefetchIter(normalize=...) applied to a uint8 NHWC feed
+    must equal the host-normalized float iterator output."""
+    path, _ = rec_file
+    mean, std = (10.0, 20.0, 30.0), (2.0, 3.0, 4.0)
+    common = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=8,
+                  resize=40, seed=11)
+    it_f32 = mx.io.ImageRecordIter(
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        std_r=std[0], std_g=std[1], std_b=std[2], **common)
+    it_u8 = mx.io.DevicePrefetchIter(
+        mx.io.ImageRecordIter(output_dtype="uint8", output_layout="NHWC",
+                              **common),
+        normalize=(mean, std), normalize_axis=-1)
+    b1 = it_f32.next()
+    b2 = it_u8.next()
+    a1 = b1.data[0].asnumpy()                      # (B, C, H, W) normalized
+    a2 = b2.data[0].asnumpy().transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(a1, a2, atol=1e-5)
+    # labels untouched by normalize
+    np.testing.assert_array_equal(b1.label[0].asnumpy(),
+                                  b2.label[0].asnumpy())

@@ -372,26 +372,49 @@ class DevicePrefetchIter(PrefetchingIter):
     ``in_shardings``.
     """
 
-    def __init__(self, iters, depth=2, device=None, cast_data=None):
+    def __init__(self, iters, depth=2, device=None, cast_data=None,
+                 normalize=None, normalize_axis=-1):
+        """`normalize=(mean, std)` applies `(x - mean) / std` ON DEVICE
+        (after the cast) with mean/std broadcast along `normalize_axis`
+        (channel axis: -1 for NHWC feeds, 1 for NCHW).  Pair it with an
+        `ImageRecordIter(output_dtype="uint8")` feed: the host ships raw
+        pixels (4x fewer bytes over the interconnect) and this prefetch
+        thread's asynchronous device op does the arithmetic the C++
+        pipeline no longer has to."""
         self._device = device
         self._cast = cast_data
+        self._norm = None
+        if normalize is not None:
+            mean, std = normalize
+            self._norm = (np.asarray(mean, np.float32),
+                          np.asarray(std, np.float32), int(normalize_axis))
         super().__init__(iters, depth=depth)
 
     def _transform(self, batches):
         import jax
         dev = self._device or jax.devices()[0]
 
-        def place(arr, cast):
+        def place(arr, cast, is_data=False):
             x = arr._data if isinstance(arr, nd.NDArray) else arr
             out = jax.device_put(x, dev)
+            norm = self._norm if is_data else None
+            if norm is not None and cast is None and out.dtype == np.uint8:
+                cast = "float32"  # normalized output needs a float dtype
             if cast is not None:
                 out = out.astype(cast)  # on-device cast, still async
+            if norm is not None:
+                mean, std, ax = norm
+                shape = [1] * out.ndim
+                ax = ax % out.ndim
+                shape[ax] = mean.size
+                out = (out - mean.reshape(shape).astype(out.dtype)) \
+                    / std.reshape(shape).astype(out.dtype)
             return nd.NDArray(out)
 
         staged = []
         for b in batches:
             staged.append(DataBatch(
-                [place(d, self._cast) for d in b.data],
+                [place(d, self._cast, is_data=True) for d in b.data],
                 [place(l, None) for l in b.label],
                 pad=b.pad, index=b.index,
                 provide_data=b.provide_data,
@@ -558,10 +581,22 @@ class ImageRecordIter(DataIter):
                  rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  preprocess_threads=4, prefetch_buffer=4, round_batch=True,
-                 seed=0, use_native=None, **kwargs):
+                 seed=0, use_native=None, output_dtype="float32",
+                 output_layout="NCHW", **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         check(len(self.data_shape) == 3, "data_shape must be (C,H,W)")
+        # TPU-feed variants (r4): output_dtype="uint8" skips host-side
+        # normalization — the iterator then emits raw pixels and the
+        # consumer normalizes ON DEVICE (DevicePrefetchIter(normalize=...))
+        # so host + interconnect move 4x fewer bytes; output_layout="NHWC"
+        # emits channels-last, the layout the TPU conv path wants.
+        check(output_dtype in ("float32", "uint8"),
+              "output_dtype must be float32|uint8")
+        check(output_layout in ("NCHW", "NHWC"),
+              "output_layout must be NCHW|NHWC")
+        self.output_dtype = output_dtype
+        self.output_layout = output_layout
         self.label_width = label_width
         self.shuffle = shuffle
         self.rand_crop = rand_crop
@@ -592,7 +627,8 @@ class ImageRecordIter(DataIter):
                     mean=self.mean, std=self.std,
                     preprocess_threads=preprocess_threads,
                     prefetch_buffer=prefetch_buffer, shuffle=shuffle,
-                    seed=seed, label_width=label_width)
+                    seed=seed, label_width=label_width,
+                    output_dtype=output_dtype, output_layout=output_layout)
             except Exception as e:
                 if use_native:
                     raise
@@ -630,7 +666,11 @@ class ImageRecordIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+        c, h, w = self.data_shape
+        shp = (h, w, c) if self.output_layout == "NHWC" else (c, h, w)
+        dt = np.uint8 if self.output_dtype == "uint8" else np.float32
+        return [DataDesc("data", (self.batch_size,) + shp, dtype=dt,
+                         layout="N" + self.output_layout[1:])]
 
     @property
     def provide_label(self):
@@ -709,10 +749,13 @@ class ImageRecordIter(DataIter):
         img = img[y:y + h, x:x + w]
         if mirror:
             img = img[:, ::-1]
-        img = (img.astype(np.float32) - self.mean) / self.std
+        if self.output_dtype != "uint8":  # u8: raw pixels, device normalizes
+            img = (img.astype(np.float32) - self.mean) / self.std
         label = header.label if self.label_width > 1 else float(
             np.asarray(header.label).ravel()[0])
-        return img.transpose(2, 0, 1), label
+        if self.output_layout == "NCHW":
+            img = img.transpose(2, 0, 1)
+        return np.ascontiguousarray(img), label
 
     def iter_next(self):
         if self._native is not None:

@@ -40,9 +40,18 @@ def _load():
             ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.tmx_pipe_create_v2.restype = ctypes.c_void_p
+        lib.tmx_pipe_create_v2.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int,
+        ]
         lib.tmx_pipe_next.restype = ctypes.c_int
         lib.tmx_pipe_next.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_void_p, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_float)]
         lib.tmx_pipe_size.restype = ctypes.c_longlong
         lib.tmx_pipe_size.argtypes = [ctypes.c_void_p]
@@ -62,23 +71,36 @@ class NativeImagePipe:
     def __init__(self, path_imgrec, batch_size, data_shape, resize=-1,
                  rand_crop=False, rand_mirror=False, mean=(0.0, 0.0, 0.0),
                  std=(1.0, 1.0, 1.0), preprocess_threads=4,
-                 prefetch_buffer=4, shuffle=False, seed=0, label_width=1):
+                 prefetch_buffer=4, shuffle=False, seed=0, label_width=1,
+                 output_dtype="float32", output_layout="NCHW"):
+        if output_dtype not in ("float32", "uint8"):
+            raise ValueError(f"output_dtype must be float32|uint8, "
+                             f"got {output_dtype!r}")
+        if output_layout not in ("NCHW", "NHWC"):
+            raise ValueError(f"output_layout must be NCHW|NHWC, "
+                             f"got {output_layout!r}")
         lib = _load()
         c, h, w = data_shape
         mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
         std_arr = (ctypes.c_float * 3)(*[float(s) for s in std])
         err = ctypes.create_string_buffer(1024)
-        self._h = lib.tmx_pipe_create(
+        self._u8 = output_dtype == "uint8"
+        self._nhwc = output_layout == "NHWC"
+        self._h = lib.tmx_pipe_create_v2(
             path_imgrec.encode(), batch_size, c, h, w,
             int(resize), int(bool(rand_crop)), int(bool(rand_mirror)),
             mean_arr, std_arr, int(preprocess_threads), int(prefetch_buffer),
-            int(bool(shuffle)), int(seed), int(label_width), err, len(err))
+            int(bool(shuffle)), int(seed), int(label_width),
+            int(self._u8), int(self._nhwc), err, len(err))
         if not self._h:
             raise IOError("NativeImagePipe: %s" %
                           err.value.decode(errors="replace"))
         self._lib = lib
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
+        # the shape next_batch actually emits (NHWC reorders data_shape)
+        self.out_shape = (h, w, c) if self._nhwc else (c, h, w)
+        self.out_dtype = np.uint8 if self._u8 else np.float32
         self.label_width = label_width
     def __len__(self):
         return int(self._lib.tmx_pipe_size(self._h))
@@ -86,11 +108,11 @@ class NativeImagePipe:
     def next_batch(self):
         """Returns (data, label) fresh arrays, or None at epoch end.  The
         C++ side fills the arrays directly — one copy total."""
-        data = np.empty((self.batch_size,) + self.data_shape, np.float32)
+        data = np.empty((self.batch_size,) + self.out_shape, self.out_dtype)
         label = np.empty((self.batch_size, self.label_width), np.float32)
         n = self._lib.tmx_pipe_next(
             self._h,
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            data.ctypes.data_as(ctypes.c_void_p),
             label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if n < 0:
             raise IOError("NativeImagePipe: %s" %
