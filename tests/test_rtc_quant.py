@@ -184,3 +184,67 @@ def test_quantized_net_with_shared_layer():
     assert rel < 0.1
     # net restored: float path unchanged afterwards
     np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_quantized_net_jit_matches_eager(monkeypatch):
+    """The jitted quantized program must equal the eager patched path
+    bit-for-bit, and the float net's own hybridize cache must stay
+    un-poisoned (still float after quantized calls)."""
+    import numpy as np
+    import tpu_mx as mx
+    from tpu_mx import gluon, nd
+    from tpu_mx.gluon import nn
+    from tpu_mx.contrib.quantization import quantize_net
+
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    net(x)
+    net.hybridize()
+    float_out = net(x).asnumpy()
+
+    qnet = quantize_net(net, calib_data=x)
+    jit_out = qnet(x).asnumpy()
+    monkeypatch.setenv("TPUMX_QUANT_JIT", "0")
+    eager_out = qnet(x).asnumpy()
+    # jit fuses what eager runs op-by-op: tiny rounding differences are
+    # expected, numerical equivalence is the contract
+    np.testing.assert_allclose(jit_out, eager_out, rtol=1e-5, atol=1e-6)
+    # quantization changes numerics vs float (otherwise the patch was
+    # silently bypassed by a cached float program)
+    assert np.abs(jit_out - float_out).max() > 0
+    # the float net still serves FLOAT results from its own cache
+    np.testing.assert_array_equal(net(x).asnumpy(), float_out)
+
+
+def test_quantized_net_jit_multi_output():
+    """Structure-agnostic includes multi-head nets: the jitted wrapper
+    must handle tuple outputs (reproduces the r4 review crash)."""
+    import numpy as np
+    from tpu_mx import nd
+    from tpu_mx.gluon import nn
+    from tpu_mx.gluon.block import HybridBlock
+    from tpu_mx.contrib.quantization import quantize_net
+
+    class TwoHead(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.body = nn.Dense(8, activation="relu")
+            self.h1 = nn.Dense(3)
+            self.h2 = nn.Dense(5)
+
+        def forward(self, x):
+            z = self.body(x)
+            return self.h1(z), self.h2(z)
+
+    np.random.seed(1)
+    net = TwoHead()
+    net.initialize()
+    x = nd.array(np.random.rand(4, 6).astype(np.float32))
+    net(x)
+    qnet = quantize_net(net, calib_data=x)
+    a, b = qnet(x)
+    assert a.shape == (4, 3) and b.shape == (4, 5)

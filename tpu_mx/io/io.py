@@ -398,17 +398,19 @@ class DevicePrefetchIter(PrefetchingIter):
             x = arr._data if isinstance(arr, nd.NDArray) else arr
             out = jax.device_put(x, dev)
             norm = self._norm if is_data else None
-            if norm is not None and cast is None and out.dtype == np.uint8:
-                cast = "float32"  # normalized output needs a float dtype
-            if cast is not None:
-                out = out.astype(cast)  # on-device cast, still async
             if norm is not None:
+                # normalize in f32 FIRST, then apply the requested cast:
+                # normalizing after a bf16 cast would quantize mean/std
+                # themselves (123.68 -> 124.0 at bf16's quantum) and bias
+                # every pixel vs the host-normalized f32 feed
                 mean, std, ax = norm
                 shape = [1] * out.ndim
                 ax = ax % out.ndim
                 shape[ax] = mean.size
-                out = (out - mean.reshape(shape).astype(out.dtype)) \
-                    / std.reshape(shape).astype(out.dtype)
+                out = (out.astype(np.float32) - mean.reshape(shape)) \
+                    / std.reshape(shape)
+            if cast is not None:
+                out = out.astype(cast)  # on-device cast, still async
             return nd.NDArray(out)
 
         staged = []
