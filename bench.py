@@ -690,6 +690,13 @@ def inner():
     bert_rec = scal_rec = None
     try:
         bert_rec = bench_bert(smoke) if "bert" in models else None
+        if bert_rec is not None:
+            # persist the moment it exists (the r4 final-run lesson: a
+            # later sub-bench hanging past the attempt timeout killed the
+            # process before the old end-of-inner persist loop ran, and
+            # the measured BERT number died with it)
+            log("bert record: " + json.dumps(bert_rec))
+            persist_lastgood(bert_rec)
     except Exception as e:  # keep the primary metric alive
         log(f"bert bench failed: {type(e).__name__}: {e}")
         bert_rec = {"metric": "bert_base_train_seqs_per_sec_per_chip",
@@ -699,6 +706,9 @@ def inner():
             raise
     try:
         scal_rec = bench_scaling(smoke) if "scaling" in models else None
+        if scal_rec is not None:
+            log("scaling record: " + json.dumps(scal_rec))
+            persist_lastgood(scal_rec)
     except Exception as e:
         log(f"scaling bench failed: {type(e).__name__}: {e}")
         if rec is None and bert_rec is None:
@@ -731,15 +741,6 @@ def inner():
     if rec is None:
         rec = bert_rec or scal_rec or next(
             (r for r in extra_recs.values() if "error" not in r), None)
-    # persist each sub-record under its OWN metric key too: the combined
-    # record is keyed by the resnet metric, so a later resnet-only run
-    # would otherwise clobber the nested bert/scaling measurements out of
-    # the store (exactly what the r4 batch sweep did to the first-ever
-    # hardware BERT number before this fix)
-    for sub in (bert_rec, scal_rec):
-        # persist_lastgood itself refuses smoke + dp1-placeholder records
-        if sub is not None and sub is not rec and "error" not in sub:
-            persist_lastgood(sub)
     if bert_rec is not None and rec is not bert_rec:
         rec["bert"] = bert_rec
     if scal_rec is not None and rec is not scal_rec:
@@ -747,7 +748,11 @@ def inner():
     for name, r in extra_recs.items():
         if rec is not r:
             rec[name] = r
-    persist_lastgood(rec)
+    # no final persist: every successful record was already persisted
+    # under its own metric key at measurement time, and re-persisting the
+    # combined record here would store the primary key WITH nested
+    # sub-records — the store pollution the per-key design exists to
+    # avoid (load_lastgood grafts the freshest subs back at read time)
     print(json.dumps(rec), flush=True)
 
 

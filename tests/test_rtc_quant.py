@@ -187,9 +187,11 @@ def test_quantized_net_with_shared_layer():
 
 
 def test_quantized_net_jit_matches_eager(monkeypatch):
-    """The jitted quantized program must equal the eager patched path
-    bit-for-bit, and the float net's own hybridize cache must stay
-    un-poisoned (still float after quantized calls)."""
+    """The jitted quantized program must be numerically equivalent to
+    the eager patched path (jit fuses what eager runs op-by-op, so tiny
+    rounding differences are expected), and the float net's own
+    hybridize cache must stay un-poisoned (still float after quantized
+    calls)."""
     import numpy as np
     import tpu_mx as mx
     from tpu_mx import gluon, nd

@@ -375,7 +375,8 @@ class DevicePrefetchIter(PrefetchingIter):
     def __init__(self, iters, depth=2, device=None, cast_data=None,
                  normalize=None, normalize_axis=-1):
         """`normalize=(mean, std)` applies `(x - mean) / std` ON DEVICE
-        (after the cast) with mean/std broadcast along `normalize_axis`
+        in f32, BEFORE the `cast_data` cast (casting first would quantize
+        mean/std themselves at bf16), broadcast along `normalize_axis`
         (channel axis: -1 for NHWC feeds, 1 for NCHW).  Pair it with an
         `ImageRecordIter(output_dtype="uint8")` feed: the host ships raw
         pixels (4x fewer bytes over the interconnect) and this prefetch
