@@ -400,6 +400,91 @@ struct PipeBase {
 // ---------------------------------------------------------------------------
 // classification pipeline (REF:src/io/iter_image_recordio_2.cc)
 // ---------------------------------------------------------------------------
+// ---------------------------------------------------------------------------
+// shared crop/mirror/emit for both pipes: {f32,u8} x {CHW,HWC} in one
+// pass.  `src` points at the first row of the source image (already
+// resized), `stride_w` is its full row width in pixels, `x0` the crop
+// column offset (0 when the source is exactly the crop).  u8 skips
+// normalization entirely — it happens on device (DevicePrefetchIter).
+// ---------------------------------------------------------------------------
+static void EmitImage(const uint8_t* src, int stride_w, int x0, int C,
+                      int H, int W, bool mirror, int out_u8, int out_nhwc,
+                      const float* mean, const float* stdv,
+                      void* img_out_v) {
+  if (out_u8 && out_nhwc) {
+    uint8_t* out = static_cast<uint8_t*>(img_out_v);
+    for (int yy = 0; yy < H; ++yy) {
+      const uint8_t* row =
+          src + (static_cast<size_t>(yy) * stride_w + x0) * 3;
+      uint8_t* drow = out + static_cast<size_t>(yy) * W * 3;
+      if (mirror) {
+        for (int xx = 0; xx < W; ++xx) {
+          const uint8_t* px = row + (W - 1 - xx) * 3;
+          drow[xx * 3] = px[0];
+          drow[xx * 3 + 1] = px[1];
+          drow[xx * 3 + 2] = px[2];
+        }
+      } else {
+        memcpy(drow, row, static_cast<size_t>(W) * 3);
+      }
+    }
+  } else if (out_u8) {
+    uint8_t* out = static_cast<uint8_t*>(img_out_v);
+    for (int c = 0; c < C && c < 3; ++c) {
+      uint8_t* dst = out + static_cast<size_t>(c) * H * W;
+      for (int yy = 0; yy < H; ++yy) {
+        const uint8_t* row =
+            src + (static_cast<size_t>(yy) * stride_w + x0) * 3 + c;
+        uint8_t* drow = dst + static_cast<size_t>(yy) * W;
+        if (mirror) {
+          for (int xx = 0; xx < W; ++xx) drow[xx] = row[(W - 1 - xx) * 3];
+        } else {
+          for (int xx = 0; xx < W; ++xx) drow[xx] = row[xx * 3];
+        }
+      }
+    }
+  } else if (out_nhwc) {
+    float* out = static_cast<float*>(img_out_v);
+    float inv[3], mu[3];
+    for (int c = 0; c < 3; ++c) {
+      mu[c] = mean[c];
+      inv[c] = 1.0f / stdv[c];
+    }
+    for (int yy = 0; yy < H; ++yy) {
+      const uint8_t* row =
+          src + (static_cast<size_t>(yy) * stride_w + x0) * 3;
+      float* drow = out + static_cast<size_t>(yy) * W * 3;
+      for (int xx = 0; xx < W; ++xx) {
+        const uint8_t* px = row + (mirror ? (W - 1 - xx) : xx) * 3;
+        drow[xx * 3] = (px[0] - mu[0]) * inv[0];
+        drow[xx * 3 + 1] = (px[1] - mu[1]) * inv[1];
+        drow[xx * 3 + 2] = (px[2] - mu[2]) * inv[2];
+      }
+    }
+  } else {
+    float* img_out = static_cast<float*>(img_out_v);
+    for (int c = 0; c < C && c < 3; ++c) {
+      float mu_ = mean[c], inv = 1.0f / stdv[c];
+      float* dst = img_out + static_cast<size_t>(c) * H * W;
+      for (int yy = 0; yy < H; ++yy) {
+        const uint8_t* row =
+            src + (static_cast<size_t>(yy) * stride_w + x0) * 3 + c;
+        float* drow = dst + static_cast<size_t>(yy) * W;
+        if (mirror) {
+          for (int xx = 0; xx < W; ++xx) {
+            drow[xx] = (row[(W - 1 - xx) * 3] - mu_) * inv;
+          }
+        } else {
+          for (int xx = 0; xx < W; ++xx) {
+            drow[xx] = (row[xx * 3] - mu_) * inv;
+          }
+        }
+      }
+    }
+  }
+}
+
+
 struct Pipe : PipeBase {
   int C, H, W, resize, rand_crop, rand_mirror;
   float mean[3], stdv[3];
@@ -487,80 +572,8 @@ struct Pipe : PipeBase {
     }
     if (rand_mirror) mirror = HashUniform(seed, epoch, pos, 2) < 0.5f;
 
-    // crop + mirror + output in one pass.  Four variants: {f32,u8} x
-    // {CHW,HWC}.  u8 skips normalization entirely (applied on device).
-    if (out_u8 && out_nhwc) {
-      uint8_t* out = static_cast<uint8_t*>(img_out_v);
-      for (int yy = 0; yy < H; ++yy) {
-        const uint8_t* row =
-            rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3;
-        uint8_t* drow = out + static_cast<size_t>(yy) * W * 3;
-        if (mirror) {
-          for (int xx = 0; xx < W; ++xx) {
-            const uint8_t* px = row + (W - 1 - xx) * 3;
-            drow[xx * 3] = px[0];
-            drow[xx * 3 + 1] = px[1];
-            drow[xx * 3 + 2] = px[2];
-          }
-        } else {
-          memcpy(drow, row, static_cast<size_t>(W) * 3);
-        }
-      }
-    } else if (out_u8) {
-      uint8_t* out = static_cast<uint8_t*>(img_out_v);
-      for (int c = 0; c < C && c < 3; ++c) {
-        uint8_t* dst = out + static_cast<size_t>(c) * H * W;
-        for (int yy = 0; yy < H; ++yy) {
-          const uint8_t* row =
-              rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3 + c;
-          uint8_t* drow = dst + static_cast<size_t>(yy) * W;
-          if (mirror) {
-            for (int xx = 0; xx < W; ++xx) drow[xx] = row[(W - 1 - xx) * 3];
-          } else {
-            for (int xx = 0; xx < W; ++xx) drow[xx] = row[xx * 3];
-          }
-        }
-      }
-    } else if (out_nhwc) {
-      float* out = static_cast<float*>(img_out_v);
-      float inv[3], m[3];
-      for (int c = 0; c < 3; ++c) {
-        m[c] = mean[c];
-        inv[c] = 1.0f / stdv[c];
-      }
-      for (int yy = 0; yy < H; ++yy) {
-        const uint8_t* row =
-            rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3;
-        float* drow = out + static_cast<size_t>(yy) * W * 3;
-        for (int xx = 0; xx < W; ++xx) {
-          const uint8_t* px = row + (mirror ? (W - 1 - xx) : xx) * 3;
-          drow[xx * 3] = (px[0] - m[0]) * inv[0];
-          drow[xx * 3 + 1] = (px[1] - m[1]) * inv[1];
-          drow[xx * 3 + 2] = (px[2] - m[2]) * inv[2];
-        }
-      }
-    } else {
-      float* img_out = static_cast<float*>(img_out_v);
-      for (int c = 0; c < C && c < 3; ++c) {
-        float m = mean[c], sd = stdv[c];
-        float inv = 1.0f / sd;
-        float* dst = img_out + static_cast<size_t>(c) * H * W;
-        for (int yy = 0; yy < H; ++yy) {
-          const uint8_t* row =
-              rgb.data() + (static_cast<size_t>(y + yy) * iw + x) * 3 + c;
-          float* drow = dst + static_cast<size_t>(yy) * W;
-          if (mirror) {
-            for (int xx = 0; xx < W; ++xx) {
-              drow[xx] = (row[(W - 1 - xx) * 3] - m) * inv;
-            }
-          } else {
-            for (int xx = 0; xx < W; ++xx) {
-              drow[xx] = (row[xx * 3] - m) * inv;
-            }
-          }
-        }
-      }
-    }
+    EmitImage(rgb.data() + static_cast<size_t>(y) * iw * 3, iw, x, C, H,
+              W, mirror, out_u8, out_nhwc, mean, stdv, img_out_v);
     return true;
   }
 
@@ -592,8 +605,11 @@ struct DetPipe : PipeBase {
     return static_cast<size_t>(max_objects) * 5;
   }
 
+  // TPU-feed variants, same contract as the classification Pipe
+  int out_u8 = 0, out_nhwc = 0;
+  size_t ElemSize() const override { return out_u8 ? 1 : 4; }
+
   bool DecodeOne(uint64_t pos, void* img_out_v, float* label_out) override {
-    float* img_out = static_cast<float*>(img_out_v);
     uint32_t rec_idx = order[pos % order.size()];
     static thread_local std::vector<uint8_t> raw;
     if (!file.Read(rec_idx, &raw) || raw.size() < 24) return false;
@@ -725,25 +741,9 @@ struct DetPipe : PipeBase {
     resized.resize(static_cast<size_t>(H) * W * 3);
     ResizeBilinear(src, sh, sw, resized.data(), H, W);
 
-    // mirror + normalize + HWC->CHW in one pass
-    for (int c = 0; c < C && c < 3; ++c) {
-      float mu_ = mean[c], inv = 1.0f / stdv[c];
-      float* dst = img_out + static_cast<size_t>(c) * H * W;
-      for (int yy = 0; yy < H; ++yy) {
-        const uint8_t* row =
-            resized.data() + static_cast<size_t>(yy) * W * 3 + c;
-        float* drow = dst + static_cast<size_t>(yy) * W;
-        if (mirror) {
-          for (int xx = 0; xx < W; ++xx) {
-            drow[xx] = (row[(W - 1 - xx) * 3] - mu_) * inv;
-          }
-        } else {
-          for (int xx = 0; xx < W; ++xx) {
-            drow[xx] = (row[xx * 3] - mu_) * inv;
-          }
-        }
-      }
-    }
+    // resized IS the exact H*W*3 crop: stride W, x0 0
+    EmitImage(resized.data(), W, 0, C, H, W, mirror, out_u8, out_nhwc,
+              mean, stdv, img_out_v);
     return true;
   }
 };
@@ -1085,27 +1085,23 @@ void* tmx_pipe_create_v2(const char* rec_path, int batch, int C, int H,
   return static_cast<PipeBase*>(p);
 }
 
-// legacy entry point: float32 NCHW output (the native test tier and any
-// older caller keep working unchanged)
-void* tmx_pipe_create(const char* rec_path, int batch, int C, int H, int W,
-                      int resize, int rand_crop, int rand_mirror,
-                      const float* mean, const float* stdv, int threads,
-                      int prefetch, int shuffle, uint64_t seed,
-                      int label_width, char* err, int errlen) {
-  return tmx_pipe_create_v2(rec_path, batch, C, H, W, resize, rand_crop,
-                            rand_mirror, mean, stdv, threads, prefetch,
-                            shuffle, seed, label_width, 0, 0, err, errlen);
-}
-
-void* tmx_det_pipe_create(const char* rec_path, int batch, int C, int H,
-                          int W, int max_objects, int rand_crop,
-                          int rand_mirror, const float* mean,
-                          const float* stdv, float min_cover, float area_lo,
-                          float area_hi, float ratio_lo, float ratio_hi,
-                          int max_attempts, int threads, int prefetch,
-                          int shuffle, uint64_t seed, char* err,
-                          int errlen) {
+void* tmx_det_pipe_create_v2(const char* rec_path, int batch, int C, int H,
+                             int W, int max_objects, int rand_crop,
+                             int rand_mirror, const float* mean,
+                             const float* stdv, float min_cover,
+                             float area_lo, float area_hi, float ratio_lo,
+                             float ratio_hi, int max_attempts, int threads,
+                             int prefetch, int shuffle, uint64_t seed,
+                             int out_u8, int out_nhwc, char* err,
+                             int errlen) {
+  if (out_nhwc && C != 3) {
+    snprintf(err, errlen,
+             "out_nhwc requires 3-channel data_shape (got C=%d)", C);
+    return nullptr;
+  }
   auto* p = new DetPipe();
+  p->out_u8 = out_u8;
+  p->out_nhwc = out_nhwc;
   std::string e;
   if (!p->file.Open(rec_path, &e) || p->file.records.empty()) {
     if (e.empty()) e = "empty recordio file";

@@ -773,3 +773,42 @@ def test_device_prefetch_iter_normalizes_on_device(rec_file):
     # labels untouched by normalize
     np.testing.assert_array_equal(b1.label[0].asnumpy(),
                                   b2.label[0].asnumpy())
+
+
+def test_det_pipe_u8_nhwc_matches_f32_nchw(det_rec_file):
+    """Det pipe TPU-feed variant: same counter-hash augment decisions, so
+    u8/NHWC normalized downstream must match f32/NCHW, boxes identical."""
+    path, _ = det_rec_file
+    mean, std = (5.0, 6.0, 7.0), (2.0, 2.5, 3.0)
+    kw = dict(rand_crop=True, rand_mirror=True, mean=mean, std=std,
+              shuffle=True, seed=9)
+    p32 = _det_pipe(path, **kw)
+    pu8 = _det_pipe(path, output_dtype="uint8", output_layout="NHWC", **kw)
+    d1, l1 = p32.next_batch()
+    d2, l2 = pu8.next_batch()
+    assert d1.shape == (4, 3, 48, 48) and d1.dtype == np.float32
+    assert d2.shape == (4, 48, 48, 3) and d2.dtype == np.uint8
+    np.testing.assert_array_equal(l1, l2)  # boxes bit-identical
+    norm = (d2.astype(np.float32) - np.asarray(mean, np.float32)) \
+        / np.asarray(std, np.float32)
+    np.testing.assert_allclose(d1, norm.transpose(0, 3, 1, 2), atol=1e-5)
+    p32.close()
+    pu8.close()
+
+
+def test_image_det_record_iter_u8_nhwc(det_rec_file):
+    """mx.io.ImageDetRecordIter carries the TPU-feed flags (native-only;
+    the variants must refuse the Python fallback rather than silently
+    change contract)."""
+    path, _ = det_rec_file
+    it = mx.io.ImageDetRecordIter(path, (3, 48, 48), batch_size=4,
+                                  output_dtype="uint8",
+                                  output_layout="NHWC")
+    assert it.provide_data[0].shape == (4, 48, 48, 3)
+    b = it.next()
+    arr = b.data[0].asnumpy()
+    assert arr.shape == (4, 48, 48, 3) and arr.min() >= 0 and arr.max() <= 255
+    assert b.label[0].shape == (4, it.max_objects, 5)
+    with pytest.raises(Exception):
+        mx.io.ImageDetRecordIter(path, (3, 48, 48), batch_size=4,
+                                 output_dtype="uint8", use_native=False)

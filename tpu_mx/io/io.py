@@ -818,10 +818,24 @@ class ImageDetRecordIter(DataIter):
                  std_b=1.0, min_object_covered=0.3, area_range=(0.3, 1.0),
                  aspect_ratio_range=(0.75, 1.33), max_attempts=20,
                  preprocess_threads=4, prefetch_buffer=4, seed=0,
-                 use_native=None, **kwargs):
+                 use_native=None, output_dtype="float32",
+                 output_layout="NCHW", **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         check(len(self.data_shape) == 3, "data_shape must be (C,H,W)")
+        # same TPU-feed contract as ImageRecordIter (uint8 feed +
+        # on-device normalization, NHWC emit); native-path only — the
+        # Python det fallback keeps the classic f32/NCHW contract
+        check(output_dtype in ("float32", "uint8"),
+              "output_dtype must be float32|uint8")
+        check(output_layout in ("NCHW", "NHWC"),
+              "output_layout must be NCHW|NHWC")
+        if (output_dtype != "float32" or output_layout != "NCHW") and \
+                use_native is False:
+            raise MXNetError("output_dtype/output_layout variants need "
+                             "the native pipeline (use_native=False set)")
+        self.output_dtype = output_dtype
+        self.output_layout = output_layout
         self.max_objects = max_objects or self._scan_max_objects(path_imgrec)
         self._pad = 0
         self._native = None
@@ -841,10 +855,13 @@ class ImageDetRecordIter(DataIter):
                     max_attempts=max_attempts,
                     preprocess_threads=preprocess_threads,
                     prefetch_buffer=prefetch_buffer, shuffle=shuffle,
-                    seed=seed)
+                    seed=seed, output_dtype=output_dtype,
+                    output_layout=output_layout)
             except Exception as e:
                 if use_native:
                     raise
+                if output_dtype != "float32" or output_layout != "NCHW":
+                    raise  # no Python analog of the TPU-feed contract
                 import warnings
                 warnings.warn(f"native det io unavailable ({e}); "
                               "using the Python pipeline")
@@ -891,7 +908,11 @@ class ImageDetRecordIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+        c, h, w = self.data_shape
+        shp = (h, w, c) if self.output_layout == "NHWC" else (c, h, w)
+        dt = np.uint8 if self.output_dtype == "uint8" else np.float32
+        return [DataDesc("data", (self.batch_size,) + shp, dtype=dt,
+                         layout="N" + self.output_layout[1:])]
 
     @property
     def provide_label(self):

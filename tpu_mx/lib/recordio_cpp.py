@@ -23,22 +23,15 @@ def _load():
     if _lib is None:
         path = ensure_built()
         lib = ctypes.CDLL(path)
-        lib.tmx_pipe_create.restype = ctypes.c_void_p
-        lib.tmx_pipe_create.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
-            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-        ]
-        lib.tmx_det_pipe_create.restype = ctypes.c_void_p
-        lib.tmx_det_pipe_create.argtypes = [
+        lib.tmx_det_pipe_create_v2.restype = ctypes.c_void_p
+        lib.tmx_det_pipe_create_v2.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
             ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
         ]
         lib.tmx_pipe_create_v2.restype = ctypes.c_void_p
         lib.tmx_pipe_create_v2.argtypes = [
@@ -148,37 +141,49 @@ class NativeDetPipe:
                  std=(1.0, 1.0, 1.0), min_object_covered=0.3,
                  area_range=(0.3, 1.0), aspect_ratio_range=(0.75, 1.33),
                  max_attempts=20, preprocess_threads=4, prefetch_buffer=4,
-                 shuffle=False, seed=0):
+                 shuffle=False, seed=0, output_dtype="float32",
+                 output_layout="NCHW"):
+        if output_dtype not in ("float32", "uint8"):
+            raise ValueError(f"output_dtype must be float32|uint8, "
+                             f"got {output_dtype!r}")
+        if output_layout not in ("NCHW", "NHWC"):
+            raise ValueError(f"output_layout must be NCHW|NHWC, "
+                             f"got {output_layout!r}")
         lib = _load()
         c, h, w = data_shape
         mean_arr = (ctypes.c_float * 3)(*[float(m) for m in mean])
         std_arr = (ctypes.c_float * 3)(*[float(s) for s in std])
         err = ctypes.create_string_buffer(1024)
-        self._h = lib.tmx_det_pipe_create(
+        self._u8 = output_dtype == "uint8"
+        self._nhwc = output_layout == "NHWC"
+        self._h = lib.tmx_det_pipe_create_v2(
             path_imgrec.encode(), batch_size, c, h, w, int(max_objects),
             int(bool(rand_crop)), int(bool(rand_mirror)), mean_arr, std_arr,
             float(min_object_covered), float(area_range[0]),
             float(area_range[1]), float(aspect_ratio_range[0]),
             float(aspect_ratio_range[1]), int(max_attempts),
             int(preprocess_threads), int(prefetch_buffer),
-            int(bool(shuffle)), int(seed), err, len(err))
+            int(bool(shuffle)), int(seed), int(self._u8), int(self._nhwc),
+            err, len(err))
         if not self._h:
             raise IOError("NativeDetPipe: %s" %
                           err.value.decode(errors="replace"))
         self._lib = lib
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
+        self.out_shape = (h, w, c) if self._nhwc else (c, h, w)
+        self.out_dtype = np.uint8 if self._u8 else np.float32
         self.max_objects = int(max_objects)
 
     def __len__(self):
         return int(self._lib.tmx_pipe_size(self._h))
 
     def next_batch(self):
-        data = np.empty((self.batch_size,) + self.data_shape, np.float32)
+        data = np.empty((self.batch_size,) + self.out_shape, self.out_dtype)
         label = np.empty((self.batch_size, self.max_objects, 5), np.float32)
         n = self._lib.tmx_pipe_next(
             self._h,
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            data.ctypes.data_as(ctypes.c_void_p),
             label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if n < 0:
             raise IOError("NativeDetPipe: %s" %
