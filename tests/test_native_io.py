@@ -812,3 +812,21 @@ def test_image_det_record_iter_u8_nhwc(det_rec_file):
     with pytest.raises(Exception):
         mx.io.ImageDetRecordIter(path, (3, 48, 48), batch_size=4,
                                  output_dtype="uint8", use_native=False)
+
+
+def test_device_prefetch_normalize_nchw_axis(rec_file):
+    """The u8/NCHW + normalize_axis=1 combination (the SSD example's
+    feed) must equal host-side f32 normalization too."""
+    path, _ = rec_file
+    mean, std = (9.0, 19.0, 29.0), (2.0, 4.0, 8.0)
+    common = dict(path_imgrec=path, data_shape=(3, 32, 32), batch_size=8,
+                  resize=40, seed=13)
+    it_f32 = mx.io.ImageRecordIter(
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        std_r=std[0], std_g=std[1], std_b=std[2], **common)
+    it_u8 = mx.io.DevicePrefetchIter(
+        mx.io.ImageRecordIter(output_dtype="uint8", **common),
+        normalize=(mean, std), normalize_axis=1)
+    a1 = it_f32.next().data[0].asnumpy()
+    a2 = it_u8.next().data[0].asnumpy()
+    np.testing.assert_allclose(a1, a2, atol=1e-5)

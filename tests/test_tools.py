@@ -252,6 +252,24 @@ def test_bench_scaling_mode():
     assert 0 < rec["value"] <= 1.5
 
 
+@pytest.mark.slow
+def test_bench_lstm_ssd_smoke():
+    """BENCH_MODELS=lstm,ssd (BASELINE workloads 3 and 5) run end-to-end
+    in smoke mode and emit both records."""
+    import json as _json
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**_env_cpu(), "BENCH_SMOKE": "1",
+             "BENCH_MODELS": "lstm,ssd"})
+    assert out.returncode == 0, out.stderr[-500:]
+    rec = _json.loads([l for l in out.stdout.splitlines()
+                       if l.startswith("{")][-1])
+    assert rec["metric"] == "lstm_smoke_tokens_per_sec" and rec["value"] > 0
+    assert rec["ssd"]["metric"] == "ssd_smoke_images_per_sec"
+    assert rec["ssd"]["value"] > 0
+
+
 def test_parse_log_table():
     """tools/parse_log.py (REF:tools/parse_log.py analog): Speedometer +
     fit log lines -> per-epoch table."""
