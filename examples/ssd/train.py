@@ -47,11 +47,18 @@ def main():
                     help="det .rec file; fed through the native "
                          "mx.io.ImageDetRecordIter (C++ decode + box-aware "
                          "augment); synthetic boxes when omitted")
+    ap.add_argument("--backbone", default="compact",
+                    choices=["compact", "vgg16_reduced"],
+                    help="vgg16_reduced = the reference SSD feature "
+                         "pyramid (scaled conv4_3 + atrous fc7)")
     ap.add_argument("--feed", default="f32", choices=["f32", "u8"],
                     help="u8 ships raw pixels and normalizes on device")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
     if args.smoke:
+        if args.backbone != "compact":
+            ap.error("--smoke uses the tiny compact net; "
+                     "--backbone has no effect there")
         args.num_classes, args.batch_size = 3, 4
         args.epochs, args.steps_per_epoch = 2, 8
         size = 64
@@ -59,7 +66,8 @@ def main():
                   ratios=[[1, 2, 0.5]] * 2, base_filters=(8, 16))
     else:
         size = 512 if args.network == "ssd_512" else 300
-        net = (ssd_512 if size == 512 else ssd_300)(args.num_classes)
+        net = (ssd_512 if size == 512 else ssd_300)(
+            args.num_classes, backbone=args.backbone)
 
     net.initialize(init="xavier")
     targets = SSDTrainingTargets()

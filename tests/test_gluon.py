@@ -510,3 +510,33 @@ def test_bert_remat_policy_without_remat_raises():
     cfg.update(num_layers=1, units=32, hidden_size=64, num_heads=2)
     with pytest.raises(ValueError, match="remat=True"):
         BERTModel(cfg, remat=False, remat_policy="dots_saveable")
+
+
+def test_parameter_own_init_beats_global_initializer():
+    """A parameter's own init (layer weight_initializer, constants like
+    the SSD L2-norm scale) must take precedence over the initializer
+    passed to net.initialize() — the global is the DEFAULT for params
+    without one (REF gluon ParameterDict.initialize semantics)."""
+    from tpu_mx import initializer as init_mod
+
+    class WithConst(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.dense = nn.Dense(4, in_units=3,
+                                  weight_initializer=init_mod.Constant(2.5))
+            self.scale = self.params.get("scale", shape=(1, 8),
+                                         init=init_mod.Constant(20.0))
+
+        def hybrid_forward(self, F, x, scale):
+            return self.dense(x) * scale[:, :4]
+
+    net = WithConst()
+    net.initialize(init="xavier")
+    np.testing.assert_array_equal(
+        net.scale.data().asnumpy(), np.full((1, 8), 20.0, np.float32))
+    np.testing.assert_array_equal(
+        net.dense.weight.data().asnumpy(),
+        np.full((4, 3), 2.5, np.float32))
+    # params WITHOUT their own init still get the global default
+    b = net.dense.bias.data().asnumpy()
+    np.testing.assert_array_equal(b, np.zeros(4, np.float32))  # bias init

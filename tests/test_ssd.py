@@ -4,7 +4,7 @@ import numpy as np
 import pytest
 
 import tpu_mx as mx
-from tpu_mx import autograd, gluon
+from tpu_mx import autograd, gluon, nd
 from tpu_mx.models import SSD, SSDTrainingTargets
 
 
@@ -96,3 +96,40 @@ def test_ssd_512_config():
     assert anchors.shape[1] == cls_preds.shape[1]
     assert cls_preds.shape[2] == 21
     assert box_preds.shape[1] == anchors.shape[1] * 4
+
+
+@pytest.mark.slow
+def test_ssd300_vgg16_reduced_canonical_anchors_and_train():
+    """backbone='vgg16_reduced' reproduces the reference SSD300 feature
+    pyramid exactly (8732 anchors: 38/19/10/5/3/1 maps, [4,6,6,6,4,4]
+    per-position), and a few training steps reduce the loss."""
+    from tpu_mx.models.ssd import ssd_300, SSDTrainingTargets
+    np.random.seed(0)
+    net = ssd_300(num_classes=3, backbone="vgg16_reduced")
+    net.initialize(init="xavier")
+    x = nd.array(np.random.rand(2, 3, 300, 300).astype(np.float32) * 0.1)
+    anchors, cls_preds, box_preds = net(x)
+    assert anchors.shape == (1, 8732, 4)
+    assert cls_preds.shape == (2, 8732, 4)
+    assert box_preds.shape == (2, 8732 * 4)
+    # one box per image; train a few steps
+    labels = np.full((2, 1, 5), -1.0, np.float32)
+    labels[0, 0] = [0, 0.1, 0.1, 0.5, 0.5]
+    labels[1, 0] = [1, 0.3, 0.3, 0.8, 0.8]
+    l_nd = nd.array(labels)
+    targets = SSDTrainingTargets()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    box_loss = gluon.loss.HuberLoss()
+    losses = []
+    for _ in range(4):
+        with autograd.record():
+            a, c, b = net(x)
+            with autograd.pause():
+                loc_t, loc_m, cls_t = targets(a, l_nd, c)
+            l = cls_loss(c, cls_t) + box_loss(b * loc_m, loc_t * loc_m)
+        l.backward()
+        trainer.step(2)
+        losses.append(float(l.mean().asnumpy()))
+    assert losses[-1] < losses[0], losses

@@ -237,7 +237,13 @@ class ParameterDict:
 
     def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
         for p in self.values():
-            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+            # the global initializer is the DEFAULT, not an override: a
+            # parameter's own init (layer weight_initializer, BN ones,
+            # constants like the SSD L2-norm scale) takes precedence —
+            # REF gluon ParameterDict.initialize passes the global as
+            # default_init for exactly this reason
+            p.initialize(init=None, ctx=ctx, default_init=init,
+                         force_reinit=force_reinit)
 
     def zero_grad(self):
         for p in self.values():

@@ -30,16 +30,79 @@ def _body_block(filters):
     return blk
 
 
-def _scale_block(filters):
-    """Extra-scale block: 1×1 reduce + 3×3/s2 (REF:example/ssd
-    multi_layer_feature extra layers).  Stride-2 conv with padding keeps
-    1×1 maps at 1×1 instead of pooling to zero."""
+def _scale_block(filters, strides=2, padding=1):
+    """Extra-scale block: 1×1 reduce + 3×3 conv (REF:example/ssd
+    multi_layer_feature extra layers).  Default 3×3/s2/p1 halves the map
+    (and keeps 1×1 maps at 1×1); the reference SSD300 tail uses
+    3×3/s1/p0 valid convs instead (5→3→1)."""
     blk = nn.HybridSequential()
     blk.add(nn.Conv2D(filters // 2, kernel_size=1),
             nn.BatchNorm(), nn.Activation("relu"),
-            nn.Conv2D(filters, kernel_size=3, strides=2, padding=1),
+            nn.Conv2D(filters, kernel_size=3, strides=strides,
+                      padding=padding),
             nn.BatchNorm(), nn.Activation("relu"))
     return blk
+
+
+class _L2NormScale(HybridBlock):
+    """Per-position channel L2 normalization with a learnable per-channel
+    scale, init 20.0 — the original SSD paper's conv4_3 treatment
+    (REF:example/ssd/symbol/common.py multi_layer_feature's
+    L2Normalization + scale)."""
+
+    def __init__(self, channels, init_scale=20.0, **kwargs):
+        super().__init__(**kwargs)
+        from ..initializer import Constant
+        self._channels = channels
+        self.scale = self.params.get("scale", shape=(1, channels, 1, 1),
+                                     init=Constant(init_scale))
+
+    def hybrid_forward(self, F, x, scale):
+        return F.L2Normalization(x, mode="channel") * scale
+
+
+class VGG16ReducedFeatures(HybridBlock):
+    """VGG16-reduced SSD backbone (REF:example/ssd/symbol/vgg16_reduced.py):
+    conv1_1…conv5_3 with pool5 3×3/1 (keeps stride 16 beyond stage 4),
+    atrous fc6 (1024, 3×3, dilation 6) and fc7 (1024, 1×1), both conv.
+    forward(x) → [scaled conv4_3 (stride 8), fc7 (stride 16)] — the two
+    base taps of the reference SSD-512/300 feature pyramid."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        layers, filters = [2, 2, 3, 3, 3], [64, 128, 256, 512, 512]
+        self.stages = []
+        for i, (num, f) in enumerate(zip(layers, filters)):
+            stage = nn.HybridSequential()
+            for _ in range(num):
+                stage.add(nn.Conv2D(f, kernel_size=3, padding=1),
+                          nn.Activation("relu"))
+            if i < 3:
+                # ceil-mode pooling matches the reference's feature-map
+                # geometry (300: 75 -> 38, not 37 -> conv4_3 is 38x38 and
+                # the pyramid reproduces the canonical 8732-anchor SSD300)
+                stage.add(nn.MaxPool2D(2, 2, ceil_mode=True))
+            # stage 4's pool (pool4) lives OUTSIDE the stage so conv4_3
+            # can be tapped pre-pool; pool5 is 3x3/1 (reduced contract)
+            self.stages.append(stage)
+            setattr(self, f"stage{i + 1}", stage)
+        self.pool4 = nn.MaxPool2D(2, 2, ceil_mode=True)
+        self.pool5 = nn.MaxPool2D(3, 1, padding=1)
+        self.fc6 = nn.Conv2D(1024, kernel_size=3, padding=6, dilation=6)
+        self.fc7 = nn.Conv2D(1024, kernel_size=1)
+        self.norm4 = _L2NormScale(512)
+
+    def forward(self, x):
+        x = self.stages[0](x)
+        x = self.stages[1](x)
+        x = self.stages[2](x)
+        conv4_3 = self.stages[3](x)
+        x = self.pool4(conv4_3)
+        x = self.stages[4](x)
+        x = self.pool5(x)
+        x = F.Activation(self.fc6(x), act_type="relu")
+        fc7 = F.Activation(self.fc7(x), act_type="relu")
+        return [self.norm4(conv4_3), fc7]
 
 
 class SSD(HybridBlock):
@@ -50,7 +113,8 @@ class SSD(HybridBlock):
     """
 
     def __init__(self, num_classes, sizes, ratios, base_filters=(16, 32, 64),
-                 scale_filters=128, num_scales=None, **kwargs):
+                 scale_filters=128, num_scales=None, backbone="compact",
+                 extra_specs=None, **kwargs):
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.sizes = [tuple(s) for s in sizes]
@@ -59,15 +123,32 @@ class SSD(HybridBlock):
         assert len(self.sizes) == len(self.ratios) == n
         self._num_anchors = [len(s) + len(r) - 1
                              for s, r in zip(self.sizes, self.ratios)]
-        self.backbone = nn.HybridSequential()
-        for f in base_filters:
-            self.backbone.add(_body_block(f))
+        # backbone="compact": the fast bench backbone (2-conv BN blocks).
+        # backbone="vgg16_reduced": the reference SSD backbone — TWO base
+        # feature taps (scaled conv4_3 + atrous fc7), extras chained from
+        # fc7 (REF:example/ssd/symbol/symbol_factory.py 'vgg16_reduced').
+        if backbone not in ("compact", "vgg16_reduced"):
+            raise ValueError(f"unknown backbone {backbone!r}")
+        self._n_base_feats = 1
+        if backbone == "vgg16_reduced":
+            self.backbone = VGG16ReducedFeatures()
+            self._n_base_feats = 2
+            assert n >= 2, "vgg16_reduced yields 2 base scales"
+        else:
+            self.backbone = nn.HybridSequential()
+            for f in base_filters:
+                self.backbone.add(_body_block(f))
         self.scale_blocks = []
         self.cls_heads = []
         self.box_heads = []
+        # per-extra (stride, padding); default s2/p1 chains (halving)
+        n_extras = n - self._n_base_feats
+        specs = list(extra_specs or [(2, 1)] * n_extras)
+        assert len(specs) == n_extras, (specs, n_extras)
         for i in range(n):
-            if i > 0:
-                blk = _scale_block(scale_filters)
+            if i >= self._n_base_feats:
+                st, pd = specs[i - self._n_base_feats]
+                blk = _scale_block(scale_filters, strides=st, padding=pd)
                 self.scale_blocks.append(blk)
                 setattr(self, f"scale_{i}", blk)
             ch = nn.Conv2D(self._num_anchors[i] * (num_classes + 1),
@@ -79,11 +160,15 @@ class SSD(HybridBlock):
             setattr(self, f"box_head_{i}", bh)
 
     def forward(self, x):
-        feats = self.backbone(x)
+        base = self.backbone(x)
+        base_list = base if isinstance(base, (list, tuple)) else [base]
+        feats = None  # set at i=0; extras chain from base_list[-1]
         anchors, cls_preds, box_preds = [], [], []
         for i in range(len(self.sizes)):
-            if i > 0:
-                feats = self.scale_blocks[i - 1](feats)
+            if i < len(base_list):
+                feats = base_list[i]
+            else:
+                feats = self.scale_blocks[i - len(base_list)](feats)
             anchors.append(_contrib.MultiBoxPrior(
                 feats, sizes=self.sizes[i], ratios=self.ratios[i]))
             c = self.cls_heads[i](feats)          # (B, K*(C+1), H, W)
@@ -128,17 +213,28 @@ class SSDTrainingTargets:
 
 def ssd_512(num_classes=20, **kwargs):
     """SSD-512 anchor configuration (REF:example/ssd/symbol/symbol_factory.py
-    get_config('vgg16_reduced', 512)) over the compact backbone."""
+    get_config('vgg16_reduced', 512)).  Default compact backbone; pass
+    backbone="vgg16_reduced" for the reference feature pyramid (scaled
+    conv4_3 + atrous fc7 + chained extras)."""
     sizes = [(0.07, 0.1025), (0.15, 0.2121), (0.3, 0.3674), (0.45, 0.5196),
              (0.6, 0.6708), (0.75, 0.8216), (0.9, 0.9721)]
-    ratios = [(1, 2, 0.5)] * 2 + [(1, 2, 0.5, 3, 1.0 / 3)] * 3 + \
+    # per-scale anchors [4,6,6,6,6,4,4] (REF symbol_factory 512 config)
+    ratios = [(1, 2, 0.5)] + [(1, 2, 0.5, 3, 1.0 / 3)] * 4 + \
         [(1, 2, 0.5)] * 2
     return SSD(num_classes, sizes, ratios, **kwargs)
 
 
 def ssd_300(num_classes=20, **kwargs):
+    """SSD-300 anchor configuration (REF:example/ssd/symbol/symbol_factory
+    get_config('vgg16_reduced', 300)): per-scale anchors [4,6,6,6,4,4];
+    with backbone="vgg16_reduced" the reference tail geometry (stride-1
+    valid convs, 38/19/10/5/3/1 maps) reproduces the canonical 8732
+    anchors."""
     sizes = [(0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
              (0.71, 0.79), (0.88, 0.961)]
-    ratios = [(1, 2, 0.5)] * 2 + [(1, 2, 0.5, 3, 1.0 / 3)] * 3 + \
-        [(1, 2, 0.5)]
+    ratios = [(1, 2, 0.5)] + [(1, 2, 0.5, 3, 1.0 / 3)] * 3 + \
+        [(1, 2, 0.5)] * 2
+    if kwargs.get("backbone") == "vgg16_reduced" and \
+            "extra_specs" not in kwargs:
+        kwargs["extra_specs"] = [(2, 1), (2, 1), (1, 0), (1, 0)]
     return SSD(num_classes, sizes, ratios, **kwargs)
