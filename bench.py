@@ -298,6 +298,21 @@ def _resnet_once(smoke, layout, stem, batch):
     shape = (batch, size, size, 3) if layout == "NHWC" else (batch, 3, size, size)
     with default_layout(layout):
         net = getattr(vision, factory)(classes=classes, stem=stem)
+    if os.environ.get("BENCH_RESNET_REMAT", "0") == "1" and not smoke:
+        # A/B knob, measured and REJECTED as a default (r4: 1847.2 vs
+        # 2371.5 img/s at batch 256): recomputed conv outputs re-
+        # materialize in HBM during the backward, so full-block remat ADDS
+        # a pass over the conv activations on this bandwidth-bound step
+        # (docs/performance.md roofline). Kept for memory-bound configs
+        # where remat buys otherwise-impossible batch.
+        from tpu_mx.gluon import nn as _nn
+        n_remat = 0
+        for stage in net.features._children.values():
+            if isinstance(stage, _nn.HybridSequential):
+                for blk in stage._children.values():
+                    blk.remat()
+                    n_remat += 1
+        log(f"resnet: remat enabled on {n_remat} residual blocks")
     net.initialize(init="xavier")
     x = nd.array(np.random.rand(*shape).astype(np.float32))
     _ = net(x)  # finalize deferred shapes

@@ -526,17 +526,25 @@ def test_parameter_own_init_beats_global_initializer():
                                   weight_initializer=init_mod.Constant(2.5))
             self.scale = self.params.get("scale", shape=(1, 8),
                                          init=init_mod.Constant(20.0))
+            # no init of its own: must fall through to the GLOBAL default
+            self.raw = self.params.get("raw", shape=(2, 3))
 
-        def hybrid_forward(self, F, x, scale):
-            return self.dense(x) * scale[:, :4]
+        def hybrid_forward(self, F, x, scale, raw):
+            return self.dense(x) * scale[:, :4] + raw.sum()
 
     net = WithConst()
-    net.initialize(init="xavier")
+    # a Constant global makes the fall-through observable: a param whose
+    # own init were (incorrectly) consulted first could never land on 3.0
+    net.initialize(init=init_mod.Constant(3.0))
     np.testing.assert_array_equal(
         net.scale.data().asnumpy(), np.full((1, 8), 20.0, np.float32))
     np.testing.assert_array_equal(
         net.dense.weight.data().asnumpy(),
         np.full((4, 3), 2.5, np.float32))
-    # params WITHOUT their own init still get the global default
-    b = net.dense.bias.data().asnumpy()
-    np.testing.assert_array_equal(b, np.zeros(4, np.float32))  # bias init
+    # param WITHOUT its own init gets the global default...
+    np.testing.assert_array_equal(
+        net.raw.data().asnumpy(), np.full((2, 3), 3.0, np.float32))
+    # ...while Dense's bias keeps its OWN default init (zeros), which
+    # also takes precedence over the global
+    np.testing.assert_array_equal(
+        net.dense.bias.data().asnumpy(), np.zeros(4, np.float32))

@@ -20,6 +20,8 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -44,12 +46,11 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
+    from tpu_mx.runtime import fetch_sync
     from jax import lax
     from jax.experimental.shard_map import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     from tpu_mx.parallel import make_mesh
 
     n = args.devices or len(jax.devices())
@@ -78,11 +79,13 @@ def main():
                            out_specs=(P(None) if name == "all_gather"
                                       else P(ax)), check_rep=False)
             jitted = jax.jit(sm)
-            jitted(x).block_until_ready()  # compile+warm
+            # bound by a host fetch (tpu_mx.runtime.fetch_sync), not
+            # block_until_ready, which lies on the tunneled axon backend
+            fetch_sync(jitted(x)[:1])  # compile+warm
             t0 = time.perf_counter()
             for _ in range(args.iters):
                 out = jitted(x)
-            out.block_until_ready()
+            fetch_sync(out[:1])
             dt = (time.perf_counter() - t0) / args.iters
             moved = factor * elems_per_dev * 4
             print(json.dumps({

@@ -1,0 +1,154 @@
+"""Long-context single-chip sweep: flash-kernel causal attention fwd+bwd
+tokens/sec across sequence lengths (SURVEY §5.7; LONGCTX_r04.json was
+produced ad hoc last session — this makes the measurement reproducible
+and extends it to T=64k).
+
+The flash kernel's O(T) memory is what makes ≥16k context possible on one
+16 GB chip at all: dense attention's backward materializes O(B·H·T²)
+probabilities (≥12 GB at T=16k) and OOMs.  Ring attention (sp-sharded)
+extends the same kernel across a pod slice — that path is exercised by
+tests/test_parallel.py and the driver's dryrun; this tool measures the
+single-chip kernel roofline.
+
+    python tools/longctx_bench.py [--out LONGCTX_r04.json]
+                                  [--lens 4096,8192,...] [--dense-at 8192]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg):
+    print(f"[longctx {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def measure(attn_fn, b, h, t, d, iters=10):
+    import jax
+    import jax.numpy as jnp
+    from tpu_mx.runtime import fetch_sync
+    key = jax.random.PRNGKey(0)
+    qk, kk, vk = jax.random.split(key, 3)
+    q = jax.random.normal(qk, (b, h, t, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
+    v = jax.random.normal(vk, (b, h, t, d), jnp.bfloat16)
+
+    def loss_and_grads(q, k, v):
+        l, g = jax.value_and_grad(
+            lambda q, k, v: attn_fn(q, k, v).astype(jnp.float32).mean(),
+            argnums=(0, 1, 2))(q, k, v)
+        return l, g
+
+    step = jax.jit(loss_and_grads)
+    # timing is bounded by fetch_sync (host fetch of the scalar loss), not
+    # block_until_ready — see tpu_mx.runtime.fetch_sync: the tunneled
+    # backend's block_until_ready returns before execution finishes (the
+    # first run of this tool recorded 0.04 ms "steps" at T=32k vs the
+    # 44 ms a fetch-bounded run measures)
+    fetch_sync(step(q, k, v)[0])                  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        l, _ = step(q, k, v)
+    fetch_sync(l)
+    dt = (time.perf_counter() - t0) / iters
+    return {"ms_per_step": round(dt * 1e3, 2),
+            "tok_per_s": int(b * t / dt)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "LONGCTX_r04.json"))
+    ap.add_argument("--lens", default="4096,8192,16384,32768,65536")
+    ap.add_argument("--dense-at", type=int, default=8192,
+                    help="also measure XLA dense attention at this T "
+                         "(0 disables); T>=16384 dense OOMs by design")
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    from tpu_mx.runtime import enable_shared_compilation_cache
+    enable_shared_compilation_cache()
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        log(f"platform is {platform}, not tpu; refusing to overwrite the "
+            "hardware artifact")
+        return 1
+    from tpu_mx.kernels.flash_attention import mha_flash_attention
+
+    b, h, d = 1, args.heads, args.dim
+    record = {
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S+0000", time.gmtime()),
+        "config": f"single chip, B={b} H={h} D={d} bf16, causal, full "
+                  f"fwd+bwd, loss-fetch-bounded timing, steady state "
+                  f"({args.iters} iters)",
+        "flash_kernel": {}, "dense_comparison": {},
+    }
+    flash = lambda q, k, v: mha_flash_attention(q, k, v, causal=True)
+    for t in [int(x) for x in args.lens.split(",") if x.strip()]:
+        log(f"flash T={t}...")
+        try:
+            record["flash_kernel"][f"T={t}"] = measure(
+                flash, b, h, t, d, args.iters)
+            log(f"  {record['flash_kernel'][f'T={t}']}")
+        except Exception as e:
+            record["flash_kernel"][f"T={t}"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
+            log(f"  T={t} failed: {type(e).__name__}")
+        with open(args.out + ".tmp", "w") as f:
+            json.dump(record, f, indent=1)
+        os.replace(args.out + ".tmp", args.out)
+
+    if args.dense_at:
+        import jax.numpy as jnp
+
+        def dense(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) / (d ** 0.5)
+            tq = s.shape[-2]
+            mask = jnp.arange(tq)[:, None] >= jnp.arange(tq)[None, :]
+            p = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p,
+                              v.astype(jnp.float32)).astype(q.dtype)
+
+        t = args.dense_at
+        log(f"dense T={t}...")
+        try:
+            rec = measure(dense, b, h, t, d, args.iters)
+            ft = record["flash_kernel"].get(f"T={t}", {}).get("ms_per_step")
+            if ft:
+                rec["note"] = (
+                    f"flash is {rec['ms_per_step'] / ft:.2f}x faster than "
+                    f"dense at T={t}; dense backward's O(B*H*T^2) "
+                    "probabilities stop fitting HBM at T>=16384 - flash's "
+                    "O(T) memory is what makes single-chip long context "
+                    "possible")
+        except Exception as e:
+            # e.g. --dense-at 16384: the dense backward OOMs by design —
+            # record it like a flash T-failure instead of losing the run
+            rec = {"error": f"{type(e).__name__}: {e}"[:300]}
+            log(f"  dense T={t} failed: {type(e).__name__}")
+        record["dense_comparison"][f"T={t}"] = rec
+    record["note"] = (
+        "SURVEY 5.7 long-context on real silicon; ring attention "
+        "(sp-sharded) extends this across a pod slice. Timing is "
+        "loss-fetch-bounded (block_until_ready does not synchronize on "
+        "the tunneled backend); supersedes the earlier under-synchronized "
+        "sweep that reported 1.17M tok/s at T=16k.")
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    log(f"done: {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,26 @@ and which optional subsystems of this framework are importable.
 """
 from __future__ import annotations
 
-__all__ = ["Feature", "Features", "feature_list"]
+__all__ = ["Feature", "Features", "feature_list", "fetch_sync"]
+
+
+def fetch_sync(x):
+    """Synchronize on device work by FETCHING data to the host, returning
+    the fetched numpy array.
+
+    The one reliable execution barrier on the tunneled axon backend:
+    ``jax.block_until_ready`` there returns before execution finishes
+    (bench.py measured 0.04 ms "steps" for 44 ms of work), so every
+    timing loop in this repo bounds itself with a device->host copy —
+    programs execute in submission order on the single stream, so
+    fetching the LAST result proves all prior work completed.  Pass a
+    small slice/scalar (e.g. ``loss`` or ``out[:1]``), not a big tensor:
+    the fetch itself rides the tunnel.  Used by tools/longctx_bench.py
+    and tools/bandwidth.py; bench.py and tools/tpu_validate.py keep
+    equivalent inline fetches (bench's outer supervisor imports no
+    tpu_mx by design)."""
+    import numpy as np
+    return np.asarray(x)
 
 
 class Feature:
