@@ -455,6 +455,18 @@ def _bert_once(smoke, batch):
 
 
 def bench_lstm(smoke):
+    # 2048-first: the r4 third-session on-chip sweep measured
+    # 512 -> 648k, 1024 -> 710k, 2048 -> 743k, 4096 -> 714k tok/s —
+    # the scan amortizes per-step overhead up to 2048, then HBM pressure
+    # wins.  Batch is recorded in the emitted record; PTB convergence
+    # configs are far smaller (the classic is 20-32) and this metric is
+    # per-chip THROUGHPUT at the annotated batch.
+    ladder = _batch_ladder("BENCH_LSTM_BATCH",
+                           (4,) if smoke else (2048, 1024, 512))
+    return _run_ladder("lstm", ladder, lambda b: _lstm_once(smoke, b))
+
+
+def _lstm_once(smoke, batch):
     """PTB word-level LSTM LM (BASELINE workload 3): medium config
     (vocab 10k, 2×650, bptt 35), full compiled train step, tokens/s.
     No A100 comparator ballpark exists in BASELINE.md for this workload,
@@ -468,11 +480,10 @@ def bench_lstm(smoke):
     from tpu_mx.parallel import CompiledTrainStep
 
     if smoke:
-        vocab, emb, hid, layers, bptt, batch = 1000, 64, 64, 1, 8, 4
+        vocab, emb, hid, layers, bptt = 1000, 64, 64, 1, 8
         warmup, iters, repeats = 1, 3, 1
     else:
-        vocab, emb, hid, layers, bptt, batch = 10000, 650, 650, 2, 35, 512
-        batch = int(os.environ.get("BENCH_LSTM_BATCH", batch))
+        vocab, emb, hid, layers, bptt = 10000, 650, 650, 2, 35
         warmup, iters, repeats = 3, 20, 3
     iters = int(os.environ.get("BENCH_ITERS", iters))
 
@@ -512,6 +523,17 @@ def bench_lstm(smoke):
 
 
 def bench_ssd(smoke):
+    # 128-first: the r4 third-session on-chip sweep measured
+    # 32 -> 186.5, 64 -> 282.2, 128 -> 485.2 img/s, 256 -> OOM —
+    # per-step fixed cost (anchor/target gen, many small heads)
+    # dominated the old batch-32 default.  128 is one doubling from the
+    # OOM point, so the ladder keeps the fallbacks.
+    ladder = _batch_ladder("BENCH_SSD_BATCH",
+                           (2,) if smoke else (128, 64, 32))
+    return _run_ladder("ssd", ladder, lambda b: _ssd_once(smoke, b))
+
+
+def _ssd_once(smoke, batch):
     """SSD-512 detection training (BASELINE workload 5): anchors +
     MultiBoxTarget matching with hard negative mining + CE/smooth-L1,
     all inside ONE compiled train step (target generation included, under
@@ -525,13 +547,12 @@ def bench_ssd(smoke):
     from tpu_mx.parallel import CompiledTrainStep
 
     if smoke:
-        size, batch, classes = 64, 2, 3
+        size, classes = 64, 3
         warmup, iters, repeats = 1, 2, 1
         net = SSD(classes, sizes=[[0.2, 0.35], [0.5, 0.7]],
                   ratios=[[1, 2, 0.5]] * 2, base_filters=(8, 16))
     else:
-        size, batch, classes = 512, 32, 20
-        batch = int(os.environ.get("BENCH_SSD_BATCH", batch))
+        size, classes = 512, 20
         warmup, iters, repeats = 3, 10, 3
         net = ssd_512(classes)
     iters = int(os.environ.get("BENCH_ITERS", iters))

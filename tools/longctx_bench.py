@@ -92,6 +92,17 @@ def main():
                   f"({args.iters} iters)",
         "flash_kernel": {}, "dense_comparison": {},
     }
+    # a partial rerun (--lens 65536 retry after a transport blip) must
+    # MERGE into the existing artifact, not clobber the other rows (the
+    # mfu_probe rule); this run's rows still replace their own keys
+    try:
+        with open(args.out) as f:
+            prior = json.load(f)
+        for sect in ("flash_kernel", "dense_comparison"):
+            if isinstance(prior.get(sect), dict):
+                record[sect].update(prior[sect])
+    except (OSError, ValueError):
+        pass
     flash = lambda q, k, v: mha_flash_attention(q, k, v, causal=True)
     for t in [int(x) for x in args.lens.split(",") if x.strip()]:
         log(f"flash T={t}...")

@@ -186,12 +186,35 @@ def main():
     record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
               "platform": platform, "peak_flops": V5E_PEAK_FLOPS,
               "configs": {}}
+    try:
+        with open(args.out) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        prior = {}
+    if platform != "tpu" and prior.get("platform") == "tpu":
+        # never clobber a hardware artifact from a TPU-less process (the
+        # longctx_bench rule): a tunnel-down run or a --cpu smoke pointed
+        # at the default --out would replace real rows with a skip/smoke
+        # record
+        log(f"platform is {platform}, not tpu; refusing to overwrite "
+            f"the hardware artifact {args.out} (pass --out elsewhere "
+            "for a smoke run)")
+        return 1
+    # a partial run (--configs retry after one transport blip) must MERGE
+    # into the existing artifact, not clobber the other rows: keep prior
+    # same-platform rows for configs this run does not touch (this run's
+    # result, including a recorded error, still replaces its own row)
+    if not args.cpu and prior.get("platform") == platform and \
+            isinstance(prior.get("configs"), dict):
+        record["configs"].update(prior["configs"])
     if platform != "tpu" and not args.cpu:
         record["skipped"] = True
         record["reason"] = f"platform is {platform}, not tpu"
         log(record["reason"])
+        probed = []
     else:
         record["skipped"] = False
+        probed = []  # keys THIS run attempts (exit code ignores merged rows)
         seen_ok = set()
         for item in args.configs.split(","):
             model, b = item.strip().split(":")
@@ -200,6 +223,7 @@ def main():
                 batch = min(batch, 8)
             if model in seen_ok and args.cpu:
                 continue
+            probed.append(f"{model}:{batch}")
             t0 = time.perf_counter()
             try:
                 rec = probe_one(model, batch)
@@ -220,8 +244,8 @@ def main():
     with open(args.out + ".tmp", "w") as f:
         json.dump(record, f, indent=1)
     os.replace(args.out + ".tmp", args.out)
-    ok = (not record["skipped"] and
-          any("error" not in c for c in record["configs"].values()))
+    ok = (not record["skipped"] and probed and
+          any("error" not in record["configs"][k] for k in probed))
     log(f"done: {args.out}")
     return 0 if ok else 1
 
