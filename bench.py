@@ -26,10 +26,13 @@ set if every attempt died.
 Env knobs: BENCH_SMOKE=1 (CPU smoke, small shapes), BENCH_LAYOUT=NCHW
 (default NHWC), BENCH_STEM=classic (default s2d), BENCH_BATCH / BENCH_ITERS /
 BENCH_BERT_BATCH / BENCH_LSTM_BATCH / BENCH_SSD_BATCH overrides,
-BENCH_MODELS ⊆ {resnet50, bert, scaling, lstm, ssd} (default resnet50,bert;
+BENCH_MODELS ⊆ {resnet50, bert, scaling, lstm, ssd} (default
+resnet50,bert,lstm,ssd — all four BASELINE workload benches, so the
+driver's round-end record carries every hardware number; per-metric
+persistence keeps a mid-sweep wedge from losing the earlier legs;
 scaling = weak-scaling efficiency over all visible devices, BASELINE
-metric 3; lstm/ssd = BASELINE workloads 3 and 5, no A100 comparator),
-BENCH_ATTEMPTS (default 3), BENCH_TIMEOUT seconds per attempt (default 900).
+metric 3, needs a multi-device mesh),
+BENCH_ATTEMPTS (default 2), BENCH_TIMEOUT seconds per attempt (default 2400).
 """
 from __future__ import annotations
 
@@ -674,7 +677,8 @@ def inner():
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
     stem = os.environ.get("BENCH_STEM", "s2d")
     models = [m.strip() for m in
-              os.environ.get("BENCH_MODELS", "resnet50,bert").split(",")
+              os.environ.get("BENCH_MODELS",
+                             "resnet50,bert,lstm,ssd").split(",")
               if m.strip()]
     unknown = set(models) - {"resnet50", "bert", "scaling", "lstm", "ssd"}
     if unknown or not models:
@@ -838,9 +842,12 @@ def _run_attempt(timeout, probe_timeout):
 
 def outer():
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
-    # two full workloads now compile+run in one attempt (~8-12 min on the
-    # tunneled chip); 1500s keeps a slow-but-alive run from being killed
-    timeout = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+    # all four workloads compile+run in one attempt (~13 min measured on
+    # the tunneled chip with a cold cache, 2026-07-31); 2400s keeps a
+    # slow-but-alive 4-model sweep from being killed mid-run — and
+    # per-metric persistence means even a killed attempt keeps its
+    # finished legs
+    timeout = float(os.environ.get("BENCH_TIMEOUT", "2400"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
     last_err = "unknown"
     for attempt in range(1, attempts + 1):

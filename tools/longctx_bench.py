@@ -84,39 +84,39 @@ def main():
         return 1
     from tpu_mx.kernels.flash_attention import mha_flash_attention
 
+    from artifact_protocol import (load_prior, merge_prior_sections,
+                                   write_atomic)
+
     b, h, d = 1, args.heads, args.dim
+    # every row carries its own geometry: merged-in rows may come from a
+    # run with different --heads/--dim/--iters, and the row is the only
+    # place that provenance survives the merge
+    geom = {"B": b, "H": h, "D": d, "iters": args.iters}
     record = {
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S+0000", time.gmtime()),
-        "config": f"single chip, B={b} H={h} D={d} bf16, causal, full "
-                  f"fwd+bwd, loss-fetch-bounded timing, steady state "
-                  f"({args.iters} iters)",
+        "config": "single chip, bf16, causal, full fwd+bwd, "
+                  "loss-fetch-bounded timing, steady state; per-row "
+                  "geometry in each entry",
+        "platform": platform,
         "flash_kernel": {}, "dense_comparison": {},
     }
     # a partial rerun (--lens 65536 retry after a transport blip) must
     # MERGE into the existing artifact, not clobber the other rows (the
-    # mfu_probe rule); this run's rows still replace their own keys
-    try:
-        with open(args.out) as f:
-            prior = json.load(f)
-        for sect in ("flash_kernel", "dense_comparison"):
-            if isinstance(prior.get(sect), dict):
-                record[sect].update(prior[sect])
-    except (OSError, ValueError):
-        pass
+    # artifact_protocol contract); this run's rows replace their own keys
+    merge_prior_sections(record, load_prior(args.out),
+                         ("flash_kernel", "dense_comparison"))
     flash = lambda q, k, v: mha_flash_attention(q, k, v, causal=True)
     for t in [int(x) for x in args.lens.split(",") if x.strip()]:
         log(f"flash T={t}...")
         try:
-            record["flash_kernel"][f"T={t}"] = measure(
-                flash, b, h, t, d, args.iters)
+            record["flash_kernel"][f"T={t}"] = dict(
+                measure(flash, b, h, t, d, args.iters), **geom)
             log(f"  {record['flash_kernel'][f'T={t}']}")
         except Exception as e:
-            record["flash_kernel"][f"T={t}"] = {
-                "error": f"{type(e).__name__}: {e}"[:300]}
+            record["flash_kernel"][f"T={t}"] = dict(
+                {"error": f"{type(e).__name__}: {e}"[:300]}, **geom)
             log(f"  T={t} failed: {type(e).__name__}")
-        with open(args.out + ".tmp", "w") as f:
-            json.dump(record, f, indent=1)
-        os.replace(args.out + ".tmp", args.out)
+        write_atomic(args.out, record)
 
     if args.dense_at:
         import jax.numpy as jnp
@@ -134,7 +134,13 @@ def main():
         log(f"dense T={t}...")
         try:
             rec = measure(dense, b, h, t, d, args.iters)
-            ft = record["flash_kernel"].get(f"T={t}", {}).get("ms_per_step")
+            # only compare against a flash row of the SAME geometry: a
+            # merged-in prior row may have been measured with different
+            # --heads/--dim/--iters, and a cross-geometry ratio would be
+            # a wrong claim with self-consistent-looking fields
+            frow = record["flash_kernel"].get(f"T={t}", {})
+            ft = frow.get("ms_per_step") if all(
+                frow.get(k) == v for k, v in geom.items()) else None
             if ft:
                 rec["note"] = (
                     f"flash is {rec['ms_per_step'] / ft:.2f}x faster than "
@@ -147,16 +153,14 @@ def main():
             # record it like a flash T-failure instead of losing the run
             rec = {"error": f"{type(e).__name__}: {e}"[:300]}
             log(f"  dense T={t} failed: {type(e).__name__}")
-        record["dense_comparison"][f"T={t}"] = rec
+        record["dense_comparison"][f"T={t}"] = dict(rec, **geom)
     record["note"] = (
         "SURVEY 5.7 long-context on real silicon; ring attention "
         "(sp-sharded) extends this across a pod slice. Timing is "
         "loss-fetch-bounded (block_until_ready does not synchronize on "
         "the tunneled backend); supersedes the earlier under-synchronized "
         "sweep that reported 1.17M tok/s at T=16k.")
-    with open(args.out + ".tmp", "w") as f:
-        json.dump(record, f, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+    write_atomic(args.out, record)
     log(f"done: {args.out}")
     return 0
 

@@ -28,6 +28,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 V5E_PEAK_FLOPS = 197e12
 
+# the default probe sweep; tools/tpu_watch.py imports this so its
+# done-predicate can never drift from what the probe actually produces
+# (a hand-maintained copy once listed a key the probe never emitted,
+# and the watcher re-ran the probe every backoff cycle)
+DEFAULT_CONFIGS = ("resnet:256", "resnet:512", "bert:512", "bert:256",
+                   "bert_flash:512")
+
 
 def log(msg):
     print(f"[mfu {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
@@ -169,9 +176,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(REPO,
                                                   "MFU_PROBE_r04.json"))
-    ap.add_argument("--configs",
-                    default="resnet:256,resnet:512,bert:512,bert:256,"
-                            "bert_flash:512")
+    ap.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (harness smoke; mirrors conftest)")
     args = ap.parse_args()
@@ -183,19 +188,13 @@ def main():
         from tpu_mx.runtime import enable_shared_compilation_cache
         enable_shared_compilation_cache()
     platform = jax.devices()[0].platform
+    from artifact_protocol import (load_prior, merge_prior_sections,
+                                   refuses_clobber, write_atomic)
     record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
               "platform": platform, "peak_flops": V5E_PEAK_FLOPS,
               "configs": {}}
-    try:
-        with open(args.out) as f:
-            prior = json.load(f)
-    except (OSError, ValueError):
-        prior = {}
-    if platform != "tpu" and prior.get("platform") == "tpu":
-        # never clobber a hardware artifact from a TPU-less process (the
-        # longctx_bench rule): a tunnel-down run or a --cpu smoke pointed
-        # at the default --out would replace real rows with a skip/smoke
-        # record
+    prior = load_prior(args.out)
+    if refuses_clobber(prior, platform):
         log(f"platform is {platform}, not tpu; refusing to overwrite "
             f"the hardware artifact {args.out} (pass --out elsewhere "
             "for a smoke run)")
@@ -204,9 +203,9 @@ def main():
     # into the existing artifact, not clobber the other rows: keep prior
     # same-platform rows for configs this run does not touch (this run's
     # result, including a recorded error, still replaces its own row)
-    if not args.cpu and prior.get("platform") == platform and \
-            isinstance(prior.get("configs"), dict):
-        record["configs"].update(prior["configs"])
+    if not args.cpu:
+        merge_prior_sections(record, prior, ("configs",),
+                             require_platform=platform)
     if platform != "tpu" and not args.cpu:
         record["skipped"] = True
         record["reason"] = f"platform is {platform}, not tpu"
@@ -238,12 +237,8 @@ def main():
                     "oom": _is_oom(e),
                     "seconds": round(time.perf_counter() - t0, 1)}
                 log(f"{model}:{batch} FAILED {err}")
-            with open(args.out + ".tmp", "w") as f:
-                json.dump(record, f, indent=1)
-            os.replace(args.out + ".tmp", args.out)
-    with open(args.out + ".tmp", "w") as f:
-        json.dump(record, f, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+            write_atomic(args.out, record)
+    write_atomic(args.out, record)
     ok = (not record["skipped"] and probed and
           any("error" not in record["configs"][k] for k in probed))
     log(f"done: {args.out}")

@@ -113,11 +113,12 @@ def bench_done():
         return False
 
 
-# must match tools/mfu_probe.py's default --configs exactly: a key the
-# probe never produces keeps mfu_done() false forever and the watcher
-# would re-run the probe on every backoff cycle
-MFU_EXPECTED = ("resnet:256", "resnet:512", "bert:512", "bert:256",
-                "bert_flash:512")
+# imported from the probe itself so the done-predicate can never drift
+# from what the probe actually produces (a hand-maintained copy once
+# listed a key the probe never emitted — mfu_done() stayed false and the
+# watcher re-ran the 90-minute probe every backoff cycle)
+from mfu_probe import DEFAULT_CONFIGS as MFU_EXPECTED  # noqa: E402
+from artifact_protocol import write_atomic  # noqa: E402
 
 
 def mfu_done():
@@ -139,9 +140,7 @@ def mfu_done():
 
 def write_status(**kw):
     kw["ts"] = ts()
-    with open(STATUS + ".tmp", "w") as f:
-        json.dump(kw, f, indent=1)
-    os.replace(STATUS + ".tmp", STATUS)
+    write_atomic(STATUS, kw)
 
 
 def main():
@@ -176,9 +175,7 @@ def main():
                          if ln.startswith("{")]
                 if rc == 0 and lines:
                     rec = json.loads(lines[-1])
-                    with open(BENCH_OUT + ".tmp", "w") as f:
-                        json.dump(rec, f, indent=1)
-                    os.replace(BENCH_OUT + ".tmp", BENCH_OUT)
+                    write_atomic(BENCH_OUT, rec)
                     log(f"bench record: value={rec.get('value')} "
                         f"stale={rec.get('stale', False)}")
                     ok = ok and rec.get("value", 0) > 0 and \
