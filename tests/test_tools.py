@@ -392,3 +392,54 @@ def test_launch_two_process_compiled_train_step(tmp_path):
         loss = step.step(nd.array(x), nd.array(y))
     np.testing.assert_allclose(float(np.asarray(loss._data)),
                                losses["0"], rtol=1e-5)
+
+
+def test_artifact_protocol_merge_and_clobber_guard(tmp_path):
+    """The on-chip artifact write contract (tools/artifact_protocol.py):
+    partial reruns merge (own keys win, sibling rows survive), a TPU-less
+    process refuses to clobber a platform=tpu artifact, cross-platform
+    rows never merge, and writes are atomic + corruption-tolerant."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from artifact_protocol import (load_prior, merge_prior_sections,
+                                       refuses_clobber, write_atomic)
+    finally:
+        sys.path.pop(0)
+
+    out = str(tmp_path / "artifact.json")
+    # absent / corrupt priors load as {}
+    assert load_prior(out) == {}
+    with open(out, "w") as f:
+        f.write("{not json")
+    assert load_prior(out) == {}
+    with open(out, "w") as f:
+        f.write('["a", "list"]')
+    assert load_prior(out) == {}
+
+    full = {"platform": "tpu",
+            "configs": {"a:1": {"v": 1}, "b:2": {"v": 2}}}
+    write_atomic(out, full)
+    prior = load_prior(out)
+    assert prior == full
+
+    # a TPU-less process must refuse; a TPU process must not
+    assert refuses_clobber(prior, "cpu")
+    assert not refuses_clobber(prior, "tpu")
+    assert not refuses_clobber({}, "cpu")  # nothing to protect
+
+    # partial rerun: own key wins, sibling survives
+    rerun = {"platform": "tpu", "configs": {"b:2": {"v": 99}}}
+    merge_prior_sections(rerun, prior, ("configs",),
+                         require_platform="tpu")
+    assert rerun["configs"] == {"a:1": {"v": 1}, "b:2": {"v": 99}}
+
+    # cross-platform rows never merge
+    cpu_run = {"platform": "cpu", "configs": {"c:3": {"v": 3}}}
+    merge_prior_sections(cpu_run, prior, ("configs",),
+                         require_platform="cpu")
+    assert cpu_run["configs"] == {"c:3": {"v": 3}}
+
+    # without a platform gate the merge is unconditional (longctx mode)
+    ungated = {"flash": {"T=2": {"v": 2}}}
+    merge_prior_sections(ungated, {"flash": {"T=1": {"v": 1}}}, ("flash",))
+    assert ungated["flash"] == {"T=1": {"v": 1}, "T=2": {"v": 2}}

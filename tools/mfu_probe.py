@@ -31,7 +31,11 @@ V5E_PEAK_FLOPS = 197e12
 # the default probe sweep; tools/tpu_watch.py imports this so its
 # done-predicate can never drift from what the probe actually produces
 # (a hand-maintained copy once listed a key the probe never emitted,
-# and the watcher re-ran the probe every backoff cycle)
+# and the watcher re-ran the probe every backoff cycle).
+# BECAUSE of that import, this module's TOP LEVEL must stay stdlib-only:
+# hoisting `import jax` here would make the watcher (whose design
+# contract is "imports NO jax — a wedged backend hangs the importing
+# process in a C call") hang at startup exactly when the tunnel is down.
 DEFAULT_CONFIGS = ("resnet:256", "resnet:512", "bert:512", "bert:256",
                    "bert_flash:512")
 

@@ -9,7 +9,8 @@ with a hard timeout.  On the first successful probe it runs, in order:
   1. tools/tpu_validate.py   — the real-chip kernel validation sweep
                                (r3's never-chip-run Pallas tail), artifact
                                TPU_VALIDATION_r04.json
-  2. python bench.py         — the full ResNet+BERT bench; its inner
+  2. python bench.py         — all four workload benches (resnet50, bert,
+                               lstm, ssd — ~13+ min cold-cache); its inner
                                persists BENCH_LASTGOOD.json per sub-bench,
                                so even a mid-run wedge keeps the number;
                                final line lands in BENCH_WATCH_r04.json
