@@ -247,3 +247,44 @@ def test_simulated_wedge_without_lastgood_emits_zero(tmp_path):
     emitted = json.loads(line)
     assert emitted["value"] == 0.0
     assert "stale" not in emitted
+
+
+def test_run_ladder_oom_fallback():
+    """The batch ladder falls back on OOM only, keeps the first success,
+    and re-raises a last-rung OOM or any non-OOM error (the lstm/ssd
+    benches joined the ladder in r4 s3 — 128 sits one doubling from the
+    measured SSD OOM point, so the fallback is load-bearing)."""
+    bench = _load_bench_module()
+
+    calls = []
+
+    def oom_then_ok(batch):
+        calls.append(batch)
+        if batch > 64:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return {"batch": batch}
+
+    assert bench._run_ladder("t", (128, 64, 32), oom_then_ok) == \
+        {"batch": 64}
+    assert calls == [128, 64]
+
+    # non-OOM errors do not fall back
+    def boom(batch):
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError):
+        bench._run_ladder("t", (128, 64), boom)
+
+    # OOM on the last rung re-raises
+    def always_oom(batch):
+        raise RuntimeError("ran out of memory")
+
+    with pytest.raises(RuntimeError):
+        bench._run_ladder("t", (128,), always_oom)
+
+    # a bare "hbm" mention is NOT an OOM (guard against silent fallback)
+    def hbm_note(batch):
+        raise RuntimeError("hbm bandwidth note, not an allocation error")
+
+    with pytest.raises(RuntimeError):
+        bench._run_ladder("t", (128, 64), hbm_note)
