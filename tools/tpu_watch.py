@@ -91,15 +91,20 @@ def run_logged(tag, cmd, timeout, env=None):
 
 
 def validation_done():
-    """Done = ran on a real TPU and every executed check passed.  An
-    all-fail (or partial-fail) artifact keeps the watcher retrying on
-    later probes — the docstring contract is 'until they have SUCCEEDED
-    once'."""
+    """Done = ran on a real TPU, every check in the CURRENT suite has a
+    record, and every executed check passed.  Requiring every current
+    check name keeps this drift-proof the way MFU_EXPECTED is: a check
+    added after the artifact was recorded makes the watcher re-run the
+    sweep instead of calling stale coverage done.  An all-fail (or
+    partial-fail) artifact keeps the watcher retrying on later probes —
+    the docstring contract is 'until they have SUCCEEDED once'."""
+    from tpu_validate import CHECKS  # stdlib-only module top, like mfu_probe
     try:
         with open(VALIDATION) as f:
             rec = json.load(f)
         checks = rec.get("checks") or {}
         return rec.get("skipped") is False and checks and \
+            all(name in checks for name, _ in CHECKS) and \
             all(c.get("ok") in (True, None) for c in checks.values())
     except (OSError, ValueError, AttributeError):
         return False
