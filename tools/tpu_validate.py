@@ -470,6 +470,97 @@ def check_device_prefetch_feed():
             "step_loss": lval}
 
 
+def _consistency_compute(out_path):
+    """Shared body of the cross-backend oracle: eager conv+relu+pool+dense
+    forward/backward on WHATEVER backend this process has, saved to npz.
+    Ends in logits (NOT softmax — sum-of-softmax is constant 1, which
+    would zero every gradient and make the comparison vacuous)."""
+    import numpy as np
+    from tpu_mx import autograd, nd
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(4, 6, 6, 3).astype(np.float32)
+    w = (rng.rand(8, 3, 3, 3).astype(np.float32) - 0.5) * 0.5
+    dw = (rng.rand(10, 8).astype(np.float32) - 0.5) * 0.5
+    nds = [nd.array(a) for a in (x, w, dw)]
+    for a in nds:
+        a.attach_grad()
+    with autograd.record():
+        xx, ww, dd = nds
+        y = nd.Convolution(xx, ww, num_filter=8, kernel=(3, 3),
+                           pad=(1, 1), no_bias=True, layout="NHWC")
+        y = nd.Activation(y, act_type="relu")
+        y = nd.Pooling(y, kernel=(6, 6), pool_type="avg", global_pool=True,
+                       layout="NHWC")
+        logits = nd.FullyConnected(nd.flatten(y), dd, None, no_bias=True,
+                                   num_hidden=10)
+        # non-constant scalar: quadratic in the logits, grads exercise
+        # every input's backward
+        loss = (logits * logits).sum()
+    loss.backward()
+    np.savez(out_path, out=logits.asnumpy(),
+             **{f"g{i}": a.grad.asnumpy() for i, a in enumerate(nds)})
+
+
+@_highest_precision
+def check_cpu_tpu_consistency():
+    """SURVEY §4's check_consistency oracle on silicon: the same eager
+    conv+relu+pool+dense forward/backward on XLA:CPU and the real chip
+    must agree (the reference's [cpu, gpu] cross-backend check, TPU
+    edition).  The CPU leg runs in a SUBPROCESS with JAX_PLATFORMS=cpu —
+    this process is pinned to the axon platform at interpreter startup,
+    so in-process context.cpu(0) would silently fall back to the TPU
+    device and compare the chip against itself."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import numpy as np
+
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        raise AssertionError("not on a TPU backend")
+
+    with tempfile.TemporaryDirectory(prefix="tmx_consist_") as td:
+        cpu_npz = os.path.join(td, "cpu.npz")
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""   # sitecustomize skips axon
+        env["JAX_PLATFORMS"] = "cpu"
+        # no trailing empty entry: "REPO:" would make Python treat the
+        # CWD as a path entry and risk module shadowing
+        extra = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = REPO + (os.pathsep + extra if extra else "")
+        script = (
+            "import sys; sys.path.insert(0, %r); "
+            "import tpu_validate; "
+            "import jax; assert jax.devices()[0].platform == 'cpu'; "
+            "tpu_validate._consistency_compute(%r)"
+            % (os.path.dirname(os.path.abspath(__file__)), cpu_npz))
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            # the child's stderr is the only diagnostic there is — fold
+            # its tail into the artifact instead of a bare exit status
+            raise AssertionError(
+                "cpu reference subprocess failed (rc=%d): %s"
+                % (proc.returncode, (proc.stderr or "")[-800:]))
+        ref = np.load(cpu_npz)
+
+        tpu_npz = os.path.join(td, "tpu.npz")
+        _consistency_compute(tpu_npz)      # this process: the real chip
+        got = np.load(tpu_npz)
+
+        errs = {}
+        for key in ref.files:
+            scale = float(np.abs(ref[key]).max()) + 1e-8
+            rel = float(np.abs(got[key] - ref[key]).max()) / scale
+            errs[key] = rel
+            if rel > 2e-3:
+                raise AssertionError(
+                    f"cpu-vs-tpu mismatch on {key}: rel={rel:.5f}")
+    return {"ctxs": ["cpu (subprocess)", "tpu"], "rel_errs": errs}
+
+
 CHECKS = [
     ("flash_fwd_bwd_vs_dense", check_flash_fwd_bwd_vs_dense),
     ("flash_bias_layouts", check_flash_bias_layouts),
@@ -481,6 +572,7 @@ CHECKS = [
     ("async_checkpoint_under_training", check_async_checkpoint),
     ("quantized_inference_jit", check_quantized_inference_jit),
     ("device_prefetch_feed", check_device_prefetch_feed),
+    ("cpu_tpu_consistency", check_cpu_tpu_consistency),
 ]
 
 
