@@ -1,5 +1,5 @@
 """Shared write protocol for on-chip measurement artifacts
-(MFU_PROBE_r04.json, LONGCTX_r04.json, ...).
+(MFU_PROBE_<round>.json, LONGCTX_<round>.json, ...).
 
 The contract (see .claude/skills/verify/SKILL.md "hardware artifacts are
 merge-on-write"):
@@ -20,6 +20,19 @@ from __future__ import annotations
 
 import json
 import os
+
+# Round stamp for every hardware artifact this tree produces.  Single
+# source of truth: the watcher, validate sweep, MFU probe, long-context
+# bench and chip profiler all derive their default --out from here, so a
+# new round is one-line (or TPUMX_ROUND=rNN) instead of a five-file sweep.
+ROUND = os.environ.get("TPUMX_ROUND", "r05")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def artifact(name, ext="json"):
+    """Round-stamped artifact path at the repo root:
+    artifact("MFU_PROBE") -> <repo>/MFU_PROBE_r05.json."""
+    return os.path.join(_REPO, f"{name}_{ROUND}.{ext}")
 
 
 def load_prior(path):

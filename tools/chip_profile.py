@@ -5,7 +5,7 @@ ResNet step's time actually goes before guessing at levers).
 Runs the step under jax.profiler.trace, then parses the newest
 vm.trace.json.gz chrome trace: device-track complete events ("ph":"X")
 are bucketed by op-name family (fusion / convolution / copy / ...) and
-written to PROFILE_STEP_r04.json with per-family total microseconds and
+written to PROFILE_STEP_<round>.json with per-family total microseconds and
 the top individual ops.
 
 Usage (ONE jax process at a time — see .claude/skills/verify):
@@ -104,11 +104,16 @@ def main():
                     choices=["resnet", "bert"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "PROFILE_STEP_r04.json"))
+    ap.add_argument("--out", default=None,
+                    help="default: PROFILE_STEP_<round>.json for resnet, "
+                         "PROFILE_<MODEL>_<round>.json otherwise")
     ap.add_argument("--trace-dir", default="/tmp/tpumx_chip_trace")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
+    if args.out is None:
+        from artifact_protocol import artifact
+        args.out = artifact("PROFILE_STEP" if args.model == "resnet"
+                            else f"PROFILE_{args.model.upper()}")
 
     import jax
     if args.cpu:

@@ -1,5 +1,5 @@
 """Long-context single-chip sweep: flash-kernel causal attention fwd+bwd
-tokens/sec across sequence lengths (SURVEY §5.7; LONGCTX_r04.json was
+tokens/sec across sequence lengths (SURVEY §5.7; LONGCTX_<round>.json was
 produced ad hoc last session — this makes the measurement reproducible
 and extends it to T=64k).
 
@@ -10,7 +10,7 @@ extends the same kernel across a pod slice — that path is exercised by
 tests/test_parallel.py and the driver's dryrun; this tool measures the
 single-chip kernel roofline.
 
-    python tools/longctx_bench.py [--out LONGCTX_r04.json]
+    python tools/longctx_bench.py [--out LONGCTX_<round>.json]
                                   [--lens 4096,8192,...] [--dense-at 8192]
 """
 from __future__ import annotations
@@ -23,6 +23,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def log(msg):
@@ -63,8 +64,9 @@ def measure(attn_fn, b, h, t, d, iters=10):
 
 
 def main():
+    from artifact_protocol import artifact
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=os.path.join(REPO, "LONGCTX_r04.json"))
+    ap.add_argument("--out", default=artifact("LONGCTX"))
     ap.add_argument("--lens", default="4096,8192,16384,32768,65536")
     ap.add_argument("--dense-at", type=int, default=8192,
                     help="also measure XLA dense attention at this T "
@@ -102,19 +104,25 @@ def main():
     }
     # a partial rerun (--lens 65536 retry after a transport blip) must
     # MERGE into the existing artifact, not clobber the other rows (the
-    # artifact_protocol contract); this run's rows replace their own keys
+    # artifact_protocol contract); this run's rows replace their own keys.
+    # require_platform: a non-tpu-labeled prior must never be grafted
+    # into this platform=tpu artifact (advisor r4 finding #1)
     merge_prior_sections(record, load_prior(args.out),
-                         ("flash_kernel", "dense_comparison"))
+                         ("flash_kernel", "dense_comparison"),
+                         require_platform="tpu")
+    row_ts = lambda: time.strftime("%Y-%m-%dT%H:%M:%S+0000", time.gmtime())
     flash = lambda q, k, v: mha_flash_attention(q, k, v, causal=True)
     for t in [int(x) for x in args.lens.split(",") if x.strip()]:
         log(f"flash T={t}...")
         try:
             record["flash_kernel"][f"T={t}"] = dict(
-                measure(flash, b, h, t, d, args.iters), **geom)
+                measure(flash, b, h, t, d, args.iters), **geom,
+                measured_at=row_ts())
             log(f"  {record['flash_kernel'][f'T={t}']}")
         except Exception as e:
             record["flash_kernel"][f"T={t}"] = dict(
-                {"error": f"{type(e).__name__}: {e}"[:300]}, **geom)
+                {"error": f"{type(e).__name__}: {e}"[:300]}, **geom,
+                measured_at=row_ts())
             log(f"  T={t} failed: {type(e).__name__}")
         write_atomic(args.out, record)
 
@@ -153,7 +161,8 @@ def main():
             # record it like a flash T-failure instead of losing the run
             rec = {"error": f"{type(e).__name__}: {e}"[:300]}
             log(f"  dense T={t} failed: {type(e).__name__}")
-        record["dense_comparison"][f"T={t}"] = dict(rec, **geom)
+        record["dense_comparison"][f"T={t}"] = dict(rec, **geom,
+                                                    measured_at=row_ts())
     record["note"] = (
         "SURVEY 5.7 long-context on real silicon; ring attention "
         "(sp-sharded) extends this across a pod slice. Timing is "

@@ -9,7 +9,7 @@ records, side by side:
   - layout/copy smell counts from the compiled HLO (transpose/pad/copy),
   - the compiled memory analysis (are we near the 16 GB HBM ceiling?).
 
-Every config's record is persisted to MFU_PROBE_r04.json as soon as it
+Every config's record is persisted to MFU_PROBE_<round>.json as soon as it
 exists (the bench lastgood lesson — a mid-run tunnel wedge keeps earlier
 rows).  Run by tools/tpu_watch.py after the bench, or by hand:
     python tools/mfu_probe.py [--out PATH] [--configs resnet:512,...]
@@ -178,8 +178,8 @@ def _probe_one(model, batch):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "MFU_PROBE_r04.json"))
+    from artifact_protocol import artifact
+    ap.add_argument("--out", default=artifact("MFU_PROBE"))
     ap.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (harness smoke; mirrors conftest)")

@@ -8,16 +8,16 @@ with a hard timeout.  On the first successful probe it runs, in order:
 
   1. tools/tpu_validate.py   — the real-chip kernel validation sweep
                                (r3's never-chip-run Pallas tail), artifact
-                               TPU_VALIDATION_r04.json
+                               TPU_VALIDATION_<round>.json
   2. python bench.py         — all four workload benches (resnet50, bert,
                                lstm, ssd — ~13+ min cold-cache); its inner
                                persists BENCH_LASTGOOD.json per sub-bench,
                                so even a mid-run wedge keeps the number;
-                               final line lands in BENCH_WATCH_r04.json
+                               final line lands in BENCH_WATCH_<round>.json
 
 Both keep re-trying on later probes until they have succeeded once (the
 tunnel can die mid-run).  Probe results are appended to
-TPU_PROBE_LOG_r04.jsonl and a human-pollable summary is kept in
+TPU_PROBE_LOG_<round>.jsonl and a human-pollable summary is kept in
 TPU_WATCH_STATUS.json.
 """
 from __future__ import annotations
@@ -29,12 +29,19 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# tools-local imports (mfu_probe, tpu_validate, artifact_protocol) must
+# resolve regardless of the entry point — script-dir auto-prepend only
+# covers direct `python tools/tpu_watch.py` (advisor r4 finding #3)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from artifact_protocol import artifact  # noqa: E402
+
 LOGDIR = os.path.join(REPO, "watch_logs")
-PROBE_LOG = os.path.join(REPO, "TPU_PROBE_LOG_r04.jsonl")
+PROBE_LOG = artifact("TPU_PROBE_LOG", ext="jsonl")
 STATUS = os.path.join(REPO, "TPU_WATCH_STATUS.json")
-VALIDATION = os.path.join(REPO, "TPU_VALIDATION_r04.json")
-BENCH_OUT = os.path.join(REPO, "BENCH_WATCH_r04.json")
-MFU_OUT = os.path.join(REPO, "MFU_PROBE_r04.json")
+VALIDATION = artifact("TPU_VALIDATION")
+BENCH_OUT = artifact("BENCH_WATCH")
+MFU_OUT = artifact("MFU_PROBE")
 
 PROBE_TIMEOUT = 120
 PROBE_INTERVAL_DOWN = 180      # probe cadence while the tunnel is down
@@ -103,9 +110,11 @@ def validation_done():
         with open(VALIDATION) as f:
             rec = json.load(f)
         checks = rec.get("checks") or {}
+        # ok must be literally True: a --skip-bert {ok: None} row is an
+        # unmeasured check and must keep the watcher re-running the sweep
         return rec.get("skipped") is False and checks and \
             all(name in checks for name, _ in CHECKS) and \
-            all(c.get("ok") in (True, None) for c in checks.values())
+            all(c.get("ok") is True for c in checks.values())
     except (OSError, ValueError, AttributeError):
         return False
 
@@ -124,7 +133,8 @@ def bench_done():
 # listed a key the probe never emitted — mfu_done() stayed false and the
 # watcher re-ran the 90-minute probe every backoff cycle)
 from mfu_probe import DEFAULT_CONFIGS as MFU_EXPECTED  # noqa: E402
-from artifact_protocol import write_atomic  # noqa: E402
+from artifact_protocol import write_atomic  # noqa: E402  (see sys.path
+# insert at the top; artifact() is imported there for the path constants)
 
 
 def mfu_done():
