@@ -52,20 +52,9 @@ def _is_oom(e):
 
 
 def _compile_step(step, batch_args):
-    import jax.numpy as jnp
-    from tpu_mx import random as _random
-    raw = tuple(b._data if b is not None and hasattr(b, "_data") else b
-                for b in batch_args)
-    if step._jitted is None:
-        step._build(len(raw))
-        step.place()
-    key = _random.take_key()
-    gacc = step._gacc if step._accum > 1 else {}
-    lowered = step._jitted.lower(
-        step.values, step.masters, step.opt_states, step._efs, gacc,
-        jnp.asarray(1.0, jnp.float32), jnp.asarray(0.1, jnp.float32),
-        key, *raw)
-    return lowered.compile()
+    # the AOT lower+compile path lives on CompiledTrainStep itself now
+    # (bench.py's XLA-cost MFU shares it)
+    return step.aot_compiled(*batch_args)
 
 
 def _timed_steps(step, batch_args, warmup, iters):
@@ -173,6 +162,12 @@ def _probe_one(model, batch):
         rec["mfu_xla_cost"] = round(xla_flops / sec / V5E_PEAK_FLOPS, 4)
         rec["analytic_vs_xla_flops_ratio"] = round(
             (unit_flops * batch) / xla_flops, 4)
+    # ONE number of record (VERDICT r4 ask#9): mfu = the XLA-cost value
+    # when the backend exposes cost_analysis, analytic model otherwise;
+    # both raw fields stay for the cross-check
+    rec["mfu"] = rec.get("mfu_xla_cost", rec["mfu_analytic_model"])
+    rec["mfu_source"] = ("xla_cost_analysis" if xla_flops
+                         else "analytic_model")
     return rec
 
 

@@ -1,6 +1,8 @@
 """Gluon basic layers (REF:python/mxnet/gluon/nn/basic_layers.py)."""
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ... import autograd
@@ -172,6 +174,57 @@ class BatchNorm(HybridBlock):
         g = gamma if self._scale else F.ones_like(gamma)
         b = beta if self._center else F.zeros_like(beta)
         training = autograd.is_training() and not self._use_global_stats
+        if os.environ.get("TPUMX_BN_ONEPASS", "1") != "1":
+            return self._legacy_forward(F, x, g, b, running_mean,
+                                        running_var, red, shape, training)
+        # One-pass f32 statistics + folded scale/bias (r5 byte diet; the
+        # r4 roofline showed the bf16 ResNet step HBM-bound with 20.5 ms
+        # of convert_reduce fusions).  The legacy two-pass form computes
+        # var = mean(square(x - mean)), whose reduce DEPENDS on the mean
+        # reduce — two sequential full reads of the activation.  The
+        # sum/sum-of-squares form has no such dependency, so XLA sibling-
+        # fuses both reductions into ONE read of x.  Stats stay f32
+        # end-to-end (the legacy path round-tripped them through bf16 via
+        # jnp.mean's upcast-and-cast-back); the normalize applies as a
+        # single per-channel scale/bias folded in f32, cast once to
+        # x.dtype — so no activation-sized f32 appears anywhere.
+        n = 1
+        for i in red:
+            n *= x.shape[i]
+        if training:
+            xf = F.cast(x, dtype="float32")
+            s1 = F.sum(xf, axis=red)
+            s2 = F.sum(F.square(xf), axis=red)
+            mean = s1 * (1.0 / n)
+            # E[x^2]-E[x]^2 cancellation is benign here (f32 accumulation,
+            # post-conv activations are near zero-mean); clamp guards the
+            # var>=0 invariant against rounding
+            var = F.maximum(s2 * (1.0 / n) - F.square(mean), 0.0)
+            m = self._momentum
+            with autograd.pause():
+                rdt = str(running_mean.dtype)
+                new_mean = m * running_mean + \
+                    (1 - m) * F.cast(F.BlockGrad(mean), dtype=rdt)
+                new_var = m * running_var + \
+                    (1 - m) * F.cast(F.BlockGrad(var), dtype=rdt)
+                self.running_mean._register_mutation(
+                    new_mean._data if hasattr(new_mean, "_data") else new_mean)
+                self.running_var._register_mutation(
+                    new_var._data if hasattr(new_var, "_data") else new_var)
+        else:
+            mean = F.cast(running_mean, dtype="float32")
+            var = F.cast(running_var, dtype="float32")
+        inv = F.rsqrt(var + self._eps)
+        scale = inv * F.cast(g, dtype="float32")
+        bias = F.cast(b, dtype="float32") - mean * scale
+        dt = str(x.dtype)
+        return x * F.reshape(F.cast(scale, dtype=dt), shape=shape) + \
+            F.reshape(F.cast(bias, dtype=dt), shape=shape)
+
+    def _legacy_forward(self, F, x, g, b, running_mean, running_var, red,
+                        shape, training):
+        """Pre-r5 two-pass form (TPUMX_BN_ONEPASS=0): kept for the
+        on-chip A/B of the one-pass byte diet."""
         if training:
             mean = F.mean(x, axis=red)
             var = F.mean(F.square(x - F.reshape(mean, shape=shape)), axis=red)

@@ -1092,22 +1092,32 @@ def batch_norm_core(x, gamma, beta, moving_mean, moving_var, eps, use_batch_stat
                     axis=1, fix_gamma=False):
     """Pure BN forward; returns (out, batch_mean, batch_var).  Gluon's
     BatchNorm layer owns the running-stat update (the reference did it via
-    FMutateInputs on aux states — here state flows functionally, SURVEY §7.1)."""
+    FMutateInputs on aux states — here state flows functionally, SURVEY §7.1).
+    One-pass sum/sum-of-squares statistics (no mean->var reduce dependency,
+    so XLA sibling-fuses both into a single read of x) and a folded
+    per-channel scale/bias applied in x.dtype — the r5 HBM byte diet;
+    same formulation as gluon.nn.BatchNorm."""
     axis = axis % x.ndim
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if use_batch_stats:
         red = tuple(i for i in range(x.ndim) if i != axis)
+        n = 1
+        for i in red:
+            n *= x.shape[i]
         xf = x.astype(jnp.float32)
-        mu = xf.mean(axis=red)
-        var = jnp.square(xf - mu.reshape(shape)).mean(axis=red)
+        mu = xf.sum(axis=red) / n
+        var = jnp.maximum(
+            jnp.square(xf).sum(axis=red) / n - jnp.square(mu), 0.0)
     else:
-        mu, var = moving_mean, moving_var
-    y = (x.astype(jnp.float32) - mu.reshape(shape)) * lax.rsqrt(
-        var.reshape(shape) + eps)
-    y = y * g.reshape(shape) + beta.reshape(shape)
-    return y.astype(x.dtype), mu, var
+        mu = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+    scale = lax.rsqrt(var + eps) * g.astype(jnp.float32)
+    bias = beta.astype(jnp.float32) - mu * scale
+    y = x * scale.reshape(shape).astype(x.dtype) + \
+        bias.reshape(shape).astype(x.dtype)
+    return y, mu, var
 
 
 def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,

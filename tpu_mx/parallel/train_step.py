@@ -556,6 +556,31 @@ class CompiledTrainStep:
         for k, p in self._params.items():
             p._data._rebind(self.values[k])
 
+    def aot_compiled(self, *batch):
+        """Lower + compile the step WITHOUT executing it and return the
+        jax Compiled object (for cost_analysis / memory_analysis / HLO
+        text).  Shares the jit/persistent compile cache with step(), so
+        after a step() has run this is cache-hit cheap.  Used by bench.py
+        (XLA-cost MFU is the number-of-record, VERDICT r4 ask#9) and
+        tools/mfu_probe.py."""
+        raw = tuple(b._data if isinstance(b, NDArray)
+                    else (None if b is None else jnp.asarray(b))
+                    for b in batch)
+        if self._jitted is None:
+            self._build(len(raw))
+            self.place()
+        # a constant key: lowering only needs the shape/dtype, and an
+        # introspection helper must not advance the global RNG stream
+        # (that would silently change later dropout masks)
+        key = jax.random.PRNGKey(0)
+        gacc = self._gacc if self._accum > 1 else {}
+        lowered = self._jitted.lower(
+            self.values, self.masters, self.opt_states, self._efs, gacc,
+            jnp.asarray(float(self._t or 1), jnp.float32),
+            jnp.asarray(self.optimizer.lr or 0.1, jnp.float32),
+            key, *raw)
+        return lowered.compile()
+
     def state_dict(self):
         """Snapshot of the train state.  Leaves are COPIED: with buffer
         donation active (the default), later step() calls delete the live
