@@ -123,6 +123,11 @@ def main():
         except Exception as e:
             row = {"error": f"{type(e).__name__}: {e}"[:400]}
             log(f"  {name} failed: {row['error']}")
+        # self-describing rows (artifact_protocol contract): merged-in
+        # rows may come from runs with different --batch/--iters, and the
+        # row is the only place that provenance survives the merge
+        row["batch"] = b
+        row["iters"] = args.iters
         row["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S+0000",
                                            time.gmtime())
         record["arms"][name] = row
@@ -149,7 +154,11 @@ def main():
     # (scales are negligible); everything else stays float.  The arm's
     # OWN param_bytes must be the quantized footprint — reporting the
     # float source net's bytes there would claim int8 saves nothing.
+    # Skip entirely on a failed arm: an error row must not carry a
+    # fabricated footprint.
     try:
+        if "error" in int8:
+            raise RuntimeError("int8 arm failed; no footprint")
         wq = sum(p._data.size for name, p in qsrc.collect_params().items()
                  if name.endswith("weight") and p._data is not None)
         float_bytes = int8.get("param_bytes", 0)

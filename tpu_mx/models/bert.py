@@ -212,6 +212,17 @@ class BERTModel(HybridBlock):
         self.mlm_ln = nn.LayerNorm(in_channels=units)
         self.mlm_bias = self.params.get("mlm_bias",
                                         shape=(cfg["vocab_size"],))
+        # dtype= must mean the WHOLE model: until r5 only the three
+        # embedding tables honored it — every transformer/head weight
+        # stayed f32, f32 params promoted every activation, and the
+        # "bf16" BERT bench silently ran f32 elementwise/attention
+        # traffic (2x HBM bytes; caught by tools/dtype_audit.py).
+        # LayerNorm/softmax statistics still compute in f32 internally
+        # (ops.LayerNorm upcasts; attention scores are f32 by
+        # preferred_element_type).
+        if dtype and str(dtype) != "float32":
+            self.cast(dtype)
+        self._dtype = str(dtype)
 
     def hybrid_forward(self, F, tokens, token_types, valid_length=None,
                        masked_positions=None, mlm_bias=None):
@@ -228,7 +239,16 @@ class BERTModel(HybridBlock):
                 [x, masked_positions], "gather_masked")        # (B,M,U)
         h = F.gelu(self.mlm_dense(x))
         h = self.mlm_ln(h)
-        # tied decoder: logits = h · E^T  (one MXU matmul over vocab)
+        # tied decoder: logits = h · E^T  (one MXU matmul over vocab).
+        # Logits come out in f32 whatever the model dtype — and the f32
+        # must be the MXU ACCUMULATOR (preferred_element_type), not a
+        # cast after the output has already rounded to bf16: log-softmax
+        # over a 30k vocab is sensitive exactly at near-tied logits,
+        # where bf16's ~2-3 decimal digits lose the ranking.
         embed = self.encoder.word_embed_weight.data()
-        logits = F.dot(h, embed, transpose_b=True) + mlm_bias
-        return logits
+        return ops._apply(
+            lambda hh, ee, bb: jnp.einsum(
+                "...u,vu->...v", hh, ee,
+                preferred_element_type=jnp.float32)
+            + bb.astype(jnp.float32),
+            [h, embed, mlm_bias], "mlm_logits_f32")

@@ -88,7 +88,12 @@ def _block_attn(q, k, v, bias=None, mask=None, scale=1.0,
     q: (B, H, Tq, D), k/v: (B, H, Tk, D).  mask: bool, True = attend.
     Dropout hits only the V-accumulation; the denominator l stays
     un-dropped (standard inverted dropout on softmax probs)."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    # scores and softmax statistics in f32 regardless of input dtype
+    # (bf16 exp/max over T keys loses ~3 decimal digits; the MXU
+    # accumulates f32 internally anyway, preferred_element_type just
+    # keeps it).  Callers cast the normalized output back to q.dtype.
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias
     if mask is not None:
@@ -146,9 +151,11 @@ def _ring_body(q, k, v, valid, seed, bias, *, axis_name, causal, scale,
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, H, Tb, D = q.shape
-    neg = jnp.full((B, H, Tb), -1e30, q.dtype)
-    zero_l = jnp.zeros((B, H, Tb), q.dtype)
-    zero_o = jnp.zeros_like(q)
+    # f32 carries: _block_attn emits f32 stats/partials (see its score
+    # comment); the final normalize casts back to q.dtype
+    neg = jnp.full((B, H, Tb), -1e30, jnp.float32)
+    zero_l = jnp.zeros((B, H, Tb), jnp.float32)
+    zero_o = jnp.zeros(q.shape, jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
     base_key = None
     if dropped:
@@ -206,7 +213,7 @@ def _ring_body(q, k, v, valid, seed, bias, *, axis_name, causal, scale,
 
     (m, l, o, _, _), _ = lax.scan(
         step, (neg, zero_l, zero_o, k, v), jnp.arange(n))
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
@@ -242,7 +249,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False,
         m, l, o = _block_attn(q, k, v, bias=bias, mask=mask, scale=scale,
                               dropout_rate=dropout_rate if dropped else 0.0,
                               dropout_key=dropout_key)
-        return o / jnp.maximum(l, 1e-30)[..., None]
+        return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
     _count("ring", f"sp={mesh.shape[axis_name]} shape={q.shape}")
     masked = valid_length is not None
@@ -338,7 +345,7 @@ def local_flash_attention(q, k, v, causal=False, valid_length=None,
     mask = _dense_mask(q.shape[2], k.shape[2], causal, valid_length)
     m, l, o = _block_attn(q, k, v, bias=bias, mask=mask, scale=scale,
                           dropout_rate=rate, dropout_key=dropout_key)
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def attention(q, k, v, mesh=None, causal=False, valid_length=None,
