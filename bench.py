@@ -421,10 +421,13 @@ def _resnet_once(smoke, layout, stem, batch):
 
 
 def bench_bert(smoke):
-    # 384-first: largest remat-free batch that fits the 16 GB HBM (the r4
-    # sweep: 384 -> 724.9 seq/s > 256 -> 707 > 512 OOM without remat)
+    # The r4 sweep (384 -> 724.9 seq/s > 256 -> 707 > 512 OOM remat-free)
+    # was measured when "bf16" BERT silently ran f32 activations (the
+    # dtype= bug fixed in r5): true-bf16 halves activation bytes, so the
+    # ladder now probes 768/512 first — largest-first with OOM fallback
+    # keeps the measured 384 as the safety net.
     ladder = _batch_ladder("BENCH_BERT_BATCH",
-                           (8,) if smoke else (384, 256))
+                           (8,) if smoke else (768, 512, 384, 256))
     return _run_ladder("bert", ladder, lambda b: _bert_once(smoke, b))
 
 
@@ -436,7 +439,7 @@ def bench_bert512(smoke):
     pinned-flash arm is measured alongside so the Pallas kernel appears
     in a driver-visible workload number either way."""
     ladder = _batch_ladder("BENCH_BERT512_BATCH",
-                           (4,) if smoke else (96, 64, 32))
+                           (4,) if smoke else (192, 128, 96, 64, 32))
     remat = os.environ.get("BENCH_BERT512_REMAT", "1") == "1"
     rec = _run_ladder("bert512", ladder,
                       lambda b: _bert_once(smoke, b, seq_len=512,
@@ -487,8 +490,9 @@ def _bert_once(smoke, batch, seq_len=128, remat=None):
 
     # remat defaults OFF at seq 128: the r4 on-chip sweep measured
     # remat-free batch 384 at 724.9 seq/s vs remat batch 512 at 578.3
-    # (recompute cost ~22% and the bigger batch does not pay for it);
-    # 512 without remat OOMs, which is what the 384-first ladder absorbs.
+    # (recompute cost ~22% and the bigger batch does not pay for it) —
+    # measured under the f32-activation dtype bug; the r5 true-bf16
+    # ladder probes larger batches first and relies on OOM fallback.
     # dots_saveable measured strictly worse (OOM at 512 AND 256).  At seq
     # 512 the caller decides (bench_bert512 defaults remat ON — the
     # activation regime is 4x per sequence).

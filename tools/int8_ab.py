@@ -156,21 +156,21 @@ def main():
     # float source net's bytes there would claim int8 saves nothing.
     # Skip entirely on a failed arm: an error row must not carry a
     # fabricated footprint.
-    try:
-        if "error" in int8:
-            raise RuntimeError("int8 arm failed; no footprint")
-        wq = sum(p._data.size for name, p in qsrc.collect_params().items()
-                 if name.endswith("weight") and p._data is not None)
-        float_bytes = int8.get("param_bytes", 0)
-        int8["param_bytes_float_source"] = float_bytes
-        int8["param_bytes"] = int(wq + max(float_bytes - wq * 4, 0))
-        int8["param_bytes_note"] = ("int8 weights at 1 B/elem + "
-                                    "non-quantized leaves at source "
-                                    "dtype (analytic; wrapper storage "
-                                    "is closure-internal)")
-        write_atomic(args.out, record)
-    except Exception as e:
-        log(f"int8 footprint calc failed: {type(e).__name__}: {e}")
+    if "error" not in int8:
+        try:
+            wq = sum(p._data.size
+                     for name, p in qsrc.collect_params().items()
+                     if name.endswith("weight") and p._data is not None)
+            float_bytes = int8.get("param_bytes", 0)
+            int8["param_bytes_float_source"] = float_bytes
+            int8["param_bytes"] = int(wq + max(float_bytes - wq * 4, 0))
+            int8["param_bytes_note"] = ("int8 weights at 1 B/elem + "
+                                        "non-quantized leaves at source "
+                                        "dtype (analytic; wrapper storage "
+                                        "is closure-internal)")
+            write_atomic(args.out, record)
+        except Exception as e:
+            log(f"int8 footprint calc failed: {type(e).__name__}: {e}")
 
     if "img_per_s" in bf16 and "img_per_s" in int8:
         record["int8_vs_bf16"] = round(int8["img_per_s"] /

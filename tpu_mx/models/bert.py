@@ -222,7 +222,6 @@ class BERTModel(HybridBlock):
         # preferred_element_type).
         if dtype and str(dtype) != "float32":
             self.cast(dtype)
-        self._dtype = str(dtype)
 
     def hybrid_forward(self, F, tokens, token_types, valid_length=None,
                        masked_positions=None, mlm_bias=None):
