@@ -548,3 +548,57 @@ def test_parameter_own_init_beats_global_initializer():
     # also takes precedence over the global
     np.testing.assert_array_equal(
         net.dense.bias.data().asnumpy(), np.zeros(4, np.float32))
+
+
+def test_batchnorm_onepass_matches_legacy(monkeypatch):
+    """The r5 one-pass f32-stat BN (sum/sum-of-squares, folded
+    scale/bias) must match the legacy two-pass form on fwd, backward,
+    and running stats — eager and hybridized (TPUMX_BN_ONEPASS A/B)."""
+    np.random.seed(0)
+    x_np = (np.random.randn(4, 5, 8) * 2 + 1.5).astype(np.float32)
+    w_np = np.random.randn(4, 5, 8).astype(np.float32)
+
+    def run(onepass, hybrid):
+        monkeypatch.setenv("TPUMX_BN_ONEPASS", "1" if onepass else "0")
+        np.random.seed(1)
+        net = nn.BatchNorm(axis=-1, in_channels=8)
+        net.initialize()
+        net.gamma.set_data(nd.array(
+            np.random.rand(8).astype(np.float32) + 0.5))
+        net.beta.set_data(nd.array(np.random.randn(8).astype(np.float32)))
+        if hybrid:
+            net.hybridize()
+        x = nd.array(x_np)
+        w = nd.array(w_np)
+        x.attach_grad()
+        with autograd.record():
+            y = net(x)            # training-mode forward (batch stats)
+            l = (y * w).sum()
+        l.backward()
+        return (y.asnumpy(), x.grad.asnumpy(), net.gamma.grad.asnumpy(),
+                net.beta.grad.asnumpy(),
+                net.running_mean.data().asnumpy(),
+                net.running_var.data().asnumpy(), net(x).asnumpy())
+
+    for hybrid in (False, True):
+        a, b = run(True, hybrid), run(False, hybrid)
+        for u, v in zip(a, b):
+            assert_almost_equal(u, v, rtol=2e-5, atol=2e-5)
+
+
+def test_bert_dtype_casts_whole_model():
+    """dtype='bfloat16' must reach EVERY parameter (the r4 bench bug:
+    only the embedding tables were cast, f32 params promoted all
+    activations) and the MLM logits must still return f32."""
+    from tpu_mx.models.bert import BERTModel, bert_base_config
+    cfg = bert_base_config(vocab_size=64, max_len=16)
+    cfg.update(num_layers=1, units=32, hidden_size=64, num_heads=2)
+    net = BERTModel(cfg, dtype="bfloat16")
+    net.initialize()
+    tokens = nd.array(np.zeros((2, 16), np.int32))
+    types = nd.array(np.zeros((2, 16), np.int32))
+    out = net(tokens, types)
+    dtypes = {str(p.data().dtype)
+              for p in net.collect_params().values()}
+    assert dtypes == {"bfloat16"}, dtypes
+    assert str(out.dtype) == "float32", out.dtype
