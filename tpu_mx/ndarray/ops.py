@@ -1019,8 +1019,32 @@ def SoftmaxActivation(data, mode="instance", **kw):
     return softmax(data, axis=axis)
 
 
+def _onepass_stats(xf, axis, keepdims=True):
+    """(mean, var) via sum / sum-of-squares in ONE pass.  ONLY for
+    reductions that span non-minor axes over more data than a VMEM tile
+    (BatchNorm's (N, *S) reduce): there the classic mean->var chain
+    forces two real HBM reads, while sibling sums fuse into one.  For
+    ROW-LOCAL norms (LayerNorm & friends, minor-axis reduce) XLA already
+    fuses the whole chain into one pass per row — use the two-pass
+    mean/var there: it costs nothing and is cancellation-safe, whereas
+    E[x^2]-E[x]^2 in f32 collapses for |mean|/std ≳ 1e3 (var rounds to
+    the 0-clamp and rsqrt(eps) amplifies garbage).  BatchNorm inputs are
+    post-conv/near-zero-mean, where the cancellation is benign."""
+    n = 1
+    ax = axis if isinstance(axis, tuple) else (axis,)
+    for a in ax:
+        n *= xf.shape[a]
+    s1 = xf.sum(axis=axis, keepdims=keepdims)
+    s2 = jnp.square(xf).sum(axis=axis, keepdims=keepdims)
+    mu = s1 / n
+    return mu, jnp.maximum(s2 / n - jnp.square(mu), 0.0)
+
+
 def LayerNorm(data, gamma=None, beta=None, axis=-1, eps=1e-5, **kw):
-    """REF:src/operator/nn/layer_norm.cc — fp32 statistics for bf16 inputs."""
+    """REF:src/operator/nn/layer_norm.cc — fp32 statistics for bf16
+    inputs.  Two-pass mean/var on purpose: the reduce is row-local
+    (minor axis), which XLA fuses into one HBM pass anyway, and the
+    two-pass form is cancellation-safe (see _onepass_stats)."""
 
     def f(x, g, b):
         xf = x.astype(jnp.float32)
@@ -1048,10 +1072,13 @@ def RMSNorm(data, gamma=None, axis=-1, eps=1e-6, **kw):
 def InstanceNorm(data, gamma, beta, eps=1e-3, **kw):
     def f(x, g, b):
         ax = tuple(range(2, x.ndim))
-        mu = x.mean(axis=ax, keepdims=True)
-        var = jnp.square(x - mu).mean(axis=ax, keepdims=True)
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(axis=ax, keepdims=True)
+        var = jnp.square(xf - mu).mean(axis=ax, keepdims=True)
         shape = (1, -1) + (1,) * (x.ndim - 2)
-        return (x - mu) * lax.rsqrt(var + eps) * g.reshape(shape) + b.reshape(shape)
+        gf = g.reshape(shape).astype(jnp.float32)
+        bf = b.reshape(shape).astype(jnp.float32)
+        return ((xf - mu) * lax.rsqrt(var + eps) * gf + bf).astype(x.dtype)
 
     return _apply(f, [data, gamma, beta], "InstanceNorm")
 
@@ -1103,13 +1130,8 @@ def batch_norm_core(x, gamma, beta, moving_mean, moving_var, eps, use_batch_stat
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if use_batch_stats:
         red = tuple(i for i in range(x.ndim) if i != axis)
-        n = 1
-        for i in red:
-            n *= x.shape[i]
-        xf = x.astype(jnp.float32)
-        mu = xf.sum(axis=red) / n
-        var = jnp.maximum(
-            jnp.square(xf).sum(axis=red) / n - jnp.square(mu), 0.0)
+        mu, var = _onepass_stats(x.astype(jnp.float32), red,
+                                 keepdims=False)
     else:
         mu = moving_mean.astype(jnp.float32)
         var = moving_var.astype(jnp.float32)
