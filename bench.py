@@ -310,6 +310,15 @@ def _attach_mfu(rec, step, batch_args, per_sec, unit_flops, batch):
     return rec
 
 
+def _bench_dtype(env_var, smoke):
+    """(dtype, multi_precision) for a bench leg: bfloat16 on hardware by
+    default, float32 in CPU smoke (keeps the nightly fast and smoke
+    numerics boring); per-leg env override (=float32 reverts on chip).
+    The resnet leg predates this helper and casts unconditionally."""
+    dt = os.environ.get(env_var, "float32" if smoke else "bfloat16")
+    return dt, dt != "float32"
+
+
 def _is_oom(e):
     # explicit allocation-failure phrases only: a bare "hbm" mention (e.g.
     # a bandwidth note inside some other error) must NOT trigger the
@@ -629,7 +638,9 @@ def _lstm_once(smoke, batch):
 
     class FlatCE(gluon.loss.Loss):
         """CE over the flattened (T·B, V) logits — the word-LM target
-        layout (REF:example/gluon/word_language_model)."""
+        layout (REF:example/gluon/word_language_model).  Logits upcast to
+        f32: log-softmax over a 10k vocab in bf16 loses the digits the
+        loss needs."""
 
         def __init__(self, **kw):
             super().__init__(weight=None, batch_axis=0, **kw)
@@ -637,14 +648,25 @@ def _lstm_once(smoke, batch):
 
         def hybrid_forward(self, F, logits, labels):
             v = logits.shape[-1]
-            return self._ce(F.reshape(logits, shape=(-1, v)),
-                            F.reshape(labels, shape=(-1,)))
+            return self._ce(
+                F.cast(F.reshape(logits, shape=(-1, v)), dtype="float32"),
+                F.reshape(labels, shape=(-1,)))
 
     rng = np.random.RandomState(0)
     x = nd.array(rng.randint(0, vocab, (bptt, batch)), dtype="float32")
     y = nd.array(rng.randint(0, vocab, (bptt * batch,)), dtype="float32")
     model(x)  # finalize deferred shapes (zero initial state)
-    opt = mx.optimizer.create("sgd", learning_rate=1.0)
+    # bf16 weights/activations (BENCH_LSTM_DTYPE=float32 reverts): the r4
+    # 740k tok/s was measured in f32 — the same dtype-audit sweep that
+    # caught BERT found the LSTM/SSD legs never cast.  Cell state runs in
+    # the compute dtype over bptt=35 (a 120-step CPU A/B tracked f32 to
+    # within 0.03 nats); the A100 comparator ballpark is derived at bf16
+    # peak, so f32 here was comparator-unfair to us.
+    ldt, lmp = _bench_dtype("BENCH_LSTM_DTYPE", smoke)
+    if ldt != "float32":
+        model.cast(ldt)
+    opt = mx.optimizer.create("sgd", learning_rate=1.0,
+                              multi_precision=lmp)
     step = CompiledTrainStep(model, FlatCE(), opt)
     log("lstm: compiling full train step (first call)...")
     tok_s = _run_timed(lambda: step.step(x, y), _fetch_loss, warmup, iters,
@@ -657,6 +679,7 @@ def _lstm_once(smoke, batch):
         "baseline_note": None if smoke else
         "derived ballpark (BASELINE.md): FLOPs model @ 20% A100 util",
         "batch": batch, "bptt": bptt, "hidden": hid, "layers": layers,
+        "dtype": ldt,
     }
 
 
@@ -705,7 +728,9 @@ def _ssd_once(smoke, batch):
         """forward(x, labels) -> per-sample loss (the tuple outputs of
         SSD can't ride through the step's single-output contract, so the
         loss lives in the forward; the step's loss_fn is a pass-through
-        mean)."""
+        mean).  Head outputs upcast to f32 before target-matching and the
+        losses — box/matching math is threshold-sensitive; the backbone
+        compute stays in the net's dtype."""
 
         def __init__(self, ssd_net, **kw):
             super().__init__(**kw)
@@ -714,8 +739,11 @@ def _ssd_once(smoke, batch):
             self._box = gluon.loss.HuberLoss()
 
         def forward(self, x, labels):
-            from tpu_mx import autograd
+            from tpu_mx import autograd, nd as _nd
             anchors, cls_preds, box_preds = self.net(x)
+            anchors = _nd.cast(anchors, "float32")
+            cls_preds = _nd.cast(cls_preds, "float32")
+            box_preds = _nd.cast(box_preds, "float32")
             with autograd.pause():
                 loc_t, loc_m, cls_t = targets(anchors, labels, cls_preds)
             return self._cls(cls_preds, cls_t) + \
@@ -728,7 +756,9 @@ def _ssd_once(smoke, batch):
         def hybrid_forward(self, F, loss_vec, _dummy):
             return loss_vec
 
-    log(f"building ssd (size={size}, classes={classes}), batch={batch}")
+    sdt, smp = _bench_dtype("BENCH_SSD_DTYPE", smoke)
+    log(f"building ssd (size={size}, classes={classes}, backbone="
+        f"{'compact' if smoke else backbone}, dtype={sdt}), batch={batch}")
     wrapper = SSDTrain(net)
     wrapper.initialize(init="xavier")
     rng = np.random.RandomState(0)
@@ -741,9 +771,15 @@ def _ssd_once(smoke, batch):
         labels[b, 0] = [cls, x0, y0, x1, y1]
     x_nd, l_nd = nd.array(x), nd.array(labels)
     wrapper(x_nd, l_nd)  # finalize deferred shapes
+    # bf16 backbone compute (BENCH_SSD_DTYPE=float32 reverts): r4's 485
+    # img/s was measured in f32 — see the lstm note; heads/targets/losses
+    # run f32 via the SSDTrain casts above
+    if sdt != "float32":
+        wrapper.cast(sdt)
+        x_nd = nd.cast(x_nd, sdt)
     dummy = nd.array(np.zeros((1,), np.float32))
     opt = mx.optimizer.create("sgd", learning_rate=0.01, momentum=0.9,
-                              wd=5e-4)
+                              wd=5e-4, multi_precision=smp)
     step = CompiledTrainStep(wrapper, PassThrough(), opt)
     log("ssd: compiling full train step (first call)...")
     img_s = _run_timed(lambda: step.step(x_nd, l_nd, dummy), _fetch_loss,
@@ -769,6 +805,7 @@ def _ssd_once(smoke, batch):
         "baseline_note": note,
         "batch": batch, "size": size,
         "backbone": "compact(smoke)" if smoke else backbone,
+        "dtype": sdt,
     }
 
 

@@ -270,6 +270,26 @@ def test_bench_lstm_ssd_smoke():
     assert rec["ssd"]["value"] > 0
 
 
+@pytest.mark.slow
+def test_bench_lstm_ssd_smoke_bf16():
+    """The on-chip default dtype path (bf16 cast + multi_precision
+    masters) must execute end-to-end, not only on TPU time: pin the
+    dtype knobs to bfloat16 in smoke (smoke defaults to f32)."""
+    import json as _json
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**_env_cpu(), "BENCH_SMOKE": "1",
+             "BENCH_MODELS": "lstm,ssd",
+             "BENCH_LSTM_DTYPE": "bfloat16",
+             "BENCH_SSD_DTYPE": "bfloat16"})
+    assert out.returncode == 0, out.stderr[-500:]
+    rec = _json.loads([l for l in out.stdout.splitlines()
+                       if l.startswith("{")][-1])
+    assert rec["dtype"] == "bfloat16" and rec["value"] > 0
+    assert rec["ssd"]["dtype"] == "bfloat16" and rec["ssd"]["value"] > 0
+
+
 def test_parse_log_table():
     """tools/parse_log.py (REF:tools/parse_log.py analog): Speedometer +
     fit log lines -> per-epoch table."""
