@@ -1,6 +1,7 @@
 """tpu_mx.parallel — mesh/SPMD layer (the reference's KVStore+launcher tier
 re-designed for ICI/DCN collectives; SURVEY §2.3, §5.7, §5.8)."""
 from .mesh import Mesh, NamedSharding, P, hybrid_mesh, local_mesh, make_mesh
+from .moe import MoEFFN, moe_sharding_rules
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import attention, local_flash_attention, ring_attention
 from .ulysses import get_sp_strategy, set_sp_strategy, ulysses_attention

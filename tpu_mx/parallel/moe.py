@@ -1,0 +1,171 @@
+"""Mixture-of-Experts FFN with expert parallelism (above-parity: the
+reference has no MoE — SURVEY §2.3 listed ep out of scope — but the
+driver's multi-chip contract names ep shardings, and sparse scaling is
+table stakes for a modern TPU framework).
+
+TPU-first design (GShard/Switch einsum formulation, all static shapes):
+  - gating, top-k selection, and capacity-limited dispatch are dense
+    einsums over a (S, E, C) one-hot dispatch tensor — no gather/scatter,
+    no dynamic shapes, everything tiles onto the MXU;
+  - expert weights are STACKED on a leading E axis ((E, H, U) / (E, U, H))
+    so expert parallelism is nothing but a PartitionSpec("ep", ...) on
+    that axis: under a mesh with an `ep` axis, GSPMD partitions the
+    per-expert compute and inserts the token-exchange collectives itself
+    (the scaling-book recipe — annotate shardings, let XLA insert
+    collectives).  `moe_sharding_rules()` returns the rules for
+    CompiledTrainStep;
+  - gate math runs in f32 whatever the model dtype (softmax over E and
+    the load-balance statistics are precision-sensitive); expert matmuls
+    run in x.dtype.
+
+Capacity: each expert processes at most C = ceil(capacity_factor·S·k/E)
+tokens; overflow tokens are DROPPED from the MoE path (their combine
+weight is zero — the residual connection around the layer carries them),
+the standard Switch trade-off that keeps shapes static.
+
+forward(x) -> (y, aux_loss): aux_loss is the Switch load-balance term
+(E · Σ_e fraction_tokens_e · mean_prob_e, ≥ 1 at perfect balance); add
+`aux_loss_weight * aux_loss` to the training objective.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..gluon.block import HybridBlock
+from ..ndarray import ops
+
+__all__ = ["MoEFFN", "moe_sharding_rules"]
+
+
+def moe_sharding_rules():
+    """Expert-parallel rules: the stacked expert axis shards over `ep`;
+    the gate is replicated.  Compose with bert_sharding_rules()-style tp
+    rules for the dense sublayers of a surrounding model."""
+    return [
+        (r"expert_w1$", P("ep", None, None)),
+        (r"expert_b1$", P("ep", None)),
+        (r"expert_w2$", P("ep", None, None)),
+        (r"expert_b2$", P("ep", None)),
+        (r"gate_weight$", P(None, None)),
+    ]
+
+
+def _moe_forward(x, gw, w1, b1, w2, b2, *, top_k, capacity, act):
+    """Core routing + expert compute on flattened tokens (S, U)."""
+    S, U = x.shape
+    E = w1.shape[0]
+    xf32 = x.astype(jnp.float32)
+    logits = xf32 @ gw.astype(jnp.float32).T                  # (S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    combine = jnp.zeros((S, E, capacity), jnp.float32)
+    dispatch = jnp.zeros((S, E, capacity), jnp.bool_)
+    masked = probs
+    gates, masks = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)                     # (S,)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # (S, E)
+        gates.append(jnp.sum(probs * onehot, axis=-1))        # (S,)
+        masks.append(onehot)
+        masked = masked * (1.0 - onehot)
+    if top_k > 1:
+        # renormalize the selected gates (the GShard top-2 convention)
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
+    # top-1 keeps the RAW router prob (Switch): y = p_i · expert_i(x) is
+    # exactly what makes the router differentiable through the task loss
+    # — renormalizing would pin the weight at ~1 and starve the gate of
+    # gradient
+
+    # positions within each expert: cumulative count over the token axis,
+    # later selections queue after ALL first-choice tokens (priority to
+    # the k=0 picks, the Switch/GShard behavior)
+    prev = jnp.zeros((E,), jnp.float32)
+    for g, m in zip(gates, masks):
+        pos = jnp.cumsum(m, axis=0) - m + prev[None, :]       # (S, E)
+        within = (pos < capacity) & (m > 0)
+        posi = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+        oh_c = jax.nn.one_hot(posi, capacity, dtype=jnp.float32)
+        sel = within[..., None] * oh_c                        # (S, E, C)
+        combine = combine + g[:, None, None] * sel
+        dispatch = dispatch | (sel > 0)
+        prev = prev + jnp.sum(m, axis=0)
+
+    dspf = dispatch.astype(x.dtype)
+    expert_in = jnp.einsum("sec,su->ecu", dspf, x)            # (E, C, U)
+    h = jnp.einsum("ecu,ehu->ech", expert_in, w1) + \
+        b1[:, None, :].astype(x.dtype)
+    h = act(h)
+    eo = jnp.einsum("ech,euh->ecu", h, w2) + \
+        b2[:, None, :].astype(x.dtype)
+    y = jnp.einsum("sec,ecu->su", combine.astype(x.dtype), eo)
+
+    # Switch load-balance auxiliary: fraction of tokens routed to each
+    # expert (first choice) x mean gate prob, scaled by E
+    frac = jnp.mean(masks[0], axis=0)                         # (E,)
+    mean_prob = jnp.mean(probs, axis=0)                       # (E,)
+    aux = E * jnp.sum(frac * mean_prob)
+    return y.astype(x.dtype), aux.astype(jnp.float32)
+
+
+class MoEFFN(HybridBlock):
+    """Sparse FFN: top-k gated mixture of `num_experts` two-layer MLPs.
+
+    forward(x: (..., units)) -> (y: (..., units), aux_loss: scalar).
+    Under a mesh with an `ep` axis (CompiledTrainStep with
+    moe_sharding_rules()), experts shard across devices."""
+
+    def __init__(self, units, hidden_size, num_experts, top_k=2,
+                 capacity_factor=1.25, activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        if top_k not in (1, 2):
+            raise ValueError("top_k must be 1 (Switch) or 2 (GShard)")
+        self._units = units
+        self._hidden = hidden_size
+        self._E = num_experts
+        self._k = top_k
+        self._cf = float(capacity_factor)
+        self._act_name = activation
+        self.gate_weight = self.params.get(
+            "gate_weight", shape=(num_experts, units))
+        self.expert_w1 = self.params.get(
+            "expert_w1", shape=(num_experts, hidden_size, units))
+        self.expert_b1 = self.params.get(
+            "expert_b1", shape=(num_experts, hidden_size),
+            init="zeros")
+        self.expert_w2 = self.params.get(
+            "expert_w2", shape=(num_experts, units, hidden_size))
+        self.expert_b2 = self.params.get(
+            "expert_b2", shape=(num_experts, units), init="zeros")
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        import math
+        shape = x.shape
+        S = 1
+        for d in shape[:-1]:
+            S *= d
+        capacity = max(1, math.ceil(self._cf * S * self._k / self._E))
+        if self._act_name == "gelu":
+            # match F.gelu (exact erf; jax.nn.gelu defaults to the tanh
+            # approximation, which is the separate gelu_tanh op here)
+            act = lambda v: jax.nn.gelu(v, approximate=False)
+        else:
+            act = getattr(jax.nn, self._act_name)
+
+        def fn(xa, gw, w1, b1, w2, b2):
+            flat = xa.reshape((S, shape[-1]))
+            y, aux = _moe_forward(flat, gw, w1, b1, w2, b2,
+                                  top_k=self._k, capacity=capacity,
+                                  act=act)
+            return y.reshape(shape), aux
+
+        return ops._apply(fn, [x, gate_weight, expert_w1, expert_b1,
+                               expert_w2, expert_b2], "MoEFFN")
+
+    def __repr__(self):
+        return (f"MoEFFN(units={self._units}, hidden={self._hidden}, "
+                f"experts={self._E}, top_k={self._k}, "
+                f"capacity_factor={self._cf})")
