@@ -749,13 +749,6 @@ def _ssd_once(smoke, batch):
             return self._cls(cls_preds, cls_t) + \
                 self._box(box_preds * loc_m, loc_t * loc_m)
 
-    class PassThrough(gluon.loss.Loss):
-        def __init__(self, **kw):
-            super().__init__(weight=None, batch_axis=0, **kw)
-
-        def hybrid_forward(self, F, loss_vec, _dummy):
-            return loss_vec
-
     sdt, smp = _bench_dtype("BENCH_SSD_DTYPE", smoke)
     log(f"building ssd (size={size}, classes={classes}, backbone="
         f"{'compact' if smoke else backbone}, dtype={sdt}), batch={batch}")
@@ -780,7 +773,7 @@ def _ssd_once(smoke, batch):
     dummy = nd.array(np.zeros((1,), np.float32))
     opt = mx.optimizer.create("sgd", learning_rate=0.01, momentum=0.9,
                               wd=5e-4, multi_precision=smp)
-    step = CompiledTrainStep(wrapper, PassThrough(), opt)
+    step = CompiledTrainStep(wrapper, gluon.loss.PassThrough(), opt)
     log("ssd: compiling full train step (first call)...")
     img_s = _run_timed(lambda: step.step(x_nd, l_nd, dummy), _fetch_loss,
                        warmup, iters, repeats, batch, "ssd")

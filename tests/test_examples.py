@@ -73,3 +73,10 @@ def test_quantization_example_smoke():
     # script asserts int8 accuracy drop <= 2% vs its trained float model
     out = _run("examples/quantization/quantize_cnn.py")
     assert "PASSED" in out and "int8    accuracy" in out, out[-500:]
+
+
+def test_moe_example_smoke():
+    # script asserts the MoE LM learned; also exercises the (y, aux)
+    # contract and the Switch load-balance term end to end
+    out = _run("examples/moe/train_moe_lm.py")
+    assert re.search(r"loss [\d.]+ -> [\d.]+", out), out[-500:]

@@ -63,14 +63,6 @@ def test_moe_matches_reference_loop(top_k):
     assert float(aux.asnumpy()) >= 0.99  # >= 1 at/above perfect balance
 
 
-class _PassThrough(gluon.loss.Loss):
-    def __init__(self, **kw):
-        super().__init__(weight=None, batch_axis=0, **kw)
-
-    def hybrid_forward(self, F, loss, _d):
-        return loss
-
-
 def test_moe_capacity_drops_tokens():
     """capacity_factor -> 0 forces drops: dropped tokens produce ZERO
     output (the residual around the layer carries them)."""
@@ -127,7 +119,7 @@ def test_moe_grads_flow_and_trains():
     x = nd.array(np.random.randn(B, T, U).astype(np.float32))
     t = nd.array(np.random.randn(B, T, U).astype(np.float32) * 0.1)
     net(x, t)
-    step = CompiledTrainStep(net, _PassThrough(),
+    step = CompiledTrainStep(net, gluon.loss.PassThrough(),
                              mx.optimizer.create("adam", learning_rate=3e-3))
     dummy = nd.array(np.zeros((1,), np.float32))
     losses = [float(np.asarray(step.step(x, t, dummy)._data).ravel()[0])
@@ -170,7 +162,7 @@ def test_moe_ep_sharded_matches_dense():
         x, t = nd.array(x_np), nd.array(t_np)
         net(x, t)
         step = CompiledTrainStep(
-            net, _PassThrough(),
+            net, gluon.loss.PassThrough(),
             mx.optimizer.create("sgd", learning_rate=0.1),
             mesh=mesh, rules=rules,
             data_specs=(P_dp, P_dp, P_none) if mesh is not None else None)

@@ -6,7 +6,7 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "PoissonNLLLoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss", "KLDivLoss",
            "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
-           "TripletLoss", "CTCLoss", "CosineEmbeddingLoss"]
+           "TripletLoss", "CTCLoss", "CosineEmbeddingLoss", "PassThrough"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -82,6 +82,20 @@ class SoftmaxCrossEntropyLoss(Loss):
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class PassThrough(Loss):
+    """Identity loss for nets that compute their own scalar objective in
+    `forward` (multi-output models whose losses can't ride the step's
+    single-output contract: SSD target-matching, MoE's (y, aux) tuple).
+    `CompiledTrainStep(net, PassThrough(), ...)` then means "the net's
+    first output IS the loss"; extra step args are ignored."""
+
+    def __init__(self, **kwargs):
+        super().__init__(weight=None, batch_axis=0, **kwargs)
+
+    def hybrid_forward(self, F, loss, *_ignored):
+        return loss
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):

@@ -81,17 +81,20 @@ def _moe_forward(x, gw, w1, b1, w2, b2, *, top_k, capacity, act):
 
     # positions within each expert: cumulative count over the token axis,
     # later selections queue after ALL first-choice tokens (priority to
-    # the k=0 picks, the Switch/GShard behavior)
-    prev = jnp.zeros((E,), jnp.float32)
+    # the k=0 picks, the Switch/GShard behavior).  int32 counts: an f32
+    # cumsum silently merges slots once an expert has seen > 2^24 tokens
+    # (pod-scale global batches get there)
+    prev = jnp.zeros((E,), jnp.int32)
     for g, m in zip(gates, masks):
-        pos = jnp.cumsum(m, axis=0) - m + prev[None, :]       # (S, E)
-        within = (pos < capacity) & (m > 0)
-        posi = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+        mi = m.astype(jnp.int32)
+        pos = jnp.cumsum(mi, axis=0) - mi + prev[None, :]     # (S, E)
+        within = (pos < capacity) & (mi > 0)
+        posi = jnp.clip(pos, 0, capacity - 1)
         oh_c = jax.nn.one_hot(posi, capacity, dtype=jnp.float32)
         sel = within[..., None] * oh_c                        # (S, E, C)
         combine = combine + g[:, None, None] * sel
         dispatch = dispatch | (sel > 0)
-        prev = prev + jnp.sum(m, axis=0)
+        prev = prev + jnp.sum(mi, axis=0)
 
     dspf = dispatch.astype(x.dtype)
     expert_in = jnp.einsum("sec,su->ecu", dspf, x)            # (E, C, U)
