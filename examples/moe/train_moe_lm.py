@@ -50,7 +50,8 @@ class MoELM(HybridBlock):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
-    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="default: 40 under --smoke, 60 otherwise")
     ap.add_argument("--mesh", default=None,
                     help="e.g. dp2,ep2 (axis name + size, comma-sep)")
     args = ap.parse_args()
@@ -58,7 +59,8 @@ def main():
     vocab, units, hidden, experts = (64, 32, 64, 4) if args.smoke else \
         (1000, 256, 1024, 8)
     B, T = (8, 16) if args.smoke else (32, 64)
-    steps = 40 if args.smoke else args.steps
+    steps = args.steps if args.steps is not None else \
+        (40 if args.smoke else 60)
 
     mesh = None
     rules = None
@@ -77,9 +79,14 @@ def main():
     np.random.seed(0)
     net = MoELM(vocab, units, hidden, experts)
     net.initialize(init="xavier")
-    # synthetic learnable stream: next token = (3 * tok + 1) mod vocab
-    toks = np.random.randint(0, vocab, (B, T + 1))
-    toks[:, 1:] = (3 * toks[:, :-1] + 1) % vocab
+    # synthetic learnable stream: CHAIN the recurrence column by column —
+    # next token = (3 * tok + 1) mod vocab everywhere, so each label is a
+    # deterministic function of its input token (a vectorized one-shot
+    # assignment would leave labels independent of inputs past column 0)
+    toks = np.empty((B, T + 1), np.int64)
+    toks[:, 0] = np.random.randint(0, vocab, B)
+    for j in range(1, T + 1):
+        toks[:, j] = (3 * toks[:, j - 1] + 1) % vocab
     x = nd.array(toks[:, :-1].astype(np.float32))
     y = nd.array(toks[:, 1:].astype(np.float32))
     net(x, y)

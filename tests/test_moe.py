@@ -175,3 +175,63 @@ def test_moe_ep_sharded_matches_dense():
     mesh = make_mesh({"dp": 2, "ep": 2}, devices=jax.devices()[:4])
     sharded = run(mesh, moe_sharding_rules())
     np.testing.assert_allclose(dense, sharded, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_bert_variant_trains():
+    """BERTModel(moe_every=2): every 2nd layer sparse; forward returns
+    (logits, aux); an MLM step through CompiledTrainStep learns.  The
+    default (moe_every=0) keeps the plain single-output contract."""
+    from tpu_mx.models.bert import BERTModel, bert_base_config
+    from tpu_mx.parallel import CompiledTrainStep
+
+    cfg = bert_base_config(vocab_size=96, max_len=16)
+    cfg.update(num_layers=2, units=32, hidden_size=64, num_heads=2)
+    # default: single output
+    plain = BERTModel(cfg)
+    plain.initialize()
+    t = nd.array(np.zeros((2, 16), np.int32))
+    ty = nd.array(np.zeros((2, 16), np.int32))
+    out = plain(t, ty)
+    assert not isinstance(out, tuple)
+
+    np.random.seed(5)
+    net = BERTModel(cfg, moe_every=2, moe_experts=4, moe_top_k=2)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    B, T = 8, 16
+    tokens = rng.randint(4, 96, (B, T)).astype(np.int32)
+    types = np.zeros((B, T), np.int32)
+    logits, aux = net(nd.array(tokens), nd.array(types))
+    assert logits.shape == (B, T, 96) and float(aux.asnumpy()) > 0
+
+    from tpu_mx.gluon.block import HybridBlock
+
+    class MoEBertTrain(HybridBlock):
+        """Loss-in-forward wrapper (the SSD/CompiledTrainStep pattern for
+        multi-output nets: the step keeps only a net's FIRST output, so
+        the aux term must fold into the objective before it returns)."""
+
+        def __init__(self, bert, **kw):
+            super().__init__(**kw)
+            self.bert = bert
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def forward(self, tokens, types, labels):
+            logits, aux = self.bert(tokens, types)
+            v = logits.shape[-1]
+            ce = nd.mean(self._ce(nd.reshape(logits, shape=(-1, v)),
+                                  nd.reshape(labels, shape=(-1,))))
+            return ce + 0.01 * aux
+
+    wrapper = MoEBertTrain(net)
+    step = CompiledTrainStep(
+        wrapper, gluon.loss.PassThrough(),
+        mx.optimizer.create("adam", learning_rate=2e-3))
+    t_nd, ty_nd = nd.array(tokens), nd.array(types)
+    l_nd = nd.array(tokens)  # identity-denoise objective: learnable
+    dummy = nd.array(np.zeros((1,), np.float32))
+    losses = [float(np.asarray(
+        step.step(t_nd, ty_nd, l_nd, dummy)._data).ravel()[0])
+        for _ in range(20)]
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(losses))

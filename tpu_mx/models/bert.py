@@ -149,10 +149,37 @@ class TransformerLayer(HybridBlock):
         return self.ln2(x + h)
 
 
+class MoETransformerLayer(HybridBlock):
+    """TransformerLayer with the dense FFN swapped for a sparse MoE FFN
+    (parallel.MoEFFN; above-parity — the reference has no MoE).  forward
+    returns (x_out, aux_loss): the Switch load-balance term bubbles up
+    through BERTEncoder/BERTModel when `moe_every` is set."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 mesh=None, num_experts=8, top_k=2, **kwargs):
+        super().__init__(**kwargs)
+        from ..parallel.moe import MoEFFN
+        self.attention = SelfAttention(units, num_heads, dropout, mesh=mesh)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.moe = MoEFFN(units, hidden_size, num_experts, top_k=top_k)
+
+    def hybrid_forward(self, F, x, valid_length=None):
+        att = self.attention(x, valid_length)
+        if self.dropout:
+            att = self.dropout(att)
+        x = self.ln1(x + att)
+        h, aux = self.moe(x)
+        if self.dropout:
+            h = self.dropout(h)
+        return self.ln2(x + h), aux
+
+
 class BERTEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads, vocab_size,
                  max_length, dropout=0.0, mesh=None, dtype="float32",
-                 **kwargs):
+                 moe_every=0, moe_experts=8, moe_top_k=2, **kwargs):
         super().__init__(**kwargs)
         self.word_embed_weight = self.params.get(
             "word_embed_weight", shape=(vocab_size, units), dtype=dtype)
@@ -162,10 +189,28 @@ class BERTEncoder(HybridBlock):
             "type_embed_weight", shape=(2, units), dtype=dtype)
         self.ln = nn.LayerNorm(in_channels=units)
         self.dropout = nn.Dropout(dropout) if dropout else None
+        self._moe = bool(moe_every)
         self.layers = nn.HybridSequential()
-        for _ in range(num_layers):
-            self.layers.add(TransformerLayer(units, hidden_size, num_heads,
-                                             dropout, mesh=mesh))
+        n_moe = 0
+        for i in range(num_layers):
+            # moe_every=2 -> layers 1, 3, 5, ... are sparse (the GShard
+            # every-other-layer convention)
+            if moe_every and (i % moe_every) == moe_every - 1:
+                self.layers.add(MoETransformerLayer(
+                    units, hidden_size, num_heads, dropout, mesh=mesh,
+                    num_experts=moe_experts, top_k=moe_top_k))
+                n_moe += 1
+            else:
+                self.layers.add(TransformerLayer(units, hidden_size,
+                                                 num_heads, dropout,
+                                                 mesh=mesh))
+        if moe_every and n_moe == 0:
+            # fail where the misconfiguration is, not as `ce + None` deep
+            # inside the user's compiled objective (the remat_policy
+            # fail-at-construction style)
+            raise ValueError(
+                f"moe_every={moe_every} places no MoE layer in "
+                f"{num_layers} layers (needs moe_every <= num_layers)")
 
     def hybrid_forward(self, F, tokens, token_types, valid_length=None,
                        word_embed_weight=None, pos_embed_weight=None,
@@ -178,20 +223,36 @@ class BERTEncoder(HybridBlock):
         x = self.ln(x)
         if self.dropout:
             x = self.dropout(x)
+        aux_total = None
         for layer in self.layers._children.values():
-            x = layer(x, valid_length)
+            if isinstance(layer, MoETransformerLayer):
+                x, aux = layer(x, valid_length)
+                aux_total = aux if aux_total is None else aux_total + aux
+            else:
+                x = layer(x, valid_length)
+        if self._moe:
+            return x, aux_total
         return x
 
 
 class BERTModel(HybridBlock):
-    """Encoder + tied-embedding MLM head (pretraining objective)."""
+    """Encoder + tied-embedding MLM head (pretraining objective).
+
+    moe_every=N makes every Nth transformer layer a sparse
+    MoETransformerLayer (GShard-style); forward then returns
+    (logits, aux_loss) — add `aux_weight * aux_loss` to the objective."""
 
     def __init__(self, config=None, mesh=None, dtype="float32", remat=False,
-                 remat_policy=None, **kwargs):
+                 remat_policy=None, moe_every=0, moe_experts=8, moe_top_k=2,
+                 **kwargs):
         super().__init__(**kwargs)
         cfg = config or bert_base_config()
         self._cfg = cfg
-        self.encoder = BERTEncoder(mesh=mesh, dtype=dtype, **cfg)
+        self._moe = bool(moe_every)
+        self.encoder = BERTEncoder(mesh=mesh, dtype=dtype,
+                                   moe_every=moe_every,
+                                   moe_experts=moe_experts,
+                                   moe_top_k=moe_top_k, **cfg)
         # resolve up front: a typo'd policy (or one passed with remat off)
         # must fail at construction, not silently skew a benchmark sweep
         policy = _resolve_remat_policy(remat_policy)
@@ -225,7 +286,11 @@ class BERTModel(HybridBlock):
 
     def hybrid_forward(self, F, tokens, token_types, valid_length=None,
                        masked_positions=None, mlm_bias=None):
-        x = self.encoder(tokens, token_types, valid_length)
+        aux = None
+        if self._moe:
+            x, aux = self.encoder(tokens, token_types, valid_length)
+        else:
+            x = self.encoder(tokens, token_types, valid_length)
         if masked_positions is not None:
             # project ONLY the masked positions through the vocab head
             # (the reference-era GluonNLP pretraining contract): at 15%
@@ -245,9 +310,12 @@ class BERTModel(HybridBlock):
         # over a 30k vocab is sensitive exactly at near-tied logits,
         # where bf16's ~2-3 decimal digits lose the ranking.
         embed = self.encoder.word_embed_weight.data()
-        return ops._apply(
+        logits = ops._apply(
             lambda hh, ee, bb: jnp.einsum(
                 "...u,vu->...v", hh, ee,
                 preferred_element_type=jnp.float32)
             + bb.astype(jnp.float32),
             [h, embed, mlm_bias], "mlm_logits_f32")
+        if self._moe:
+            return logits, aux
+        return logits

@@ -17,6 +17,7 @@ device jit).
 from __future__ import annotations
 
 import functools
+import logging
 import os
 import re
 
@@ -28,6 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ndarray import NDArray
 
 __all__ = ["CompiledTrainStep", "fsdp_rules", "sharding_for", "apply_rules"]
+
+_logger = logging.getLogger(__name__)
 
 
 def apply_rules(name, shape, rules, mesh):
@@ -259,6 +262,19 @@ class CompiledTrainStep:
                 pm.update(dv)
                 out, updates = net._functional_call(pm, key, True, data_args)
                 if isinstance(out, (tuple, list)):
+                    # multi-output nets: the step trains on the FIRST
+                    # output only.  That silently drops e.g. an MoE aux
+                    # loss unless the net folds it into output[0] (the
+                    # loss-in-forward + PassThrough pattern) — warn once
+                    # per build so the dropped term is never invisible.
+                    from ..gluon.loss import PassThrough
+                    if not isinstance(loss_fn, PassThrough):
+                        _logger.warning(
+                            "CompiledTrainStep: net returned %d outputs; "
+                            "training on output[0] and DROPPING the rest "
+                            "(an MoE aux loss would be lost — fold extra "
+                            "terms into the objective in forward() and "
+                            "use gluon.loss.PassThrough)", len(out))
                     out = out[0]
                 l = loss_fn(out, *loss_args)
                 return jnp.mean(l), updates
