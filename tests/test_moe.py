@@ -177,6 +177,7 @@ def test_moe_ep_sharded_matches_dense():
     np.testing.assert_allclose(dense, sharded, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_moe_bert_variant_trains():
     """BERTModel(moe_every=2): every 2nd layer sparse; forward returns
     (logits, aux); an MLM step through CompiledTrainStep learns.  The
