@@ -34,6 +34,12 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+# exported for tpu_watch's done-predicate (the drift-proofing pattern:
+# hand-maintained copies of a tool's coverage once cost a 90-min rerun
+# loop); module top stays stdlib-only so the watcher can import it
+DEFAULT_LENS = (128, 256, 512, 1024)
+
+
 def log(msg):
     print(f"[flash_sweep {time.strftime('%H:%M:%S')}] {msg}",
           file=sys.stderr, flush=True)
@@ -72,7 +78,8 @@ def main():
                                    write_atomic)
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=artifact("FLASH_SWEEP"))
-    ap.add_argument("--lens", default="128,256,512,1024")
+    ap.add_argument("--lens",
+                    default=",".join(str(t) for t in DEFAULT_LENS))
     ap.add_argument("--tokens", type=int, default=65536,
                     help="constant token budget; B = tokens / T")
     ap.add_argument("--heads", type=int, default=12)
@@ -162,6 +169,10 @@ def main():
                                           row["dense"]["tok_per_s"], 4)
             log(f"T={t}: best flash {best[0]} = "
                 f"{row['flash_vs_dense']:.3f}x dense")
+        # the watcher's resume contract keys off this: a wedge mid-row
+        # leaves complete unset and the stage re-runs (merge keeps the
+        # finished combos)
+        row["complete"] = True
         record["sweep"][f"T={t}"] = row
         write_atomic(args.out, record)
     log(f"done: {args.out}")

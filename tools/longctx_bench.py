@@ -25,6 +25,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# exported for tpu_watch's done-predicate (drift-proofing); module top
+# stays stdlib-only so the watcher can import it
+DEFAULT_LENS = (4096, 8192, 16384, 32768, 65536)
+DEFAULT_DENSE_AT = 8192
+
 
 def log(msg):
     print(f"[longctx {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
@@ -67,8 +72,9 @@ def main():
     from artifact_protocol import artifact
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=artifact("LONGCTX"))
-    ap.add_argument("--lens", default="4096,8192,16384,32768,65536")
-    ap.add_argument("--dense-at", type=int, default=8192,
+    ap.add_argument("--lens",
+                    default=",".join(str(t) for t in DEFAULT_LENS))
+    ap.add_argument("--dense-at", type=int, default=DEFAULT_DENSE_AT,
                     help="also measure XLA dense attention at this T "
                          "(0 disables); T>=16384 dense OOMs by design")
     ap.add_argument("--heads", type=int, default=12)

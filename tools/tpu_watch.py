@@ -159,11 +159,187 @@ def write_status(**kw):
     write_atomic(STATUS, kw)
 
 
+# every coverage expectation is IMPORTED from the tool that produces the
+# artifact (the MFU_EXPECTED pattern above: a hand-maintained copy once
+# kept mfu_done() false forever and re-ran the 90-minute probe every
+# backoff cycle); all three modules keep stdlib-only tops
+from flash_sweep import DEFAULT_LENS as FLASH_LENS          # noqa: E402
+from int8_ab import ARMS as INT8_ARMS                       # noqa: E402
+from longctx_bench import (DEFAULT_DENSE_AT as LC_DENSE_AT,  # noqa: E402
+                           DEFAULT_LENS as LC_LENS)
+from artifact_protocol import load_prior                    # noqa: E402
+
+
+def _profile_done(path):
+    rec = load_prior(path)
+    return rec.get("platform") == "tpu" and \
+        bool(rec.get("families_us_per_step"))
+
+
+def bn_ab_done():
+    leg = load_prior(artifact("BN_AB")).get("legacy_onepass0") or {}
+    return leg.get("value", 0) > 0 and not leg.get("stale")
+
+
+def resweep384_done():
+    rec = load_prior(artifact("RESNET_B384")).get("batch384") or {}
+    return rec.get("value", 0) > 0 and not rec.get("stale")
+
+
+def int8_ab_done():
+    arms = load_prior(artifact("INT8_AB")).get("arms") or {}
+    return all(a in arms and ("img_per_s" in arms[a] or "error" in arms[a])
+               for a in INT8_ARMS) and \
+        any("img_per_s" in arms.get(a, {}) for a in INT8_ARMS)
+
+
+def flash_sweep_done():
+    # per-T "complete" is stamped by the tool only after every block
+    # combo + the best/ratio summary: a wedge mid-row re-runs the stage
+    # (the artifact merge keeps the finished combos)
+    sweep = load_prior(artifact("FLASH_SWEEP")).get("sweep") or {}
+    return all(sweep.get(f"T={t}", {}).get("complete") for t in FLASH_LENS)
+
+
+def longctx_done():
+    rec = load_prior(artifact("LONGCTX"))
+    rows = rec.get("flash_kernel") or {}
+    dense = rec.get("dense_comparison") or {}
+    return all(f"T={t}" in rows for t in LC_LENS) and \
+        any("tok_per_s" in rows.get(f"T={t}", {}) for t in LC_LENS) and \
+        f"T={LC_DENSE_AT}" in dense
+
+
+def _run_bench(tag, extra_env=None):
+    """One bench.py run; returns (ok, record_or_None)."""
+    rc, out = run_logged(tag, [sys.executable, "bench.py"], 5400,
+                         env=extra_env)
+    lines = [ln for ln in (out or "").splitlines() if ln.startswith("{")]
+    if rc == 0 and lines:
+        rec = json.loads(lines[-1])
+        return rec.get("value", 0) > 0 and not rec.get("stale"), rec
+    return False, None
+
+
+def stage_bench():
+    ok, rec = _run_bench("bench")
+    if rec is not None:
+        write_atomic(BENCH_OUT, rec)
+        log(f"bench record: value={rec.get('value')} "
+            f"stale={rec.get('stale', False)}")
+    return ok
+
+
+def stage_validate():
+    rc, _ = run_logged("validate",
+                       [sys.executable, "tools/tpu_validate.py"], 5400)
+    # artifact written per-check by the tool; rc None = timeout/wedge,
+    # rc 1 = a check failed — both keep the stage pending for retry
+    return rc == 0
+
+
+def stage_profile_bert():
+    rc, _ = run_logged("profile_bert", [
+        sys.executable, "tools/chip_profile.py", "--model", "bert",
+        "--batch", "384"], 2400)
+    return rc == 0
+
+
+def stage_profile_resnet():
+    rc, _ = run_logged("profile_resnet", [
+        sys.executable, "tools/chip_profile.py", "--model", "resnet",
+        "--batch", "256"], 2400)
+    return rc == 0
+
+
+def stage_bn_ab():
+    """Legacy two-pass-BN arm of the r5 byte-diet A/B (the official bench
+    runs the one-pass default).  Its OWN lastgood path: the A/B arm must
+    never pollute the official store."""
+    ok, rec = _run_bench("bn_ab", {
+        "TPUMX_BN_ONEPASS": "0", "BENCH_MODELS": "resnet50",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_LASTGOOD_PATH": os.path.join(LOGDIR, "bn_ab_lastgood.json")})
+    if ok and rec:
+        write_atomic(artifact("BN_AB"), {
+            "ts": ts(), "legacy_onepass0": rec,
+            "note": "TPUMX_BN_ONEPASS=0 arm; compare the official bench "
+                    "resnet record (one-pass default) against this"})
+        log(f"bn_ab legacy arm: {rec.get('value')}")
+    return ok
+
+
+def stage_resweep384():
+    """ResNet batch re-sweep at the post-BN-diet byte budget (ROUND5
+    plan item 7): fewer bytes/step can move the 256 optimum."""
+    ok, rec = _run_bench("resweep384", {
+        "BENCH_MODELS": "resnet50", "BENCH_BATCH": "384",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_LASTGOOD_PATH": os.path.join(LOGDIR,
+                                            "resweep384_lastgood.json")})
+    if ok and rec:
+        write_atomic(artifact("RESNET_B384"), {
+            "ts": ts(), "batch384": rec,
+            "note": "BENCH_BATCH=384 arm at the one-pass-BN byte budget; "
+                    "compare the official batch-256 record"})
+        log(f"resweep384: {rec.get('value')}")
+    return ok
+
+
+def stage_int8_ab():
+    rc, _ = run_logged("int8_ab", [sys.executable, "tools/int8_ab.py"],
+                       3000)
+    return rc == 0
+
+
+def stage_flash_sweep():
+    rc, _ = run_logged("flash_sweep",
+                       [sys.executable, "tools/flash_sweep.py"], 3600)
+    return rc == 0
+
+
+def stage_longctx():
+    rc, _ = run_logged("longctx",
+                       [sys.executable, "tools/longctx_bench.py"], 2400)
+    return rc == 0
+
+
+def stage_mfu():
+    rc, _ = run_logged("mfu", [sys.executable, "tools/mfu_probe.py"], 5400)
+    return rc == 0
+
+
+# The first-window session plan (ROUND5_NOTES items 1-10 EXCEPT the
+# on-chip pytest tier, which stays manual), in VERDICT priority order:
+# official bench and the silicon validation sweep first — the tunnel can
+# die again at any minute — then the BERT roofline (ask#3), the resnet
+# profile + BN-diet + batch-384 receipts, the A/Bs, and the LONG probes
+# last (mfu is ~90 min, deliberately demoted from its old 3rd slot so a
+# short window captures the higher-priority artifacts first).  Each
+# stage's done-predicate reads the artifact it produces, so a
+# wedge-shortened window resumes at the first unfinished stage on the
+# next contact.
+STAGES = [
+    ("bench", bench_done, stage_bench),
+    ("validate", validation_done, stage_validate),
+    ("profile_bert", lambda: _profile_done(artifact("PROFILE_BERT")),
+     stage_profile_bert),
+    ("profile_resnet", lambda: _profile_done(artifact("PROFILE_STEP")),
+     stage_profile_resnet),
+    ("bn_ab", bn_ab_done, stage_bn_ab),
+    ("resweep384", resweep384_done, stage_resweep384),
+    ("int8_ab", int8_ab_done, stage_int8_ab),
+    ("flash_sweep", flash_sweep_done, stage_flash_sweep),
+    ("longctx", longctx_done, stage_longctx),
+    ("mfu", mfu_done, stage_mfu),
+]
+
+
 def main():
     n_probe = up_count = 0
     last_fail = 0.0
     log(f"watching for the TPU backend (probe every "
-        f"{PROBE_INTERVAL_DOWN}s while down)")
+        f"{PROBE_INTERVAL_DOWN}s while down; {len(STAGES)} stages armed)")
     while True:
         n_probe += 1
         up, detail = probe()
@@ -172,54 +348,41 @@ def main():
                                 "detail": detail}) + "\n")
         if up:
             up_count += 1
-        v_done, b_done, m_done = validation_done(), bench_done(), mfu_done()
+        stages_done = {name: bool(done()) for name, done, _ in STAGES}
         write_status(up=up, probes=n_probe, up_probes=up_count,
-                     validation_done=bool(v_done), bench_done=bool(b_done),
-                     mfu_done=bool(m_done), detail=detail)
-        if up and not (v_done and b_done and m_done) and \
+                     stages_done=stages_done,
+                     validation_done=stages_done["validate"],
+                     bench_done=stages_done["bench"],
+                     mfu_done=stages_done["mfu"], detail=detail)
+        if up and not all(stages_done.values()) and \
                 time.time() - last_fail > FAIL_BACKOFF:
-            log(f"TPU is UP ({detail}); validation_done={bool(v_done)} "
-                f"bench_done={bool(b_done)}")
+            log(f"TPU is UP ({detail}); pending: "
+                f"{[n for n, d in stages_done.items() if not d]}")
             ok = True
-            # bench FIRST (VERDICT r3 ask#1: capture the round's numbers
-            # before anything else — the tunnel can die again mid-sweep)
-            if not b_done:
-                rc, out = run_logged("bench", [sys.executable, "bench.py"],
-                                     5400)
-                log(f"bench rc={rc}")
-                lines = [ln for ln in (out or "").splitlines()
-                         if ln.startswith("{")]
-                if rc == 0 and lines:
-                    rec = json.loads(lines[-1])
-                    write_atomic(BENCH_OUT, rec)
-                    log(f"bench record: value={rec.get('value')} "
-                        f"stale={rec.get('stale', False)}")
-                    ok = ok and rec.get("value", 0) > 0 and \
-                        not rec.get("stale")
-                else:
+            for name, done, runner in STAGES:
+                if done():
+                    continue
+                # re-probe between stages: a dead tunnel must cost one
+                # 120s probe, not a stage's full timeout budget
+                alive, _ = probe()
+                if not alive:
+                    log(f"tunnel lost before stage {name}; backing off")
                     ok = False
-            if not v_done:
-                rc, out = run_logged(
-                    "validate",
-                    [sys.executable, "tools/tpu_validate.py"], 5400)
-                log(f"validate rc={rc}")
-                # artifact written per-check by the tool; rc None means
-                # timeout/wedge, rc 1 means some check failed — both
-                # leave validation_done() false and retry next cycle
-                ok = ok and rc == 0
-            if not mfu_done():
-                rc, out = run_logged(
-                    "mfu", [sys.executable, "tools/mfu_probe.py"], 5400)
-                log(f"mfu probe rc={rc}")
-                ok = ok and rc == 0
+                    break
+                log(f"running stage {name}...")
+                st_ok = runner()
+                log(f"stage {name}: {'ok' if st_ok else 'FAILED/partial'}")
+                ok = ok and st_ok
             if not ok:
                 last_fail = time.time()
+            stages_done = {name: bool(done()) for name, done, _ in STAGES}
             write_status(up=up, probes=n_probe, up_probes=up_count,
-                         validation_done=bool(validation_done()),
-                         bench_done=bool(bench_done()),
-                         mfu_done=bool(mfu_done()), detail=detail)
-        done = validation_done() and bench_done() and mfu_done()
-        time.sleep(PROBE_INTERVAL_DONE if done else PROBE_INTERVAL_DOWN)
+                         stages_done=stages_done,
+                         validation_done=stages_done["validate"],
+                         bench_done=stages_done["bench"],
+                         mfu_done=stages_done["mfu"], detail=detail)
+        done_all = all(stages_done.values())
+        time.sleep(PROBE_INTERVAL_DONE if done_all else PROBE_INTERVAL_DOWN)
 
 
 if __name__ == "__main__":
