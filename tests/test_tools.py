@@ -463,3 +463,53 @@ def test_artifact_protocol_merge_and_clobber_guard(tmp_path):
     ungated = {"flash": {"T=2": {"v": 2}}}
     merge_prior_sections(ungated, {"flash": {"T=1": {"v": 1}}}, ("flash",))
     assert ungated["flash"] == {"T=1": {"v": 1}, "T=2": {"v": 2}}
+
+
+def test_watch_stage_predicates(tmp_path):
+    """The staged watcher's done-predicates key off artifact contents:
+    fresh round -> all pending; a flash row without its 'complete' stamp
+    (mid-row wedge) stays pending; stamped rows + a successful longctx
+    row flip done.  Run under an isolated TPUMX_ROUND so no real round
+    artifact is touched."""
+    import json as _json
+    import textwrap
+    script = tmp_path / "drive.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        sys.path.insert(0, os.path.join(%r, 'tools'))
+        import tpu_watch as w
+        dm = {n: bool(d()) for n, d, _ in w.STAGES}
+        assert not any(dm.values()), dm
+        from flash_sweep import DEFAULT_LENS
+        from longctx_bench import DEFAULT_DENSE_AT, DEFAULT_LENS as LC
+        # partial flash row (no complete stamp on the last T): pending
+        json.dump({"sweep": {f"T={t}": ({"complete": True}
+                   if t != DEFAULT_LENS[-1] else {"flash": {}})
+                   for t in DEFAULT_LENS}},
+                  open(w.artifact("FLASH_SWEEP"), "w"))
+        assert not w.flash_sweep_done()
+        json.dump({"sweep": {f"T={t}": {"complete": True}
+                   for t in DEFAULT_LENS}},
+                  open(w.artifact("FLASH_SWEEP"), "w"))
+        assert w.flash_sweep_done()
+        # longctx needs >=1 success AND the dense row
+        json.dump({"flash_kernel": {f"T={t}": {"error": "x"} for t in LC},
+                   "dense_comparison": {}},
+                  open(w.artifact("LONGCTX"), "w"))
+        assert not w.longctx_done()
+        json.dump({"flash_kernel": dict(
+                     {f"T={t}": {"error": "x"} for t in LC},
+                     **{f"T={LC[0]}": {"tok_per_s": 1}}),
+                   "dense_comparison": {f"T={DEFAULT_DENSE_AT}": {}}},
+                  open(w.artifact("LONGCTX"), "w"))
+        assert w.longctx_done()
+        print("PREDICATES-OK")
+    """ % REPO))
+    env = dict(_env_cpu(), TPUMX_ROUND="rtest")
+    out = subprocess.run([sys.executable, str(script)], capture_output=True,
+                         text=True, env=env, timeout=120)
+    # clean up any rtest artifacts regardless of outcome
+    import glob as _glob
+    for p in _glob.glob(os.path.join(REPO, "*_rtest.json*")):
+        os.remove(p)
+    assert "PREDICATES-OK" in out.stdout, (out.stdout, out.stderr[-1500:])
