@@ -97,6 +97,36 @@ def log(msg):
           flush=True)
 
 
+_PERSIST_PLATFORM_OK = None
+
+
+def _persist_platform_ok():
+    """Only a process whose backend is the real TPU may write the store.
+    The smoke guard below is not enough: a non-smoke CPU drive with the
+    production metric name (e.g. a BENCH_BATCH=4 JAX_PLATFORMS=cpu
+    verification run — r5 hit exactly this) would clobber a real-chip
+    record.  Same platform contract as mfu_probe/longctx merge-on-write.
+    BENCH_PERSIST_ANY_PLATFORM=1 bypasses for the store-logic tests."""
+    global _PERSIST_PLATFORM_OK
+    if os.environ.get("BENCH_PERSIST_ANY_PLATFORM") == "1":
+        return True
+    if _PERSIST_PLATFORM_OK is None:
+        try:
+            import jax
+            platform = jax.devices()[0].platform
+        except Exception as e:
+            # transient probe failure: refuse THIS persist (loudly) but
+            # don't cache — a later call in the same run may succeed
+            log(f"persist refused: backend probe failed "
+                f"({type(e).__name__}: {e}); record NOT stored")
+            return False
+        _PERSIST_PLATFORM_OK = platform == "tpu"
+        if not _PERSIST_PLATFORM_OK:
+            log(f"persist refused: platform is {platform}, not tpu — "
+                "records from this process will NOT touch the store")
+    return _PERSIST_PLATFORM_OK
+
+
 def persist_lastgood(rec):
     """Write the measurement to BENCH_LASTGOOD.json the moment it exists
     (VERDICT r3 weak#2: round 3's official record was 0.0/error while a
@@ -111,6 +141,8 @@ def persist_lastgood(rec):
     layer must not be able to kill a successful measurement run."""
     if os.environ.get("BENCH_SMOKE") == "1" or \
             "smoke" in rec.get("metric", ""):
+        return
+    if not _persist_platform_ok():
         return
     if rec.get("metric") == "weak_scaling_efficiency_dp1":
         # single-device placeholder (trivially 1.0), not a measurement —
@@ -397,8 +429,12 @@ def _resnet_once(smoke, layout, stem, batch):
                     n_remat += 1
         log(f"resnet: remat enabled on {n_remat} residual blocks")
     net.initialize(init="xavier")
-    x = nd.array(np.random.rand(*shape).astype(np.float32))
-    _ = net(x)  # finalize deferred shapes
+    # Finalize deferred shapes on a tiny ON-DEVICE batch: param shapes
+    # don't depend on batch, and the old full-batch host tensor cost
+    # ~150 MB of tunnel transfer + a batch-256 eager forward before the
+    # first measurement (r5: the tunnel wedged inside exactly that
+    # window — keep cold-start device traffic minimal).
+    _ = net(nd.random.uniform(shape=(2,) + shape[1:]))
     net.cast("bfloat16")
 
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
@@ -406,9 +442,8 @@ def _resnet_once(smoke, layout, stem, batch):
                               wd=1e-4, multi_precision=True)
     step = CompiledTrainStep(net, loss_fn, opt, mesh=None)
 
-    data = nd.cast(nd.array(np.random.rand(*shape).astype(np.float32)),
-                   "bfloat16")
-    label = nd.array(np.random.randint(0, classes, (batch,)), dtype="float32")
+    data = nd.cast(nd.random.uniform(shape=shape), "bfloat16")
+    label = nd.random.randint(0, classes, (batch,), dtype="float32")
 
     log("resnet: compiling full train step (first call)...")
     img_s = _run_timed(lambda: step.step(data, label), _fetch_loss, warmup, iters,
@@ -755,15 +790,17 @@ def _ssd_once(smoke, batch):
     wrapper = SSDTrain(net)
     wrapper.initialize(init="xavier")
     rng = np.random.RandomState(0)
-    x = rng.rand(batch, 3, size, size).astype(np.float32) * 0.1
     labels = np.full((batch, 2, 5), -1.0, np.float32)
     for b in range(batch):
         cls = rng.randint(0, classes)
         x0, y0 = rng.uniform(0.05, 0.5, 2)
         x1, y1 = min(x0 + 0.3, 0.95), min(y0 + 0.3, 0.95)
         labels[b, 0] = [cls, x0, y0, x1, y1]
-    x_nd, l_nd = nd.array(x), nd.array(labels)
-    wrapper(x_nd, l_nd)  # finalize deferred shapes
+    # images on device (the full-batch host tensor was ~100 MB of tunnel
+    # transfer — see the resnet leg note); structured labels stay host-built
+    x_nd = nd.random.uniform(high=0.1, shape=(batch, 3, size, size))
+    l_nd = nd.array(labels)
+    wrapper(x_nd[:2], l_nd[:2])  # finalize deferred shapes (tiny batch)
     # bf16 backbone compute (BENCH_SSD_DTYPE=float32 reverts): r4's 485
     # img/s was measured in f32 — see the lstm note; heads/targets/losses
     # run f32 via the SSDTrain casts above

@@ -27,6 +27,34 @@ def _load_bench_module():
     return mod
 
 
+@pytest.fixture(autouse=True)
+def _bypass_platform_gate(monkeypatch):
+    """The store-logic tests run on the CPU backend; without this bypass
+    the platform gate (see test_cpu_platform_never_persists) would turn
+    every persist into a no-op and the tests would assert on nothing."""
+    monkeypatch.setenv("BENCH_PERSIST_ANY_PLATFORM", "1")
+
+
+def test_cpu_platform_never_persists(tmp_path, monkeypatch):
+    """A non-smoke run on a non-TPU backend must not write the store even
+    with a production metric name: a JAX_PLATFORMS=cpu verification drive
+    (BENCH_BATCH=4) clobbered the real-chip resnet record in r5.
+
+    jax.devices is stubbed rather than called: the real probe would hang
+    the whole pytest process on a wedged tunnel (and report tpu on the
+    on-chip tier, inverting the assert)."""
+    import types
+    import jax
+    monkeypatch.setenv("BENCH_LASTGOOD_PATH", str(tmp_path / "lg.json"))
+    monkeypatch.delenv("BENCH_PERSIST_ANY_PLATFORM", raising=False)
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **k: [types.SimpleNamespace(
+                            platform="cpu")])
+    bench = _load_bench_module()
+    bench.persist_lastgood({"metric": bench.PRIMARY_METRIC, "value": 0.39})
+    assert bench.load_lastgood() == (None, None)
+
+
 def test_persist_and_load_roundtrip(tmp_path, monkeypatch):
     monkeypatch.setenv("BENCH_LASTGOOD_PATH", str(tmp_path / "lg.json"))
     bench = _load_bench_module()

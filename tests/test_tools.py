@@ -482,34 +482,42 @@ def test_watch_stage_predicates(tmp_path):
         assert not any(dm.values()), dm
         from flash_sweep import DEFAULT_LENS
         from longctx_bench import DEFAULT_DENSE_AT, DEFAULT_LENS as LC
+        def dump(obj, path):
+            with open(path, "w") as f:
+                json.dump(obj, f)
         # partial flash row (no complete stamp on the last T): pending
-        json.dump({"sweep": {f"T={t}": ({"complete": True}
-                   if t != DEFAULT_LENS[-1] else {"flash": {}})
-                   for t in DEFAULT_LENS}},
-                  open(w.artifact("FLASH_SWEEP"), "w"))
+        dump({"sweep": {f"T={t}": ({"complete": True}
+              if t != DEFAULT_LENS[-1] else {"flash": {}})
+              for t in DEFAULT_LENS}}, w.artifact("FLASH_SWEEP"))
         assert not w.flash_sweep_done()
-        json.dump({"sweep": {f"T={t}": {"complete": True}
-                   for t in DEFAULT_LENS}},
-                  open(w.artifact("FLASH_SWEEP"), "w"))
+        dump({"sweep": {f"T={t}": {"complete": True}
+              for t in DEFAULT_LENS}}, w.artifact("FLASH_SWEEP"))
         assert w.flash_sweep_done()
         # longctx needs >=1 success AND the dense row
-        json.dump({"flash_kernel": {f"T={t}": {"error": "x"} for t in LC},
-                   "dense_comparison": {}},
-                  open(w.artifact("LONGCTX"), "w"))
+        dump({"flash_kernel": {f"T={t}": {"error": "x"} for t in LC},
+              "dense_comparison": {}}, w.artifact("LONGCTX"))
         assert not w.longctx_done()
-        json.dump({"flash_kernel": dict(
-                     {f"T={t}": {"error": "x"} for t in LC},
-                     **{f"T={LC[0]}": {"tok_per_s": 1}}),
-                   "dense_comparison": {f"T={DEFAULT_DENSE_AT}": {}}},
-                  open(w.artifact("LONGCTX"), "w"))
+        dump({"flash_kernel": dict(
+                {f"T={t}": {"error": "x"} for t in LC},
+                **{f"T={LC[0]}": {"tok_per_s": 1}}),
+              "dense_comparison": {f"T={DEFAULT_DENSE_AT}": {}}},
+             w.artifact("LONGCTX"))
         assert w.longctx_done()
         print("PREDICATES-OK")
     """ % REPO))
     env = dict(_env_cpu(), TPUMX_ROUND="rtest")
-    out = subprocess.run([sys.executable, str(script)], capture_output=True,
-                         text=True, env=env, timeout=120)
-    # clean up any rtest artifacts regardless of outcome
     import glob as _glob
+    # pre-clean: a SIGKILLed prior run can leave rtest artifacts that
+    # would flip the child's all-pending assertion
     for p in _glob.glob(os.path.join(REPO, "*_rtest.json*")):
         os.remove(p)
+    try:
+        out = subprocess.run([sys.executable, str(script)],
+                             capture_output=True, text=True, env=env,
+                             timeout=120)
+    finally:
+        # clean up any rtest artifacts regardless of outcome (incl. a
+        # TimeoutExpired: the child may have written some before dying)
+        for p in _glob.glob(os.path.join(REPO, "*_rtest.json*")):
+            os.remove(p)
     assert "PREDICATES-OK" in out.stdout, (out.stdout, out.stderr[-1500:])
