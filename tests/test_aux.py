@@ -221,6 +221,69 @@ def test_mixed_and_load_initializers():
         ld("w", (5,))
 
 
+def test_device_init_samples_on_device():
+    """Standard initializers sample with the device PRNG (no host numpy
+    transfer), driven by mx.random.seed; see initializer.device_sample."""
+    import jax
+    import tpu_mx as mx
+    import tpu_mx.initializer as I
+    from tpu_mx.gluon import nn
+
+    def build():
+        mx.random.seed(7)
+        net = nn.Dense(8, in_units=16)
+        net.initialize(init="xavier")
+        return net.weight.data().asnumpy(), net.bias.data().asnumpy()
+
+    w1, b1 = build()
+    w2, _ = build()
+    assert (w1 == w2).all()          # device PRNG is mx.random.seed-driven
+    assert (b1 == 0).all()           # name-dispatch: bias -> 0
+    # xavier-uniform bounds: scale = sqrt(3 / avg_fan(16,8)) = 0.5
+    assert abs(w1).max() <= 0.5 and abs(w1).std() > 0.05
+
+    # direct surface: jax array of the requested dtype; aux names get
+    # their convention constants
+    out = I.Xavier().device_sample("blk_weight", (4, 8), "bfloat16")
+    assert isinstance(out, jax.Array) and str(out.dtype) == "bfloat16"
+    var = I.Xavier().device_sample("bn_running_var", (4,))
+    assert (np.asarray(var) == 1.0).all()
+
+    # no device rule / custom __call__ semantics -> host path (None)
+    assert I.Orthogonal().device_sample("w", (4, 4)) is None
+    assert I.Bilinear().device_sample("w", (1, 1, 4, 4)) is None
+    assert I.LSTMBias().device_sample("h2h_bias", (8,)) is None
+    # LSTMBias host path still sets the forget-gate block to 1
+    b = I.LSTMBias()("h2h_bias", (8,))
+    assert (b[2:4] == 1.0).all() and b.sum() == 2.0
+
+
+def test_hybrid_first_call_deferred_init_no_tracer_leak():
+    """Deferred init firing INSIDE the hybridize trace must fall back to
+    the host path: device sampling (even jnp.full for aux params) would
+    stage into the jaxpr and leave a tracer in Parameter._data."""
+    import jax
+    from tpu_mx import nd
+    from tpu_mx.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.BatchNorm(), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    out1 = net(nd.ones((2, 4)))  # params finalize inside this trace
+    for p in net.collect_params().values():
+        assert not isinstance(p.data()._data, jax.core.Tracer), p.name
+    out2 = net(nd.ones((2, 4)))  # cached program, concrete params
+    np.testing.assert_array_equal(out1.asnumpy(), out2.asnumpy())
+
+
+def test_device_init_host_revert_knob(monkeypatch):
+    import tpu_mx.initializer as I
+    monkeypatch.setenv("TPUMX_HOST_INIT", "1")
+    assert I.Xavier().device_sample("w", (2, 2)) is None
+    monkeypatch.delenv("TPUMX_HOST_INIT")
+    assert I.Xavier().device_sample("w", (2, 2)) is not None
+
+
 def test_symbolic_check_helpers_and_tensorrt_stub():
     import tpu_mx.test_utils as T
     x = mx.sym.Variable("x")

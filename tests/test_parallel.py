@@ -104,7 +104,7 @@ def test_sharded_checkpoint_reshard_dp2tp2_to_dp4(tmp_path):
     from tpu_mx.parallel import CompiledTrainStep
 
     def build():
-        np.random.seed(11)
+        mx.random.seed(11)
         net = nn.HybridSequential(prefix="ckmodel_")
         net.add(nn.Dense(16, in_units=8, activation="relu", prefix="fc1_"))
         net.add(nn.Dense(4, in_units=16, prefix="fc2_"))
@@ -155,7 +155,7 @@ def test_compiled_train_step_dp_matches_single_device():
     from tpu_mx.parallel import CompiledTrainStep
 
     def build():
-        np.random.seed(3)
+        mx.random.seed(3)
         net = nn.HybridSequential()
         net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
         net.initialize()
@@ -207,7 +207,7 @@ def test_tp_sharded_dense_matches():
     from tpu_mx.parallel import CompiledTrainStep, P
 
     def build():
-        np.random.seed(5)
+        mx.random.seed(5)
         net = nn.HybridSequential()
         net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
         net.initialize()
@@ -447,7 +447,7 @@ def test_compressed_instep_allreduce(ctype):
     from tpu_mx.parallel import CompiledTrainStep
 
     def build():
-        np.random.seed(5)
+        mx.random.seed(5)
         net = nn.HybridSequential()
         net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
         net.initialize()
@@ -607,7 +607,7 @@ def test_grad_accumulation_matches_big_batch():
     from tpu_mx.parallel import CompiledTrainStep
 
     def build():
-        np.random.seed(9)
+        mx.random.seed(9)
         net = nn.HybridSequential()
         net.add(nn.Dense(16, activation="tanh"), nn.Dense(3))
         net.initialize()
@@ -804,7 +804,7 @@ def test_async_checkpoint_overlaps_training(tmp_path):
     from tpu_mx.parallel import CompiledTrainStep
 
     def build():
-        np.random.seed(5)
+        mx.random.seed(5)
         net = nn.HybridSequential()
         net.add(nn.Dense(16, in_units=8, activation="relu"), nn.Dense(4))
         net.initialize()
@@ -851,7 +851,7 @@ def test_compressed_accumulation_compress_once_per_update():
     from tpu_mx.parallel import CompiledTrainStep
 
     def build():
-        np.random.seed(21)
+        mx.random.seed(21)
         net = nn.HybridSequential()
         net.add(nn.Dense(16, in_units=8, activation="tanh"), nn.Dense(4))
         net.initialize()
@@ -922,7 +922,7 @@ def test_fsdp_rules_shard_params_and_match_replicated():
     from tpu_mx.parallel import CompiledTrainStep, fsdp_rules
 
     def build():
-        np.random.seed(31)
+        mx.random.seed(31)
         net = nn.HybridSequential()
         net.add(nn.Dense(64, in_units=16, activation="relu"),
                 nn.Dense(4, in_units=64))
@@ -1049,7 +1049,7 @@ def test_fused_flat_update_matches_per_param(monkeypatch):
     from tpu_mx.parallel import CompiledTrainStep
 
     def build():
-        np.random.seed(11)
+        mx.random.seed(11)
         net = nn.HybridSequential()
         net.add(nn.Dense(16, activation="relu"), nn.Dense(16,
                 activation="relu"), nn.Dense(4))

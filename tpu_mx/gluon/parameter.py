@@ -95,8 +95,16 @@ class Parameter:
         initializer = init or self.init or default_init or init_mod.Uniform(0.07)
         if isinstance(initializer, str):
             initializer = init_mod.registry.create(initializer)
-        data = initializer(self.name, self.shape, self.dtype)
-        self._data = array(data, ctx=ctx or current_context(), dtype=self.dtype)
+        dev = initializer.device_sample(self.name, self.shape, self.dtype) \
+            if isinstance(initializer, init_mod.Initializer) else None
+        if dev is not None:
+            # sampled by the device's own PRNG — wrap directly; routing
+            # through array() would round-trip the tensor via host numpy
+            self._data = NDArray(dev, ctx=ctx or current_context())
+        else:
+            data = initializer(self.name, self.shape, self.dtype)
+            self._data = array(data, ctx=ctx or current_context(),
+                               dtype=self.dtype)
         if self._grad_req != "null":
             self._data.attach_grad(self._grad_req)
         self._deferred_init_args = None

@@ -301,6 +301,14 @@ class Module(BaseModule):
         preloaded = getattr(self, "_preloaded", None)
         if preloaded is not None and arg_params is None:
             arg_params, aux_params = preloaded
+
+        def _sample(n, shape):
+            # device-PRNG init when the initializer has a rule for it
+            # (no host->device transfer; see docs/DIVERGENCES.md #23)
+            dev = initializer.device_sample(n, shape) \
+                if isinstance(initializer, _init_mod.Initializer) else None
+            return dev if dev is not None else initializer(n, shape)
+
         for n in self._param_names:
             arr = self._exec.arg_dict[n]
             if arg_params and n in arg_params:
@@ -310,7 +318,7 @@ class Module(BaseModule):
                     raise MXNetError(f"missing parameter '{n}' "
                                      "(pass allow_missing=True to initialize)")
                 self._set_param(self._exec.arg_dict, n,
-                                initializer(n, arr.shape))
+                                _sample(n, arr.shape))
         for n in self._aux_names:
             if aux_params and n in aux_params:
                 self._set_param(self._exec.aux_dict, n, aux_params[n])
@@ -319,7 +327,7 @@ class Module(BaseModule):
                     raise MXNetError(f"missing aux state '{n}' "
                                      "(pass allow_missing=True to initialize)")
                 self._set_param(self._exec.aux_dict, n,
-                                initializer(n, self._exec.aux_dict[n].shape))
+                                _sample(n, self._exec.aux_dict[n].shape))
         self.params_initialized = True
 
     def _set_param(self, d, name, value):

@@ -57,5 +57,14 @@ def take_key():
 
 
 def seed(seed_state, ctx="all"):
-    """mx.random.seed (REF:python/mxnet/random.py)."""
+    """mx.random.seed (REF:python/mxnet/random.py).
+
+    Seeds BOTH generators the framework draws from: the JAX key (device
+    sampling — `nd.random.*`, on-device parameter init) and numpy's
+    global state (the host-path initializers, e.g. Orthogonal/Bilinear,
+    sample from np.random the way the reference's initializers sample
+    from its own engine RNG — one seed call must make either path
+    deterministic)."""
+    import numpy as _np
     _GLOBAL.key = jax.random.PRNGKey(int(seed_state))
+    _np.random.seed(int(seed_state) % (2 ** 32))
