@@ -189,3 +189,40 @@ def test_bidirectional_cell_unroll():
             rtol=1e-5)
     with pytest.raises(mx.MXNetError, match="unroll"):
         bi(x, states)
+
+
+def test_cast_bf16_recurrence_stays_bf16():
+    """cast('bfloat16') must reach the implicit zero states: an f32
+    state would promote every scan step back to f32 (the r5 dtype audit
+    found the 'bf16' PTB leg recurring in f32 exactly this way)."""
+    lstm = rnn.LSTM(8, 1, input_size=4)
+    lstm.initialize()
+    x = nd.array(np.random.RandomState(0).rand(3, 2, 4)
+                 .astype(np.float32))
+    lstm(x)  # finalize
+    lstm.cast("bfloat16")
+    xb = nd.cast(x, "bfloat16")
+    out = lstm(xb)
+    assert str(out.dtype) == "bfloat16"
+    # explicit begin_state follows the cast too
+    states = lstm.begin_state(batch_size=2)
+    assert all(str(s.dtype) == "bfloat16" for s in states)
+    out2, new_states = lstm(xb, states)
+    assert str(out2.dtype) == "bfloat16"
+    assert all(str(s.dtype) == "bfloat16" for s in new_states)
+
+
+def test_mixed_dtype_input_promotes_not_crashes():
+    """f32 net fed bf16 input (or the reverse) must run with promoted-f32
+    recurrence — the scan carry has to match what the dots produce
+    (review r5: an inputs.dtype-only rule crashed this case)."""
+    lstm = rnn.LSTM(8, 1, input_size=4)
+    lstm.initialize()
+    x = nd.array(np.random.RandomState(0).rand(3, 2, 4)
+                 .astype(np.float32))
+    lstm(x)
+    out = lstm(nd.cast(x, "bfloat16"))     # f32 net, bf16 input
+    assert str(out.dtype) == "float32"
+    lstm.cast("bfloat16")
+    out2 = lstm(x)                         # bf16 net, f32 input
+    assert str(out2.dtype) == "float32"

@@ -74,7 +74,8 @@ def audit_text(text, batch, per_img_threshold=16384):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="resnet", choices=["resnet", "bert"])
+    ap.add_argument("--model", default="resnet",
+                    choices=["resnet", "bert", "lstm", "ssd"])
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--per-img-threshold", type=int, default=16384,
                     help="f32 tensors above this many elements PER BATCH "
@@ -88,10 +89,11 @@ def main():
     import mfu_probe
 
     log(f"building {args.model} batch={args.batch} (CPU, trace-only)...")
-    if args.model == "resnet":
-        step, batch_args = hlo_inspect.build_resnet_step(False, args.batch)
-    else:
-        step, batch_args = hlo_inspect.build_bert_step(False, args.batch)
+    builders = {"resnet": hlo_inspect.build_resnet_step,
+                "bert": hlo_inspect.build_bert_step,
+                "lstm": hlo_inspect.build_lstm_step,
+                "ssd": hlo_inspect.build_ssd_step}
+    step, batch_args = builders[args.model](False, args.batch)
     log("lowering...")
     import jax.numpy as jnp
     from tpu_mx import random as _random

@@ -8,7 +8,7 @@ fusion count, and every convolution's shapes/layout line.  Run on the real
 TPU (plain `python tools/hlo_inspect.py resnet`) to see what XLA actually
 made of the step; `--smoke` uses tiny shapes for a CPU sanity pass.
 
-Usage: python tools/hlo_inspect.py {resnet|bert} [--smoke] [--batch N]
+Usage: python tools/hlo_inspect.py {resnet|bert|lstm|ssd} [--smoke] [--batch N]
 """
 import argparse
 import collections
@@ -92,6 +92,104 @@ def build_bert_step(smoke, batch):
                   nd.array(positions), nd.array(labels))
 
 
+def build_lstm_step(smoke, batch):
+    """The bench's PTB LSTM leg (bf16 weights, f32 CE logits) — mirrors
+    bench.py _lstm_once so dtype_audit sees the hardware configuration.
+    KEEP IN SYNC with bench.py: a bench-side change (loss/optimizer/
+    dtype knob) silently desynchronizes the audited program from the
+    benched one."""
+    import numpy as np
+    import tpu_mx as mx
+    from tpu_mx import gluon, nd
+    from tpu_mx.models.lstm_lm import RNNModel
+    from tpu_mx.parallel import CompiledTrainStep
+
+    vocab, emb, hid, layers, bptt = (1000, 64, 64, 1, 8) if smoke else \
+        (10000, 650, 650, 2, 35)
+    model = RNNModel(mode="lstm", vocab_size=vocab, num_embed=emb,
+                     num_hidden=hid, num_layers=layers, dropout=0.0)
+    model.initialize(init="xavier")
+
+    class FlatCE(gluon.loss.Loss):
+        def __init__(self, **kw):
+            super().__init__(weight=None, batch_axis=0, **kw)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, logits, labels):
+            v = logits.shape[-1]
+            return self._ce(
+                F.cast(F.reshape(logits, shape=(-1, v)), dtype="float32"),
+                F.reshape(labels, shape=(-1,)))
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, vocab, (bptt, batch)), dtype="float32")
+    y = nd.array(rng.randint(0, vocab, (bptt * batch,)), dtype="float32")
+    model(x)
+    model.cast("bfloat16")
+    opt = mx.optimizer.create("sgd", learning_rate=1.0,
+                              multi_precision=True)
+    step = CompiledTrainStep(model, FlatCE(), opt)
+    return step, (x, y)
+
+
+def build_ssd_step(smoke, batch):
+    """The bench's SSD leg (bf16 backbone, f32 heads/targets/losses) —
+    mirrors bench.py _ssd_once (vgg16_reduced official config).
+    KEEP IN SYNC with bench.py (see build_lstm_step note)."""
+    import numpy as np
+    import tpu_mx as mx
+    from tpu_mx import autograd, gluon, nd
+    from tpu_mx.gluon.block import HybridBlock
+    from tpu_mx.models.ssd import SSD, SSDTrainingTargets, ssd_512
+    from tpu_mx.parallel import CompiledTrainStep
+
+    if smoke:
+        size, classes = 64, 3
+        net = SSD(classes, sizes=[[0.2, 0.35], [0.5, 0.7]],
+                  ratios=[[1, 2, 0.5]] * 2, base_filters=(8, 16))
+    else:
+        size, classes = 512, 20
+        net = ssd_512(classes, backbone="vgg16_reduced")
+    targets = SSDTrainingTargets()
+
+    class SSDTrain(HybridBlock):
+        def __init__(self, ssd_net, **kw):
+            super().__init__(**kw)
+            self.net = ssd_net
+            self._cls = gluon.loss.SoftmaxCrossEntropyLoss()
+            self._box = gluon.loss.HuberLoss()
+
+        def forward(self, x, labels):
+            anchors, cls_preds, box_preds = self.net(x)
+            anchors = nd.cast(anchors, "float32")
+            cls_preds = nd.cast(cls_preds, "float32")
+            box_preds = nd.cast(box_preds, "float32")
+            with autograd.pause():
+                loc_t, loc_m, cls_t = targets(anchors, labels, cls_preds)
+            return self._cls(cls_preds, cls_t) + \
+                self._box(box_preds * loc_m, loc_t * loc_m)
+
+    wrapper = SSDTrain(net)
+    wrapper.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    labels = np.full((batch, 2, 5), -1.0, np.float32)
+    for b in range(batch):
+        cls = rng.randint(0, classes)
+        x0, y0 = rng.uniform(0.05, 0.5, 2)
+        labels[b, 0] = [cls, x0, y0, min(x0 + 0.3, 0.95),
+                        min(y0 + 0.3, 0.95)]
+    x_nd = nd.random.uniform(high=0.1, shape=(batch, 3, size, size))
+    l_nd = nd.array(labels)
+    wrapper(x_nd[:2], l_nd[:2])
+    wrapper.cast("bfloat16")
+    x_nd = nd.cast(x_nd, "bfloat16")
+    dummy = nd.array(np.zeros((1,), np.float32))
+    opt = mx.optimizer.create("sgd", learning_rate=0.01, momentum=0.9,
+                              wd=5e-4, multi_precision=True)
+    step = CompiledTrainStep(wrapper, gluon.loss.PassThrough(), opt)
+    return step, (x_nd, l_nd, dummy)
+
+
 SMELLS = ("transpose", "copy", "pad", "reshape", "convert", "bitcast",
           "all-reduce", "dynamic-slice", "dynamic-update-slice", "gather",
           "scatter")
@@ -117,17 +215,16 @@ def analyze(hlo_text):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("model", choices=["resnet", "bert"])
+    ap.add_argument("model", choices=["resnet", "bert", "lstm", "ssd"])
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--dump", help="write full HLO text here")
     args = ap.parse_args()
 
     batch = args.batch or (8 if args.smoke else 256)
-    if args.model == "resnet":
-        step, batch_args = build_resnet_step(args.smoke, batch)
-    else:
-        step, batch_args = build_bert_step(args.smoke, batch)
+    builders = {"resnet": build_resnet_step, "bert": build_bert_step,
+                "lstm": build_lstm_step, "ssd": build_ssd_step}
+    step, batch_args = builders[args.model](args.smoke, batch)
 
     # trigger the build without running a step, then compile the jitted fn
     raw = tuple(b._data if b is not None and hasattr(b, "_data") else b

@@ -154,6 +154,14 @@ class _RNNLayer(HybridBlock):
             infos.append(dict(infos[0]))
         return infos
 
+    def cast(self, dtype):
+        """Track the compute dtype: the implicit zero states must follow
+        the cast or a bf16 net recurs in f32 (the r5 dtype audit caught
+        exactly this — f32 states promoted every scan step of the 'bf16'
+        PTB leg)."""
+        super().cast(dtype)
+        self._dtype = dtype
+
     def begin_state(self, batch_size=0, func=None, **kwargs):
         from ...ndarray import ops as F
         return [F.zeros(info["shape"], dtype=self._dtype)
@@ -185,7 +193,14 @@ class _RNNLayer(HybridBlock):
             inputs = F.swapaxes(inputs, 0, 1)
         batch = inputs.shape[1]
         if skip_states:
-            states = [F.zeros(info["shape"], dtype=self._dtype)
+            # implicit states follow the PROMOTED compute dtype: a bf16
+            # net on bf16 input must not recur in f32 via its own zero
+            # states (r5 dtype audit), while a mixed call (f32 net on
+            # bf16 input or vice versa) recurs in the promoted f32 the
+            # dots produce — anything else mismatches the scan carry
+            import jax.numpy as _jnp
+            sdt = _jnp.result_type(inputs.dtype, _jnp.dtype(self._dtype))
+            states = [F.zeros(info["shape"], dtype=sdt)
                       for info in self.state_info(batch)]
         ordered = [params[n.lstrip("_")] for n in self._param_names]
         training = autograd.is_training()
