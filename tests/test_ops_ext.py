@@ -1180,3 +1180,18 @@ def test_identity_attach_kl_sparse_reg_aux_semantics():
     net2.hybridize()
     out = net2(nd.array(x))
     np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)
+
+
+def test_l2normalization_bf16_accumulates_f32():
+    """Channel L2Normalization on bf16 input must accumulate its
+    sum-of-squares in f32 (norm-op precision policy): the result then
+    matches the f32 oracle to bf16 resolution even over many channels."""
+    from tpu_mx import nd
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 512, 4, 4).astype(np.float32) + 0.5
+    ref = nd.L2Normalization(nd.array(x), mode="channel").asnumpy()
+    out = nd.L2Normalization(nd.cast(nd.array(x), "bfloat16"),
+                             mode="channel")
+    assert str(out.dtype) == "bfloat16"
+    np.testing.assert_allclose(out.asnumpy().astype(np.float32), ref,
+                               rtol=1.2e-2, atol=1e-3)

@@ -226,3 +226,25 @@ def test_mixed_dtype_input_promotes_not_crashes():
     lstm.cast("bfloat16")
     out2 = lstm(x)                         # bf16 net, f32 input
     assert str(out2.dtype) == "float32"
+
+
+def test_explicit_states_promote_after_cast():
+    """Caller-provided states in a different dtype than the net/input
+    must be promoted, not crash the scan carry (review r5: f32 states
+    kept from before a cast, or begin_state dtype vs f32 input)."""
+    lstm = rnn.LSTM(8, 1, input_size=4)
+    lstm.initialize()
+    x = nd.array(np.random.RandomState(0).rand(3, 2, 4)
+                 .astype(np.float32))
+    states_f32 = lstm.begin_state(batch_size=2)
+    lstm(x)
+    lstm.cast("bfloat16")
+    # bf16 net + f32 input + bf16 begin_state -> promoted f32 recurrence
+    out, ns = lstm(x, lstm.begin_state(batch_size=2))
+    assert str(out.dtype) == "float32"
+    # bf16 net + bf16 input + stale f32 states -> promoted f32 (no crash)
+    out2, _ = lstm(nd.cast(x, "bfloat16"), states_f32)
+    assert str(out2.dtype) == "float32"
+    # fully bf16 call stays bf16
+    out3, _ = lstm(nd.cast(x, "bfloat16"), lstm.begin_state(batch_size=2))
+    assert str(out3.dtype) == "bfloat16"

@@ -13,7 +13,7 @@ reports:
 
 Usage:
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu PYTHONPATH=. \
-        python tools/dtype_audit.py [--model resnet|bert] [--batch 8]
+        python tools/dtype_audit.py [--model resnet|bert|lstm|ssd] [--batch 8]
 """
 from __future__ import annotations
 

@@ -192,16 +192,20 @@ class _RNNLayer(HybridBlock):
         if self._layout == "NTC":
             inputs = F.swapaxes(inputs, 0, 1)
         batch = inputs.shape[1]
+        # states follow the PROMOTED compute dtype: a bf16 net on bf16
+        # input must not recur in f32 via f32 states (r5 dtype audit),
+        # while any mixed call (f32 net on bf16 input, f32 states after
+        # cast, ...) recurs in the promoted f32 the dots produce —
+        # anything else mismatches the scan carry
         if skip_states:
-            # implicit states follow the PROMOTED compute dtype: a bf16
-            # net on bf16 input must not recur in f32 via its own zero
-            # states (r5 dtype audit), while a mixed call (f32 net on
-            # bf16 input or vice versa) recurs in the promoted f32 the
-            # dots produce — anything else mismatches the scan carry
-            import jax.numpy as _jnp
-            sdt = _jnp.result_type(inputs.dtype, _jnp.dtype(self._dtype))
+            sdt = jnp.result_type(inputs.dtype, jnp.dtype(self._dtype))
             states = [F.zeros(info["shape"], dtype=sdt)
                       for info in self.state_info(batch)]
+        else:
+            sdt = jnp.result_type(inputs.dtype, jnp.dtype(self._dtype),
+                                  *[s.dtype for s in states])
+            states = [s if s.dtype == sdt else F.cast(s, dtype=sdt)
+                      for s in states]
         ordered = [params[n.lstrip("_")] for n in self._param_names]
         training = autograd.is_training()
         key = _random.take_key() if (self._dropout > 0 and training) else None
