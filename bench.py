@@ -461,7 +461,8 @@ def _resnet_once(smoke, layout, stem, batch):
     rec["layout"] = layout
     rec["stem"] = stem
     rec["batch"] = batch
-    return rec
+    rec["iters"] = iters  # self-describing: a 5-iter quick probe must be
+    return rec            # distinguishable from the official 30-iter run
 
 
 def bench_bert(smoke):
@@ -624,6 +625,7 @@ def _bert_once(smoke, batch, seq_len=128, remat=None):
         "attention_path": path,
         "seq_len": seq_len,
         "batch": batch,
+        "iters": iters,
         "remat": bool(remat),
     }
     if not smoke:
@@ -714,7 +716,7 @@ def _lstm_once(smoke, batch):
         "baseline_note": None if smoke else
         "derived ballpark (BASELINE.md): FLOPs model @ 20% A100 util",
         "batch": batch, "bptt": bptt, "hidden": hid, "layers": layers,
-        "dtype": ldt,
+        "iters": iters, "dtype": ldt,
     }
 
 
@@ -835,7 +837,7 @@ def _ssd_once(smoke, batch):
         "baseline_note": note,
         "batch": batch, "size": size,
         "backbone": "compact(smoke)" if smoke else backbone,
-        "dtype": sdt,
+        "iters": iters, "dtype": sdt,
     }
 
 
