@@ -434,7 +434,7 @@ def _resnet_once(smoke, layout, stem, batch):
     # ~150 MB of tunnel transfer + a batch-256 eager forward before the
     # first measurement (r5: the tunnel wedged inside exactly that
     # window — keep cold-start device traffic minimal).
-    _ = net(nd.random.uniform(shape=(2,) + shape[1:]))
+    net.finalize_shapes(nd.random.uniform(shape=(2,) + shape[1:]))
     net.cast("bfloat16")
 
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
@@ -564,10 +564,11 @@ def _bert_once(smoke, batch, seq_len=128, remat=None):
     positions = np.stack([rng.choice(seq_len, n_masked, replace=False)
                           for _ in range(batch)]).astype(np.int32)
     labels = np.take_along_axis(tokens, positions, axis=1)
-    # finalize deferred shapes on ONE row through the masked head — the
-    # full-batch full-T head would materialize ~4 GB of logits here
-    net(nd.array(tokens[:1]), nd.array(types[:1]), None,
-        nd.array(positions[:1]))
+    # ONE row through the masked head if anything is deferred — BERT
+    # declares every dim so this is normally a no-op (an eager 12-layer
+    # forward over the tunnel is pure cold-start waste)
+    net.finalize_shapes(nd.array(tokens[:1]), nd.array(types[:1]), None,
+                        nd.array(positions[:1]))
 
     class MLMLoss(gluon.loss.Loss):
         """CE over the gathered masked positions (every label is a real
@@ -692,7 +693,7 @@ def _lstm_once(smoke, batch):
     rng = np.random.RandomState(0)
     x = nd.array(rng.randint(0, vocab, (bptt, batch)), dtype="float32")
     y = nd.array(rng.randint(0, vocab, (bptt * batch,)), dtype="float32")
-    model(x)  # finalize deferred shapes (zero initial state)
+    model.finalize_shapes(x)  # no-op: RNNModel declares every dim
     # bf16 weights/activations (BENCH_LSTM_DTYPE=float32 reverts): the r4
     # 740k tok/s was measured in f32 — the same dtype-audit sweep that
     # caught BERT found the LSTM/SSD legs never cast.  Cell state runs in
@@ -802,7 +803,7 @@ def _ssd_once(smoke, batch):
     # transfer — see the resnet leg note); structured labels stay host-built
     x_nd = nd.random.uniform(high=0.1, shape=(batch, 3, size, size))
     l_nd = nd.array(labels)
-    wrapper(x_nd[:2], l_nd[:2])  # finalize deferred shapes (tiny batch)
+    wrapper.finalize_shapes(x_nd[:2], l_nd[:2])  # tiny on-device batch
     # bf16 backbone compute (BENCH_SSD_DTYPE=float32 reverts): r4's 485
     # img/s was measured in f32 — see the lstm note; heads/targets/losses
     # run f32 via the SSDTrain casts above

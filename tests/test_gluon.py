@@ -606,3 +606,24 @@ def test_bert_dtype_casts_whole_model():
               for p in net.collect_params().values()}
     assert dtypes == {"bfloat16"}, dtypes
     assert str(out.dtype) == "float32", out.dtype
+
+
+def test_finalize_shapes_noop_when_fully_declared():
+    """finalize_shapes runs a forward only when deferred params remain;
+    fully-declared models skip the device round-trip entirely."""
+    calls = []
+
+    class Probe(nn.Dense):
+        def forward(self, *a):
+            calls.append(1)
+            return super().forward(*a)
+
+    full = Probe(4, in_units=3)
+    full.initialize()
+    assert full.finalize_shapes(nd.ones((2, 3))) is full
+    assert not calls                 # no forward: nothing deferred
+    deferred = Probe(4)
+    deferred.initialize()
+    deferred.finalize_shapes(nd.ones((2, 3)))
+    assert calls                     # forward ran to finalize
+    assert deferred.weight.shape == (4, 3)

@@ -35,16 +35,16 @@ def build_resnet_step(smoke, batch, layout="NHWC", stem="s2d"):
     with default_layout(layout):
         net = getattr(vision, factory)(classes=classes, stem=stem)
     net.initialize(init="xavier")
-    x = nd.array(np.random.rand(*shape).astype(np.float32))
-    net(x)
+    # tiny on-device finalize + on-device data, mirroring bench.py's
+    # tunnel-lean cold start (chip_profile runs this builder ON CHIP)
+    net.finalize_shapes(nd.random.uniform(shape=(2,) + shape[1:]))
     net.cast("bfloat16")
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
                               wd=1e-4, multi_precision=True)
     step = CompiledTrainStep(net, loss_fn, opt, mesh=None)
-    data = nd.cast(nd.array(np.random.rand(*shape).astype(np.float32)),
-                   "bfloat16")
-    label = nd.array(np.random.randint(0, classes, (batch,)), dtype="float32")
+    data = nd.cast(nd.random.uniform(shape=shape), "bfloat16")
+    label = nd.random.randint(0, classes, (batch,), dtype="float32")
     return step, (data, label)
 
 
@@ -72,8 +72,8 @@ def build_bert_step(smoke, batch):
     positions = np.stack([rng.choice(seq_len, n_masked, replace=False)
                           for _ in range(batch)]).astype(np.int32)
     labels = np.take_along_axis(tokens, positions, axis=1)
-    net(nd.array(tokens[:1]), nd.array(types[:1]), None,
-        nd.array(positions[:1]))
+    net.finalize_shapes(nd.array(tokens[:1]), nd.array(types[:1]), None,
+                        nd.array(positions[:1]))
     ce = gluon.loss.SoftmaxCrossEntropyLoss()
 
     class MLMLoss(gluon.loss.Loss):
@@ -124,7 +124,7 @@ def build_lstm_step(smoke, batch):
     rng = np.random.RandomState(0)
     x = nd.array(rng.randint(0, vocab, (bptt, batch)), dtype="float32")
     y = nd.array(rng.randint(0, vocab, (bptt * batch,)), dtype="float32")
-    model(x)
+    model.finalize_shapes(x)  # no-op: RNNModel declares every dim
     model.cast("bfloat16")
     opt = mx.optimizer.create("sgd", learning_rate=1.0,
                               multi_precision=True)
@@ -180,7 +180,7 @@ def build_ssd_step(smoke, batch):
                         min(y0 + 0.3, 0.95)]
     x_nd = nd.random.uniform(high=0.1, shape=(batch, 3, size, size))
     l_nd = nd.array(labels)
-    wrapper(x_nd[:2], l_nd[:2])
+    wrapper.finalize_shapes(x_nd[:2], l_nd[:2])
     wrapper.cast("bfloat16")
     x_nd = nd.cast(x_nd, "bfloat16")
     dummy = nd.array(np.zeros((1,), np.float32))

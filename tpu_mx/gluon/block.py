@@ -289,6 +289,18 @@ class HybridBlock(Block):
     def _uninitialized(self):
         return [p for p in self.collect_params().values() if p._data is None]
 
+    def finalize_shapes(self, *args):
+        """Finalize any deferred-shape parameters with ONE predict-mode
+        forward over example inputs — and no-op (no device work) when the
+        model declares every dim.  The public cold-start helper for
+        benches/tools: `net.finalize_shapes(tiny_batch)` replaces the
+        unconditional eager forward that cost an extra compile+transfer
+        round-trip per model build over the tunneled TPU.  Returns self."""
+        if self._uninitialized():
+            with autograd.predict_mode():
+                self(*args)
+        return self
+
     # -- the functional core --------------------------------------------------
     def _functional_call(self, param_map, key, train, raw_args):
         """Pure: (params, key, *inputs) -> (outputs, aux_updates)."""
