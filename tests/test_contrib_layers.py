@@ -150,6 +150,7 @@ def test_conv_rnn_unroll_and_deferred_state_error():
         cell2.begin_state(batch_size=2)
 
 
+@pytest.mark.slow
 def test_conv_lstm_unroll_learns():
     """2-step unrolled Conv2DLSTM regression — checks grads flow through
     the recurrent conv."""

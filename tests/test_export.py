@@ -23,6 +23,7 @@ def _small_net():
     return net
 
 
+@pytest.mark.slow
 def test_export_roundtrip_bit_identical(tmp_path):
     net = _small_net()
     net.initialize(init="xavier")

@@ -471,6 +471,7 @@ def test_infer_shape_custom_block_without_override_raises():
         c(nd.ones((2, 5)))
 
 
+@pytest.mark.slow
 def test_bert_remat_policy_grads_match():
     """remat_policy (save-dots vs recompute-all) changes memory/FLOPs,
     never numerics: grads match the no-remat model."""

@@ -268,6 +268,7 @@ class TestFlashBias:
         ((2, 4, 128, 128), False), ((1, 4, 128, 128), False),
         ((1, 1, 128, 128), False), ((2, 4, 128, 128), True),
     ])
+    @pytest.mark.slow
     def test_bias_fwd_bwd_vs_dense(self, bias_shape, causal):
         import jax
         import jax.numpy as jnp
@@ -298,6 +299,7 @@ class TestFlashBias:
                                        rtol=3e-4, atol=3e-5,
                                        err_msg=f"d{name} {bias_shape}")
 
+    @pytest.mark.slow
     def test_bias_with_padding_mask(self):
         import jax.numpy as jnp
         from tpu_mx.kernels.flash_attention import mha_flash_attention

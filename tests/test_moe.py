@@ -42,6 +42,7 @@ def _ref_moe(x, gw, w1, b1, w2, b2, top_k, capacity, act=None):
     return y.astype(np.float32)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("top_k", [1, 2])
 def test_moe_matches_reference_loop(top_k):
     np.random.seed(0)
@@ -77,6 +78,7 @@ def test_moe_capacity_drops_tokens():
     assert (yn > 1e-6).sum() <= 2, yn
 
 
+@pytest.mark.slow
 def test_moe_top1_router_gets_task_gradient():
     """Switch (top-1) keeps the RAW router prob as the combine weight,
     so gate_weight must receive a real task-loss gradient (a

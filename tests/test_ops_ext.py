@@ -157,6 +157,7 @@ class TestVisionOps:
                                     target_shape=(5, 5)).asnumpy()
         np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_bilinear_sampler_grad(self):
         x = rs.rand(1, 2, 5, 5).astype(np.float32)
         theta = np.array([[0.8, 0.1, 0.0, -0.1, 0.9, 0.05]], np.float32)
@@ -217,6 +218,7 @@ class TestCTC:
                               [T] * N, lab_lens, blank=0)
         np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
 
+    @pytest.mark.slow
     def test_matches_torch_with_lengths(self):
         T, N, C, L = 12, 2, 5, 3
         acts = rs.rand(T, N, C).astype(np.float32)
@@ -310,6 +312,7 @@ class TestFusedRNN:
         np.testing.assert_allclose(hN.asnumpy(), t_h.numpy(), rtol=1e-4,
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_rnn_grad_flows(self):
         from tpu_mx import autograd
         from tpu_mx.ndarray.rnn_op import rnn_param_size
@@ -911,6 +914,7 @@ class TestPSROI:
         np.testing.assert_allclose(float(np.asarray(out.asnumpy()).ravel()[0]),
                                    ref, rtol=0.05)
 
+    @pytest.mark.slow
     def test_deformable_psroi_no_trans_matches_zero_offsets(self):
         D, g = 2, 3
         x, C = self._ps_data(D, g)
@@ -933,6 +937,7 @@ class TestPSROI:
                     ref[d, i, j] = (d * g + i) * g + j
         np.testing.assert_allclose(base.asnumpy()[0], ref, rtol=1e-6)
 
+    @pytest.mark.slow
     def test_deformable_psroi_offsets_shift_sampling(self):
         # gradient image along x: positive dx offset must increase values
         H = W = 12

@@ -112,6 +112,7 @@ def _train_small_cnn(steps=40):
     return net, xs, ys
 
 
+@pytest.mark.slow
 def test_quantized_cnn_accuracy_drop_under_1pct():
     from tpu_mx.contrib.quantization import quantize_net
     net, xs, ys = _train_small_cnn()

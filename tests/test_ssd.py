@@ -15,6 +15,7 @@ def _tiny_ssd(num_classes=3):
                scale_filters=16)
 
 
+@pytest.mark.slow
 def test_ssd_forward_shapes():
     net = _tiny_ssd()
     net.initialize(mx.init.Xavier())
@@ -56,6 +57,7 @@ def test_ssd_train_step():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_ssd_detect():
     net = _tiny_ssd()
     net.initialize(mx.init.Xavier())

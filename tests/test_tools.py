@@ -53,6 +53,7 @@ def test_im2rec_roundtrip(tmp_path):
     assert next(iter(it)).data[0].shape == (3, 3, 16, 16)
 
 
+@pytest.mark.slow
 def test_launch_local_spmd(tmp_path):
     """launch.py -n 2: both processes join one jax.distributed group and
     agree on rank/size (the dist_sync_kvstore.py pattern)."""
@@ -168,6 +169,7 @@ def test_ssh_launcher_command_construction(tmp_path):
         launch.read_hostfile(str(empty))
 
 
+@pytest.mark.slow
 def test_dist_sync_kvstore_cross_process_sum(tmp_path):
     """Eager dist_sync push/pull performs a REAL cross-process reduce
     (REF:tests/nightly/dist_sync_kvstore.py): pulled values can only arise
