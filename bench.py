@@ -848,7 +848,6 @@ def bench_scaling(smoke):
     this harness measures whatever mesh the process sees, e.g. the
     8-virtual-device CPU mesh in smoke or a real slice when available):
     throughput(dp=N, batch=N·b) / (N · throughput(dp=1, batch=b))."""
-    import numpy as np
     import jax
     import tpu_mx as mx
     from tpu_mx import gluon, nd
@@ -870,15 +869,14 @@ def bench_scaling(smoke):
         with default_layout("NHWC"):
             net = vision.resnet18_v1(classes=100)
         net.initialize(init="xavier")
-        x = nd.array(np.random.rand(batch, size, size, 3)
-                     .astype(np.float32))
-        net(x)
+        net.finalize_shapes(nd.random.uniform(shape=(2, size, size, 3)))
+        x = nd.random.uniform(shape=(batch, size, size, 3))
         mesh = make_mesh({"dp": ndev}, devices=jax.devices()[:ndev]) \
             if ndev > 1 else None
         opt = mx.optimizer.create("sgd", learning_rate=0.1)
         step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                                  opt, mesh=mesh)
-        y = nd.array(np.random.randint(0, 100, (batch,)), dtype="float32")
+        y = nd.random.randint(0, 100, (batch,), dtype="float32")
         _timed(lambda: step.step(x, y), _fetch_loss, 1)    # compile
         dt = _timed(lambda: step.step(x, y), _fetch_loss, iters)
         return batch * iters / dt

@@ -3,6 +3,12 @@
 Same architecture family (18/34/50/101/152, BasicBlock/Bottleneck, v1 post-act
 and v2 pre-act) — the ResNet-50 ImageNet headline config of BASELINE.md.
 NCHW API layout; XLA:TPU re-layouts convolutions internally for the MXU.
+
+Every layer declares its dims (r5): no deferred-shape params means model
+build touches the device only for on-device parameter init — no
+finalize forward.  The stems therefore pin the 3-channel image contract
+(the reference leaves stem in_channels deferred; grayscale input now
+fails loudly at the first conv instead of silently specializing).
 """
 from __future__ import annotations
 
@@ -41,7 +47,7 @@ class SpaceToDepthStem(HybridBlock):
         self._nhwc = is_channels_last(get_default_layout(2))
         self.conv = nn.Conv2D(channels, kernel_size=3, strides=1, padding=1,
                               use_bias=False, in_channels=3 * block * block)
-        self.bn = nn.BatchNorm()
+        self.bn = nn.BatchNorm(in_channels=channels)
 
     def hybrid_forward(self, F, x):
         b = self._block
@@ -61,16 +67,16 @@ class BasicBlockV1(HybridBlock):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential()
         self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.BatchNorm(in_channels=channels))
         self.body.add(nn.Activation("relu"))
         self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.BatchNorm(in_channels=channels))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
                                           strides=stride, use_bias=False,
                                           in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+            self.downsample.add(nn.BatchNorm(in_channels=channels))
         else:
             self.downsample = None
 
@@ -87,20 +93,22 @@ class BottleneckV1(HybridBlock):
                  **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential()
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
+                                in_channels=in_channels))
+        self.body.add(nn.BatchNorm(in_channels=channels // 4))
         self.body.add(nn.Activation("relu"))
         self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.BatchNorm(in_channels=channels // 4))
         self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
+                                in_channels=channels // 4))
+        self.body.add(nn.BatchNorm(in_channels=channels))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
                                           strides=stride, use_bias=False,
                                           in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+            self.downsample.add(nn.BatchNorm(in_channels=channels))
         else:
             self.downsample = None
 
@@ -116,9 +124,9 @@ class BasicBlockV2(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
+        self.bn1 = nn.BatchNorm(in_channels=in_channels)
         self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
+        self.bn2 = nn.BatchNorm(in_channels=channels)
         self.conv2 = _conv3x3(channels, 1, channels)
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
@@ -143,14 +151,14 @@ class BottleneckV2(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
+        self.bn1 = nn.BatchNorm(in_channels=in_channels)
         self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
+                               use_bias=False, in_channels=in_channels)
+        self.bn2 = nn.BatchNorm(in_channels=channels // 4)
         self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
+        self.bn3 = nn.BatchNorm(in_channels=channels // 4)
         self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
+                               use_bias=False, in_channels=channels // 4)
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
                                         in_channels=in_channels)
@@ -180,12 +188,13 @@ class ResNetV1(HybridBlock):
         assert len(layers) == len(channels) - 1
         self.features = nn.HybridSequential()
         if thumbnail:
-            self.features.add(_conv3x3(channels[0], 1, 0))
+            self.features.add(_conv3x3(channels[0], 1, 3))
         elif stem == "s2d":
             self.features.add(SpaceToDepthStem(channels[0]))
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                        in_channels=3))
+            self.features.add(nn.BatchNorm(in_channels=channels[0]))
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.MaxPool2D(3, 2, 1))
         for i, num_layer in enumerate(layers):
@@ -215,14 +224,16 @@ class ResNetV2(HybridBlock):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self.features = nn.HybridSequential()
-        self.features.add(nn.BatchNorm(scale=False, center=False))
+        self.features.add(nn.BatchNorm(scale=False, center=False,
+                                       in_channels=3))
         if thumbnail:
-            self.features.add(_conv3x3(channels[0], 1, 0))
+            self.features.add(_conv3x3(channels[0], 1, 3))
         elif stem == "s2d":
             self.features.add(SpaceToDepthStem(channels[0]))
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                        in_channels=3))
+            self.features.add(nn.BatchNorm(in_channels=channels[0]))
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.MaxPool2D(3, 2, 1))
         in_channels = channels[0]
@@ -232,7 +243,7 @@ class ResNetV2(HybridBlock):
                 block, num_layer, channels[i + 1], stride,
                 in_channels=in_channels))
             in_channels = channels[i + 1]
-        self.features.add(nn.BatchNorm())
+        self.features.add(nn.BatchNorm(in_channels=in_channels))
         self.features.add(nn.Activation("relu"))
         self.features.add(nn.GlobalAvgPool2D())
         self.features.add(nn.Flatten())
