@@ -20,27 +20,29 @@ from ..ndarray import ops as F
 __all__ = ["SSD", "ssd_512", "ssd_300", "SSDTrainingTargets"]
 
 
-def _body_block(filters):
+def _body_block(filters, in_channels):
     """VGG-ish downsampling block: 2×(conv-bn-relu) + pool/2."""
     blk = nn.HybridSequential()
-    for _ in range(2):
-        blk.add(nn.Conv2D(filters, kernel_size=3, padding=1),
-                nn.BatchNorm(), nn.Activation("relu"))
+    for j in range(2):
+        blk.add(nn.Conv2D(filters, kernel_size=3, padding=1,
+                          in_channels=in_channels if j == 0 else filters),
+                nn.BatchNorm(in_channels=filters), nn.Activation("relu"))
     blk.add(nn.MaxPool2D(2, 2))
     return blk
 
 
-def _scale_block(filters, strides=2, padding=1):
+def _scale_block(filters, strides=2, padding=1, in_channels=0):
     """Extra-scale block: 1×1 reduce + 3×3 conv (REF:example/ssd
     multi_layer_feature extra layers).  Default 3×3/s2/p1 halves the map
     (and keeps 1×1 maps at 1×1); the reference SSD300 tail uses
     3×3/s1/p0 valid convs instead (5→3→1)."""
     blk = nn.HybridSequential()
-    blk.add(nn.Conv2D(filters // 2, kernel_size=1),
-            nn.BatchNorm(), nn.Activation("relu"),
+    blk.add(nn.Conv2D(filters // 2, kernel_size=1,
+                      in_channels=in_channels),
+            nn.BatchNorm(in_channels=filters // 2), nn.Activation("relu"),
             nn.Conv2D(filters, kernel_size=3, strides=strides,
-                      padding=padding),
-            nn.BatchNorm(), nn.Activation("relu"))
+                      padding=padding, in_channels=filters // 2),
+            nn.BatchNorm(in_channels=filters), nn.Activation("relu"))
     return blk
 
 
@@ -68,15 +70,18 @@ class VGG16ReducedFeatures(HybridBlock):
     forward(x) → [scaled conv4_3 (stride 8), fc7 (stride 16)] — the two
     base taps of the reference SSD-512/300 feature pyramid."""
 
-    def __init__(self, **kwargs):
+    def __init__(self, in_channels=3, **kwargs):
         super().__init__(**kwargs)
         layers, filters = [2, 2, 3, 3, 3], [64, 128, 256, 512, 512]
         self.stages = []
+        in_ch = in_channels
         for i, (num, f) in enumerate(zip(layers, filters)):
             stage = nn.HybridSequential()
             for _ in range(num):
-                stage.add(nn.Conv2D(f, kernel_size=3, padding=1),
+                stage.add(nn.Conv2D(f, kernel_size=3, padding=1,
+                                    in_channels=in_ch),
                           nn.Activation("relu"))
+                in_ch = f
             if i < 3:
                 # ceil-mode pooling matches the reference's feature-map
                 # geometry (300: 75 -> 38, not 37 -> conv4_3 is 38x38 and
@@ -88,8 +93,9 @@ class VGG16ReducedFeatures(HybridBlock):
             setattr(self, f"stage{i + 1}", stage)
         self.pool4 = nn.MaxPool2D(2, 2, ceil_mode=True)
         self.pool5 = nn.MaxPool2D(3, 1, padding=1)
-        self.fc6 = nn.Conv2D(1024, kernel_size=3, padding=6, dilation=6)
-        self.fc7 = nn.Conv2D(1024, kernel_size=1)
+        self.fc6 = nn.Conv2D(1024, kernel_size=3, padding=6, dilation=6,
+                             in_channels=512)
+        self.fc7 = nn.Conv2D(1024, kernel_size=1, in_channels=1024)
         self.norm4 = _L2NormScale(512)
 
     def forward(self, x):
@@ -114,7 +120,7 @@ class SSD(HybridBlock):
 
     def __init__(self, num_classes, sizes, ratios, base_filters=(16, 32, 64),
                  scale_filters=128, num_scales=None, backbone="compact",
-                 extra_specs=None, **kwargs):
+                 extra_specs=None, in_channels=3, **kwargs):
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.sizes = [tuple(s) for s in sizes]
@@ -131,13 +137,17 @@ class SSD(HybridBlock):
             raise ValueError(f"unknown backbone {backbone!r}")
         self._n_base_feats = 1
         if backbone == "vgg16_reduced":
-            self.backbone = VGG16ReducedFeatures()
+            self.backbone = VGG16ReducedFeatures(in_channels=in_channels)
             self._n_base_feats = 2
             assert n >= 2, "vgg16_reduced yields 2 base scales"
+            feat_channels = [512, 1024]  # scaled conv4_3, atrous fc7
         else:
             self.backbone = nn.HybridSequential()
+            in_ch = in_channels
             for f in base_filters:
-                self.backbone.add(_body_block(f))
+                self.backbone.add(_body_block(f, in_ch))
+                in_ch = f
+            feat_channels = [base_filters[-1]]
         self.scale_blocks = []
         self.cls_heads = []
         self.box_heads = []
@@ -148,12 +158,16 @@ class SSD(HybridBlock):
         for i in range(n):
             if i >= self._n_base_feats:
                 st, pd = specs[i - self._n_base_feats]
-                blk = _scale_block(scale_filters, strides=st, padding=pd)
+                blk = _scale_block(scale_filters, strides=st, padding=pd,
+                                   in_channels=feat_channels[-1])
                 self.scale_blocks.append(blk)
                 setattr(self, f"scale_{i}", blk)
+                feat_channels.append(scale_filters)
             ch = nn.Conv2D(self._num_anchors[i] * (num_classes + 1),
-                           kernel_size=3, padding=1)
-            bh = nn.Conv2D(self._num_anchors[i] * 4, kernel_size=3, padding=1)
+                           kernel_size=3, padding=1,
+                           in_channels=feat_channels[i])
+            bh = nn.Conv2D(self._num_anchors[i] * 4, kernel_size=3, padding=1,
+                           in_channels=feat_channels[i])
             self.cls_heads.append(ch)
             self.box_heads.append(bh)
             setattr(self, f"cls_head_{i}", ch)
