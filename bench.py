@@ -1030,6 +1030,45 @@ def inner():
 # ---------------------------------------------------------------------------
 # outer: supervisor — no jax import, hard timeouts, retry, partial JSON
 # ---------------------------------------------------------------------------
+def _acquire_chip_lock():
+    """Cooperative single-chip lock (flock on .chip_lock, self-releasing
+    on process death): the round-end driver bench and a mid-stage
+    tpu_watch must not hit the chip concurrently — two jax processes
+    wedge each other in make_c_api_client and BOTH lose.  The watcher
+    holds the lock around each stage and sets TPUMX_CHIP_LOCK_HELD=1 for
+    its children (this outer runs AS such a child: skip re-acquiring the
+    lock the parent already holds).  Bounded wait: a stage tops out at
+    90 min but the watcher yields between stages, so waiting a while
+    usually wins; after TPUMX_CHIP_LOCK_WAIT (default 1800 s) proceed
+    anyway rather than lose the round to patience.  Returns the open
+    lock file (hold until exit) or None."""
+    if os.environ.get("TPUMX_CHIP_LOCK_HELD") == "1":
+        return None
+    import fcntl
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".chip_lock")
+    f = open(path, "w")
+    deadline = time.time() + float(
+        os.environ.get("TPUMX_CHIP_LOCK_WAIT", "1800"))
+    logged = False
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except OSError:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                log("chip lock still held at wait deadline; proceeding "
+                    "WITHOUT the lock (accepting contention risk)")
+                f.close()
+                return None  # honest: exclusivity does NOT hold
+            if not logged:
+                log("chip lock held (a watcher stage is on the chip); "
+                    "waiting for it to finish...")
+                logged = True
+            time.sleep(min(10.0, max(0.5, remaining)))
+
+
 def _run_attempt(timeout, probe_timeout):
     """Run one --inner child.  The child's stderr is teed through so the
     stage log stays visible, and watched for the 'backend up' marker: a
@@ -1080,6 +1119,7 @@ def outer():
     # finished legs
     timeout = float(os.environ.get("BENCH_TIMEOUT", "2400"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
+    _chip_lock = _acquire_chip_lock()  # held (or None) until process exit
     last_err = "unknown"
     for attempt in range(1, attempts + 1):
         log(f"attempt {attempt}/{attempts} (timeout {timeout:.0f}s, "

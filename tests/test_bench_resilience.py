@@ -316,3 +316,53 @@ def test_run_ladder_oom_fallback():
 
     with pytest.raises(RuntimeError):
         bench._run_ladder("t", (128, 64), hbm_note)
+
+
+def test_chip_lock_contention(tmp_path):
+    """bench's outer waits (bounded) for the cooperative chip lock, the
+    watcher's non-blocking acquire reports busy, and a watcher child
+    (TPUMX_CHIP_LOCK_HELD=1) skips acquiring the lock its parent holds."""
+    import time as _time
+    holder = tmp_path / "hold.py"
+    holder.write_text(
+        "import fcntl, sys, time\n"
+        f"f = open({os.path.join(REPO, '.chip_lock')!r}, 'w')\n"
+        "fcntl.flock(f, fcntl.LOCK_EX)\n"
+        "print('HELD', flush=True)\n"
+        "time.sleep(60)\n")
+    proc = subprocess.Popen([sys.executable, str(holder)],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "HELD"
+        bench = _load_bench_module()
+        # child mode: parent already holds the lock -> no acquisition
+        os.environ["TPUMX_CHIP_LOCK_HELD"] = "1"
+        try:
+            assert bench._acquire_chip_lock() is None
+        finally:
+            del os.environ["TPUMX_CHIP_LOCK_HELD"]
+        # bounded wait: deadline passes while the holder lives -> None
+        # (honest "no exclusivity"), after waiting roughly the deadline
+        os.environ["TPUMX_CHIP_LOCK_WAIT"] = "2"
+        try:
+            t0 = _time.time()
+            assert bench._acquire_chip_lock() is None
+            assert 1.5 < _time.time() - t0 < 30
+        finally:
+            del os.environ["TPUMX_CHIP_LOCK_WAIT"]
+        # watcher side: non-blocking acquire reports busy
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import tpu_watch as w
+        finally:
+            sys.path.pop(0)
+        with pytest.raises(w.ChipBusy):
+            w._chip_lock()
+    finally:
+        proc.kill()
+        proc.wait()
+    # holder dead: both sides acquire freely
+    bench = _load_bench_module()
+    f = bench._acquire_chip_lock()
+    assert f is not None
+    f.close()

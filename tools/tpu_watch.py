@@ -63,13 +63,20 @@ def ts():
 
 
 def probe():
-    """One backend probe in a subprocess.  Returns (up, detail)."""
+    """One backend probe in a subprocess, under the chip lock (an
+    unlocked probe IS a second jax process — the exact wedge the lock
+    exists to prevent).  Returns (up, detail); raises ChipBusy when
+    another process owns the chip."""
+    lock = _chip_lock()
     try:
-        out = subprocess.run([sys.executable, "-c", PROBE_SNIPPET],
-                             capture_output=True, text=True,
-                             timeout=PROBE_TIMEOUT, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return False, f"probe timed out after {PROBE_TIMEOUT}s"
+        try:
+            out = subprocess.run([sys.executable, "-c", PROBE_SNIPPET],
+                                 capture_output=True, text=True,
+                                 timeout=PROBE_TIMEOUT, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            return False, f"probe timed out after {PROBE_TIMEOUT}s"
+    finally:
+        lock.close()
     lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
     if out.returncode == 0 and lines:
         info = json.loads(lines[-1])
@@ -77,25 +84,63 @@ def probe():
     return False, f"rc={out.returncode} stderr={out.stderr[-200:]}"
 
 
+CHIP_LOCK = os.path.join(REPO, ".chip_lock")
+
+
+class ChipBusy(Exception):
+    """Another process (the round-end driver bench) holds the chip."""
+
+
+def _chip_lock():
+    """Non-blocking flock on the shared single-chip lock.  bench.py's
+    outer takes the same lock (blocking) so the round-end driver bench
+    and a watcher stage can never hit the chip concurrently — two jax
+    processes wedge each other in make_c_api_client and both lose.
+    flock self-releases on process death: no stale-lock handling."""
+    import fcntl
+    f = open(CHIP_LOCK, "w")
+    try:
+        fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        f.close()
+        raise ChipBusy
+    return f
+
+
 def run_logged(tag, cmd, timeout, env=None):
-    """Run cmd with stdout+stderr teed to a log file; returns (rc, stdout)
-    or (None, reason) on timeout."""
+    """Run cmd with stdout+stderr teed to a log file, holding the chip
+    lock; returns (rc, stdout) or (None, reason) on timeout/chip-busy."""
     os.makedirs(LOGDIR, exist_ok=True)
     path = os.path.join(LOGDIR, f"{tag}_{time.strftime('%H%M%S')}.log")
+    lock = _chip_lock()  # ChipBusy propagates: the caller yields the window
     log(f"running {tag}: {' '.join(cmd)} (timeout {timeout}s, log {path})")
     full_env = dict(os.environ)
+    full_env["TPUMX_CHIP_LOCK_HELD"] = "1"  # children skip re-acquiring
     if env:
         full_env.update(env)
-    with open(path, "w") as f:
-        try:
-            out = subprocess.run(cmd, stdout=subprocess.PIPE,
-                                 stderr=f, text=True, timeout=timeout,
-                                 cwd=REPO, env=full_env)
-        except subprocess.TimeoutExpired:
-            return None, f"{tag} timed out after {timeout}s (log: {path})"
-    with open(path, "a") as f:
-        f.write(f"\n--- stdout ---\n{out.stdout}")
-    return out.returncode, out.stdout
+    import signal
+    try:
+        with open(path, "w") as f:
+            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=f,
+                                    text=True, cwd=REPO, env=full_env,
+                                    start_new_session=True)
+            try:
+                stdout, _ = proc.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                # kill the WHOLE group: subprocess kill alone leaves e.g.
+                # bench.py's --inner jax grandchild alive on the chip
+                # while the released lock tells the driver it is free
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait()
+                return None, f"{tag} timed out after {timeout}s (log: {path})"
+        with open(path, "a") as f:
+            f.write(f"\n--- stdout ---\n{stdout}")
+        return proc.returncode, stdout
+    finally:
+        lock.close()
 
 
 def validation_done():
@@ -373,13 +418,25 @@ def main():
         f"{PROBE_INTERVAL_DOWN}s while down; {len(STAGES)} stages armed)")
     while True:
         n_probe += 1
-        up, detail = probe()
+        stages_done = {name: bool(done()) for name, done, _ in STAGES}
+        try:
+            up, detail = probe()  # probe holds the chip lock itself
+        except ChipBusy:
+            log("chip lock held by another process (driver bench?); "
+                "yielding this cycle")
+            write_status(up=None, probes=n_probe, up_probes=up_count,
+                         stages_done=stages_done,
+                         validation_done=stages_done["validate"],
+                         bench_done=stages_done["bench"],
+                         mfu_done=stages_done["mfu"],
+                         detail="chip lock held; probe skipped")
+            time.sleep(PROBE_INTERVAL_DOWN)
+            continue
         with open(PROBE_LOG, "a") as f:
             f.write(json.dumps({"ts": ts(), "up": up,
                                 "detail": detail}) + "\n")
         if up:
             up_count += 1
-        stages_done = {name: bool(done()) for name, done, _ in STAGES}
         write_status(up=up, probes=n_probe, up_probes=up_count,
                      stages_done=stages_done,
                      validation_done=stages_done["validate"],
@@ -393,15 +450,23 @@ def main():
             for name, done, runner in STAGES:
                 if done():
                     continue
-                # re-probe between stages: a dead tunnel must cost one
-                # 120s probe, not a stage's full timeout budget
-                alive, _ = probe()
-                if not alive:
-                    log(f"tunnel lost before stage {name}; backing off")
+                try:
+                    # re-probe between stages: a dead tunnel must cost
+                    # one 120s probe, not a stage's full timeout budget
+                    alive, _ = probe()
+                    if not alive:
+                        log(f"tunnel lost before stage {name}; backing off")
+                        ok = False
+                        break
+                    log(f"running stage {name}...")
+                    st_ok = runner()
+                except ChipBusy:
+                    # the driver bench grabbed the chip between stages:
+                    # yield the whole window, don't poke at a busy chip
+                    log("chip lock taken (driver bench?); yielding the "
+                        "rest of the stage window")
                     ok = False
                     break
-                log(f"running stage {name}...")
-                st_ok = runner()
                 log(f"stage {name}: {'ok' if st_ok else 'FAILED/partial'}")
                 ok = ok and st_ok
             if not ok:
