@@ -1144,6 +1144,15 @@ def outer():
         rec["stale"] = True
         rec["measured_at"] = measured_at
         rec["error"] = last_err
+        if "iters" not in rec and \
+                not str(rec.get("metric", "")).startswith(
+                    "weak_scaling_efficiency"):
+            # a record without the r5 self-describing fields predates the
+            # r5 byte-diet (one-pass BN default, true-bf16 BERT/LSTM/SSD
+            # legs): it measured code paths that no longer exist
+            rec["stale_note"] = ("measured before the r5 byte-diet "
+                                 "changes — see docs/performance.md "
+                                 "'r5 byte-diet changes'")
         log(f"all attempts failed; emitting last good measurement "
             f"from {measured_at} marked stale")
         print(json.dumps(rec), flush=True)
