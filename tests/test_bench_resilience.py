@@ -366,3 +366,97 @@ def test_chip_lock_contention(tmp_path):
     f = bench._acquire_chip_lock()
     assert f is not None
     f.close()
+
+
+def _store_with(tmp_path, monkeypatch, rec, measured_at=None):
+    """Persist rec via the real persist path, optionally rewriting the
+    stored measured_at (to age the record for the freshness tests)."""
+    path = tmp_path / "lg.json"
+    monkeypatch.setenv("BENCH_LASTGOOD_PATH", str(path))
+    bench = _load_bench_module()
+    bench.persist_lastgood(rec)
+    if measured_at is not None:
+        store = json.loads(path.read_text())
+        store["records"][rec["metric"]]["measured_at"] = measured_at
+        path.write_text(json.dumps(store))
+    return bench
+
+
+def test_fresh_stored_carries_recent_record(tmp_path, monkeypatch):
+    """BENCH_SKIP_FRESH: a record measured minutes ago is carried with
+    carried_fresh=True and its own measured_at, so a wedge-shortened
+    retry spends the window on the legs still missing."""
+    rec = {"metric": "bert_base_train_seqs_per_sec_per_chip",
+           "value": 790.89, "iters": 20}
+    bench = _store_with(tmp_path, monkeypatch, rec)
+    got = bench._fresh_stored(rec["metric"], 3600)
+    assert got is not None
+    assert got["value"] == 790.89
+    assert got["carried_fresh"] is True
+    assert got["measured_at"]
+
+
+def test_fresh_stored_rejects_old_record(tmp_path, monkeypatch):
+    rec = {"metric": "bert_base_train_seqs_per_sec_per_chip",
+           "value": 726.09}
+    bench = _store_with(tmp_path, monkeypatch, rec,
+                        measured_at="2026-07-31T11:52:17+0000")
+    assert bench._fresh_stored(rec["metric"], 14400) is None
+
+
+def test_fresh_stored_min_iters_gates_quick_bench(tmp_path, monkeypatch):
+    """The quick stage's 5-iter resnet number must never be carried as
+    the official 30-iter record."""
+    rec = {"metric": "resnet50_train_images_per_sec_per_chip",
+           "value": 2303.33, "iters": 5}
+    bench = _store_with(tmp_path, monkeypatch, rec)
+    assert bench._fresh_stored(rec["metric"], 3600, min_iters=30) is None
+    assert bench._fresh_stored(rec["metric"], 3600, min_iters=5) is not None
+
+
+def test_fresh_stored_require_narrows_match(tmp_path, monkeypatch):
+    """The r4-era compact-backbone ssd record shares the official metric
+    key; require={'backbone': 'vgg16_reduced'} must reject it."""
+    rec = {"metric": "ssd512_train_images_per_sec_per_chip",
+           "value": 485.18, "backbone": "compact"}
+    bench = _store_with(tmp_path, monkeypatch, rec)
+    key = rec["metric"]
+    assert bench._fresh_stored(
+        key, 3600, require={"backbone": "vgg16_reduced"}) is None
+    assert bench._fresh_stored(
+        key, 3600, require={"backbone": "compact"}) is not None
+
+
+def test_fresh_stored_rejects_error_zero_and_future(tmp_path, monkeypatch):
+    key = "lstm_ptb_train_tokens_per_sec_per_chip"
+    bench = _store_with(tmp_path, monkeypatch, {"metric": key, "value": 0.0})
+    assert bench._fresh_stored(key, 3600) is None
+    bench = _store_with(tmp_path, monkeypatch,
+                        {"metric": key, "value": 100.0, "error": "wedge"})
+    assert bench._fresh_stored(key, 3600) is None
+    # a future-dated measured_at (clock skew) must not qualify as fresh
+    import datetime
+    future = (datetime.datetime.now(datetime.timezone.utc) +
+              datetime.timedelta(hours=2)).strftime("%Y-%m-%dT%H:%M:%S%z")
+    bench = _store_with(tmp_path, monkeypatch,
+                        {"metric": key, "value": 100.0},
+                        measured_at=future)
+    assert bench._fresh_stored(key, 3600) is None
+
+
+def test_fresh_stored_missing_store_and_key(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_LASTGOOD_PATH", str(tmp_path / "absent.json"))
+    bench = _load_bench_module()
+    assert bench._fresh_stored("anything", 3600) is None
+    bench = _store_with(tmp_path, monkeypatch,
+                        {"metric": "some_other_metric", "value": 5.0})
+    assert bench._fresh_stored("not_that_metric", 3600) is None
+
+
+def test_fresh_stored_extra_leg_min_iters(tmp_path, monkeypatch):
+    """lstm/ssd honor BENCH_ITERS too: a short manual sanity run must not
+    be carried as the official leg (review finding, session 4)."""
+    rec = {"metric": "lstm_ptb_train_tokens_per_sec_per_chip",
+           "value": 700000.0, "iters": 3}
+    bench = _store_with(tmp_path, monkeypatch, rec)
+    assert bench._fresh_stored(rec["metric"], 3600, min_iters=20) is None
