@@ -123,9 +123,13 @@ def _fwd_kernel(*refs, scale, causal, masked, rate, biased, block_q,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                      # (Bq, D)
-        k = k_ref[0].astype(jnp.float32)                      # (Bk, D)
-        v = v_ref[0].astype(jnp.float32)                      # (Bk, D)
+        # dots run in the INPUT dtype with f32 accumulation: on the MXU a
+        # dot with f32 operands is emulated in multiple bf16 passes, so
+        # upcasting bf16 q/k/v before the dot tripled the matmul cost for
+        # precision the softmax stats (kept f32 throughout) never needed
+        q = q_ref[0]                                          # (Bq, D)
+        k = k_ref[0]                                          # (Bk, D)
+        v = v_ref[0]                                          # (Bk, D)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if biased:
@@ -144,7 +148,7 @@ def _fwd_kernel(*refs, scale, causal, masked, rate, biased, block_q,
         else:
             p_acc = p
         acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
-            p_acc, v, (((1,), (0,)), ((), ())),
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
@@ -319,10 +323,11 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, rate, biased, block_q,
         db_ref[0] = jnp.zeros_like(db_ref[0])
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype dot operands, f32 stats/accumulators (see fwd)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]                                 # (Bq,)
         delta = delta_ref[0][:, 0]                             # (Bq,)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -344,7 +349,7 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, rate, biased, block_q,
             db_ref[0] = ds_raw.astype(db_ref.dtype)
         ds = ds_raw * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     run = _run_cond(causal, valid, qi, ki, block_q, block_k)
@@ -380,10 +385,11 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, rate, biased, block_q,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype dot operands, f32 stats/accumulators (see fwd)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -401,7 +407,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, rate, biased, block_q,
             keep = None
             p_drop = p
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())),
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -409,7 +415,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, rate, biased, block_q,
             dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta[:, None]) * scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     run = _run_cond(causal, valid, qi, ki, block_q, block_k)

@@ -106,7 +106,14 @@ def _block_attn(q, k, v, bias=None, mask=None, scale=1.0,
     if dropout_rate > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)                   # (B,H,Tq,D)
+    # probs cast to v.dtype for the AV matmul (flash-kernel numerics: the
+    # softmax stats m/l stay f32, only the normalized weights round).  On
+    # the dense path p is a materialized (B,H,Tq,Tk) HBM tensor and the
+    # default MXU precision truncates f32 dot operands to bf16 anyway —
+    # keeping p f32 paid double the HBM bytes for no extra matmul
+    # precision; f32 accumulation is preserved via preferred_element_type.
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)        # (B,H,Tq,D)
     return m_safe, l, o
 
 
