@@ -7,13 +7,20 @@ unmissable banner when any test fails, and prints per-tier timing so the
 slowest tier stays visible.
 
 Tiers: core (`-m "not slow"`, <5 min), slow (virtual-mesh parallelism,
-full-model layout trains, op-audit sweep, native C++ tier), then the
-example smokes.  `--core-only` runs just the first for a quick gate.
+full-model layout trains, op-audit sweep, native C++ tier), the example
+smokes, then native-asan — an AddressSanitizer build+run of
+`native/tpumx_io_test.cpp`, the one multithreaded-shared-state code the
+project owns (threads + shared queues; the reference ran ASAN CI,
+SURVEY §5.2 / VERDICT r5 missing#6).  `--core-only` runs just the first
+for a quick gate.
 """
 from __future__ import annotations
 
+import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 TIERS = [
@@ -23,6 +30,45 @@ TIERS = [
               "--deselect", "tests/test_examples.py"]),
     ("examples", ["tests/test_examples.py"]),
 ]
+
+
+def native_asan():
+    """Compile and run the native io C++ unit tier under
+    -fsanitize=address.  Returns a process-style rc (0 = green).  The
+    tpumx_io_test source skips its RLIMIT_AS observable under ASAN (the
+    shadow reservation needs terabytes of address space); everything
+    else — threaded decode, RecordIO scan, det label bounds — runs with
+    heap/use-after-free checking armed."""
+    if shutil.which("g++") is None:
+        print("  native-asan: g++ not found — cannot run the sanitizer "
+              "tier (counts as FAIL: the gate must not pass vacuously)")
+        return 1
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "native", "tpumx_io_test.cpp")
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            binary = os.path.join(d, "tpumx_io_test_asan")
+            cc = subprocess.run(
+                ["g++", "-O1", "-g", "-std=c++17", "-fsanitize=address",
+                 src, "-o", binary, "-ljpeg", "-lpthread"],
+                capture_output=True, text=True, timeout=300)
+            if cc.returncode != 0:
+                print(f"  native-asan: compile failed:\n{cc.stderr[-2000:]}")
+                return cc.returncode or 1
+            run = subprocess.run([binary], capture_output=True, text=True,
+                                 timeout=300)
+            out = (run.stdout or "") + (run.stderr or "")
+            if run.returncode != 0 or "ALL PASS" not in out:
+                print(f"  native-asan: run failed (rc={run.returncode}):\n"
+                      f"{out[-3000:]}")
+                return run.returncode or 1
+    except subprocess.TimeoutExpired as e:
+        # a wedged compile or a hung test binary (e.g. the threaded-decode
+        # deadlock this tier exists to police) must surface as a FAIL row
+        # in the results table, not crash the driver
+        print(f"  native-asan: timed out: {e}")
+        return 1
+    return 0
 
 
 def main():
@@ -37,6 +83,9 @@ def main():
         t0 = time.time()
         proc = subprocess.run([sys.executable, "-m", "pytest", "-q", *args])
         results.append((name, proc.returncode, time.time() - t0))
+    if not opts.core_only:
+        t0 = time.time()
+        results.append(("native-asan", native_asan(), time.time() - t0))
     print()
     red = False
     for name, rc, dt in results:

@@ -54,6 +54,7 @@ from . import test_utils
 from . import profiler
 from . import monitor
 from . import runtime
+from . import fusion
 from . import engine
 from . import layout
 from . import elastic

@@ -62,6 +62,12 @@ class _RecordingScope:
 
     def __enter__(self):
         self._old = (_STATE.recording, _STATE.training)
+        if self._rec is not None and self._rec != _STATE.recording:
+            # tape boundary: a pending fused op segment must flush under
+            # the recording state its ops were issued in, so fusion never
+            # tapes (or skips taping) ops across a record()/pause() edge
+            from . import fusion
+            fusion.flush("tape_boundary")
         if self._rec and not _STATE.recording:
             # entering the outermost record scope starts a fresh graph; a
             # prior recorded-but-never-backwarded forward (e.g. an aborted
@@ -74,6 +80,9 @@ class _RecordingScope:
         return self
 
     def __exit__(self, *exc):
+        if _STATE.recording != self._old[0]:
+            from . import fusion
+            fusion.flush("tape_boundary")
         _STATE.recording, _STATE.training = self._old
         return False
 
@@ -150,6 +159,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     attached grad buffer.  Matches reference semantics: default head gradient
     is ones; ``grad_req='add'`` accumulates across backward calls."""
     from .ndarray import NDArray  # late import (cycle)
+    from . import fusion
+    fusion.flush("backward")  # heads/tape must be realized before the walk
 
     if not isinstance(heads, (list, tuple)):
         heads = [heads]

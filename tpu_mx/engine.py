@@ -4,23 +4,34 @@ The reference's dependency engine schedules every NDArray mutation on
 per-device thread pools; Python exposes ``bulk`` (op bulking) and engine
 type inspection.  TPU-natively the "engine" is JAX's async dispatch plus
 XLA program order: ops issue immediately and execute in stream order, and
-``jit`` regions are the bulked segments.  This module keeps the control
-surface: ``bulk`` is honored as a hint (ops inside are already batched by
-dispatch), and the wait functions map to ``block_until_ready``.
+``jit`` regions are the bulked segments.
 
-DIVERGENCE — read before benchmarking dispatch overhead: ``set_bulk_size``
-and ``bulk()`` are **semantic no-ops** here.  They record the value and
-restore it, but do not change how ops execute; XLA fusion under
-``hybridize()``/``jit`` is the real bulking mechanism.  Numbers measured
-inside ``bulk()`` scopes reflect plain eager dispatch.
+``bulk()`` is REAL op bulking here (since the fusion engine landed —
+previously a documented no-op): inside a ``bulk(size)`` scope, fusible
+imperative ops (elementwise / broadcast / cast / reduce tails) are
+deferred onto a pending segment and flushed as ONE jitted XLA program at
+any barrier (a buffer read, a non-fusible consumer, an autograd tape
+boundary, the segment reaching ``size`` ops, or scope exit).  The jitted
+program is memoized across scopes keyed by the op-chain signature, so
+steady-state bulked dispatch costs one cache hit + one XLA call instead
+of N eager dispatches with N-1 materialized intermediates.  See
+``tpu_mx/fusion.py`` for the segment IR and the numerics contract
+(hybridize-grade XLA semantics; ``TPUMX_FUSION=0`` restores plain eager
+dispatch exactly, ``TPUMX_FUSION=1`` turns fusion on outside ``bulk``
+scopes too).  ``bulk_stats()`` exposes the engine counters.
+
+The wait functions map to ``block_until_ready`` over live buffers, with a
+pending-segment flush first — a real full-engine barrier.
 """
 from __future__ import annotations
 
 import contextlib
 import os
 
+from . import fusion as _fusion
+
 __all__ = ["bulk", "set_bulk_size", "wait_for_all", "engine_type",
-           "push_async", "push_sync"]
+           "push_async", "push_sync", "bulk_stats", "reset_bulk_stats"]
 
 try:
     _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
@@ -31,14 +42,14 @@ except ValueError:
 def engine_type():
     """Name of the active scheduler.  The reference returns one of
     NaiveEngine/ThreadedEngine/ThreadedEnginePerDevice; here scheduling is
-    JAX's asynchronous dispatch."""
+    JAX's asynchronous dispatch plus the lazy fusion segments."""
     return "JaxAsyncDispatch"
 
 
 def set_bulk_size(size):
-    """Set the bulking hint; returns the previous value.  Kept for API
-    compatibility — XLA fusion under ``jit`` supersedes engine-level
-    bulking (REF:src/imperative/cached_op.cc bulking)."""
+    """Set the max ops per fused segment; returns the previous value
+    (REF:src/imperative/cached_op.cc bulking).  Takes effect for segments
+    started after the call; a size <= 1 means no bulking."""
     global _bulk_size
     prev, _bulk_size = _bulk_size, int(size)
     return prev
@@ -46,19 +57,45 @@ def set_bulk_size(size):
 
 @contextlib.contextmanager
 def bulk(size):
-    """Scope within which ops may be bulked (no-op semantically: JAX's
-    dispatch already pipelines; use ``hybridize()``/``jit`` for true
-    single-program execution)."""
+    """Scope within which fusible imperative ops are bulked into lazily
+    flushed jitted segments of up to ``size`` ops (the reference's engine
+    bulking, realized through tpu_mx/fusion.py).  Scope exit is a flush
+    barrier.  ``size <= 1`` disables bulking for the scope — including
+    under ``TPUMX_FUSION=1`` — matching the reference's
+    MXNET_ENGINE_BULK_SIZE=0/1 escape hatch (op-by-op execution, e.g. to
+    localize a deferred error to its call site)."""
     prev = set_bulk_size(size)
+    fusing = int(size) > 1
+    if fusing:
+        _fusion.enter_scope()
+    else:
+        _fusion.enter_suppress()
     try:
         yield
     finally:
+        if fusing:
+            _fusion.exit_scope()  # flushes the pending segment
+        else:
+            _fusion.exit_suppress()
         set_bulk_size(prev)
+
+
+def bulk_stats():
+    """Engine bulking counters: ops_fused, segments_flushed, cache hits /
+    misses, flush reasons.  Cumulative per process; reset with
+    ``reset_bulk_stats()``."""
+    out = dict(_fusion.stats)
+    out["flush_reasons"] = dict(_fusion.stats["flush_reasons"])
+    return out
+
+
+def reset_bulk_stats():
+    _fusion.reset_stats()
 
 
 def wait_for_all():
     """Block until all issued computation has finished
-    (Engine::WaitForAll)."""
+    (Engine::WaitForAll).  Flushes any pending fused segment first."""
     from .ndarray import waitall
     waitall()
 

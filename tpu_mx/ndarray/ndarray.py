@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import autograd
+from .. import fusion as _fusion
 from ..context import Context, current_context, default_context
 
 __all__ = ["NDArray", "array", "save", "load", "waitall", "concatenate", "from_numpy"]
@@ -64,37 +65,62 @@ def _to_ctx_device(data, ctx):
 
 
 class NDArray:
-    """Mutable tensor handle; wraps an immutable jax.Array + autograd hooks."""
+    """Mutable tensor handle; wraps an immutable jax.Array + autograd hooks.
 
-    __slots__ = ("_data", "_grad", "_grad_req", "_tape_node", "_version", "__weakref__")
+    The buffer lives behind the ``_data`` property: ``_buf`` is the
+    concrete jax.Array, or None while ``_lazy`` points at a pending
+    fusion-segment node (engine bulking, see tpu_mx/fusion.py).  Every
+    read path goes through the property, so ANY buffer access is a flush
+    barrier that realizes the lazy thunk; shape/dtype queries answer from
+    the segment's abstract eval without forcing execution."""
+
+    __slots__ = ("_buf", "_lazy", "_grad", "_grad_req", "_tape_node",
+                 "_version", "__weakref__")
 
     def __init__(self, data, ctx=None):
         if isinstance(data, NDArray):
             data = data._data
         elif not isinstance(data, jax.Array):
             data = jnp.asarray(data)
-        self._data = _to_ctx_device(data, ctx)
+        self._buf = _to_ctx_device(data, ctx)
+        self._lazy = None
         self._grad = None
         self._grad_req = "write"
         self._tape_node = None
         self._version = 0
 
+    @property
+    def _data(self):
+        if self._lazy is not None:
+            _fusion.realize(self)
+        return self._buf
+
+    @_data.setter
+    def _data(self, value):
+        self._buf = value
+        self._lazy = None
+
     # ------------------------------------------------------------------ meta
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        if self._lazy is not None:
+            return tuple(_fusion.aval_of(self._lazy).shape)
+        return tuple(self._buf.shape)
 
     @property
     def dtype(self):
-        return self._data.dtype
+        if self._lazy is not None:
+            return _fusion.aval_of(self._lazy).dtype
+        return self._buf.dtype
 
     @property
     def size(self):
-        return int(np.prod(self._data.shape)) if self._data.shape else 1
+        shape = self.shape
+        return int(np.prod(shape)) if shape else 1
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return len(self.shape)
 
     @property
     def context(self):
@@ -492,7 +518,9 @@ def waitall():
     Blocks until every live jax.Array in the process is ready — a real sync
     of all previously dispatched device work, not just a fresh dummy
     computation (which would only bound the dispatch queue, not completion
-    on every device)."""
+    on every device).  A pending fused op segment flushes first: waitall
+    is a full engine barrier."""
+    _fusion.flush("waitall")
     for a in jax.live_arrays():
         try:
             a.block_until_ready()

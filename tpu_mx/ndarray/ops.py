@@ -24,6 +24,7 @@ from jax import lax
 
 from .. import autograd
 from .. import _functional
+from .. import fusion as _fusion
 from .. import layout as _layout_mod
 from .ndarray import NDArray, array, concatenate, load, save, waitall
 from ..context import current_context
@@ -49,14 +50,24 @@ def _raw(a):
     return a  # python scalar — kept as-is so jnp broadcasting rules apply
 
 
-def _apply(fn, args, name="op", nondiff=False):
-    """Dispatch one op: args = tensor positionals (NDArray | array | scalar)."""
-    datas = [_raw(a) for a in args]
+def _apply(fn, args, name="op", nondiff=False, fuse=None):
+    """Dispatch one op: args = tensor positionals (NDArray | array | scalar).
+
+    `fuse` marks the op fusible for engine bulking: a hashable key naming
+    the op AND every static parameter its `fn` closes over (the fusion
+    cache replays a previously traced chain on key match, so anything that
+    changes the math must be in the key).  None = non-fusible; reading the
+    args below is then the flush barrier for any lazy inputs."""
     if _functional.active() or not any(isinstance(a, NDArray) for a in args):
         # functional mode: inside a hybridize/apply trace (even if an NDArray
         # leaked in via a creation op), or a pure-array call — no wrapping,
         # no tape
-        return fn(*datas)
+        return fn(*[_raw(a) for a in args])
+    if fuse is not None and _fusion.enabled():
+        res = _fusion.append(fn, args, name, fuse, nondiff)
+        if res is not None:
+            return res
+    datas = [_raw(a) for a in args]
 
     diff_idx = [
         i for i, a in enumerate(args)
@@ -142,15 +153,20 @@ def eye(N, M=0, k=0, ctx=None, dtype="float32"):
 
 
 def zeros_like(a, **kw):
-    return _apply(jnp.zeros_like, [a], "zeros_like", nondiff=True)
+    return _apply(jnp.zeros_like, [a], "zeros_like", nondiff=True,
+                  fuse="zeros_like")
 
 
 def ones_like(a, **kw):
-    return _apply(jnp.ones_like, [a], "ones_like", nondiff=True)
+    return _apply(jnp.ones_like, [a], "ones_like", nondiff=True,
+                  fuse="ones_like")
 
 
 def full_like(a, fill_value, **kw):
-    return _apply(lambda x: jnp.full_like(x, fill_value), [a], "full_like", nondiff=True)
+    fuse = ("full_like", fill_value) \
+        if isinstance(fill_value, (int, float)) else None
+    return _apply(lambda x: jnp.full_like(x, fill_value), [a], "full_like",
+                  nondiff=True, fuse=fuse)
 
 
 # ----------------------------------------------------------------------------
@@ -158,7 +174,11 @@ def full_like(a, fill_value, **kw):
 # ----------------------------------------------------------------------------
 def _unary(jfn, name):
     def op(data, out=None, **kw):
-        res = _apply(jfn, [data], name)
+        # fusible: jfn is a module-level pure function, the name alone is
+        # a complete chain-cache key.  The out= path realizes immediately
+        # (res._data is a flush barrier) — in-place targets keep strict
+        # eager rebind semantics.
+        res = _apply(jfn, [data], name, fuse=name)
         if out is not None:
             out._rebind(res._data if isinstance(res, NDArray) else res)
             return out
@@ -216,7 +236,8 @@ isfinite = _unary(jnp.isfinite, "isfinite")
 
 
 def cast(data, dtype, **kw):
-    return _apply(lambda x: x.astype(dtype), [data], "cast")
+    return _apply(lambda x: x.astype(dtype), [data], "cast",
+                  fuse=("cast", jnp.dtype(dtype).name))
 
 
 Cast = cast
@@ -233,14 +254,18 @@ def amp_multicast(*data, num_outputs=None):
 
 
 def BlockGrad(data, **kw):
-    return _apply(lax.stop_gradient, [data], "BlockGrad", nondiff=True)
+    # fusible: lax.stop_gradient inside the composite blocks the
+    # cotangent in the segment's single vjp exactly as not-recording
+    # blocks it eagerly
+    return _apply(lax.stop_gradient, [data], "BlockGrad", nondiff=True,
+                  fuse="BlockGrad")
 
 
 stop_gradient = BlockGrad
 
 
 def identity(data, **kw):
-    return _apply(lambda x: x, [data], "identity")
+    return _apply(lambda x: x, [data], "identity", fuse="identity")
 
 
 def shape_array(data):
@@ -258,7 +283,7 @@ def size_array(data):
 # ----------------------------------------------------------------------------
 def _binary(jfn, name):
     def op(lhs, rhs, out=None, **kw):
-        res = _apply(jfn, [lhs, rhs], name)
+        res = _apply(jfn, [lhs, rhs], name, fuse=name)
         if out is not None:
             out._rebind(res._data)
             return out
@@ -308,7 +333,8 @@ for _nm, _op in [
 
 
 def add_n(*args, **kw):
-    return _apply(lambda *xs: functools.reduce(jnp.add, xs), list(args), "add_n")
+    return _apply(lambda *xs: functools.reduce(jnp.add, xs), list(args),
+                  "add_n", fuse=("add_n", len(args)))
 
 
 ElementWiseSum = add_n
@@ -330,7 +356,10 @@ def _reduce(jfn, name):
             nd_ = data.ndim if hasattr(data, "ndim") else jnp.asarray(data).ndim
             axset = {a % nd_ for a in (ax if isinstance(ax, tuple) else (ax,))}
             ax = tuple(i for i in range(nd_) if i not in axset)
-        return _apply(lambda x: jfn(x, axis=ax, keepdims=keepdims), [data], name)
+        # the "reduce tail" of a fusible chain; resolved axis/keepdims are
+        # the closure's only state, so they complete the key
+        return _apply(lambda x: jfn(x, axis=ax, keepdims=keepdims), [data],
+                      name, fuse=(name, ax, keepdims))
     op.__name__ = name
     return op
 
@@ -349,12 +378,14 @@ min_axis = min
 
 def argmax(data, axis=None, keepdims=False, **kw):
     return _apply(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.float32),
-                  [data], "argmax", nondiff=True)
+                  [data], "argmax", nondiff=True,
+                  fuse=("argmax", axis, keepdims))
 
 
 def argmin(data, axis=None, keepdims=False, **kw):
     return _apply(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32),
-                  [data], "argmin", nondiff=True)
+                  [data], "argmin", nondiff=True,
+                  fuse=("argmin", axis, keepdims))
 
 
 def argmax_channel(data, **kw):
@@ -373,7 +404,7 @@ def norm(data, ord=2, axis=None, keepdims=False, **kw):
             return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
         return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
 
-    return _apply(f, [data], "norm")
+    return _apply(f, [data], "norm", fuse=("norm", ord, ax, keepdims))
 
 
 def cumsum(data, axis=None, dtype=None):
@@ -587,11 +618,16 @@ def slice_like(data, shape_like, axes=None, **kw):
 
 
 def clip(data, a_min, a_max, **kw):
-    return _apply(lambda x: jnp.clip(x, a_min, a_max), [data], "clip")
+    fuse = ("clip", a_min, a_max) \
+        if isinstance(a_min, (int, float)) and isinstance(a_max, (int, float)) \
+        else None
+    return _apply(lambda x: jnp.clip(x, a_min, a_max), [data], "clip",
+                  fuse=fuse)
 
 
 def where(condition, x, y, **kw):
-    return _apply(lambda c, a, b: jnp.where(c != 0, a, b), [condition, x, y], "where")
+    return _apply(lambda c, a, b: jnp.where(c != 0, a, b), [condition, x, y],
+                  "where", fuse="where")
 
 
 # ----------------------------------------------------------------------------
@@ -990,7 +1026,9 @@ def softmax(data, axis=-1, temperature=None, length=None, **kw):
         return jax.nn.softmax(z, axis=axis)
 
     args = [data] + ([length] if length is not None else [])
-    return _apply(f, args, "softmax")
+    fuse = ("softmax", axis, temperature) if length is None and \
+        isinstance(temperature, (int, float, type(None))) else None
+    return _apply(f, args, "softmax", fuse=fuse)
 
 
 def log_softmax(data, axis=-1, temperature=None, **kw):
@@ -998,7 +1036,9 @@ def log_softmax(data, axis=-1, temperature=None, **kw):
         z = x / temperature if temperature else x
         return jax.nn.log_softmax(z, axis=axis)
 
-    return _apply(f, [data], "log_softmax")
+    fuse = ("log_softmax", axis, temperature) \
+        if isinstance(temperature, (int, float, type(None))) else None
+    return _apply(f, [data], "log_softmax", fuse=fuse)
 
 
 def softmin(data, axis=-1, **kw):
@@ -1215,8 +1255,14 @@ def adam_update_core(weight, grad, mean, var, lr, beta1, beta2, epsilon, wd, t,
 def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1,
                out=None, **kw):
     cg = clip_gradient if clip_gradient and clip_gradient > 0 else None
+    # fusible elementwise update (an engine.bulk() around a parameter loop
+    # bulks the whole sweep); all hyper-params ride the key — a schedule
+    # changing lr compiles a fresh chain, same as the reference re-bulking
+    fuse = ("sgd_update", lr, wd, rescale_grad, cg) \
+        if all(isinstance(v, (int, float, type(None)))
+               for v in (lr, wd, rescale_grad, cg)) else None
     res = _apply(lambda w, g: sgd_update_core(w, g, lr, wd, rescale_grad, cg),
-                 [weight, grad], "sgd_update", nondiff=True)
+                 [weight, grad], "sgd_update", nondiff=True, fuse=fuse)
     if out is not None:
         out._rebind(res._data)
         return out
