@@ -174,10 +174,12 @@ def persist_lastgood(rec):
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
             "commit": _git_head(),
             "record": rec}
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"records": records}, f, indent=1)
-        os.replace(tmp, path)
+        # durability-layer atomic write (tmp + fsync + rename, ISSUE 2): a
+        # bench run killed mid-persist can never leave a truncated
+        # BENCH_LASTGOOD.json that poisons the carry logic
+        from tpu_mx.checkpoint import atomic_write
+        with atomic_write(path, "w") as f:
+            f.write(json.dumps({"records": records}, indent=1))
     except Exception as e:
         log(f"persist_lastgood failed (measurement still emitted): "
             f"{type(e).__name__}: {e}")

@@ -1,0 +1,291 @@
+"""Elastic auto-resume under chaos (tpu_mx/elastic.py + checkpoint.py).
+
+The acceptance proof for ISSUE 2 lives here: a save killed mid-write (via
+`crash_after_bytes`) must leave `auto_resume` restoring the last *verified*
+checkpoint — a corrupt or truncated checkpoint is unreachable through the
+elastic path, and `verify_checkpoint` names the torn file explicitly."""
+import logging
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import checkpoint as ckpt, nd
+from tpu_mx.contrib import chaos
+from tpu_mx.gluon import nn
+
+
+def _dense(value):
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    net.weight.set_data(nd.full((3, 4), float(value)))
+    net.bias.set_data(nd.full((3,), 0.0))
+    return net
+
+
+def _weight(net):
+    return float(net.weight.data().asnumpy()[0, 0])
+
+
+# ---------------------------------------------------------------------------
+# the chaos recovery proof (acceptance criteria)
+# ---------------------------------------------------------------------------
+def test_crash_mid_save_auto_resume_recovers_previous_epoch(tmp_path):
+    """Kill the epoch-2 save mid-write: epoch 1 must remain the newest
+    verified checkpoint, and auto_resume restores IT — never the partial
+    epoch-2 state."""
+    prefix = str(tmp_path / "ck")
+    net = _dense(1.0)
+    mx.elastic.save_checkpoint(prefix, 1, net=net)
+    assert ckpt.verify_checkpoint(prefix, 1)[0] == "verified"
+
+    net.weight.set_data(nd.full((3, 4), 2.0))
+    with chaos.enable(crash_after_bytes=100, match=".params") as cfg:
+        with pytest.raises(chaos.ChaosCrash):
+            mx.elastic.save_checkpoint(prefix, 2, net=net)
+    assert cfg.crashes == 1
+    # the crashed save left only tmp debris — no committed epoch-2 file
+    assert not os.path.exists(f"{prefix}-0002.params")
+    assert any(".tmp." in f for f in os.listdir(tmp_path))
+    # epoch 2 is unreachable: latest is the verified epoch 1
+    assert mx.elastic.latest_checkpoint(prefix)[0] == 1
+    net2 = nn.Dense(3, in_units=4)
+    assert mx.elastic.auto_resume(prefix, net=net2) == 2
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), 1.0)
+
+
+def test_torn_write_detected_and_skipped(tmp_path):
+    """A torn write that os.replace COMMITS (short write + clean rename) is
+    the nastier case: the file exists at full path with a manifest — the
+    size/sha check must flag it and the elastic path must skip it."""
+    prefix = str(tmp_path / "ck")
+    net = _dense(1.0)
+    mx.elastic.save_checkpoint(prefix, 1, net=net)
+    net.weight.set_data(nd.full((3, 4), 2.0))
+    with chaos.enable(torn_write=64, match=".params") as cfg:
+        mx.elastic.save_checkpoint(prefix, 2, net=net)  # "succeeds"…
+    assert cfg.tears >= 1
+    status, problems = ckpt.verify_checkpoint(prefix, 2)
+    assert status == "corrupt"
+    assert any("torn" in p for p in problems), problems
+    epoch, path = mx.elastic.latest_checkpoint(prefix)
+    assert epoch == 1 and path.endswith("-0001.params")
+    net2 = nn.Dense(3, in_units=4)
+    assert mx.elastic.auto_resume(prefix, net=net2) == 2
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), 1.0)
+
+
+def test_manifestless_epoch_newer_than_manifested_is_skipped(tmp_path):
+    """A save that dies between the params rename and the manifest commit
+    leaves a VALID-looking manifest-less params file newer than the last
+    manifested epoch.  It must be treated as an interrupted save and
+    skipped — even though it would load — because its states/manifest
+    never committed (the manifest is the commit point)."""
+    prefix = str(tmp_path / "ck")
+    net = _dense(1.0)
+    mx.elastic.save_checkpoint(prefix, 1, net=net)
+    net.weight.set_data(nd.full((3, 4), 2.0))
+    net.save_parameters(f"{prefix}-0002.params")  # params landed, no manifest
+    assert mx.elastic.latest_checkpoint(prefix)[0] == 1
+    net2 = nn.Dense(3, in_units=4)
+    assert mx.elastic.auto_resume(prefix, net=net2) == 2
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), 1.0)
+
+
+def test_auto_resume_raises_on_exhaustion_after_mutation(tmp_path):
+    """If every candidate fails but a failed attempt already wrote into the
+    net, auto_resume must raise — returning 0 ('fresh') over half-restored
+    state would silently train from a partial mix."""
+    from tpu_mx.base import MXNetError
+    prefix = str(tmp_path / "ck")
+    net, trainer = _trained_net_and_trainer(1.0)
+    mx.elastic.save_checkpoint(prefix, 1, net=net, trainer=trainer)
+    # corrupt the ONLY epoch's states so it unpickles but fails to apply
+    # (written durably + re-manifested so screening still says 'verified')
+    with ckpt.atomic_write(f"{prefix}-0001.states") as f:
+        f.write(pickle.dumps({"not": "a trainer payload"}))
+    ckpt.write_manifest(prefix, 1,
+                        [f"{prefix}-0001.params", f"{prefix}-0001.states"])
+    assert ckpt.verify_checkpoint(prefix, 1)[0] == "verified"
+    net2, trainer2 = _trained_net_and_trainer(5.0)
+    with pytest.raises(MXNetError, match="re-initialize"):
+        mx.elastic.auto_resume(prefix, net=net2, trainer=trainer2)
+
+
+def test_truncated_legacy_checkpoint_falls_back_at_load(tmp_path):
+    """The pre-durability failure mode, recreated by hand: a truncated
+    manifest-less .params file is newest on disk.  Screening treats it as
+    an interrupted save (older epochs have manifests) — and auto_resume
+    falls back to the previous good epoch instead of crashing or loading
+    garbage."""
+    prefix = str(tmp_path / "ck")
+    net = _dense(1.0)
+    mx.elastic.save_checkpoint(prefix, 1, net=net)
+    with open(f"{prefix}-0002.params", "wb") as f:
+        f.write(b"PK\x03\x04 this is not a complete npz archive")
+    net2 = nn.Dense(3, in_units=4)
+    assert mx.elastic.auto_resume(prefix, net=net2) == 2
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: ≥5-digit epochs
+# ---------------------------------------------------------------------------
+def test_epoch_regex_accepts_five_plus_digits(tmp_path):
+    prefix = str(tmp_path / "ck")
+    net = _dense(3.0)
+    for epoch in (9999, 10000, 123456):
+        mx.elastic.save_checkpoint(prefix, epoch, net=net)
+    assert os.path.exists(f"{prefix}-123456.params")  # %04d pads, not caps
+    epoch, path = mx.elastic.latest_checkpoint(prefix)
+    assert epoch == 123456 and path.endswith("-123456.params")
+    net2 = nn.Dense(3, in_units=4)
+    assert mx.elastic.auto_resume(prefix, net=net2) == 123457
+
+
+# ---------------------------------------------------------------------------
+# satellite: states validation before committing to an epoch
+# ---------------------------------------------------------------------------
+def _trained_net_and_trainer(value):
+    from tpu_mx import autograd, gluon
+    net = _dense(value)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.ones((2, 4))
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    trainer.step(2)
+    return net, trainer
+
+
+def test_auto_resume_validates_states_before_committing(tmp_path):
+    """Epoch 2 has verified params but its .states file is garbage (written
+    outside the durable path): with a trainer passed, auto_resume must fall
+    back to epoch 1 BEFORE touching net state — no half-restore where the
+    net holds epoch-2 weights and the trainer epoch-1 momenta."""
+    prefix = str(tmp_path / "ck")
+    net, trainer = _trained_net_and_trainer(1.0)
+    mx.elastic.save_checkpoint(prefix, 1, net=net, trainer=trainer)
+    epoch1_w = net.weight.data().asnumpy().copy()
+
+    net.weight.set_data(nd.full((3, 4), 2.0))
+    mx.elastic.save_checkpoint(prefix, 2, net=net)  # params only
+    with open(f"{prefix}-0002.states", "wb") as f:
+        f.write(b"\x80\x04 truncated pickle garbage")
+
+    net2, trainer2 = _trained_net_and_trainer(5.0)
+    start = mx.elastic.auto_resume(prefix, net=net2, trainer=trainer2)
+    assert start == 2  # fell back to epoch 1
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), epoch1_w)
+
+
+def test_auto_resume_falls_back_when_states_fail_to_apply(tmp_path):
+    """An epoch whose .states UNPICKLES but fails to APPLY (format drift:
+    valid pickle, wrong payload shape) must also fall back — the
+    no-half-restore contract covers apply failures, not just unpickling."""
+    prefix = str(tmp_path / "ck")
+    net, trainer = _trained_net_and_trainer(1.0)
+    mx.elastic.save_checkpoint(prefix, 1, net=net, trainer=trainer)
+    epoch1_w = net.weight.data().asnumpy().copy()
+
+    net.weight.set_data(nd.full((3, 4), 2.0))
+    mx.elastic.save_checkpoint(prefix, 2, net=net)
+    with open(f"{prefix}-0002.states", "wb") as f:
+        f.write(pickle.dumps({"not": "a trainer payload"}))  # valid pickle
+
+    net2, trainer2 = _trained_net_and_trainer(5.0)
+    start = mx.elastic.auto_resume(prefix, net=net2, trainer=trainer2)
+    assert start == 2  # fell back to epoch 1, params re-overwritten
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), epoch1_w)
+
+
+def test_auto_resume_with_valid_states_restores_trainer(tmp_path):
+    prefix = str(tmp_path / "ck")
+    net, trainer = _trained_net_and_trainer(1.0)
+    num_update = trainer._optimizer.num_update
+    mx.elastic.save_checkpoint(prefix, 3, net=net, trainer=trainer)
+    assert ckpt.verify_checkpoint(prefix, 3)[0] == "verified"
+    man = ckpt.read_manifest(prefix, 3)
+    assert set(man["files"]) == {"ck-0003.params", "ck-0003.states"}
+
+    net2, trainer2 = _trained_net_and_trainer(9.0)
+    assert mx.elastic.auto_resume(prefix, net=net2, trainer=trainer2) == 4
+    np.testing.assert_allclose(net2.weight.data().asnumpy(),
+                               net.weight.data().asnumpy())
+    assert trainer2._optimizer.num_update == num_update
+
+
+# ---------------------------------------------------------------------------
+# legacy (manifest-less) checkpoints keep loading, with a warning
+# ---------------------------------------------------------------------------
+def test_legacy_manifestless_checkpoint_loads_with_warning(tmp_path, caplog):
+    prefix = str(tmp_path / "ck")
+    net = _dense(4.0)
+    net.save_parameters(f"{prefix}-0005.params")  # bare pre-durability save
+    with caplog.at_level(logging.WARNING, logger="tpu_mx.elastic"):
+        epoch, path = mx.elastic.latest_checkpoint(prefix)
+        net2 = nn.Dense(3, in_units=4)
+        start = mx.elastic.auto_resume(prefix, net=net2)
+    assert (epoch, start) == (5, 6)
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), 4.0)
+    assert any("no manifest" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# durable save: retry/retention integration
+# ---------------------------------------------------------------------------
+def test_save_checkpoint_retries_transient_oserrors(tmp_path, monkeypatch):
+    monkeypatch.setattr(ckpt.time, "sleep", lambda s: None)
+    prefix = str(tmp_path / "ck")
+    net = _dense(6.0)
+    with chaos.enable(transient_oserror=2) as cfg:
+        mx.elastic.save_checkpoint(prefix, 1, net=net)
+    assert cfg.oserrors_fired == 2
+    assert ckpt.verify_checkpoint(prefix, 1)[0] == "verified"
+
+
+def test_save_checkpoint_retention_keeps_k(tmp_path):
+    prefix = str(tmp_path / "ck")
+    net = _dense(1.0)
+    for epoch in (1, 2, 3, 4):
+        mx.elastic.save_checkpoint(prefix, epoch, net=net, keep_last=2)
+    assert ckpt.list_epochs(prefix) == [3, 4]
+    assert mx.elastic.latest_checkpoint(prefix)[0] == 4
+
+
+# ---------------------------------------------------------------------------
+# chaos kill_peer: the barrier failure path without a 2-process run
+# ---------------------------------------------------------------------------
+def test_barrier_kill_peer_chaos_raises_worker_failure():
+    with chaos.enable(kill_peer=True):
+        with pytest.raises(mx.elastic.WorkerFailure, match="resume"):
+            mx.elastic.barrier("chaos-epoch", timeout=5)
+    mx.elastic.barrier("chaos-epoch", timeout=5)  # disarmed: no-op again
+
+
+def test_recovery_loop_pattern_with_kill_peer(tmp_path):
+    """The documented supervisor pattern (docs/robustness.md): barrier
+    raises WorkerFailure -> save what we have -> exit for restart ->
+    restarted run auto_resumes the saved epoch."""
+    prefix = str(tmp_path / "ck")
+    net = _dense(1.0)
+    completed = 0
+    try:
+        for epoch in (1, 2):
+            net.weight.set_data(nd.full((3, 4), float(epoch * 10)))
+            mx.elastic.save_checkpoint(prefix, epoch, net=net)
+            completed = epoch
+            if epoch == 2:
+                with chaos.enable(kill_peer=True):
+                    mx.elastic.barrier("epoch-end", timeout=5)
+    except mx.elastic.WorkerFailure:
+        pass
+    assert completed == 2
+    # "restarted" process:
+    net2 = nn.Dense(3, in_units=4)
+    assert mx.elastic.auto_resume(prefix, net=net2) == 3
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), 20.0)

@@ -57,6 +57,7 @@ from . import runtime
 from . import fusion
 from . import engine
 from . import layout
+from . import checkpoint
 from . import elastic
 from . import operator
 from . import rtc

@@ -5,3 +5,4 @@ from . import quantization
 from . import text
 from . import onnx
 from . import tensorrt
+from . import chaos

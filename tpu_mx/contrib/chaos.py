@@ -1,0 +1,262 @@
+"""Deterministic fault injection for the durability layer (chaos harness).
+
+The elastic-lite `--resume` contract (SURVEY §5.3, docs/robustness.md) is
+only as good as its behavior under the faults it claims to survive:
+preemption mid-write, torn writes that `os.replace` happily commits,
+transient filesystem errors, and dead collective peers.  This module gives
+tests — and manual runs, via the ``TPUMX_CHAOS`` env var — *seedable,
+deterministic* injection points so the recovery path is exercised, not
+asserted.
+
+Injection kinds (all one process, no root, no LD_PRELOAD):
+
+- ``crash_after_bytes=N``: the Nth byte written through a chaos-wrapped
+  file raises :class:`ChaosCrash` (or, with ``hard=1``, calls
+  ``os._exit(137)`` — a true mid-syscall death for subprocess tests).
+  One-shot: disarms after firing so the *recovery* save can succeed.
+- ``torn_write=N``: only the first N bytes reach the file; the tail is
+  silently dropped but reported as written — the classic short-write /
+  power-loss tear that size+sha256 manifest verification must catch.
+- ``slow_io=S``: every write sleeps a seed-deterministic duration in
+  [0, S) seconds (races saves against preemption timers).
+- ``transient_oserror=K``: the next K chaos-checked filesystem operations
+  raise ``OSError`` (exercises ``checkpoint.retry`` backoff).
+- ``kill_peer=1``: ``elastic.barrier`` sees a dead peer and raises
+  ``WorkerFailure`` deterministically, without a real 2-process run.
+- ``match=SUBSTR``: scope file-level faults to paths containing SUBSTR
+  (e.g. ``match=.params`` tears the params file but not the manifest).
+
+Programmatic use (tests)::
+
+    from tpu_mx.contrib import chaos
+    with chaos.enable(crash_after_bytes=100, match=".params", seed=7):
+        net_save_that_should_die()
+
+Env use (manual runs; parsed lazily on the first checkpoint write)::
+
+    TPUMX_CHAOS="torn_write=4096,match=.params,seed=7" python train.py
+
+The reference had no fault-injection tier at all — recovery was assumed
+(docs/DIVERGENCES.md).  Keeping the harness in-tree, next to the code it
+attacks, is the point: every durability claim in ``tpu_mx/checkpoint.py``
+has a chaos test that falsifies the naive implementation.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import random
+import re
+import threading
+import time
+
+__all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
+           "wrap_file", "maybe_oserror", "peer_killed"]
+
+log = logging.getLogger(__name__)
+
+
+class ChaosCrash(Exception):
+    """Simulated process death mid-write (soft mode).
+
+    Deliberately NOT an OSError: ``checkpoint.retry`` must never swallow a
+    crash — a real kill would not be retried either.  ``atomic_write``
+    recognizes it and leaves the partial tmp file on disk, exactly the
+    debris a real crash leaves behind."""
+
+
+class _Config:
+    _KINDS = ("crash_after_bytes", "torn_write", "slow_io",
+              "transient_oserror", "kill_peer", "seed", "hard", "match")
+
+    def __init__(self, crash_after_bytes=None, torn_write=None, slow_io=None,
+                 transient_oserror=0, kill_peer=False, seed=None, hard=False,
+                 match=None):
+        if seed is None:
+            seed = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
+        self.crash_after_bytes = crash_after_bytes
+        self.torn_write = torn_write
+        self.slow_io = slow_io
+        self.transient_oserror = int(transient_oserror)
+        self.kill_peer = bool(kill_peer)
+        self.seed = seed
+        self.hard = bool(hard)
+        self.match = match
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+        # mutable counters (under lock)
+        self.bytes_written = 0       # cumulative across matched writes
+        self.oserrors_left = self.transient_oserror
+        self.crashes = 0             # how many times a fault actually fired
+        self.tears = 0
+        self.oserrors_fired = 0
+
+    def matches(self, path):
+        return self.match is None or (path is not None
+                                      and self.match in str(path))
+
+    def __repr__(self):
+        on = {k: getattr(self, k) for k in self._KINDS
+              if getattr(self, k) not in (None, 0, False)}
+        return f"ChaosConfig({on})"
+
+
+_config = None
+_env_parsed = False
+
+
+def active():
+    """The currently-enabled chaos config, or None (the common case)."""
+    return _config
+
+
+@contextlib.contextmanager
+def enable(**kwargs):
+    """Enable chaos for the dynamic extent of the with-block (tests).
+
+    Nesting replaces the outer config for the inner block.  Yields the
+    config object so tests can assert on fire counters
+    (``cfg.crashes``, ``cfg.tears``, ``cfg.oserrors_fired``)."""
+    global _config
+    prev = _config
+    cfg = _Config(**kwargs)
+    _config = cfg
+    try:
+        yield cfg
+    finally:
+        _config = prev
+
+
+def configure_from_env():
+    """Arm chaos from ``TPUMX_CHAOS`` (comma/space-separated k=v pairs).
+
+    Called lazily by the first durability-layer operation; a programmatic
+    `enable()` always wins over the env, and the env is parsed at most
+    once per process."""
+    global _config, _env_parsed
+    if _env_parsed or _config is not None:
+        return _config
+    _env_parsed = True
+    spec = os.environ.get("TPUMX_CHAOS")
+    if not spec:
+        return None
+    kwargs = {}
+    for part in re.split(r"[,\s]+", spec.strip()):
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        if key not in _Config._KINDS:
+            log.warning("TPUMX_CHAOS: unknown knob %r ignored "
+                        "(known: %s)", key, ", ".join(_Config._KINDS))
+            continue
+        if key == "match":
+            kwargs[key] = val
+        elif key == "slow_io":
+            kwargs[key] = float(val)
+        elif key in ("kill_peer", "hard"):
+            kwargs[key] = val in ("", "1", "true", "yes", "on")
+        else:
+            kwargs[key] = int(val)
+    _config = _Config(**kwargs)
+    log.warning("chaos armed from TPUMX_CHAOS: %r", _config)
+    return _config
+
+
+# ---------------------------------------------------------------------------
+# injection points (called by tpu_mx/checkpoint.py and tpu_mx/elastic.py)
+# ---------------------------------------------------------------------------
+class _ChaosFile:
+    """File proxy that applies byte-level faults to .write().
+
+    Wraps the *real* (innermost) file object: the durability layer's
+    sha256-of-intended-bytes accounting sits above this wrapper, so a torn
+    write records the digest the caller *meant* — which is exactly what
+    lets manifest verification flag the tear."""
+
+    def __init__(self, f, cfg, path):
+        self._f = f
+        self._cfg = cfg
+        self._path = path
+
+    def _partial(self, data, allowed):
+        """First `allowed` BYTES of `data`, in the underlying file's type.
+        Text mode: slice the utf-8 encoding so the fault boundary is a true
+        byte offset even for multi-byte characters (a split character's
+        partial bytes are dropped — the nearest char boundary at-or-before
+        the cut, deterministic for a given payload)."""
+        if isinstance(data, str):
+            return data.encode("utf-8")[:allowed].decode("utf-8", "ignore")
+        return data[:allowed]
+
+    def write(self, data):
+        cfg = self._cfg
+        if isinstance(data, str):
+            nbytes = len(data.encode("utf-8"))
+        else:
+            nbytes = memoryview(data).nbytes
+        with cfg.lock:
+            if cfg.slow_io:
+                time.sleep(cfg.rng.uniform(0.0, float(cfg.slow_io)))
+            start = cfg.bytes_written
+            if (cfg.crash_after_bytes is not None
+                    and start + nbytes >= cfg.crash_after_bytes):
+                allowed = max(0, cfg.crash_after_bytes - start)
+                self._f.write(self._partial(data, allowed))
+                self._f.flush()
+                cfg.bytes_written += allowed
+                cfg.crash_after_bytes = None  # one-shot: recovery may save
+                cfg.crashes += 1
+                if cfg.hard:  # pragma: no cover - exercised via subprocess
+                    os._exit(137)
+                raise ChaosCrash(
+                    f"chaos: simulated crash after {cfg.bytes_written} bytes "
+                    f"into {self._path}")
+            if cfg.torn_write is not None:
+                allowed = max(0, cfg.torn_write - start)
+                if allowed < nbytes:
+                    cfg.tears += 1
+                self._f.write(self._partial(data, allowed))
+                # the caller is told the whole write landed — that is the tear
+                cfg.bytes_written += nbytes
+                return len(data)
+            cfg.bytes_written += nbytes
+        self._f.write(data)
+        return len(data)
+
+    def __getattr__(self, name):  # flush/fileno/close/seek/tell/...
+        return getattr(self._f, name)
+
+
+def wrap_file(f, path=None):
+    """Wrap a writable file object with the active byte-level faults.
+
+    Returns `f` unchanged when chaos is off, no byte-level fault is armed,
+    or `path` does not match the config's ``match`` filter."""
+    cfg = _config
+    if cfg is None or not cfg.matches(path):
+        return f
+    if (cfg.crash_after_bytes is None and cfg.torn_write is None
+            and not cfg.slow_io):
+        return f
+    return _ChaosFile(f, cfg, path)
+
+
+def maybe_oserror(op="io", path=None):
+    """Raise a transient OSError if the fault budget says so (else no-op)."""
+    cfg = _config
+    if cfg is None or not cfg.matches(path):
+        return
+    with cfg.lock:
+        if cfg.oserrors_left > 0:
+            cfg.oserrors_left -= 1
+            cfg.oserrors_fired += 1
+            raise OSError(
+                f"chaos: transient {op} failure on {path or '<fs>'} "
+                f"({cfg.oserrors_left} more armed)")
+
+
+def peer_killed():
+    """True when `kill_peer` chaos is armed (elastic.barrier checks this)."""
+    cfg = _config
+    return cfg is not None and cfg.kill_peer

@@ -1,4 +1,5 @@
-"""Elastic-lite: multi-host failure detection + auto-resume (SURVEY §5.3).
+"""Elastic-lite: multi-host failure detection + durable auto-resume
+(SURVEY §5.3, docs/robustness.md).
 
 The reference's ps-lite tracked worker liveness through the scheduler and
 could re-admit workers.  A TPU SPMD job has no scheduler tier and XLA
@@ -10,9 +11,20 @@ collectives simply hang if a peer dies — so the cheap, robust design is:
    instead of hanging forever in a collective.
 2. **Recovery** = the auto-resume contract: checkpoints carry epoch numbers
    (`prefix-0007.params` ...), `latest_checkpoint(prefix)` finds the newest
-   complete one, and a `--resume` run restarts the whole SPMD job from it.
+   *verified* one, and a `--resume` run restarts the whole SPMD job from it.
    Re-forming the collective group is the launcher's job (just rerun it);
    re-forming *state* is this module's.
+
+"Newest complete" is enforced, not assumed: every epoch written through
+`save_checkpoint` commits a manifest (tpu_mx/checkpoint.py) as its last
+write, and the resume path verifies sizes + sha256 digests before touching
+model state, skipping torn/corrupt epochs and falling back to the next
+good one.  Manifest-less checkpoints (pre-durability writers, bare
+`net.save_parameters`) still load, with a warning.  `preemption_handler`
+(re-exported from tpu_mx.checkpoint) turns SIGTERM into one emergency
+durable save.  The whole path is chaos-tested: tpu_mx/contrib/chaos.py
+injects mid-save crashes, torn writes and dead peers deterministically
+(tests/test_elastic.py).
 
 The barrier runs `multihost_utils.sync_global_devices` on a daemon thread
 and joins with a timeout — a hung collective (dead peer) leaves a parked
@@ -22,13 +34,21 @@ can exit for the supervisor to restart.
 from __future__ import annotations
 
 import glob
+import logging
 import os
+import pickle
 import re
 import threading
 
 from .base import MXNetError
+from . import checkpoint as _ckpt
+from .checkpoint import preemption_handler  # noqa: F401  (re-export)
 
-__all__ = ["WorkerFailure", "barrier", "latest_checkpoint", "auto_resume"]
+__all__ = ["WorkerFailure", "barrier", "latest_checkpoint",
+           "candidate_checkpoints", "auto_resume", "save_checkpoint",
+           "preemption_handler"]
+
+log = logging.getLogger(__name__)
 
 
 class WorkerFailure(MXNetError):
@@ -41,7 +61,15 @@ def barrier(tag="tpumx_elastic", timeout=60.0):
 
     Call between epochs (cheap: one tiny collective) so a dead rank turns
     into a clean, fast failure instead of an indefinite hang in the next
-    psum."""
+    psum.  The `kill_peer` chaos knob (contrib.chaos) makes this raise
+    deterministically so recovery loops are testable single-process."""
+    from .contrib import chaos
+    chaos.configure_from_env()
+    if chaos.peer_killed():
+        raise WorkerFailure(
+            f"barrier '{tag}': chaos kill_peer armed — simulating a dead "
+            "peer. Restart the job with --resume to continue from the last "
+            "checkpoint.")
     import jax
     if jax.process_count() <= 1:
         return
@@ -70,39 +98,176 @@ def barrier(tag="tpumx_elastic", timeout=60.0):
         raise WorkerFailure(f"barrier '{tag}' failed: {err[0]}")
 
 
-_EPOCH_RE = re.compile(r"-(\d{4})\.params(\.npz)?$")
+# ≥5-digit epochs are legal: the reference's %04d format *pads to* four
+# digits, it does not cap at four (a 4h-step-checkpointing run passes
+# epoch 10000 in under a month)
+_EPOCH_RE = re.compile(r"-(\d{4,})\.params(\.npz)?$")
 
 
-def latest_checkpoint(prefix):
-    """Newest `(epoch, params_path)` under the reference's checkpoint naming
-    (`prefix-0007.params[.npz]`), or (None, None) if none exist."""
-    best = (None, None)
+def _screened_checkpoints(prefix):
+    """Yield `(epoch, params_path, status)` newest-first, integrity-screened
+    (status is 'verified' or 'legacy' — corrupt epochs are skipped).
+
+    Epochs whose manifest fails verification (torn/missing/corrupt files)
+    are skipped with a warning naming the damage.  Manifest-less epochs are
+    *legacy* (pre-durability writers) — accepted with a warning — UNLESS
+    the prefix has manifested epochs and this one is newer than the newest
+    of them: then it is almost certainly a save that died between the data
+    rename and the manifest commit, and trusting it would resurrect exactly
+    the torn-resume failure the manifest exists to prevent, so it is
+    skipped.  In-flight `*.tmp.<pid>` debris from a crashed save never
+    matches."""
+    found = {}
     for path in glob.glob(f"{prefix}-*.params*"):
         m = _EPOCH_RE.search(path)
         if m:
-            epoch = int(m.group(1))
-            if best[0] is None or epoch > best[0]:
-                best = (epoch, path)
-    return best
+            found.setdefault(int(m.group(1)), path)
+    manifested = {e for e in found
+                  if os.path.exists(_ckpt.manifest_path(prefix, e))}
+    newest_manifested = max(manifested) if manifested else None
+    for epoch in sorted(found, reverse=True):
+        status, problems = _ckpt.verify_checkpoint(prefix, epoch)
+        if status == "verified":
+            yield epoch, found[epoch], status
+        elif status == "legacy":
+            if newest_manifested is not None and epoch > newest_manifested:
+                log.warning(
+                    "checkpoint epoch %d of %s has no manifest although "
+                    "older epochs of this prefix do: treating it as a save "
+                    "interrupted before its manifest commit — skipping",
+                    epoch, prefix)
+                continue
+            log.warning(
+                "checkpoint epoch %d of %s has no manifest (legacy "
+                "writer or pre-durability save): accepting unverified",
+                epoch, prefix)
+            yield epoch, found[epoch], status
+        else:
+            log.warning("skipping corrupt checkpoint epoch %d of %s: %s",
+                        epoch, prefix, "; ".join(problems))
+
+
+def candidate_checkpoints(prefix):
+    """Yield `(epoch, params_path)` newest-first, integrity-screened
+    (see `_screened_checkpoints` for the screening rules)."""
+    for epoch, params, _status in _screened_checkpoints(prefix):
+        yield epoch, params
+
+
+def latest_checkpoint(prefix):
+    """Newest *verified* `(epoch, params_path)` under the reference's
+    checkpoint naming (`prefix-0007.params[.npz]`), or (None, None) if no
+    loadable epoch exists.  Corrupt epochs (failed manifest verification)
+    are skipped in favor of the next-newest good one."""
+    for epoch, params in candidate_checkpoints(prefix):
+        return epoch, params
+    return (None, None)
+
+
+def _states_loadable(states_path):
+    """Full unpickle of a trainer/module .states file WITHOUT applying it —
+    the pre-commit validation that prevents a half-restore (params loaded,
+    then states blow up)."""
+    with open(states_path, "rb") as f:
+        pickle.load(f)
 
 
 def auto_resume(prefix, net=None, module=None, trainer=None):
-    """Restore the newest checkpoint for a Gluon net (or Module) + optional
-    Trainer states; returns the epoch to resume FROM (0 if fresh).
+    """Restore the newest *loadable* checkpoint for a Gluon net (or Module)
+    + optional Trainer states; returns the epoch to resume FROM (0 if
+    fresh).
 
     The `--resume` contract (SURVEY §5.3): a restarted job calls this before
-    the train loop and starts at the returned epoch."""
-    epoch, params = latest_checkpoint(prefix)
-    if epoch is None:
-        return 0
+    the train loop and starts at the returned epoch.  Robustness contract
+    (ISSUE 2): an epoch is committed to only after (a) its manifest
+    verifies — `_screened_checkpoints` — and (b) its `.states` file, when a
+    trainer is passed, actually unpickles (pre-checked for *legacy* epochs;
+    verified epochs' bytes are already sha256-proven, so the extra read is
+    skipped); any failure falls back to the next-newest epoch instead of
+    half-restoring or crashing.  If every candidate fails AFTER some
+    attempt already mutated net/module/trainer state, an MXNetError is
+    raised — returning 0 ('train fresh') over silently half-restored state
+    would be the exact corruption this module exists to prevent."""
+    mutated = False
+    for epoch, params, status in _screened_checkpoints(prefix):
+        states = f"{prefix}-{epoch:04d}.states"
+        have_states = os.path.exists(states)
+        if trainer is not None and have_states and status == "legacy":
+            try:
+                _states_loadable(states)
+            except Exception as e:
+                log.warning(
+                    "epoch %d: %s exists but does not unpickle (%s: %s) — "
+                    "falling back a checkpoint instead of half-restoring",
+                    epoch, states, type(e).__name__, e)
+                continue
+        try:
+            if net is not None:
+                net.load_parameters(params)
+                mutated = True
+            if module is not None:
+                sym, arg, aux = __import__("tpu_mx").model.load_checkpoint(
+                    prefix, epoch)
+                module.set_params(arg, aux)
+                mutated = True
+        except Exception as e:
+            log.warning("epoch %d: params failed to load (%s: %s) — "
+                        "falling back a checkpoint", epoch,
+                        type(e).__name__, e)
+            continue
+        if trainer is not None and have_states:
+            try:
+                trainer.load_states(states)
+            except Exception as e:
+                # unpickled fine but failed to APPLY (format drift, wrong
+                # optimizer/param set): fall back — the next iteration's
+                # param load overwrites the partial restore
+                log.warning(
+                    "epoch %d: %s unpickled but failed to apply "
+                    "(%s: %s) — falling back a checkpoint", epoch, states,
+                    type(e).__name__, e)
+                continue
+        return epoch + 1
+    if mutated:
+        raise MXNetError(
+            f"auto_resume({prefix!r}): every candidate epoch failed, and a "
+            "failed attempt already modified net/module/trainer state — "
+            "re-initialize before training fresh (state is a partial mix, "
+            "not epoch-0)")
+    return 0
+
+
+def save_checkpoint(prefix, epoch, net=None, trainer=None, keep_last=None,
+                    attempts=4):
+    """Durable counterpart of `auto_resume`: write the epoch's params (and
+    trainer states) atomically, commit the manifest LAST, then apply
+    retention.
+
+    Every write is atomic (tmp+fsync+rename) and wrapped in
+    `checkpoint.retry` against transient filesystem errors; the manifest is
+    the commit point, so a crash anywhere mid-save leaves the previous
+    epoch as the newest *verified* checkpoint.  `keep_last=K` prunes older
+    epochs (never the newest verified one).  Returns the params path.
+
+    Module users: `module.save_checkpoint(prefix, epoch)` commits its own
+    manifest through `model.save_checkpoint` — this helper is the Gluon
+    (net/trainer) flow, and the natural `save_fn` for
+    `preemption_handler`."""
+    if net is None and trainer is None:
+        raise MXNetError("save_checkpoint: pass net= and/or trainer=")
+    files = []
+    params = f"{prefix}-{epoch:04d}.params"
     if net is not None:
-        net.load_parameters(params)
-    if module is not None:
-        sym, arg, aux = __import__("tpu_mx").model.load_checkpoint(
-            prefix, epoch)
-        module.set_params(arg, aux)
+        _ckpt.retry(lambda: net.save_parameters(params), attempts=attempts)
+        files.append(params)
     if trainer is not None:
         states = f"{prefix}-{epoch:04d}.states"
-        if os.path.exists(states):
-            trainer.load_states(states)
-    return epoch + 1
+        _ckpt.retry(lambda: trainer.save_states(states), attempts=attempts)
+        files.append(states)
+    _ckpt.retry(lambda: _ckpt.write_manifest(prefix, epoch, files),
+                attempts=attempts)
+    if keep_last:
+        # the epoch just committed is verified by construction — skip the
+        # full from-disk re-hash the newest-verified scan would otherwise do
+        _ckpt.apply_retention(prefix, keep_last, known_verified=epoch)
+    return params

@@ -516,11 +516,13 @@ class HybridBlock(Block):
         exported = jexport.export(jax.jit(infer_fn))(
             param_avals, jax.ShapeDtypeStruct(key0.shape, key0.dtype),
             *in_avals)
-        with open(f"{path}-{epoch:04d}.stablehlo", "wb") as f:
+        from ..checkpoint import atomic_write, write_manifest
+        hlo_path = f"{path}-{epoch:04d}.stablehlo"
+        with atomic_write(hlo_path) as f:
             f.write(exported.serialize())
 
-        with open(f"{path}-symbol.json", "w") as f:
-            json.dump({
+        with atomic_write(f"{path}-symbol.json", "w") as f:
+            f.write(json.dumps({
                 "format": "tpu_mx-stablehlo-v1",
                 "name": self.name,
                 "params": sorted(payload),
@@ -528,7 +530,15 @@ class HybridBlock(Block):
                             "dtype": _np.dtype(a.dtype).name}
                            for a in in_avals],
                 "artifact": f"{path.split('/')[-1]}-{epoch:04d}.stablehlo",
-            }, f)
+            }))
+        # export is a checkpoint too: commit a manifest over the per-epoch
+        # artifacts so a torn export can't be mistaken for a loadable
+        # model.  {path}-symbol.json is deliberately NOT listed: it is
+        # rewritten by every export with an epoch-dependent "artifact"
+        # pointer, so digesting it would mark every OLDER epoch corrupt
+        # the moment a newer one is exported
+        write_manifest(path, epoch, [f"{path}-{epoch:04d}.params.npz",
+                                     hlo_path])
 
     def optimize_for(self, *args, **kwargs):
         self.hybridize(True)

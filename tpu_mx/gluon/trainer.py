@@ -161,8 +161,9 @@ class Trainer:
             "num_update": self._optimizer.num_update,
             "index_update_count": self._optimizer._index_update_count,
         }
-        with open(fname, "wb") as f:
-            pickle.dump(payload, f)
+        from ..checkpoint import atomic_write
+        with atomic_write(fname) as f:
+            f.write(pickle.dumps(payload))
 
     def load_states(self, fname):
         import pickle

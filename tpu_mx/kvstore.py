@@ -81,6 +81,7 @@ class KVStore:
         reduce, matching the reference's worker→server message compression."""
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
+            self._check_inited(k)
             vlist = v if isinstance(v, list) else [v]
             agg = vlist[0]
             for extra in vlist[1:]:
@@ -125,9 +126,18 @@ class KVStore:
         gathered = multihost_utils.process_allgather(agg._data)  # (W, ...)
         return NDArray(jnp.asarray(gathered).sum(axis=0).astype(agg.dtype))
 
+    def _check_inited(self, key):
+        """Reference contract (REF:src/kvstore/kvstore_local.h CHECK on
+        init): push/pull on a key nobody init'ed is a usage error — raise
+        the framework's error type with the fix, not a bare KeyError."""
+        if key not in self._store:
+            raise MXNetError(
+                f"key {key!r} not initialized; call kv.init first")
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
+            self._check_inited(k)
             pending = self._store.pop(f"_pending_{k}", None)
             src = self._store[k] if pending is None else pending
             if self._updater is None and pending is not None:
@@ -160,14 +170,33 @@ class KVStore:
 
     # -- persistence (reference: save/load optimizer states on rank 0) --------
     def save_optimizer_states(self, fname, dump_optimizer=False):
-        with open(fname, "wb") as f:
-            pickle.dump(self._updater.get_states() if self._updater else {}, f)
+        """Atomic dump of the updater's per-key states; with
+        `dump_optimizer=True` the optimizer OBJECT rides along too
+        (reference parity: the PS server pickled both, so a restore on a
+        fresh process needs no set_optimizer call first)."""
+        states = self._updater.get_states() if self._updater else {}
+        if dump_optimizer:
+            payload = {"__tpumx_format__": "kvstore-states-v2",
+                       "states": states, "optimizer": self._optimizer}
+        else:
+            payload = states
+        from .checkpoint import atomic_write
+        with atomic_write(fname) as f:
+            f.write(pickle.dumps(payload))
 
     def load_optimizer_states(self, fname):
         with open(fname, "rb") as f:
-            states = pickle.load(f)
+            payload = pickle.load(f)
+        if isinstance(payload, dict) and \
+                payload.get("__tpumx_format__") == "kvstore-states-v2":
+            if payload["optimizer"] is not None:
+                self._optimizer = payload["optimizer"]
+                self._updater = Updater(self._optimizer)
+            if self._updater:
+                self._updater.set_states(payload["states"])
+            return
         if self._updater:
-            self._updater.set_states(states)
+            self._updater.set_states(payload)
 
     def barrier(self):
         if self._is_dist:

@@ -434,7 +434,12 @@ class Module(BaseModule):
         arg, aux = self.get_params()
         save_checkpoint(prefix, epoch, self._symbol, arg, aux)
         if save_optimizer_states:
-            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+            from ..checkpoint import update_manifest
+            states = f"{prefix}-{epoch:04d}.states"
+            self.save_optimizer_states(states)
+            # fold the states file into the epoch's already-committed
+            # manifest so verification covers the full restore set
+            update_manifest(prefix, epoch, [states])
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -448,11 +453,12 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         import pickle
+        from ..checkpoint import atomic_write
         states = {n: jax.tree.map(
             lambda x: np.asarray(x) if hasattr(x, "dtype") else x, s)
             for n, s in self._updater_states.items()}
-        with open(fname, "wb") as f:
-            pickle.dump(states, f)
+        with atomic_write(fname) as f:
+            f.write(pickle.dumps(states))
 
     def load_optimizer_states(self, fname):
         import pickle

@@ -555,10 +555,17 @@ def save(fname, data):
         meta = "dict"
     else:
         raise TypeError("save: need NDArray, list or dict of NDArray")
-    # write through a file object so the exact filename is kept (np.savez
-    # appends .npz to bare paths, breaking `<prefix>-NNNN.params` parity)
-    with open(fname, "wb") as f:
-        np.savez(f, __layout__=np.array(meta), **payload)
+    # serialize to memory first, then one atomic_write: (a) np.savez on a
+    # bare path appends .npz, breaking `<prefix>-NNNN.params` parity; (b) a
+    # single linear write keeps the durability layer's intended-bytes
+    # digest exact (zipfile seeks would invalidate it); (c) a crash mid-save
+    # can then never leave a truncated destination (docs/robustness.md)
+    import io as _io
+    from ..checkpoint import atomic_write
+    bio = _io.BytesIO()
+    np.savez(bio, __layout__=np.array(meta), **payload)
+    with atomic_write(fname) as f:
+        f.write(bio.getbuffer())
 
 
 def load(fname):

@@ -682,34 +682,111 @@ class CompiledTrainStep:
         returns), so training continues — and may donate/overwrite the live
         buffers — while tensorstore commits in the background.  Call
         `wait_for_checkpoint()` (or any later save/load, which waits
-        internally) before reading the files."""
-        import os
+        internally) before reading the files.
+
+        Durability (docs/robustness.md): after the orbax commit completes, a
+        `<path>.commit.json` marker is written atomically NEXT TO the
+        checkpoint directory (never inside it — orbax owns that layout).
+        The marker is the verified-commit point: a preemption between
+        tensorstore's partial writes and the marker leaves a directory that
+        `load_checkpoint` treats as suspect, not as the newest state.  For
+        async saves the marker lands in `wait_for_checkpoint()`."""
         state = dict(self.state_dict())
         state.pop("efs", None)  # per-device; see _abstract_state
         state["t"] = jnp.asarray(state["t"], jnp.int32)
         ck = self._checkpointer
-        ck.save(os.path.abspath(str(path)), state, force=True)
+        if getattr(self, "_pending_commit", None) is not None:
+            # an earlier async save is still marker-less: finish and stamp
+            # it before its slot is overwritten, or a fully-committed
+            # checkpoint would stay permanently unverified
+            ck.wait_until_finished()
+            self._write_commit_marker()
+        ap = os.path.abspath(str(path))
+        ck.save(ap, state, force=True)
+        self._pending_commit = (ap, int(self._t))  # t of the SAVED state
         if block:
             ck.wait_until_finished()
+            self._write_commit_marker()
+
+    @staticmethod
+    def commit_marker_path(path):
+        return os.path.abspath(str(path)) + ".commit.json"
+
+    def _write_commit_marker(self):
+        """Stamp the verified-commit marker for the save that just finished
+        (multi-host: every host replace()s the same content onto a shared
+        filesystem — idempotent and atomic either way)."""
+        import json
+        import time
+        pending = getattr(self, "_pending_commit", None)
+        if pending is None:
+            return
+        self._pending_commit = None
+        p, saved_t = pending
+        from ..checkpoint import atomic_write
+        with atomic_write(self.commit_marker_path(p), "w") as f:
+            f.write(json.dumps({"format": "tpu_mx-orbax-commit-v1",
+                                "path": os.path.basename(p),
+                                "t": saved_t,
+                                "wall_time": time.time()}))
 
     def wait_for_checkpoint(self):
-        """Block until any in-flight async save has committed to disk."""
+        """Block until any in-flight async save has committed to disk, then
+        stamp its verified-commit marker."""
         if getattr(self, "_ckpt", None) is not None:
             self._ckpt.wait_until_finished()
+        self._write_commit_marker()
 
-    def load_checkpoint(self, path):
+    def load_checkpoint(self, path, fallback_paths=()):
         """Restore a sharded checkpoint onto THIS step's mesh — the saved
         mesh/layout may differ (dp=2×tp=2 → dp=4 etc.); every host reads
-        only the shards its devices need."""
-        import os
+        only the shards its devices need.
+
+        Robustness: a path without its `.commit.json` marker (interrupted
+        save) is skipped when `fallback_paths` remain — pass older
+        checkpoints newest-first to get elastic-style fall-back.  A
+        marker-less path is still *attempted* as legacy (with a warning)
+        when it is the last resort; restore errors also advance to the next
+        fallback.  Raises MXNetError when no candidate restores."""
+        from ..base import MXNetError
         ck = self._checkpointer
         ck.wait_until_finished()  # an async save may still be committing
-        state = ck.restore(os.path.abspath(str(path)), self._abstract_state())
-        self.values = state["values"]
-        self.masters = state.get("masters", {})
-        self.opt_states = state["opt_states"]
-        self._t = int(state["t"])
-        self._reset_accumulation()
+        self._write_commit_marker()
+        logger = logging.getLogger(__name__)
+        candidates = [os.path.abspath(str(p))
+                      for p in (path, *tuple(fallback_paths))]
+        errors = []
+        for i, ap in enumerate(candidates):
+            last_resort = i == len(candidates) - 1
+            if not os.path.exists(ap):
+                errors.append(f"{ap}: does not exist")
+                continue
+            if not os.path.exists(self.commit_marker_path(ap)):
+                if not last_resort:
+                    logger.warning(
+                        "checkpoint %s has no commit marker (interrupted "
+                        "or pre-durability save): falling back", ap)
+                    errors.append(f"{ap}: no commit marker")
+                    continue
+                logger.warning(
+                    "checkpoint %s has no commit marker: attempting "
+                    "unverified restore (legacy/last resort)", ap)
+            try:
+                state = ck.restore(ap, self._abstract_state())
+            except Exception as e:
+                logger.warning("checkpoint %s failed to restore (%s: %s)%s",
+                               ap, type(e).__name__, e,
+                               "" if last_resort else " — falling back")
+                errors.append(f"{ap}: {type(e).__name__}: {e}")
+                continue
+            self.values = state["values"]
+            self.masters = state.get("masters", {})
+            self.opt_states = state["opt_states"]
+            self._t = int(state["t"])
+            self._reset_accumulation()
+            return ap
+        raise MXNetError("load_checkpoint: no restorable checkpoint among "
+                         + "; ".join(errors))
 
 
 def fsdp_rules(params, axis="dp", min_size=1024, axis_size=None):
