@@ -527,3 +527,23 @@ def _timed(chain, x, n, bulk_size):
         else:
             chain(x).wait_to_read()
     return time.perf_counter() - t0
+
+
+def test_scalar_spelling_does_not_collide_in_chain_cache():
+    """clip(x, 0, 1) and clip(x, 0.0, 1.0) compare equal as Python values
+    but bake DIFFERENT trace constants (int vs weak-float promotion) —
+    the chain cache must key them apart, or the float-spelled call
+    replays the int program and returns the wrong dtype vs eager."""
+    xi = nd.array(np.arange(-2, 3, dtype=np.int32))
+    with engine.bulk(4):
+        a = nd.clip(xi, 0, 1)
+        a.wait_to_read()
+    with engine.bulk(4):
+        b = nd.clip(xi, 0.0, 1.0)
+        b.wait_to_read()
+    eager_int = nd.clip(xi, 0, 1)      # no bulk scope: plain eager
+    eager_float = nd.clip(xi, 0.0, 1.0)
+    assert a.dtype == eager_int.dtype, (a.dtype, eager_int.dtype)
+    assert b.dtype == eager_float.dtype, (b.dtype, eager_float.dtype)
+    np.testing.assert_array_equal(a.asnumpy(), eager_int.asnumpy())
+    np.testing.assert_array_equal(b.asnumpy(), eager_float.asnumpy())

@@ -1,0 +1,120 @@
+"""mx.monitor coverage (previously untested; ISSUE 3 satellite): forward
+hooks collect per-layer stats, interval gating, pattern filtering, and —
+the one that bites — uninstall actually detaching every hook."""
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd
+from tpu_mx.gluon import nn
+from tpu_mx.monitor import Monitor
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="relu"),
+            nn.Dense(2, in_units=8))
+    net.initialize()
+    net(nd.ones((1, 4)))
+    return net
+
+
+def test_monitor_collects_layer_stats():
+    net = _net()
+    mon = Monitor(interval=1).install(net)
+    mon.tic()
+    net(nd.ones((2, 4)))
+    res = mon.toc()
+    assert res, "forward hooks must have recorded outputs"
+    names = [name for _step, name, _stat in res]
+    # children walk by registration key: hybridsequential.0 / .1
+    assert {"hybridsequential.0", "hybridsequential.1"} <= set(names)
+    assert any(n == "hybridsequential" for n in names)  # root included
+    for step, _name, stat in res:
+        assert step == 1  # tic() advances the batch count after arming
+        assert isinstance(stat, float) and stat >= 0  # default: mean |x|
+
+
+def test_monitor_default_stat_is_mean_abs():
+    net = _net()
+    mon = Monitor(interval=1, pattern="hybridsequential$").install(net)
+    mon.tic()
+    out = net(nd.ones((2, 4)))
+    res = mon.toc()
+    assert len(res) == 1
+    assert res[0][2] == pytest.approx(
+        float(np.abs(out.asnumpy()).mean()), rel=1e-6)
+
+
+def test_monitor_interval_gating():
+    net = _net()
+    mon = Monitor(interval=2).install(net)
+    seen = []
+    for _ in range(4):
+        mon.tic()
+        net(nd.ones((2, 4)))
+        seen.append(bool(mon.toc()))
+    assert seen == [True, False, True, False]
+
+
+def test_monitor_pattern_filters_layers():
+    net = _net()
+    mon = Monitor(interval=1, pattern=r".*\.0$").install(net)
+    mon.tic()
+    net(nd.ones((2, 4)))
+    names = {name for _s, name, _v in mon.toc()}
+    assert names and all(n.endswith(".0") for n in names)
+
+
+def test_monitor_sort_orders_by_name():
+    net = _net()
+    mon = Monitor(interval=1, sort=True).install(net)
+    mon.tic()
+    net(nd.ones((2, 4)))
+    names = [name for _s, name, _v in mon.toc()]
+    assert names == sorted(names)
+
+
+def test_monitor_custom_stat_func():
+    net = _net()
+    mon = Monitor(interval=1, stat_func=lambda a: float(a.max()),
+                  pattern="hybridsequential$").install(net)
+    mon.tic()
+    out = net(nd.ones((2, 4)))
+    res = mon.toc()
+    assert res[0][2] == pytest.approx(float(out.asnumpy().max()), rel=1e-6)
+
+
+def test_monitor_uninstall_detaches_every_hook():
+    net = _net()
+    mon = Monitor(interval=1).install(net)
+    mon.tic()
+    net(nd.ones((2, 4)))
+    assert mon.toc()
+    mon.uninstall()
+    assert mon._handles == []
+    # no block keeps a live hook behind uninstall's back
+    def hooks_of(block):
+        yield from block.__dict__.get("_fwd_hooks", ())
+        for child in block._children.values():
+            yield from hooks_of(child)
+    assert not list(hooks_of(net))
+    mon.tic()
+    net(nd.ones((2, 4)))
+    assert mon.toc() == [], "detached monitor must record nothing"
+
+
+def test_monitor_toc_without_tic_is_empty():
+    net = _net()
+    mon = Monitor(interval=1).install(net)
+    assert mon.toc() == []
+
+
+def test_toc_print_smoke(capsys):
+    net = _net()
+    mon = Monitor(interval=1, pattern="hybridsequential$").install(net)
+    mon.tic()
+    net(nd.ones((2, 4)))
+    mon.toc_print()
+    out = capsys.readouterr().out
+    assert "hybridsequential" in out and "Batch" in out

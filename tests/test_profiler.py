@@ -1,0 +1,162 @@
+"""mx.profiler coverage (previously untested; ISSUE 3 satellite): scope
+aggregate math, pause/resume gating, dump round-trip + atomicity, and the
+telemetry span merge point."""
+import json
+import os
+
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import profiler
+
+
+@pytest.fixture(autouse=True)
+def _fresh_profiler():
+    """Profiler state is process-global — isolate every test."""
+    def clear():
+        with profiler._lock:
+            profiler._agg.clear()
+            profiler._events.clear()
+        profiler._state["running"] = False
+        profiler._state["paused"] = False
+        profiler._state["jax_trace"] = False
+    clear()
+    yield
+    clear()
+    profiler._state["filename"] = "profile.json"
+
+
+def _run(paused=False):
+    profiler._state["running"] = True
+    profiler._state["paused"] = paused
+
+
+def test_scope_aggregate_math():
+    """dumps() reproduces the reference aggregate table: calls, total,
+    mean, min, max — deterministic via direct interval recording."""
+    profiler._record_scope("train", 0.0, 0.1)
+    profiler._record_scope("train", 1.0, 1.3)
+    profiler._record_scope("io", 0.0, 0.05)
+    out = profiler.dumps()
+    lines = {ln.split()[0]: ln.split() for ln in out.splitlines()[1:]}
+    name, calls, total, mean, mn, mx_ = lines["train"]
+    assert int(calls) == 2
+    assert float(total) == pytest.approx(400.0)
+    assert float(mean) == pytest.approx(200.0)
+    assert float(mn) == pytest.approx(100.0)
+    assert float(mx_) == pytest.approx(300.0)
+    assert int(lines["io"][1]) == 1
+    # rows sort by descending total time
+    assert out.splitlines()[1].startswith("train")
+
+
+def test_dumps_reset_clears_aggregates():
+    profiler._record_scope("uniq_scope", 0.0, 0.1)
+    assert "uniq_scope" in profiler.dumps(reset=True)
+    assert "uniq_scope" not in profiler.dumps()
+
+
+def test_pause_resume_gates_recording():
+    _run()
+    with profiler.scope("a"):
+        pass
+    profiler.pause()
+    with profiler.scope("b"):
+        pass
+    profiler.resume()
+    with profiler.scope("c"):
+        pass
+    names = {e["name"] for e in profiler._events}
+    assert names == {"a", "c"}, "paused interval must not record"
+
+
+def test_task_event_counter_marker_emit():
+    _run()
+    t = profiler.Task("work")
+    t.start()
+    t.stop()
+    c = profiler.Counter("items")
+    c.increment(5)
+    c.decrement(2)
+    profiler.Marker("hit").mark("global")
+    by_name = {}
+    for e in profiler._events:
+        by_name.setdefault(e["name"], []).append(e)
+    assert by_name["work"][0]["ph"] == "X"
+    assert by_name["items"][-1]["args"]["items"] == 3
+    assert by_name["hit"][0]["ph"] == "i"
+    assert by_name["hit"][0]["s"] == "g"
+
+
+def test_record_span_merges_only_while_recording():
+    profiler.record_span("tele", 0.0, 0.5)
+    assert not profiler._events
+    _run()
+    profiler.record_span("tele", 0.0, 0.5)
+    assert profiler._events[0]["name"] == "tele"
+    assert profiler._events[0]["cat"] == "telemetry"
+    assert "tele" in profiler.dumps()
+
+
+def test_set_state_dump_roundtrip(tmp_path, monkeypatch):
+    """run -> record -> stop writes chrome-trace JSON to the configured
+    filename (the jax device trace is stubbed out — host events are what
+    this asserts)."""
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda *a, **k: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname)
+    assert profiler._state["trace_dir"] == str(tmp_path / "profile_xla_trace")
+    profiler.set_state("run")
+    with profiler.scope("step"):
+        pass
+    profiler.set_state("stop")
+    with open(fname) as f:
+        trace = json.load(f)
+    assert trace["displayTimeUnit"] == "ms"
+    evs = [e for e in trace["traceEvents"] if e["name"] == "step"]
+    assert evs and evs[0]["ph"] == "X" and evs[0]["dur"] >= 0
+    with pytest.raises(ValueError):
+        profiler.set_state("bogus")
+
+
+def test_dump_is_atomic_under_mid_write_crash(tmp_path):
+    """Satellite: profiler.dump rides checkpoint.atomic_write — a crash
+    mid-dump leaves the previous complete profile.json, never a
+    truncated one."""
+    from tpu_mx.contrib import chaos
+    from tpu_mx.contrib.chaos import ChaosCrash
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname)
+    _run()
+    with profiler.scope("first"):
+        pass
+    profiler._state["running"] = False
+    profiler.dump()
+    before = open(fname).read()
+    json.loads(before)
+    _run()
+    with profiler.scope("second"):
+        pass
+    profiler._state["running"] = False
+    with chaos.enable(crash_after_bytes=10, match="profile.json", seed=3):
+        with pytest.raises(ChaosCrash):
+            profiler.dump()
+    assert open(fname).read() == before, \
+        "crashed dump must leave the previous complete file untouched"
+    assert any(".tmp." in p.name for p in tmp_path.iterdir()), \
+        "a simulated crash leaves tmp debris (like a real kill)"
+
+
+def test_set_state_run_clears_previous_session(monkeypatch):
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda *a, **k: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    profiler._record_scope("stale", 0.0, 1.0)
+    profiler.set_state("run")
+    try:
+        assert not profiler._events and not profiler._agg
+    finally:
+        profiler._state["running"] = False
+        profiler._state["jax_trace"] = False

@@ -1,0 +1,425 @@
+"""Unified runtime telemetry (ISSUE 3): registry semantics, the three
+exporters (JSONL / Prometheus exposition / profiler chrome-trace merge),
+and the instrumented hot paths — fusion, checkpoint, elastic, kvstore,
+train step, chaos, Speedometer."""
+import json
+import os
+import re
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import nd, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Every test starts from an empty registry (it is process-global)."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+def test_counter_and_gauge_basics():
+    c = telemetry.counter("fusion.flushes")
+    c.inc()
+    c.inc(4)
+    assert telemetry.counter("fusion.flushes") is c  # create-or-fetch
+    assert c.value == 5
+    g = telemetry.gauge("train_step.examples_per_sec")
+    g.set(123.5)
+    g.set(99)
+    assert telemetry.gauge("train_step.examples_per_sec").value == 99.0
+
+
+def test_labels_make_distinct_series():
+    telemetry.counter("chaos.injections", kind="crash").inc()
+    telemetry.counter("chaos.injections", kind="torn_write").inc(2)
+    assert telemetry.counter("chaos.injections", kind="crash").value == 1
+    assert telemetry.counter("chaos.injections",
+                             kind="torn_write").value == 2
+    # get() never creates
+    assert telemetry.get("chaos.injections", kind="oserror") is None
+
+
+def test_kind_conflict_raises():
+    telemetry.counter("fusion.flushes")
+    with pytest.raises(TypeError, match="already registered"):
+        telemetry.gauge("fusion.flushes")
+
+
+def test_histogram_buckets_minmax_and_monotonicity():
+    h = telemetry.histogram("checkpoint.save_seconds")
+    for v in (0.0005, 0.002, 0.002, 5.0, 100.0):  # 100s -> +Inf overflow
+        h.observe(v)
+    assert h.count == 5
+    assert h.min == 0.0005 and h.max == 100.0
+    assert abs(h.sum - 105.0045) < 1e-9
+    cum = h.cumulative()
+    counts = [c for _, c in cum]
+    assert counts == sorted(counts), "cumulative counts must be monotone"
+    assert cum[-1][0] == "+Inf" and cum[-1][1] == 5
+    # fixed log-scale ladder: bucket edges are the documented constant
+    assert h.buckets == telemetry.LATENCY_BUCKETS
+
+
+def test_snapshot_records_are_schema_valid():
+    telemetry.counter("fusion.flushes").inc()
+    telemetry.gauge("speedometer.samples_per_sec").set(10.0)
+    telemetry.histogram("train_step.seconds").observe(0.01)
+    telemetry.counter("chaos.injections", kind="crash").inc()
+    recs = telemetry.snapshot()
+    assert len(recs) == 4
+    for rec in recs:
+        telemetry.validate_record(rec)
+        json.dumps(rec)  # JSONL-serializable
+    ts = {rec["ts"] for rec in recs}
+    assert len(ts) == 1, "one snapshot shares one timestamp"
+
+
+def test_validate_record_rejects_bad_records():
+    with pytest.raises(ValueError, match="missing name"):
+        telemetry.validate_record({"type": "counter", "value": 1, "ts": 1.0})
+    with pytest.raises(ValueError, match="bad type"):
+        telemetry.validate_record(
+            {"name": "x", "type": "timer", "value": 1, "ts": 1.0})
+    with pytest.raises(ValueError, match="numeric 'value'|missing numeric"):
+        telemetry.validate_record(
+            {"name": "x", "type": "counter", "value": "many", "ts": 1.0})
+    base = {"name": "h", "type": "histogram", "value": 3, "ts": 1.0,
+            "sum": 1.0}
+    with pytest.raises(ValueError, match="not monotone"):
+        telemetry.validate_record(
+            dict(base, buckets=[[0.1, 2], [0.3, 1], ["+Inf", 3]]))
+    with pytest.raises(ValueError, match=r"\+Inf"):
+        telemetry.validate_record(
+            dict(base, buckets=[[0.1, 2], [0.3, 3]]))
+    with pytest.raises(ValueError, match="!= value"):
+        telemetry.validate_record(
+            dict(base, buckets=[[0.1, 2], ["+Inf", 2]]))
+
+
+# ---------------------------------------------------------------------------
+# JSONL exporter
+# ---------------------------------------------------------------------------
+def test_jsonl_flush_appends_and_final_is_complete(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    telemetry.counter("fusion.flushes").inc()
+    telemetry.histogram("checkpoint.save_seconds").observe(0.002)
+    assert telemetry.flush(path=path) is not None
+    telemetry.counter("fusion.flushes").inc()
+    telemetry.flush(path=path)
+    lines = [ln for ln in open(path).read().splitlines() if ln]
+    assert len(lines) == 4  # two snapshots x two metrics, appended
+    # final snapshot: the whole history is rewritten atomically
+    telemetry.flush(path=path, final=True)
+    lines = [ln for ln in open(path).read().splitlines() if ln]
+    assert len(lines) == 6
+    for ln in lines:
+        telemetry.validate_record(json.loads(ln))
+    # the two counter snapshots carry the cumulative values 1 then 2
+    vals = [json.loads(ln)["value"] for ln in lines
+            if json.loads(ln)["name"] == "fusion.flushes"]
+    assert vals == [1, 2, 2]
+    assert not list(tmp_path.glob("*.tmp.*")), "atomic rewrite left debris"
+
+
+def test_snapshot_consistent_under_concurrent_observes():
+    """A snapshot taken while another thread observes must still satisfy
+    the schema's +Inf-count == value invariant (records are built under
+    the registry lock, never from torn reads)."""
+    h = telemetry.histogram("train_step.seconds")
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            h.observe(0.001)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    try:
+        for _ in range(400):
+            for rec in telemetry.snapshot():
+                telemetry.validate_record(rec)
+            telemetry.exposition()
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_atexit_hook_does_not_duplicate_explicit_final_flush(tmp_path,
+                                                             monkeypatch):
+    path = str(tmp_path / "m.jsonl")
+    monkeypatch.setenv("TPUMX_TELEMETRY", path)
+    telemetry.counter("fusion.flushes").inc()
+    telemetry.flush(final=True)
+    before = open(path).read()
+    telemetry._flush_at_exit()  # what interpreter shutdown would run
+    assert open(path).read() == before, \
+        "atexit must not append a second final snapshot"
+
+
+def test_flush_without_sink_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPUMX_TELEMETRY", raising=False)
+    telemetry.counter("fusion.flushes").inc()
+    assert telemetry.flush() is None
+    assert telemetry.configured_path() is None
+    monkeypatch.setenv("TPUMX_TELEMETRY", str(tmp_path / "m.jsonl"))
+    assert telemetry.configured_path() == str(tmp_path / "m.jsonl")
+    assert telemetry.flush() is not None
+    assert (tmp_path / "m.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+_PROM_SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+="[^"]*"'
+    r'(,[a-zA-Z0-9_]+="[^"]*")*\})? [-+0-9.eE]+(inf)?$')
+
+
+def test_exposition_parses_as_prometheus_text():
+    telemetry.counter("fusion.flushes").inc(7)
+    telemetry.gauge("train_step.examples_per_sec").set(1234.5)
+    telemetry.histogram("train_step.seconds").observe(0.02)
+    telemetry.counter("chaos.injections", kind="torn_write").inc()
+    text = telemetry.exposition()
+    assert text.endswith("\n")
+    families = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            families[name] = kind
+        else:
+            assert _PROM_SAMPLE.match(line), f"unparseable line: {line!r}"
+    assert families["tpumx_fusion_flushes_total"] == "counter"
+    assert families["tpumx_train_step_examples_per_sec"] == "gauge"
+    assert families["tpumx_train_step_seconds"] == "histogram"
+    # histogram family completeness + cumulative bucket monotonicity
+    buckets = [int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+               if ln.startswith("tpumx_train_step_seconds_bucket")]
+    assert buckets == sorted(buckets) and buckets[-1] == 1
+    assert 'le="+Inf"' in text
+    assert "tpumx_train_step_seconds_sum" in text
+    assert "tpumx_train_step_seconds_count 1" in text
+    assert 'tpumx_chaos_injections_total{kind="torn_write"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# spans + profiler merge
+# ---------------------------------------------------------------------------
+def test_span_observes_histogram_and_merges_into_profiler():
+    from tpu_mx import profiler
+    with profiler._lock:
+        profiler._events.clear()
+        profiler._agg.clear()
+    profiler._state["running"], profiler._state["paused"] = True, False
+    try:
+        with telemetry.span("checkpoint.save_seconds"):
+            pass
+        h = telemetry.get("checkpoint.save_seconds")
+        assert h is not None and h.count == 1
+        names = [(e["name"], e.get("cat")) for e in profiler._events]
+        assert ("checkpoint.save_seconds", "telemetry") in names
+    finally:
+        profiler._state["running"] = False
+        with profiler._lock:
+            profiler._events.clear()
+            profiler._agg.clear()
+
+
+def test_span_without_profiler_running_still_counts():
+    with telemetry.span("checkpoint.save_seconds"):
+        pass
+    assert telemetry.get("checkpoint.save_seconds").count == 1
+
+
+# ---------------------------------------------------------------------------
+# instrumented paths
+# ---------------------------------------------------------------------------
+def test_fusion_flush_counters_and_cache_stats():
+    from tpu_mx import engine, fusion
+    x = nd.array(np.ones((4, 4), np.float32))
+    for _ in range(3):
+        with engine.bulk(16):
+            y = nd.tanh(x * 1.5 + 0.5)
+            y.wait_to_read()
+    assert telemetry.counter("fusion.flushes").value == 3
+    assert telemetry.counter("fusion.ops_fused").value == 9
+    causes = [m for m in telemetry.snapshot()
+              if m["name"] == "fusion.flush_cause"]
+    assert sum(m["value"] for m in causes) == 3
+    assert all("cause" in m["labels"] for m in causes)
+    seg = telemetry.get("fusion.segment_ops")
+    assert seg.count == 3 and seg.min == 3 and seg.max == 3
+    assert seg.unit == "ops"
+    # the jit program cache may be warm from earlier tests in this
+    # process; hits + misses must still account for every flush
+    cs = fusion.cache_stats()
+    assert cs["hits"] + cs["misses"] == 3
+    assert cs["segments_flushed"] == 3
+    assert cs["programs"] >= 1
+    assert cs["hits"] == telemetry.counter("fusion.cache_hits").value
+    assert cs["misses"] == telemetry.counter("fusion.cache_misses").value
+
+
+def test_fusion_eager_fallback_counter():
+    from tpu_mx import engine
+    x = nd.array(np.ones((4, 4), np.float32))
+    with engine.bulk(16):
+        # np.float32 is an np.generic, not a bakeable python scalar —
+        # the fusion engine must fall back to eager dispatch and count it
+        y = x * np.float32(2.0)
+        y.wait_to_read()
+    assert telemetry.counter("fusion.eager_fallbacks").value >= 1
+    np.testing.assert_allclose(y.asnumpy(), 2.0)
+
+
+def test_checkpoint_atomic_write_and_retry_counters(tmp_path):
+    from tpu_mx import checkpoint
+    with checkpoint.atomic_write(str(tmp_path / "a.bin")) as f:
+        f.write(b"payload")
+    assert telemetry.counter("checkpoint.atomic_writes").value == 1
+    h = telemetry.get("checkpoint.write_seconds")
+    assert h is not None and h.count == 1 and h.sum > 0
+
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert checkpoint.retry(flaky, attempts=4, backoff=0.001,
+                            max_backoff=0.002, seed=0) == "ok"
+    assert telemetry.counter("checkpoint.retries").value == 2
+
+
+def test_checkpoint_corrupt_detection_counter(tmp_path):
+    from tpu_mx import checkpoint
+    prefix = str(tmp_path / "ck")
+    data = f"{prefix}-0000.params"
+    with checkpoint.atomic_write(data) as f:
+        f.write(b"x" * 64)
+    checkpoint.write_manifest(prefix, 0, [data])
+    assert checkpoint.verify_checkpoint(prefix, 0)[0] == "verified"
+    assert telemetry.get("checkpoint.corrupt_detected") is None
+    os.remove(data)
+    status, problems = checkpoint.verify_checkpoint(prefix, 0)
+    assert status == "corrupt" and problems
+    assert telemetry.counter("checkpoint.corrupt_detected").value == 1
+    assert telemetry.get("checkpoint.verify_seconds").count == 2
+
+
+def test_elastic_resume_and_corrupt_skip_counters(tmp_path):
+    from tpu_mx import elastic
+    from tpu_mx.gluon import nn
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    net(nd.ones((1, 2)))
+    prefix = str(tmp_path / "run")
+    elastic.save_checkpoint(prefix, 1, net=net)
+    elastic.save_checkpoint(prefix, 2, net=net)
+    # corrupt the newest epoch's params behind the manifest's back
+    with open(f"{prefix}-0002.params", "wb") as f:
+        f.write(b"garbage")
+    epoch, params = elastic.latest_checkpoint(prefix)
+    assert epoch == 1
+    assert telemetry.counter("elastic.epochs_skipped_corrupt").value >= 1
+    assert elastic.auto_resume(prefix, net=net) == 2  # resumes FROM 1 -> 2
+    assert telemetry.counter("elastic.resume_attempts").value >= 1
+    assert telemetry.get("checkpoint.save_seconds").count == 2
+
+
+def test_kvstore_push_pull_counters():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((4, 4)))
+    grads = [nd.array(np.ones((4, 4), np.float32)),
+             nd.array(np.ones((4, 4), np.float32))]
+    kv.push("w", grads)
+    out = nd.zeros((4, 4))
+    kv.pull("w", out=out)
+    assert telemetry.counter("kvstore.pushes").value == 1
+    assert telemetry.counter("kvstore.pulls").value == 1
+    # 4x4 float32 = 64 bytes; push saw a 2-element device list
+    assert telemetry.counter("kvstore.push_bytes").value == 128
+    assert telemetry.counter("kvstore.pull_bytes").value == 64
+
+
+def test_chaos_injection_counter_under_env(tmp_path, monkeypatch):
+    """Chaos faults fired under TPUMX_CHAOS are tagged by kind in the
+    registry — chaos runs can assert observability of faults, not just
+    survival."""
+    from tpu_mx import checkpoint
+    from tpu_mx.contrib import chaos
+    monkeypatch.setattr(chaos, "_config", None)
+    monkeypatch.setattr(chaos, "_env_parsed", False)
+    monkeypatch.setenv("TPUMX_CHAOS", "torn_write=4,match=.chaosdat")
+    target = str(tmp_path / "file.chaosdat")
+    with checkpoint.atomic_write(target) as f:
+        f.write(b"z" * 100)  # tail silently dropped: the tear
+    assert os.path.getsize(target) == 4
+    assert telemetry.counter("chaos.injections",
+                             kind="torn_write").value >= 1
+    monkeypatch.setattr(chaos, "_config", None)
+    monkeypatch.setattr(chaos, "_env_parsed", False)
+
+
+def test_speedometer_publishes_gauge():
+    from tpu_mx import callback
+    sp = callback.Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    p = types.SimpleNamespace(epoch=0, nbatch=2, eval_metric=None)
+    sp(p)                    # arms the timer
+    p = types.SimpleNamespace(epoch=0, nbatch=4, eval_metric=None)
+    sp(p)                    # hits count % frequent == 0 -> publishes
+    g = telemetry.get("speedometer.samples_per_sec")
+    assert g is not None and g.value > 0
+
+
+def test_train_step_counters_and_examples_gauge():
+    from tpu_mx import gluon
+    from tpu_mx.gluon import nn
+    from tpu_mx.parallel import CompiledTrainStep
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 4)))
+    X = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+    Y = (X.sum(1) > 2).astype(np.float32)
+    step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mx.optimizer.create("sgd", learning_rate=0.1))
+    for _ in range(3):
+        step.step(nd.array(X), nd.array(Y))
+    assert telemetry.counter("train_step.recompiles").value == 1
+    assert telemetry.counter("train_step.steps").value == 3
+    assert telemetry.get("train_step.seconds").count == 3
+    assert telemetry.gauge("train_step.examples_per_sec").value > 0
+
+
+def test_known_metrics_catalog_covers_instrumentation():
+    """Every name the instrumented tree emits must be in the stable
+    catalog — this is the same contract tools/ci.py's obs tier enforces
+    on a real run's JSONL."""
+    emitted = {
+        "fusion.flushes", "fusion.flush_cause", "fusion.segment_ops",
+        "fusion.ops_fused", "fusion.segments_dead", "fusion.cache_hits",
+        "fusion.cache_misses", "fusion.eager_fallbacks",
+        "checkpoint.save_seconds", "checkpoint.write_seconds",
+        "checkpoint.verify_seconds",
+        "checkpoint.atomic_writes", "checkpoint.retries",
+        "checkpoint.corrupt_detected", "elastic.resume_attempts",
+        "elastic.epochs_skipped_corrupt", "elastic.legacy_fallbacks",
+        "train_step.seconds",
+        "train_step.steps", "train_step.recompiles",
+        "train_step.examples_per_sec", "kvstore.pushes", "kvstore.pulls",
+        "kvstore.push_bytes", "kvstore.pull_bytes", "chaos.injections",
+        "speedometer.samples_per_sec",
+    }
+    assert emitted <= telemetry.KNOWN_METRICS

@@ -9,12 +9,16 @@ slowest tier stays visible.
 Tiers: core (`-m "not slow"`, <5 min), slow (virtual-mesh parallelism,
 full-model layout trains, op-audit sweep, native C++ tier), the example
 smokes, chaos (the fault-injection durability tests re-run under a fixed
-TPUMX_CHAOS_SEED, docs/robustness.md), then native-asan — an
+TPUMX_CHAOS_SEED, docs/robustness.md), native-asan — an
 AddressSanitizer build+run of
 `native/tpumx_io_test.cpp`, the one multithreaded-shared-state code the
 project owns (threads + shared queues; the reference ran ASAN CI,
-SURVEY §5.2 / VERDICT r5 missing#6).  `--core-only` runs just the first
-for a quick gate.
+SURVEY §5.2 / VERDICT r5 missing#6) — then obs: a tiny instrumented
+train loop run with TPUMX_TELEMETRY set, whose emitted JSONL must
+validate against the telemetry schema AND the stable metric-name catalog
+(tools/telemetry_report.py --validate; docs/observability.md — an
+accidental metric rename fails this tier).  `--core-only` runs just the
+first for a quick gate.
 """
 from __future__ import annotations
 
@@ -79,6 +83,88 @@ def native_asan():
     return 0
 
 
+# The obs tier's workload: every instrumented subsystem the acceptance
+# criteria name must emit — the compiled train step (recompiles + step
+# latency), the fusion engine (flushes), and the durable checkpoint path
+# (save latency histogram).  Runs on the CPU backend like the test suite.
+OBS_SCRIPT = """
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tpu_mx as mx
+from tpu_mx import nd, engine, elastic, gluon, telemetry
+from tpu_mx.gluon import nn
+from tpu_mx.parallel import CompiledTrainStep
+
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+net.initialize()
+net(nd.ones((1, 4)))
+X = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+Y = (X.sum(1) > 2).astype(np.float32)
+step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         mx.optimizer.create("sgd", learning_rate=0.1))
+for _ in range(4):
+    step.step(nd.array(X), nd.array(Y))
+step.sync_to_net()
+telemetry.flush()  # mid-run append-mode snapshot
+
+x = nd.array(np.ones((8, 8), np.float32))
+for _ in range(3):
+    with engine.bulk(8):
+        nd.tanh(x * 1.5 + 0.5).wait_to_read()
+
+prefix = os.path.join(os.path.dirname(os.environ["TPUMX_TELEMETRY"]), "ck")
+elastic.save_checkpoint(prefix, 0, net=net)
+assert elastic.latest_checkpoint(prefix)[0] == 0
+telemetry.flush(final=True)  # atomic final snapshot
+"""
+
+OBS_REQUIRED = ("fusion.flushes", "checkpoint.save_seconds",
+                "train_step.recompiles", "train_step.steps")
+
+
+def obs_tier():
+    """Run the instrumented train loop with TPUMX_TELEMETRY set, then
+    validate the emitted JSONL (schema + metric-name catalog + required
+    nonzero metrics).  Returns a process-style rc (0 = green)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as d:
+        jsonl = os.path.join(d, "telemetry.jsonl")
+        env = dict(os.environ, TPUMX_TELEMETRY=jsonl, JAX_PLATFORMS="cpu")
+        env.pop("TPUMX_CHAOS", None)  # a chaos-armed env would tear the run
+        # TPUMX_FUSION=0 would force the bulk() blocks eager and zero the
+        # required fusion.flushes (same scrub bench.py's fusion leg does)
+        env.pop("TPUMX_FUSION", None)
+        try:
+            run = subprocess.run([sys.executable, "-c", OBS_SCRIPT],
+                                 env=env, cwd=repo, capture_output=True,
+                                 text=True, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            print(f"  obs: train loop timed out: {e}")
+            return 1
+        if run.returncode != 0:
+            print(f"  obs: train loop failed (rc={run.returncode}):\n"
+                  f"{((run.stdout or '') + (run.stderr or ''))[-3000:]}")
+            return run.returncode or 1
+        try:
+            val = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "telemetry_report.py"),
+                 jsonl, "--validate", "--require", ",".join(OBS_REQUIRED)],
+                capture_output=True, text=True, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  obs: telemetry validation timed out: {e}")
+            return 1
+        out = (val.stdout or "") + (val.stderr or "")
+        if val.returncode != 0:
+            print(f"  obs: telemetry validation failed "
+                  f"(rc={val.returncode}):\n{out[-3000:]}")
+            return val.returncode or 1
+    return 0
+
+
 def main():
     import argparse
     ap = argparse.ArgumentParser()
@@ -99,6 +185,8 @@ def main():
     if not opts.core_only:
         t0 = time.time()
         results.append(("native-asan", native_asan(), time.time() - t0))
+        t0 = time.time()
+        results.append(("obs", obs_tier(), time.time() - t0))
     print()
     red = False
     for name, rc, dt in results:

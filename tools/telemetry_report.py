@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Render a TPUMX_TELEMETRY JSONL file as a human-readable report.
+
+Histogram series are rendered in the same per-scope aggregate table format
+``mx.profiler.dumps()`` uses (Name / Calls / Total / Mean / Min / Max, in
+ms), followed by counter and gauge sections.  Because each flush appends a
+CUMULATIVE snapshot, the report aggregates by taking the LAST record of
+every (name, labels) series.
+
+Modes (the ``obs`` tier of tools/ci.py runs both):
+
+    python tools/telemetry_report.py metrics.jsonl
+    python tools/telemetry_report.py metrics.jsonl --validate \
+        --require fusion.flushes,checkpoint.save_seconds
+
+``--validate`` checks every record against the telemetry schema
+(name/type/value/ts present; histogram bucket monotonicity) and fails on
+metric names outside ``telemetry.KNOWN_METRICS`` — stable metric names are
+an API, and this is the gate that catches accidental renames.
+``--require`` additionally fails unless each listed metric exists with a
+nonzero value (counter > 0 / histogram count > 0 / gauge != 0).
+
+The telemetry module is loaded standalone from its file — this tool never
+imports the ``tpu_mx`` package (which would boot jax) just to read JSON.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+
+def load_telemetry():
+    """Load tpu_mx/telemetry.py WITHOUT importing the tpu_mx package
+    (telemetry.py is stdlib-only at module level by contract)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tpu_mx", "telemetry.py")
+    spec = importlib.util.spec_from_file_location("_tpumx_telemetry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def read_series(path, telemetry, validate=False):
+    """Parse the JSONL file into {(name, labels_json): last_record}.
+
+    Returns (series, n_snapshots, errors).  With validate=True, schema
+    violations and unknown metric names land in `errors` instead of being
+    silently passed through."""
+    series = {}
+    stamps = set()
+    errors = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errors.append(f"line {lineno}: not JSON: {e}")
+                continue
+            if validate:
+                try:
+                    telemetry.validate_record(rec)
+                except ValueError as e:
+                    errors.append(f"line {lineno}: {e}")
+                    continue
+                if rec["name"] not in telemetry.KNOWN_METRICS:
+                    errors.append(
+                        f"line {lineno}: unknown metric name "
+                        f"{rec['name']!r} — not in telemetry.KNOWN_METRICS "
+                        "(stable names are an API; register new metrics in "
+                        "the catalog + docs/observability.md)")
+                    continue
+            key = (rec.get("name"),
+                   json.dumps(rec.get("labels", {}), sort_keys=True))
+            series[key] = rec
+            if "ts" in rec:
+                stamps.add(rec["ts"])
+    return series, len(stamps), errors
+
+
+def _series_label(name, labels_json):
+    labels = json.loads(labels_json)
+    if not labels:
+        return name
+    body = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{body}}}"
+
+
+def render(series, n_snapshots, path):
+    """The report string: histogram table (profiler.dumps format) +
+    counter/gauge sections."""
+    hists = {k: r for k, r in series.items() if r["type"] == "histogram"}
+    counters = {k: r for k, r in series.items() if r["type"] == "counter"}
+    gauges = {k: r for k, r in series.items() if r["type"] == "gauge"}
+    lines = [f"Telemetry report: {path}",
+             f"  {n_snapshots} snapshot(s), {len(series)} series", ""]
+
+    def table(entries, scale, suffix):
+        lines.append("%-40s %8s %12s %12s %12s %12s" %
+                     ("Name", "Calls", f"Total{suffix}", f"Mean{suffix}",
+                      f"Min{suffix}", f"Max{suffix}"))
+        for (name, lj), rec in entries:
+            n = rec["value"]
+            tot = rec.get("sum", 0.0)
+            if n:
+                lines.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f" % (
+                    _series_label(name, lj), n, tot * scale,
+                    tot / n * scale, rec.get("min", 0.0) * scale,
+                    rec.get("max", 0.0) * scale))
+            else:
+                lines.append("%-40s %8d %12.3f %12s %12s %12s" % (
+                    _series_label(name, lj), 0, 0.0, "-", "-", "-"))
+        lines.append("")
+
+    # seconds-unit histograms render in the profiler.dumps() ms table;
+    # count-valued ones (e.g. fusion.segment_ops) keep their own unit
+    timed = sorted((k, r) for k, r in hists.items()
+                   if r.get("unit", "seconds") == "seconds")
+    other = sorted((k, r) for k, r in hists.items()
+                   if r.get("unit", "seconds") != "seconds")
+    if timed:
+        table(timed, 1e3, "(ms)")
+    for (name, lj), rec in other:
+        table([((name, lj), rec)], 1.0, f"({rec.get('unit', '')})")
+    if counters:
+        lines.append("Counters:")
+        for (name, lj), rec in sorted(counters.items()):
+            lines.append("  %-50s %s" % (_series_label(name, lj),
+                                         rec["value"]))
+        lines.append("")
+    if gauges:
+        lines.append("Gauges:")
+        for (name, lj), rec in sorted(gauges.items()):
+            lines.append("  %-50s %g" % (_series_label(name, lj),
+                                         rec["value"]))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def check_required(series, required):
+    """Names in `required` must exist with a nonzero value; returns the
+    list of violation strings (empty = good)."""
+    problems = []
+    by_name = {}
+    for (name, _lj), rec in series.items():
+        prev = by_name.get(name)
+        if prev is None or rec["value"] > prev["value"]:
+            by_name[name] = rec
+    for name in required:
+        rec = by_name.get(name)
+        if rec is None:
+            problems.append(f"required metric {name!r} never emitted")
+        elif not rec["value"]:
+            kind = rec["type"]
+            what = "count" if kind == "histogram" else "value"
+            problems.append(f"required metric {name!r} has zero {what}")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("file", help="TPUMX_TELEMETRY JSONL file")
+    ap.add_argument("--validate", action="store_true",
+                    help="fail on schema violations or unknown metric names")
+    ap.add_argument("--require", default="",
+                    help="comma-separated metric names that must be present "
+                         "and nonzero")
+    opts = ap.parse_args(argv)
+    telemetry = load_telemetry()
+    series, n_snapshots, errors = read_series(opts.file, telemetry,
+                                              validate=opts.validate)
+    print(render(series, n_snapshots, opts.file))
+    required = [n for n in opts.require.split(",") if n]
+    errors += check_required(series, required)
+    if not series and not errors:
+        errors.append("file contains no telemetry records")
+    if errors:
+        print("VALIDATION FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    if opts.validate:
+        print(f"schema OK: {len(series)} series, all names in the catalog")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

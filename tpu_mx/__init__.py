@@ -51,6 +51,7 @@ from . import recordio
 from . import io
 from . import image
 from . import test_utils
+from . import telemetry
 from . import profiler
 from . import monitor
 from . import runtime

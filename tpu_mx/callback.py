@@ -30,6 +30,10 @@ class Speedometer:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / \
                     (time.time() - self.tic)
+                # module-API training publishes its throughput without any
+                # new user code (satellite of the telemetry layer)
+                from . import telemetry
+                telemetry.gauge("speedometer.samples_per_sec").set(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

@@ -51,6 +51,7 @@ import threading
 import time
 
 from .base import MXNetError
+from . import telemetry as _telemetry
 
 __all__ = ["atomic_write", "retry", "sha256_file", "manifest_path",
            "write_manifest", "update_manifest", "read_manifest",
@@ -149,6 +150,7 @@ def atomic_write(path, mode="wb", fsync=True):
     """
     if mode not in ("wb", "w"):
         raise ValueError(f"atomic_write: mode must be 'wb' or 'w', got {mode}")
+    t_start = time.perf_counter()
     chaos = _chaos()
     path = os.fspath(path)
     ap = os.path.abspath(path)
@@ -176,6 +178,11 @@ def atomic_write(path, mode="wb", fsync=True):
             _intended[ap] = info
             while len(_intended) > _INTENDED_MAX:
                 _intended.popitem(last=False)
+        _telemetry.counter("checkpoint.atomic_writes").inc()
+        # per-FILE commit latency; whole-checkpoint save latency is the
+        # checkpoint.save_seconds span at the save call sites
+        _telemetry.histogram("checkpoint.write_seconds").observe(
+            time.perf_counter() - t_start)
     except BaseException as e:
         try:
             raw.close()
@@ -222,6 +229,7 @@ def retry(fn, attempts=4, backoff=0.05, max_backoff=2.0, jitter=0.5,
         except exceptions as e:
             if attempt >= attempts:
                 raise
+            _telemetry.counter("checkpoint.retries").inc()
             sleep = delay * (1.0 + float(jitter) * rng.random())
             log.warning("retry %d/%d: %s: %s (backing off %.3fs)",
                         attempt, attempts, type(e).__name__, e, sleep)
@@ -341,6 +349,16 @@ def verify_checkpoint(prefix, epoch):
       torn (size mismatch) / content-corrupt (digest mismatch); each
       problem string names the offending file and the failure mode.
     """
+    t_start = time.perf_counter()
+    status, problems = _verify_checkpoint(prefix, epoch)
+    _telemetry.histogram("checkpoint.verify_seconds").observe(
+        time.perf_counter() - t_start)
+    if status == "corrupt":
+        _telemetry.counter("checkpoint.corrupt_detected").inc()
+    return status, problems
+
+
+def _verify_checkpoint(prefix, epoch):
     mp = manifest_path(prefix, epoch)
     if not os.path.exists(mp):
         legacy = [p for p in glob.glob(f"{prefix}-{int(epoch):04d}.*")

@@ -51,8 +51,17 @@ import re
 import threading
 import time
 
+from .. import telemetry as _telemetry
+
 __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
            "wrap_file", "maybe_oserror", "peer_killed"]
+
+
+def _count_injection(kind):
+    """Every fault actually FIRED lands in the telemetry registry tagged by
+    kind — chaos tests assert the *observability* of faults, not just
+    survival (ISSUE 3)."""
+    _telemetry.counter("chaos.injections", kind=kind).inc()
 
 log = logging.getLogger(__name__)
 
@@ -197,6 +206,7 @@ class _ChaosFile:
             nbytes = memoryview(data).nbytes
         with cfg.lock:
             if cfg.slow_io:
+                _count_injection("slow_io")
                 time.sleep(cfg.rng.uniform(0.0, float(cfg.slow_io)))
             start = cfg.bytes_written
             if (cfg.crash_after_bytes is not None
@@ -207,6 +217,7 @@ class _ChaosFile:
                 cfg.bytes_written += allowed
                 cfg.crash_after_bytes = None  # one-shot: recovery may save
                 cfg.crashes += 1
+                _count_injection("crash")
                 if cfg.hard:  # pragma: no cover - exercised via subprocess
                     os._exit(137)
                 raise ChaosCrash(
@@ -216,6 +227,7 @@ class _ChaosFile:
                 allowed = max(0, cfg.torn_write - start)
                 if allowed < nbytes:
                     cfg.tears += 1
+                    _count_injection("torn_write")
                 self._f.write(self._partial(data, allowed))
                 # the caller is told the whole write landed — that is the tear
                 cfg.bytes_written += nbytes
@@ -251,6 +263,7 @@ def maybe_oserror(op="io", path=None):
         if cfg.oserrors_left > 0:
             cfg.oserrors_left -= 1
             cfg.oserrors_fired += 1
+            _count_injection("transient_oserror")
             raise OSError(
                 f"chaos: transient {op} failure on {path or '<fs>'} "
                 f"({cfg.oserrors_left} more armed)")
@@ -259,4 +272,7 @@ def maybe_oserror(op="io", path=None):
 def peer_killed():
     """True when `kill_peer` chaos is armed (elastic.barrier checks this)."""
     cfg = _config
-    return cfg is not None and cfg.kill_peer
+    if cfg is not None and cfg.kill_peer:
+        _count_injection("kill_peer")
+        return True
+    return False

@@ -42,6 +42,7 @@ import threading
 
 from .base import MXNetError
 from . import checkpoint as _ckpt
+from . import telemetry as _telemetry
 from .checkpoint import preemption_handler  # noqa: F401  (re-export)
 
 __all__ = ["WorkerFailure", "barrier", "latest_checkpoint",
@@ -131,18 +132,21 @@ def _screened_checkpoints(prefix):
             yield epoch, found[epoch], status
         elif status == "legacy":
             if newest_manifested is not None and epoch > newest_manifested:
+                _telemetry.counter("elastic.epochs_skipped_corrupt").inc()
                 log.warning(
                     "checkpoint epoch %d of %s has no manifest although "
                     "older epochs of this prefix do: treating it as a save "
                     "interrupted before its manifest commit — skipping",
                     epoch, prefix)
                 continue
+            _telemetry.counter("elastic.legacy_fallbacks").inc()
             log.warning(
                 "checkpoint epoch %d of %s has no manifest (legacy "
                 "writer or pre-durability save): accepting unverified",
                 epoch, prefix)
             yield epoch, found[epoch], status
         else:
+            _telemetry.counter("elastic.epochs_skipped_corrupt").inc()
             log.warning("skipping corrupt checkpoint epoch %d of %s: %s",
                         epoch, prefix, "; ".join(problems))
 
@@ -190,6 +194,7 @@ def auto_resume(prefix, net=None, module=None, trainer=None):
     would be the exact corruption this module exists to prevent."""
     mutated = False
     for epoch, params, status in _screened_checkpoints(prefix):
+        _telemetry.counter("elastic.resume_attempts").inc()
         states = f"{prefix}-{epoch:04d}.states"
         have_states = os.path.exists(states)
         if trainer is not None and have_states and status == "legacy":
@@ -255,19 +260,23 @@ def save_checkpoint(prefix, epoch, net=None, trainer=None, keep_last=None,
     `preemption_handler`."""
     if net is None and trainer is None:
         raise MXNetError("save_checkpoint: pass net= and/or trainer=")
-    files = []
-    params = f"{prefix}-{epoch:04d}.params"
-    if net is not None:
-        _ckpt.retry(lambda: net.save_parameters(params), attempts=attempts)
-        files.append(params)
-    if trainer is not None:
-        states = f"{prefix}-{epoch:04d}.states"
-        _ckpt.retry(lambda: trainer.save_states(states), attempts=attempts)
-        files.append(states)
-    _ckpt.retry(lambda: _ckpt.write_manifest(prefix, epoch, files),
-                attempts=attempts)
-    if keep_last:
-        # the epoch just committed is verified by construction — skip the
-        # full from-disk re-hash the newest-verified scan would otherwise do
-        _ckpt.apply_retention(prefix, keep_last, known_verified=epoch)
-    return params
+    with _telemetry.span("checkpoint.save_seconds"):
+        files = []
+        params = f"{prefix}-{epoch:04d}.params"
+        if net is not None:
+            _ckpt.retry(lambda: net.save_parameters(params),
+                        attempts=attempts)
+            files.append(params)
+        if trainer is not None:
+            states = f"{prefix}-{epoch:04d}.states"
+            _ckpt.retry(lambda: trainer.save_states(states),
+                        attempts=attempts)
+            files.append(states)
+        _ckpt.retry(lambda: _ckpt.write_manifest(prefix, epoch, files),
+                    attempts=attempts)
+        if keep_last:
+            # the epoch just committed is verified by construction — skip
+            # the full from-disk re-hash the newest-verified scan would
+            # otherwise do
+            _ckpt.apply_retention(prefix, keep_last, known_verified=epoch)
+        return params

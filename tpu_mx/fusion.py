@@ -54,8 +54,10 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
+from . import telemetry as _telemetry
+
 __all__ = ["enabled", "flush", "stats", "reset_stats", "pending_ops",
-           "FusionSegment"]
+           "cache_stats", "FusionSegment"]
 
 
 class _TLS(threading.local):
@@ -92,6 +94,23 @@ def clear_cache():
     """Drop the memoized jitted segment programs (test hook)."""
     _FWD_CACHE.clear()
     _BWD_CACHE.clear()
+
+
+def cache_stats():
+    """Public jit-cache accessor: compiled-program counts plus hit/miss
+    totals, backed by the telemetry registry counters
+    (``fusion.cache_hits`` / ``fusion.cache_misses`` /
+    ``fusion.flushes``).  bench.py's fusion leg persists this dict into
+    its JSON record, so cache behavior rides every benchmark receipt."""
+    def val(name):
+        m = _telemetry.get(name)
+        return int(m.value) if m is not None else 0
+
+    return {"programs": len(_FWD_CACHE),
+            "bwd_programs": len(_BWD_CACHE),
+            "hits": val("fusion.cache_hits"),
+            "misses": val("fusion.cache_misses"),
+            "segments_flushed": val("fusion.flushes")}
 
 
 # os.environ.get costs ~3us per call (str->bytes encode in os.py) — far
@@ -279,6 +298,7 @@ def append(fn, args, name, key, nondiff):
             # np.generic scalars, tracers, anything else: promotion or
             # identity semantics are not scalar-bakeable — let the caller
             # dispatch eagerly (a flush barrier via _raw)
+            _telemetry.counter("fusion.eager_fallbacks").inc()
             return None
 
     idx = len(seg.fns)
@@ -367,6 +387,10 @@ def _execute(seg, reason):
         stats["flush_reasons"].get(reason, 0) + 1
     if not seg.fns:
         return
+    _telemetry.counter("fusion.flush_cause", cause=reason).inc()
+    _telemetry.histogram("fusion.segment_ops",
+                         buckets=_telemetry.SEGMENT_OPS_BUCKETS,
+                         unit="ops").observe(len(seg.fns))
 
     # Live outputs: node results whose handle is still reachable and still
     # lazy on THIS segment.  Dead intermediates stay internal to the fused
@@ -381,6 +405,7 @@ def _execute(seg, reason):
             live.append((i, h))
     if not live:
         stats["segments_dead"] += 1
+        _telemetry.counter("fusion.segments_dead").inc()
         return
 
     out_idxs = tuple(i for i, _ in live)
@@ -390,11 +415,13 @@ def _execute(seg, reason):
     fwd = _FWD_CACHE.get(chain_key)
     if fwd is None:
         stats["cache_misses"] += 1
+        _telemetry.counter("fusion.cache_misses").inc()
         fwd = jax.jit(_make_replay(seg.fns, seg.specs, seg.nondiffs,
                                    out_idxs))
         _FWD_CACHE[chain_key] = fwd
     else:
         stats["cache_hits"] += 1
+        _telemetry.counter("fusion.cache_hits").inc()
 
     try:
         results = fwd(*seg.ext)
@@ -410,6 +437,12 @@ def _execute(seg, reason):
         h._buf = r
         h._lazy = None
     stats["segments_flushed"] += 1
+    # telemetry scope differs from the legacy stats dict by design:
+    # stats["ops_fused"] counts appends (incl. segments that later die
+    # unread), fusion.ops_fused counts only ops that EXECUTED fused —
+    # the number that tells an operator what the engine actually won
+    _telemetry.counter("fusion.flushes").inc()
+    _telemetry.counter("fusion.ops_fused").inc(len(seg.fns))
 
     # ---- autograd: the whole segment becomes ONE tape node -------------
     # Only inexact outputs of DIFF nodes join the tape: integer outputs

@@ -25,8 +25,19 @@ import pickle
 from .base import MXNetError, get_env
 from .ndarray import NDArray
 from .optimizer import Updater, create as _create_optimizer
+from . import telemetry as _telemetry
 
 __all__ = ["KVStore", "create", "dist_init"]
+
+
+def _nbytes(arr):
+    """Payload size of an NDArray/array-like, for the transfer counters
+    (best-effort: a 0 for exotic leaves beats breaking push/pull)."""
+    try:
+        import numpy as np
+        return int(arr.size) * int(np.dtype(arr.dtype).itemsize)
+    except Exception:
+        return 0
 
 
 def dist_init():
@@ -83,6 +94,9 @@ class KVStore:
         for k, v in zip(keys, values):
             self._check_inited(k)
             vlist = v if isinstance(v, list) else [v]
+            _telemetry.counter("kvstore.pushes").inc()
+            _telemetry.counter("kvstore.push_bytes").inc(
+                sum(_nbytes(x) for x in vlist))
             agg = vlist[0]
             for extra in vlist[1:]:
                 agg = agg + extra
@@ -143,6 +157,9 @@ class KVStore:
             if self._updater is None and pending is not None:
                 self._store[k] = pending
             olist = o if isinstance(o, list) else [o]
+            _telemetry.counter("kvstore.pulls").inc()
+            _telemetry.counter("kvstore.pull_bytes").inc(
+                _nbytes(src) * len(olist))
             for dst in olist:
                 src.copyto(dst)
 

@@ -22,16 +22,19 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     mid-save leaves the previous epoch as the newest verified checkpoint
     instead of a truncated .params file (docs/robustness.md)."""
     from . import checkpoint as _ckpt
-    files = []
-    if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
-        files.append(f"{prefix}-symbol.json")
-    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
-    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
-    params = f"{prefix}-{epoch:04d}.params"
-    _nd.save(params, save_dict)
-    files.append(params)
-    _ckpt.write_manifest(prefix, epoch, files)
+    from . import telemetry as _telemetry
+    with _telemetry.span("checkpoint.save_seconds"):
+        files = []
+        if symbol is not None:
+            symbol.save(f"{prefix}-symbol.json")
+            files.append(f"{prefix}-symbol.json")
+        save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+        save_dict.update({f"aux:{k}": v
+                          for k, v in (aux_params or {}).items()})
+        params = f"{prefix}-{epoch:04d}.params"
+        _nd.save(params, save_dict)
+        files.append(params)
+        _ckpt.write_manifest(prefix, epoch, files)
 
 
 def load_checkpoint(prefix, epoch):

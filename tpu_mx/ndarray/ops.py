@@ -50,6 +50,15 @@ def _raw(a):
     return a  # python scalar — kept as-is so jnp broadcasting rules apply
 
 
+def _scalar_key(*vals):
+    """Type-tagged scalars for fuse keys.  1 == 1.0 == True in Python, so
+    bare values would collide across spellings — but each bakes a
+    DIFFERENT trace constant into the op's closure (int vs weak-float
+    promotion), and a key collision replays the wrong cached program with
+    the wrong output dtype vs eager."""
+    return tuple((type(v).__name__, v) for v in vals)
+
+
 def _apply(fn, args, name="op", nondiff=False, fuse=None):
     """Dispatch one op: args = tensor positionals (NDArray | array | scalar).
 
@@ -163,7 +172,7 @@ def ones_like(a, **kw):
 
 
 def full_like(a, fill_value, **kw):
-    fuse = ("full_like", fill_value) \
+    fuse = ("full_like",) + _scalar_key(fill_value) \
         if isinstance(fill_value, (int, float)) else None
     return _apply(lambda x: jnp.full_like(x, fill_value), [a], "full_like",
                   nondiff=True, fuse=fuse)
@@ -404,7 +413,8 @@ def norm(data, ord=2, axis=None, keepdims=False, **kw):
             return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
         return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
 
-    return _apply(f, [data], "norm", fuse=("norm", ord, ax, keepdims))
+    return _apply(f, [data], "norm",
+                  fuse=("norm",) + _scalar_key(ord) + (ax, keepdims))
 
 
 def cumsum(data, axis=None, dtype=None):
@@ -618,7 +628,7 @@ def slice_like(data, shape_like, axes=None, **kw):
 
 
 def clip(data, a_min, a_max, **kw):
-    fuse = ("clip", a_min, a_max) \
+    fuse = ("clip",) + _scalar_key(a_min, a_max) \
         if isinstance(a_min, (int, float)) and isinstance(a_max, (int, float)) \
         else None
     return _apply(lambda x: jnp.clip(x, a_min, a_max), [data], "clip",
@@ -1026,8 +1036,8 @@ def softmax(data, axis=-1, temperature=None, length=None, **kw):
         return jax.nn.softmax(z, axis=axis)
 
     args = [data] + ([length] if length is not None else [])
-    fuse = ("softmax", axis, temperature) if length is None and \
-        isinstance(temperature, (int, float, type(None))) else None
+    fuse = ("softmax", axis) + _scalar_key(temperature) if length is None \
+        and isinstance(temperature, (int, float, type(None))) else None
     return _apply(f, args, "softmax", fuse=fuse)
 
 
@@ -1036,7 +1046,7 @@ def log_softmax(data, axis=-1, temperature=None, **kw):
         z = x / temperature if temperature else x
         return jax.nn.log_softmax(z, axis=axis)
 
-    fuse = ("log_softmax", axis, temperature) \
+    fuse = ("log_softmax", axis) + _scalar_key(temperature) \
         if isinstance(temperature, (int, float, type(None))) else None
     return _apply(f, [data], "log_softmax", fuse=fuse)
 
@@ -1258,7 +1268,7 @@ def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1,
     # fusible elementwise update (an engine.bulk() around a parameter loop
     # bulks the whole sweep); all hyper-params ride the key — a schedule
     # changing lr compiles a fresh chain, same as the reference re-bulking
-    fuse = ("sgd_update", lr, wd, rescale_grad, cg) \
+    fuse = ("sgd_update",) + _scalar_key(lr, wd, rescale_grad, cg) \
         if all(isinstance(v, (int, float, type(None)))
                for v in (lr, wd, rescale_grad, cg)) else None
     res = _apply(lambda w, g: sgd_update_core(w, g, lr, wd, rescale_grad, cg),

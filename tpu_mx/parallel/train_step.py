@@ -20,12 +20,14 @@ import functools
 import logging
 import os
 import re
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _telemetry
 from ..ndarray import NDArray
 
 __all__ = ["CompiledTrainStep", "fsdp_rules", "sharding_for", "apply_rules"]
@@ -211,6 +213,10 @@ class CompiledTrainStep:
 
     # -- the compiled program -------------------------------------------------
     def _build(self, n_batch_args):
+        # every _build is a fresh jit program (first compile, or a batch-
+        # arity change invalidating the old one) — the recompile-storm
+        # signal ops dashboards watch (docs/observability.md)
+        _telemetry.counter("train_step.recompiles").inc()
         net, loss_fn, opt = self.net, self.loss_fn, self.optimizer
         diff_keys = list(self._diff_keys)
         lr_mults, wd_mults = self._lr_mults, self._wd_mults
@@ -536,6 +542,7 @@ class CompiledTrainStep:
     def step(self, *batch, lr=None):
         """Run one step; batch = (*data_args, label) as NDArray/array."""
         from .. import random as _random
+        t_start = time.perf_counter()
         # None batch args pass through (optional model inputs like
         # valid_length); they contribute no leaves to the jitted signature
         raw = tuple(b._data if isinstance(b, NDArray)
@@ -550,6 +557,7 @@ class CompiledTrainStep:
             self.values, self._gacc, loss = self._accum_jit(
                 self.values, self._gacc, key, *raw)
             self._micro += 1
+            self._record_step(raw, t_start)
             return NDArray(loss)
         self._t += 1
         self._micro = 0
@@ -564,7 +572,22 @@ class CompiledTrainStep:
             key, *raw)
         if self._accum > 1:
             self._gacc = gacc
+        self._record_step(raw, t_start)
         return NDArray(loss)
+
+    @staticmethod
+    def _record_step(raw, t_start):
+        """Per-step telemetry: host-side dispatch latency (jax dispatch is
+        async, so this is queue latency — steady-state it converges to the
+        device step time because the dispatch queue applies backpressure),
+        step count, and the examples/sec gauge from the batch leading dim."""
+        dt = time.perf_counter() - t_start
+        _telemetry.counter("train_step.steps").inc()
+        _telemetry.histogram("train_step.seconds").observe(dt)
+        n = next((b.shape[0] for b in raw
+                  if b is not None and getattr(b, "ndim", 0)), None)
+        if n and dt > 0:
+            _telemetry.gauge("train_step.examples_per_sec").set(n / dt)
 
     def sync_to_net(self):
         """Write device weights back into the Gluon parameters (for eval,

@@ -22,7 +22,8 @@ import threading
 import time
 
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
-           "scope", "Task", "Frame", "Event", "Counter", "Marker"]
+           "scope", "record_span", "Task", "Frame", "Event", "Counter",
+           "Marker"]
 
 _state = {
     "filename": "profile.json",
@@ -108,6 +109,16 @@ def _record_scope(name, t0, t1, category="scope"):
         _events.append({"name": name, "cat": category, "ph": "X",
                         "ts": t0 * 1e6, "dur": dt * 1e6,
                         "pid": _pid, "tid": threading.get_ident()})
+
+
+def record_span(name, t0, t1, category="telemetry"):
+    """Merge an externally-timed interval (``time.perf_counter`` endpoints)
+    into the chrome-trace event stream and the aggregate table — the bridge
+    ``tpu_mx.telemetry.span`` uses so telemetry spans land on the same
+    Perfetto timeline as the profiler scopes and XLA annotations.  No-op
+    unless the profiler is recording."""
+    if _recording():
+        _record_scope(name, t0, t1, category)
 
 
 class scope:
@@ -208,10 +219,17 @@ class Marker:
 def dump(finished=True):
     """Write recorded host-side events as chrome://tracing JSON to the
     configured filename.  The XLA device trace lives separately under
-    ``<filename-stem>_xla_trace/`` (view with Perfetto/TensorBoard)."""
+    ``<filename-stem>_xla_trace/`` (view with Perfetto/TensorBoard).
+
+    Routed through ``checkpoint.atomic_write`` (tmp+fsync+rename): a crash
+    mid-dump leaves the previous complete ``profile.json`` — the same
+    contract every other state writer got in the durability PR."""
     with _lock:
         events = list(_events)
-    with open(_state["filename"], "w") as f:
+    from .checkpoint import atomic_write
+    with atomic_write(_state["filename"], "w") as f:
+        # stream — a long session's trace is large; one monolithic
+        # json.dumps string would double peak memory at dump time
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
 
 

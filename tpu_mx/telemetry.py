@@ -1,0 +1,482 @@
+"""Unified runtime telemetry: a process-wide metrics registry + event spans.
+
+The fusion engine (ISSUE 1) and the durability layer (ISSUE 2) are both
+workload-dependent — "Operator Fusion in XLA" (arxiv 2301.13062) shows
+fusion behavior must be *measured*, not assumed, and a recompile storm or
+a checkpoint-retry spiral is invisible until something exports a number.
+This module is the one place every runtime subsystem reports to:
+
+- **Registry**: :func:`counter` / :func:`gauge` / :func:`histogram`
+  create-or-fetch named metrics (optional key=value labels make distinct
+  series, e.g. ``counter("chaos.injections", kind="torn_write")``).  All
+  operations are thread-safe; instrumented hot paths touch the registry
+  at *flush/step/save* granularity, never per-op, so the disabled-exporter
+  overhead is a few dict ops per event.
+- **Spans**: ``with span("elastic.save_checkpoint_seconds"): ...`` times a
+  region into the same-named histogram AND — when ``mx.profiler`` is
+  recording — merges the interval into the profiler's chrome-trace event
+  stream, so telemetry spans land on the same Perfetto timeline as the
+  XLA annotations (`profiler.record_span` is the merge point).
+- **Exporters** (all pull-based; none require a server):
+
+  1. JSONL append — set ``TPUMX_TELEMETRY=/path/metrics.jsonl`` and call
+     :func:`flush` (the instrumented train loop does; an atexit hook
+     writes the final snapshot).  Each flush appends one record per live
+     metric (see :func:`validate_record` for the schema).  The *final*
+     snapshot (``flush(final=True)`` / atexit) rewrites the whole file
+     through ``checkpoint.atomic_write`` so a crash mid-dump cannot leave
+     a truncated file.
+  2. Prometheus text exposition — :func:`exposition` returns the
+     registry in the text format a Prometheus scraper (or a human) parses;
+     no HTTP server required, wire it to whatever transport exists.
+  3. Chrome trace — spans ride ``mx.profiler``'s event stream (above).
+
+Metric NAMES ARE AN API (tools/ci.py's ``obs`` tier fails on names
+outside :data:`KNOWN_METRICS`); the catalog lives in
+docs/observability.md.  Histograms use fixed log-scale latency buckets
+(10µs→30s in 1–3–10 steps) so snapshots from different runs always merge.
+
+This module deliberately imports ONLY the stdlib at module level: it is
+imported by the lowest layers (chaos, checkpoint, fusion) and is also
+loadable standalone (tools/telemetry_report.py) without booting jax.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import re
+import threading
+import time
+
+__all__ = ["counter", "gauge", "histogram", "span", "get", "reset",
+           "snapshot", "flush", "exposition", "validate_record",
+           "configured_path", "Counter", "Gauge", "Histogram",
+           "KNOWN_METRICS", "LATENCY_BUCKETS", "SEGMENT_OPS_BUCKETS"]
+
+# fixed log-scale latency buckets, in SECONDS: 10µs → 30s in 1–3–10 steps
+# (the "ms buckets": every decade of the millisecond range is covered).
+# Fixed — never derived from data — so histograms from any two runs merge.
+LATENCY_BUCKETS = (1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2,
+                   0.1, 0.3, 1.0, 3.0, 10.0, 30.0)
+
+# count-valued buckets for fusion segment lengths (power-of-two edges)
+SEGMENT_OPS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
+# The stable metric-name catalog (docs/observability.md).  tools/ci.py's
+# `obs` tier fails the build when an emitted record's name is not listed
+# here — an accidental rename breaks every dashboard reading the old name.
+KNOWN_METRICS = frozenset({
+    # fusion engine (tpu_mx/fusion.py)
+    "fusion.flushes", "fusion.flush_cause", "fusion.segment_ops",
+    "fusion.ops_fused", "fusion.segments_dead",
+    "fusion.cache_hits", "fusion.cache_misses", "fusion.eager_fallbacks",
+    # durability layer (tpu_mx/checkpoint.py; save_seconds is the span at
+    # the whole-checkpoint save sites, write_seconds the per-file commit)
+    "checkpoint.save_seconds", "checkpoint.write_seconds",
+    "checkpoint.verify_seconds", "checkpoint.atomic_writes",
+    "checkpoint.retries", "checkpoint.corrupt_detected",
+    # elastic resume (tpu_mx/elastic.py)
+    "elastic.resume_attempts", "elastic.epochs_skipped_corrupt",
+    "elastic.legacy_fallbacks",
+    # compiled train step (tpu_mx/parallel/train_step.py)
+    "train_step.seconds", "train_step.steps", "train_step.recompiles",
+    "train_step.examples_per_sec",
+    # kvstore eager path (tpu_mx/kvstore.py)
+    "kvstore.pushes", "kvstore.pulls",
+    "kvstore.push_bytes", "kvstore.pull_bytes",
+    # fault injection (tpu_mx/contrib/chaos.py)
+    "chaos.injections",
+    # module-API training (tpu_mx/callback.py)
+    "speedometer.samples_per_sec",
+})
+
+_lock = threading.RLock()
+_metrics: dict = {}          # (name, labels_tuple) -> metric object
+
+
+def _labels_key(labels):
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    __slots__ = ("name", "labels")
+    kind = None
+
+    def __init__(self, name, labels):
+        self.name = name
+        self.labels = labels
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (resets only with the process)."""
+
+    __slots__ = ("value",)
+    kind = "counter"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self.value = 0
+
+    def inc(self, n=1):
+        with _lock:
+            self.value += n
+        return self
+
+    def _record(self, ts):
+        return _rec(self, ts, self.value)
+
+
+class Gauge(_Metric):
+    """Last-written value (e.g. examples/sec)."""
+
+    __slots__ = ("value",)
+    kind = "gauge"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self.value = 0.0
+
+    def set(self, value):
+        with _lock:
+            self.value = float(value)
+        return self
+
+    def _record(self, ts):
+        return _rec(self, ts, self.value)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket distribution; default buckets are the log-scale
+    latency ladder (:data:`LATENCY_BUCKETS`, seconds).  Tracks count, sum,
+    min and max alongside the cumulative bucket counts.  ``unit`` rides
+    the JSONL record so renderers know whether ms-scaling applies."""
+
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max", "unit")
+    kind = "histogram"
+
+    def __init__(self, name, labels, buckets=None, unit="seconds"):
+        super().__init__(name, labels)
+        self.unit = unit
+        self.buckets = tuple(float(b) for b in (buckets or LATENCY_BUCKETS))
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, value):
+        value = float(value)
+        with _lock:
+            i = 0
+            for b in self.buckets:
+                if value <= b:
+                    break
+                i += 1
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+        return self
+
+    def cumulative(self):
+        """[(upper_bound | "+Inf", cumulative_count), ...] — monotone."""
+        out, acc = [], 0
+        with _lock:
+            for b, c in zip(self.buckets, self.counts):
+                acc += c
+                out.append((b, acc))
+            out.append(("+Inf", acc + self.counts[-1]))
+        return out
+
+    def _record(self, ts):
+        rec = _rec(self, ts, self.count)
+        rec["sum"] = self.sum
+        rec["unit"] = self.unit
+        if self.count:
+            rec["min"] = self.min
+            rec["max"] = self.max
+        rec["buckets"] = [[b, c] for b, c in self.cumulative()]
+        return rec
+
+
+def _rec(metric, ts, value):
+    rec = {"name": metric.name, "type": metric.kind, "value": value,
+           "ts": ts}
+    if metric.labels:
+        rec["labels"] = dict(metric.labels)
+    return rec
+
+
+def _get_or_make(cls, name, labels, **kw):
+    key = (name, _labels_key(labels))
+    with _lock:
+        m = _metrics.get(key)
+        if m is None:
+            m = _metrics[key] = cls(name, _labels_key(labels), **kw)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"not {cls.kind}")
+        return m
+
+
+def counter(name, **labels):
+    """Create-or-fetch the Counter `name` (labels make distinct series)."""
+    return _get_or_make(Counter, name, labels)
+
+
+def gauge(name, **labels):
+    """Create-or-fetch the Gauge `name`."""
+    return _get_or_make(Gauge, name, labels)
+
+
+def histogram(name, buckets=None, unit="seconds", **labels):
+    """Create-or-fetch the Histogram `name`; `buckets` and `unit` only
+    apply on first creation (fixed thereafter — merged snapshots depend
+    on the bucket edges)."""
+    return _get_or_make(Histogram, name, labels, buckets=buckets, unit=unit)
+
+
+def get(name, **labels):
+    """The already-registered metric, or None (no create side effect)."""
+    with _lock:
+        return _metrics.get((name, _labels_key(labels)))
+
+
+def reset():
+    """Drop every metric (test hook)."""
+    with _lock:
+        _metrics.clear()
+    _finalized.clear()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+class span:
+    """Context manager: time a region into the histogram `name` and merge
+    the interval into ``mx.profiler``'s chrome-trace stream when the
+    profiler is recording (one Perfetto timeline for spans + XLA)."""
+
+    __slots__ = ("name", "labels", "_t0")
+
+    def __init__(self, name, **labels):
+        self.name = name
+        self.labels = labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        histogram(self.name, **self.labels).observe(t1 - self._t0)
+        try:
+            from . import profiler
+            profiler.record_span(self.name, self._t0, t1)
+        except Exception:
+            pass  # standalone load (no package) or profiler torn down
+        return False
+
+
+# ---------------------------------------------------------------------------
+# JSONL exporter
+# ---------------------------------------------------------------------------
+def configured_path():
+    """The JSONL sink from the TPUMX_TELEMETRY env var, or None."""
+    return os.environ.get("TPUMX_TELEMETRY") or None
+
+
+def snapshot():
+    """One record per live metric, sharing a wall-clock ``ts``.
+
+    Built entirely under the registry lock (no I/O happens here): a
+    concurrent ``observe()`` between reading ``count`` and the bucket
+    array would otherwise produce a record violating the schema's own
+    +Inf-count == value invariant."""
+    ts = time.time()
+    with _lock:
+        return [m._record(ts) for m in _metrics.values()]
+
+
+def flush(path=None, final=False):
+    """Append one snapshot to the JSONL sink (`path` or TPUMX_TELEMETRY).
+
+    No sink configured → no-op (returns None), which is what makes
+    instrumentation free to call this unconditionally.  ``final=True``
+    rewrites the file — full history + this snapshot — through
+    ``checkpoint.atomic_write``, so the at-exit dump can never leave a
+    truncated file; intermediate flushes are plain appends (cheap, and a
+    torn tail there is recoverable line-by-line).  Returns the records."""
+    path = path or configured_path()
+    if not path:
+        return None
+    recs = snapshot()
+    payload = "".join(json.dumps(r, sort_keys=True) + "\n" for r in recs)
+    # The registry _lock is NEVER held across file I/O: the write path
+    # below re-enters instrumented code (atomic_write counts itself;
+    # chaos faults count their own firing), and holding _lock here would
+    # invert against the locks those layers hold (cfg.lock -> _lock vs
+    # _lock -> cfg.lock).  _flush_io_lock serializes concurrent flush()
+    # calls instead, so a final read-modify-rewrite cannot drop a
+    # concurrent append.  Earlier snapshots are re-read from disk for the
+    # final rewrite — no in-memory history accumulates over a long run.
+    with _flush_io_lock:
+        if final:
+            _finalized.add(path)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    prev = f.read()
+            except OSError:
+                prev = ""
+            try:
+                from .checkpoint import atomic_write
+                with atomic_write(path, "w") as f:
+                    f.write(prev + payload)
+            except ImportError:  # standalone module load: plain rewrite
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(prev + payload)
+        else:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(payload)
+    return recs
+
+
+# paths a final flush already rewrote — the atexit hook must not append a
+# duplicate final snapshot after an explicit flush(final=True)
+_finalized: set = set()
+_flush_io_lock = threading.Lock()
+
+
+@atexit.register
+def _flush_at_exit():  # pragma: no cover — exercised via subprocess (ci obs)
+    try:
+        path = configured_path()
+        if path and _metrics and path not in _finalized:
+            flush(final=True)
+    except Exception:
+        pass
+
+
+def validate_record(rec):
+    """Raise ValueError unless `rec` is a schema-valid telemetry record.
+
+    Schema (the contract tools/ci.py's `obs` tier enforces): every record
+    has a str ``name``, ``type`` in {counter, gauge, histogram}, numeric
+    ``value`` and ``ts``; histograms additionally carry a numeric ``sum``
+    and cumulative ``buckets`` [[bound, count], ...] whose counts are
+    monotone non-decreasing, whose last bound is "+Inf", and whose total
+    equals ``value``."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"record is {type(rec).__name__}, not an object")
+    name = rec.get("name")
+    if not isinstance(name, str) or not name:
+        raise ValueError(f"record missing name: {rec!r}")
+    kind = rec.get("type")
+    if kind not in ("counter", "gauge", "histogram"):
+        raise ValueError(f"{name}: bad type {kind!r}")
+    for field in ("value", "ts"):
+        if not isinstance(rec.get(field), (int, float)) \
+                or isinstance(rec.get(field), bool):
+            raise ValueError(f"{name}: missing numeric {field!r}")
+    if "labels" in rec and not (
+            isinstance(rec["labels"], dict)
+            and all(isinstance(k, str) and isinstance(v, str)
+                    for k, v in rec["labels"].items())):
+        raise ValueError(f"{name}: labels must be a str->str object")
+    if kind == "histogram":
+        if not isinstance(rec.get("sum"), (int, float)):
+            raise ValueError(f"{name}: histogram missing numeric 'sum'")
+        buckets = rec.get("buckets")
+        if not isinstance(buckets, list) or not buckets:
+            raise ValueError(f"{name}: histogram missing 'buckets'")
+        prev = None
+        for entry in buckets:
+            if (not isinstance(entry, list) or len(entry) != 2
+                    or not isinstance(entry[1], int)):
+                raise ValueError(f"{name}: malformed bucket {entry!r}")
+            if prev is not None and entry[1] < prev:
+                raise ValueError(
+                    f"{name}: bucket counts not monotone "
+                    f"({entry[1]} after {prev})")
+            prev = entry[1]
+        if buckets[-1][0] != "+Inf":
+            raise ValueError(f"{name}: last bucket bound must be '+Inf', "
+                             f"got {buckets[-1][0]!r}")
+        if buckets[-1][1] != rec["value"]:
+            raise ValueError(
+                f"{name}: +Inf bucket count {buckets[-1][1]} != "
+                f"value {rec['value']}")
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name):
+    return "tpumx_" + _NAME_RE.sub("_", name)
+
+
+def _prom_labels(pairs):
+    if not pairs:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (_NAME_RE.sub("_", k),
+                     str(v).replace("\\", r"\\").replace('"', r'\"'))
+        for k, v in pairs)
+    return "{" + body + "}"
+
+
+def _prom_num(v):
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def exposition():
+    """The registry in Prometheus text exposition format (one string —
+    serve it over whatever transport exists; no HTTP server here).
+    Counters get the conventional ``_total`` suffix; histograms emit the
+    ``_bucket{le=...}`` / ``_sum`` / ``_count`` family.  Rendered under
+    the registry lock (pure string building, no I/O) so a concurrent
+    ``observe()`` cannot tear a histogram's bucket/sum/count family."""
+    with _lock:
+        return _exposition_locked()
+
+
+def _exposition_locked():
+    metrics = sorted(_metrics.values(), key=lambda m: (m.name, m.labels))
+    lines = []
+    typed = set()
+
+    def type_line(pname, kind):
+        if pname not in typed:
+            typed.add(pname)
+            lines.append(f"# TYPE {pname} {kind}")
+
+    for m in metrics:
+        if m.kind == "counter":
+            pname = _prom_name(m.name) + "_total"
+            type_line(pname, "counter")
+            lines.append(f"{pname}{_prom_labels(m.labels)} "
+                         f"{_prom_num(m.value)}")
+        elif m.kind == "gauge":
+            pname = _prom_name(m.name)
+            type_line(pname, "gauge")
+            lines.append(f"{pname}{_prom_labels(m.labels)} "
+                         f"{_prom_num(m.value)}")
+        else:
+            pname = _prom_name(m.name)
+            type_line(pname, "histogram")
+            for bound, cum in m.cumulative():
+                le = "+Inf" if bound == "+Inf" else repr(float(bound))
+                lab = _prom_labels(tuple(m.labels) + (("le", le),))
+                lines.append(f"{pname}_bucket{lab} {cum}")
+            lines.append(f"{pname}_sum{_prom_labels(m.labels)} "
+                         f"{_prom_num(m.sum)}")
+            lines.append(f"{pname}_count{_prom_labels(m.labels)} "
+                         f"{m.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
