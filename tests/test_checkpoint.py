@@ -305,8 +305,11 @@ def test_module_checkpoint_commits_verified_manifest(tmp_path):
     status, problems = ckpt.verify_checkpoint(prefix, 3)
     assert (status, problems) == ("verified", [])
     man = ckpt.read_manifest(prefix, 3)
-    assert set(man["files"]) == {"mlp-symbol.json", "mlp-0003.params",
-                                 "mlp-0003.states"}
+    # the shared, every-save-rewritten symbol.json is deliberately NOT in
+    # the verified file set (it would corrupt older epochs on a symbol
+    # change); its save-time hash rides the unverified "shared" table
+    assert set(man["files"]) == {"mlp-0003.params", "mlp-0003.states"}
+    assert man["shared"]["mlp-symbol.json"]["sha256"]
     assert man["git_head"] and man["epoch"] == 3
 
 
@@ -501,3 +504,68 @@ def test_kvstore_states_without_optimizer_stays_legacy_format(tmp_path):
     kv2.set_optimizer(mx.optimizer.create("sgd"))
     kv2.load_optimizer_states(fname)
     assert set(kv2._updater.get_states()) == set(kv._updater.get_states())
+
+
+# ---------------------------------------------------------------------------
+# shared symbol.json vs per-epoch manifests (the parked ROADMAP bug)
+# ---------------------------------------------------------------------------
+def _module_symbol(extra_layer=False):
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc1")
+    if extra_layer:
+        fc = mx.sym.Activation(fc, act_type="relu", name="relu1")
+    return fc
+
+
+def test_symbol_rewrite_keeps_older_epochs_verified(tmp_path):
+    """`{prefix}-symbol.json` is rewritten by EVERY model.save_checkpoint;
+    listing it in per-epoch manifests made a later save with a changed
+    symbol flip every older epoch to "corrupt", defeating the
+    fall-back-to-older-epoch contract.  It is excluded now (its content
+    hash rides the manifest's unverified "shared" table instead)."""
+    prefix = str(tmp_path / "m")
+    arg = {"fc1_weight": nd.ones((3, 4)), "fc1_bias": nd.zeros((3,))}
+    mx.model.save_checkpoint(prefix, 0, _module_symbol(), arg, {})
+    mx.model.save_checkpoint(prefix, 1, _module_symbol(), arg, {})
+    man = ckpt.read_manifest(prefix, 0)
+    assert "m-symbol.json" not in man["files"]
+    assert man["shared"]["m-symbol.json"]["sha256"]
+
+    # the symbol CHANGES (a new layer): older epochs must stay verified
+    mx.model.save_checkpoint(prefix, 2, _module_symbol(extra_layer=True),
+                             arg, {})
+    for epoch in (0, 1, 2):
+        assert ckpt.verify_checkpoint(prefix, epoch)[0] == "verified", epoch
+
+    # torn-fallback proof: corrupt the newest epoch's params; the elastic
+    # path must fall back to epoch 1 — which a symbol-bearing manifest
+    # would have declared corrupt too, leaving nothing to resume from
+    with open(f"{prefix}-0002.params", "r+b") as f:
+        f.truncate(10)
+    assert ckpt.verify_checkpoint(prefix, 2)[0] == "corrupt"
+    assert mx.elastic.latest_checkpoint(prefix)[0] == 1
+    sym, arg2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    np.testing.assert_allclose(arg2["fc1_weight"].asnumpy(), 1.0)
+
+
+def test_module_save_checkpoint_states_ride_manifest_after_symbol_fix(
+        tmp_path):
+    """Module.save_checkpoint(save_optimizer_states=True) still folds the
+    .states file into the (symbol-less) manifest."""
+    from tpu_mx.io.io import DataBatch
+    prefix = str(tmp_path / "mod")
+    sym = mx.sym.SoftmaxOutput(_module_symbol(),
+                               mx.sym.Variable("softmax_label"))
+    mod = mx.module.Module(sym, context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (2, 4))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    mod.init_optimizer()
+    mod.forward_backward(DataBatch(data=[nd.ones((2, 4))],
+                                   label=[nd.zeros((2,))]))
+    mod.update()
+    mod.save_checkpoint(prefix, 3, save_optimizer_states=True)
+    man = ckpt.read_manifest(prefix, 3)
+    assert set(man["files"]) == {"mod-0003.params", "mod-0003.states"}
+    assert "mod-symbol.json" not in man["files"]
+    assert ckpt.verify_checkpoint(prefix, 3)[0] == "verified"

@@ -17,8 +17,12 @@ SURVEY §5.2 / VERDICT r5 missing#6) — then obs: a tiny instrumented
 train loop run with TPUMX_TELEMETRY set, whose emitted JSONL must
 validate against the telemetry schema AND the stable metric-name catalog
 (tools/telemetry_report.py --validate; docs/observability.md — an
-accidental metric rename fails this tier).  `--core-only` runs just the
-first for a quick gate.
+accidental metric rename fails this tier) — and soak: a supervised
+training run under a fixed-seed randomized chaos schedule (hang, NaN
+streak, crash-mid-save, torn write) that must finish with a verified
+latest checkpoint, a finite loss, and ≥1 recorded restart, rollback and
+watchdog fire (tpu_mx/supervisor.py; docs/robustness.md).  `--core-only`
+runs just the first for a quick gate.
 """
 from __future__ import annotations
 
@@ -40,6 +44,7 @@ TIERS = [
     # is reproducible run-to-run (ISSUE 2; the core tier runs these too,
     # but under whatever seed the environment happens to carry)
     ("chaos", ["tests/test_checkpoint.py", "tests/test_elastic.py",
+               "tests/test_supervisor.py",
                "-m", "not slow"], {"TPUMX_CHAOS_SEED": "20260804"}),
 ]
 
@@ -125,6 +130,156 @@ OBS_REQUIRED = ("fusion.flushes", "checkpoint.save_seconds",
                 "train_step.recompiles", "train_step.steps")
 
 
+# The soak tier's workload: a REAL supervised training run under a
+# fixed-seed randomized fault schedule — hang, NaN streak, crash-mid-save,
+# torn write — that must end with a verified latest checkpoint, a finite
+# loss, and every recovery path provably taken (ISSUE 4 acceptance).
+# The schedule is derived from TPUMX_CHAOS_SEED so a red run reproduces.
+SOAK_SCRIPT = """
+import contextlib
+import math
+import os
+import random
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tpu_mx as mx
+from tpu_mx import checkpoint as ckpt, elastic, gluon, nd, telemetry
+from tpu_mx.contrib import chaos
+from tpu_mx.gluon import nn
+from tpu_mx.parallel import CompiledTrainStep
+from tpu_mx.supervisor import Supervisor
+
+SEED = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
+rng = random.Random(SEED)
+prefix = os.path.join(os.path.dirname(os.environ["TPUMX_TELEMETRY"]),
+                      "soak")
+
+net = nn.HybridSequential()
+net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+net.initialize()
+net(nd.ones((1, 4)))
+step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         mx.optimizer.create("sgd", learning_rate=0.05))
+R = np.random.RandomState(SEED)
+X = R.rand(64, 4).astype(np.float32)
+Y = (X.sum(1) > 2).astype(np.float32)
+NB, BS, EPOCHS = 4, 16, 10
+
+# the randomized fault schedule (all positions seed-derived).  Ranges
+# keep the script's own assertions satisfiable for EVERY seed: the torn
+# epoch stays below EPOCHS-1 (the final epoch must verify as latest) and
+# the NaN streak starts early enough to fit inside its epoch (a streak
+# split across the chaos scope would disarm after one skip — no rollback)
+hang_epoch = rng.randint(2, 3)
+nan_epoch = rng.randint(4, 5)
+crash_epoch = rng.randint(6, 7)
+torn_epoch = rng.randint(8, EPOCHS - 2)
+EPOCH_FAULTS = {
+    hang_epoch: dict(hang_step=rng.randint(1, NB), seed=SEED),
+    nan_epoch: dict(nan_after=rng.randint(1, NB - 1), nan_streak=2,
+                    seed=SEED),
+}
+SAVE_FAULTS = {
+    crash_epoch: dict(crash_after_bytes=200, match=".params", seed=SEED),
+    torn_epoch: dict(torn_write=120, match=".params", seed=SEED),
+}
+print("SOAK schedule: hang@%d nan@%d crash@%d torn@%d" %
+      (hang_epoch, nan_epoch, crash_epoch, torn_epoch), flush=True)
+
+
+def save_fn(epoch):
+    faults = SAVE_FAULTS.pop(epoch, None)  # pop: the retried save is clean
+    with (chaos.enable(**faults) if faults else contextlib.nullcontext()):
+        step.sync_to_net()
+        elastic.save_checkpoint(prefix, epoch, net=net)
+
+
+def restore_fn():
+    start = elastic.auto_resume(prefix, net=net)
+    step.sync_from_net()
+    return start
+
+
+sup = Supervisor(save_fn=save_fn, restore_fn=restore_fn,
+                 deadline=20.0, compile_grace=60.0, max_restarts=5,
+                 max_rollbacks=3, skip_limit=1, backoff=0.05,
+                 cooldown=0.0, seed=SEED)
+
+
+def epoch_fn(epoch):
+    faults = EPOCH_FAULTS.pop(epoch, None)
+    with (chaos.enable(**faults) if faults else contextlib.nullcontext()):
+        for i in range(NB):
+            xb, yb = X[i * BS:(i + 1) * BS], Y[i * BS:(i + 1) * BS]
+            sup.step(lambda: step.step(nd.array(xb), nd.array(yb)))
+
+
+res = sup.run(epoch_fn, begin_epoch=0, num_epoch=EPOCHS)
+print("SOAK result:", res.as_dict(), flush=True)
+assert res.status == "completed", res.as_dict()
+# ≥1 recorded restart, rollback, watchdog fire, skipped batch (acceptance)
+assert res.restarts >= 2, res.as_dict()       # hang + crash-mid-save
+assert res.rollbacks >= 1, res.as_dict()      # NaN streak past the budget
+assert res.watchdog_fires >= 1, res.as_dict()
+assert res.batches_skipped >= 1, res.as_dict()
+# finite final loss, verified latest checkpoint
+assert res.final_loss is not None and math.isfinite(res.final_loss)
+epoch, path = elastic.latest_checkpoint(prefix)
+assert epoch == EPOCHS - 1, (epoch, path)
+assert ckpt.verify_checkpoint(prefix, epoch)[0] == "verified"
+# the torn epoch is on disk but detectably corrupt (manifest caught it)
+assert ckpt.verify_checkpoint(prefix, torn_epoch)[0] == "corrupt"
+assert ckpt.newest_verified_epoch(prefix) == EPOCHS - 1
+telemetry.flush(final=True)
+print("SOAK OK", flush=True)
+"""
+
+# "supervisor" is a telemetry_report require-preset expanding to the
+# supervisor recovery counters (restarts/rollbacks/watchdog_fires/
+# batches_skipped — the degraded gauge is rightly 0 on a healthy soak)
+SOAK_REQUIRED = ("supervisor", "chaos.injections",
+                 "checkpoint.corrupt_detected", "train_step.steps")
+
+
+def soak_tier():
+    """Run the supervised chaos-soak training job with a FIXED chaos seed
+    and bounded wall-clock, then validate its telemetry (the supervisor
+    metrics must all be nonzero — recovery paths taken, not assumed)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as d:
+        jsonl = os.path.join(d, "telemetry.jsonl")
+        env = dict(os.environ, TPUMX_TELEMETRY=jsonl, JAX_PLATFORMS="cpu",
+                   TPUMX_CHAOS_SEED="20260804")
+        env.pop("TPUMX_CHAOS", None)  # the script arms its own schedule
+        try:
+            run = subprocess.run([sys.executable, "-c", SOAK_SCRIPT],
+                                 env=env, cwd=repo, capture_output=True,
+                                 text=True, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: supervised run timed out: {e}")
+            return 1
+        if run.returncode != 0 or "SOAK OK" not in (run.stdout or ""):
+            print(f"  soak: supervised run failed (rc={run.returncode}):\n"
+                  f"{((run.stdout or '') + (run.stderr or ''))[-4000:]}")
+            return run.returncode or 1
+        try:
+            val = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "telemetry_report.py"),
+                 jsonl, "--validate", "--require", ",".join(SOAK_REQUIRED)],
+                capture_output=True, text=True, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: telemetry validation timed out: {e}")
+            return 1
+        if val.returncode != 0:
+            print(f"  soak: telemetry validation failed "
+                  f"(rc={val.returncode}):\n"
+                  f"{((val.stdout or '') + (val.stderr or ''))[-3000:]}")
+            return val.returncode or 1
+    return 0
+
+
 def obs_tier():
     """Run the instrumented train loop with TPUMX_TELEMETRY set, then
     validate the emitted JSONL (schema + metric-name catalog + required
@@ -187,6 +342,8 @@ def main():
         results.append(("native-asan", native_asan(), time.time() - t0))
         t0 = time.time()
         results.append(("obs", obs_tier(), time.time() - t0))
+        t0 = time.time()
+        results.append(("soak", soak_tier(), time.time() - t0))
     print()
     red = False
     for name, rc, dt in results:

@@ -18,7 +18,9 @@ Modes (the ``obs`` tier of tools/ci.py runs both):
 metric names outside ``telemetry.KNOWN_METRICS`` — stable metric names are
 an API, and this is the gate that catches accidental renames.
 ``--require`` additionally fails unless each listed metric exists with a
-nonzero value (counter > 0 / histogram count > 0 / gauge != 0).
+nonzero value (counter > 0 / histogram count > 0 / gauge != 0).  A token
+naming a preset (``supervisor`` — the self-healing recovery counters the
+``soak`` CI tier gates on) expands to its metric list.
 
 The telemetry module is loaded standalone from its file — this tool never
 imports the ``tpu_mx`` package (which would boot jax) just to read JSON.
@@ -30,6 +32,26 @@ import importlib.util
 import json
 import os
 import sys
+
+
+# --require presets: one token → a metric family.  "supervisor" gates the
+# soak tier: every recovery path must have actually fired (the degraded
+# gauge is deliberately absent — it is 0 on any healthy run).
+REQUIRE_PRESETS = {
+    "supervisor": ("supervisor.restarts", "supervisor.rollbacks",
+                   "supervisor.watchdog_fires",
+                   "supervisor.batches_skipped"),
+}
+
+
+def expand_required(spec):
+    """Comma-separated metric names / preset tokens → the flat name list."""
+    names = []
+    for token in spec.split(","):
+        if not token:
+            continue
+        names.extend(REQUIRE_PRESETS.get(token, (token,)))
+    return names
 
 
 def load_telemetry():
@@ -168,14 +190,15 @@ def main(argv=None):
     ap.add_argument("--validate", action="store_true",
                     help="fail on schema violations or unknown metric names")
     ap.add_argument("--require", default="",
-                    help="comma-separated metric names that must be present "
-                         "and nonzero")
+                    help="comma-separated metric names (or preset tokens: "
+                         f"{', '.join(REQUIRE_PRESETS)}) that must be "
+                         "present and nonzero")
     opts = ap.parse_args(argv)
     telemetry = load_telemetry()
     series, n_snapshots, errors = read_series(opts.file, telemetry,
                                               validate=opts.validate)
     print(render(series, n_snapshots, opts.file))
-    required = [n for n in opts.require.split(",") if n]
+    required = expand_required(opts.require)
     errors += check_required(series, required)
     if not series and not errors:
         errors.append("file contains no telemetry records")

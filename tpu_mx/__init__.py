@@ -60,5 +60,6 @@ from . import engine
 from . import layout
 from . import checkpoint
 from . import elastic
+from . import supervisor
 from . import operator
 from . import rtc

@@ -55,9 +55,9 @@ from . import telemetry as _telemetry
 
 __all__ = ["atomic_write", "retry", "sha256_file", "manifest_path",
            "write_manifest", "update_manifest", "read_manifest",
-           "verify_checkpoint", "list_epochs", "checkpoint_files",
-           "apply_retention", "preemption_handler", "CheckpointCorrupt",
-           "MANIFEST_FORMAT"]
+           "verify_checkpoint", "newest_verified_epoch", "list_epochs",
+           "checkpoint_files", "apply_retention", "preemption_handler",
+           "CheckpointCorrupt", "MANIFEST_FORMAT"]
 
 log = logging.getLogger(__name__)
 
@@ -388,6 +388,15 @@ def _verify_checkpoint(prefix, epoch):
     return ("verified" if not problems else "corrupt"), problems
 
 
+def newest_verified_epoch(prefix):
+    """Newest epoch of `prefix` whose manifest verifies, or None — the one
+    recovery point retention and the training supervisor must preserve."""
+    for e in reversed(list_epochs(prefix)):
+        if verify_checkpoint(prefix, e)[0] == "verified":
+            return e
+    return None
+
+
 # ---------------------------------------------------------------------------
 # enumeration + retention
 # ---------------------------------------------------------------------------
@@ -448,10 +457,9 @@ def apply_retention(prefix, keep_last, known_verified=None):
     if known_verified is not None and int(known_verified) >= epochs[-1]:
         keep.add(int(known_verified))  # newest epoch, verified by caller
     else:
-        for e in reversed(epochs):
-            if verify_checkpoint(prefix, e)[0] == "verified":
-                keep.add(e)
-                break
+        nv = newest_verified_epoch(prefix)
+        if nv is not None:
+            keep.add(nv)
     removed = []
     for e in epochs:
         if e in keep:

@@ -23,6 +23,15 @@ Injection kinds (all one process, no root, no LD_PRELOAD):
   raise ``OSError`` (exercises ``checkpoint.retry`` backoff).
 - ``kill_peer=1``: ``elastic.barrier`` sees a dead peer and raises
   ``WorkerFailure`` deterministically, without a real 2-process run.
+- ``nan_after=N``: the Nth loss observed through :func:`poison_loss` (the
+  supervisor's numeric sentinel calls it on every supervised step) comes
+  back NaN; ``nan_streak=K`` (default 1) poisons K consecutive losses
+  before disarming — set K past the sentinel's skip budget to *provoke*
+  the rollback path, not just a skipped batch.
+- ``hang_step=N``: the Nth supervised step blocks for ``hang_seconds``
+  (default 3600 — "forever" at test scale) before running, simulating a
+  stalled collective/compile; the supervisor's hung-step watchdog must
+  convert it into a catchable ``WorkerFailure``.  One-shot.
 - ``match=SUBSTR``: scope file-level faults to paths containing SUBSTR
   (e.g. ``match=.params`` tears the params file but not the manifest).
 
@@ -54,7 +63,8 @@ import time
 from .. import telemetry as _telemetry
 
 __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
-           "wrap_file", "maybe_oserror", "peer_killed"]
+           "wrap_file", "maybe_oserror", "peer_killed", "poison_loss",
+           "maybe_hang"]
 
 
 def _count_injection(kind):
@@ -77,11 +87,13 @@ class ChaosCrash(Exception):
 
 class _Config:
     _KINDS = ("crash_after_bytes", "torn_write", "slow_io",
-              "transient_oserror", "kill_peer", "seed", "hard", "match")
+              "transient_oserror", "kill_peer", "nan_after", "nan_streak",
+              "hang_step", "hang_seconds", "seed", "hard", "match")
 
     def __init__(self, crash_after_bytes=None, torn_write=None, slow_io=None,
-                 transient_oserror=0, kill_peer=False, seed=None, hard=False,
-                 match=None):
+                 transient_oserror=0, kill_peer=False, nan_after=None,
+                 nan_streak=1, hang_step=None, hang_seconds=3600.0,
+                 seed=None, hard=False, match=None):
         if seed is None:
             seed = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
         self.crash_after_bytes = crash_after_bytes
@@ -89,6 +101,10 @@ class _Config:
         self.slow_io = slow_io
         self.transient_oserror = int(transient_oserror)
         self.kill_peer = bool(kill_peer)
+        self.nan_after = None if nan_after is None else int(nan_after)
+        self.nan_streak = max(1, int(nan_streak))
+        self.hang_step = None if hang_step is None else int(hang_step)
+        self.hang_seconds = float(hang_seconds)
         self.seed = seed
         self.hard = bool(hard)
         self.match = match
@@ -100,6 +116,10 @@ class _Config:
         self.crashes = 0             # how many times a fault actually fired
         self.tears = 0
         self.oserrors_fired = 0
+        self.losses_seen = 0         # losses observed while nan_after armed
+        self.steps_seen = 0          # steps observed while hang_step armed
+        self.nans_fired = 0
+        self.hangs = 0
 
     def matches(self, path):
         return self.match is None or (path is not None
@@ -161,7 +181,7 @@ def configure_from_env():
             continue
         if key == "match":
             kwargs[key] = val
-        elif key == "slow_io":
+        elif key in ("slow_io", "hang_seconds"):
             kwargs[key] = float(val)
         elif key in ("kill_peer", "hard"):
             kwargs[key] = val in ("", "1", "true", "yes", "on")
@@ -276,3 +296,50 @@ def peer_killed():
         _count_injection("kill_peer")
         return True
     return False
+
+
+def poison_loss(value):
+    """Return `value`, or NaN when the ``nan_after`` fault says this loss is
+    poisoned (the supervisor's numeric sentinel routes every observed loss
+    through here).  Counting starts when the fault is armed: the Nth loss
+    seen *since arming* — and the next ``nan_streak - 1`` after it — come
+    back NaN; the fault then disarms so recovery can converge."""
+    cfg = _config
+    if cfg is None or cfg.nan_after is None:
+        return value
+    with cfg.lock:
+        if cfg.nan_after is None:
+            return value
+        cfg.losses_seen += 1
+        if cfg.losses_seen >= cfg.nan_after:
+            cfg.nans_fired += 1
+            _count_injection("nan")
+            if cfg.losses_seen >= cfg.nan_after + cfg.nan_streak - 1:
+                cfg.nan_after = None  # streak complete: disarm
+            return float("nan")
+    return value
+
+
+def maybe_hang():
+    """Block for ``hang_seconds`` when the ``hang_step`` fault says this is
+    the hung step (the supervisor calls this at the top of every supervised
+    step, INSIDE the watchdog thread — the sleep simulates a stalled
+    collective/compile the hung-step watchdog must convert into a
+    ``WorkerFailure``).  One-shot; counting starts when armed."""
+    cfg = _config
+    if cfg is None or cfg.hang_step is None:
+        return
+    secs = None
+    with cfg.lock:
+        if cfg.hang_step is None:
+            return
+        cfg.steps_seen += 1
+        if cfg.steps_seen >= cfg.hang_step:
+            cfg.hang_step = None  # one-shot: the retried step runs clean
+            cfg.hangs += 1
+            _count_injection("hang")
+            secs = cfg.hang_seconds
+    if secs:
+        log.warning("chaos: hanging this step for %.0fs (hang_step fired)",
+                    secs)
+        time.sleep(secs)

@@ -27,9 +27,11 @@ injects mid-save crashes, torn writes and dead peers deterministically
 (tests/test_elastic.py).
 
 The barrier runs `multihost_utils.sync_global_devices` on a daemon thread
-and joins with a timeout — a hung collective (dead peer) leaves a parked
-daemon thread behind but the main thread gets control back, reports, and
-can exit for the supervisor to restart.
+and joins with a timeout (`supervisor.run_with_deadline` — the same
+watchdog the training supervisor puts around every step) — a hung
+collective (dead peer) leaves a parked daemon thread behind but the main
+thread gets control back, reports, and the supervisor (tpu_mx/supervisor.py)
+restarts from the last verified checkpoint.
 """
 from __future__ import annotations
 
@@ -38,7 +40,6 @@ import logging
 import os
 import pickle
 import re
-import threading
 
 from .base import MXNetError
 from . import checkpoint as _ckpt
@@ -75,28 +76,23 @@ def barrier(tag="tpumx_elastic", timeout=60.0):
     if jax.process_count() <= 1:
         return
     from jax.experimental import multihost_utils
-
-    done = threading.Event()
-    err = []
-
-    def _sync():
-        try:
-            multihost_utils.sync_global_devices(tag)
-        except Exception as e:  # pragma: no cover - backend-specific
-            err.append(e)
-        finally:
-            done.set()
-
-    t = threading.Thread(target=_sync, daemon=True, name=f"barrier-{tag}")
-    t.start()
-    if not done.wait(timeout):
-        raise WorkerFailure(
-            f"barrier '{tag}' timed out after {timeout:.0f}s: a worker is "
-            f"dead or hung (rank {jax.process_index()} of "
-            f"{jax.process_count()} reporting). Restart the job with "
-            "--resume to continue from the last checkpoint.")
-    if err:
-        raise WorkerFailure(f"barrier '{tag}' failed: {err[0]}")
+    # the thread-join-with-deadline lives in supervisor.run_with_deadline
+    # now (the supervisor's hung-step watchdog is this same pattern); a
+    # timeout raises WatchdogTimeout, a WorkerFailure subclass
+    from .supervisor import run_with_deadline
+    try:
+        run_with_deadline(
+            lambda: multihost_utils.sync_global_devices(tag),
+            timeout, name=f"barrier-{tag}",
+            message=(
+                f"barrier '{tag}' timed out after {timeout:.0f}s: a worker "
+                f"is dead or hung (rank {jax.process_index()} of "
+                f"{jax.process_count()} reporting). Restart the job with "
+                "--resume to continue from the last checkpoint."))
+    except WorkerFailure:
+        raise
+    except Exception as e:  # pragma: no cover - backend-specific
+        raise WorkerFailure(f"barrier '{tag}' failed: {e}")
 
 
 # ≥5-digit epochs are legal: the reference's %04d format *pads to* four

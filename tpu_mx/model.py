@@ -21,20 +21,29 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     durability manifest LAST (tpu_mx/checkpoint.py): a crash at any point
     mid-save leaves the previous epoch as the newest verified checkpoint
     instead of a truncated .params file (docs/robustness.md)."""
+    import os
     from . import checkpoint as _ckpt
     from . import telemetry as _telemetry
     with _telemetry.span("checkpoint.save_seconds"):
-        files = []
+        extra = None
         if symbol is not None:
-            symbol.save(f"{prefix}-symbol.json")
-            files.append(f"{prefix}-symbol.json")
+            sym_file = f"{prefix}-symbol.json"
+            symbol.save(sym_file)
+            # {prefix}-symbol.json is SHARED across epochs and rewritten by
+            # every save: listing it in the per-epoch manifest would flip
+            # every older epoch to "corrupt" the moment the symbol changes,
+            # defeating fall-back-to-older-epoch (gluon/block.py export
+            # excludes it for the same reason).  Its content hash at save
+            # time rides the manifest's unverified "shared" table instead,
+            # so the epoch↔symbol pairing stays auditable.
+            extra = {"shared": {os.path.basename(sym_file):
+                                _ckpt._file_entry(sym_file)}}
         save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
         save_dict.update({f"aux:{k}": v
                           for k, v in (aux_params or {}).items()})
         params = f"{prefix}-{epoch:04d}.params"
         _nd.save(params, save_dict)
-        files.append(params)
-        _ckpt.write_manifest(prefix, epoch, files)
+        _ckpt.write_manifest(prefix, epoch, [params], extra=extra)
 
 
 def load_checkpoint(prefix, epoch):

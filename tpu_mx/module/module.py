@@ -121,9 +121,19 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
+            monitor=None, supervised=None):
         """The reference's canonical training loop
-        (REF:python/mxnet/module/base_module.py fit)."""
+        (REF:python/mxnet/module/base_module.py fit).
+
+        ``supervised=`` (a ``supervisor.Supervise`` config, or a dict of
+        its kwargs) makes the loop self-healing: every epoch commits a
+        durable checkpoint under the config's prefix, each batch runs
+        under the hung-step watchdog + numeric sentinel, and transient
+        faults / divergence restart or roll back from the last verified
+        checkpoint instead of killing the job.  Returns the run's
+        ``SupervisorResult`` (None in the plain path).  Each supervised
+        batch reads back the first output for the NaN sentinel — one
+        device sync per batch, the cost of the health check."""
         assert num_epoch is not None, "num_epoch must be specified"
         initializer = initializer or _init_mod.Uniform(0.01)
         self.bind(data_shapes=train_data.provide_data,
@@ -140,15 +150,38 @@ class BaseModule:
         validation_metric = (_metric(validation_metric)
                              if validation_metric is not None else eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
+        def one_batch(data_batch):
+            self.forward_backward(data_batch)
+            self.update()
+
+        def sentinel_batch(data_batch, sup):
+            gen = sup.generation
+            self.forward_backward(data_batch)
+            # the supervisor's numeric-sentinel observable: mean of the
+            # first output (a single NaN/Inf anywhere poisons the mean).
+            # Checked BEFORE update() so a poisoned batch is genuinely
+            # skipped — its gradients never reach the weights (a NaN that
+            # appears only in the gradients still slips through; repeated
+            # divergence then triggers the rollback path).  The generation
+            # check discards a watchdog-abandoned batch that unblocks
+            # after a restore: its stale gradients must not be applied
+            # over the restored weights.
+            obs = float(np.mean(self.get_outputs()[0].asnumpy()))
+            if np.isfinite(obs) and gen == sup.generation:
+                self.update()
+            return obs
+
+        def run_epoch(epoch, sup=None):
             tic = time.time()
             eval_metric.reset()
             train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                if sup is None:
+                    one_batch(data_batch)
+                else:
+                    sup.step(lambda: sentinel_batch(data_batch, sup))
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
@@ -170,6 +203,15 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
+
+        if supervised is None:
+            for epoch in range(begin_epoch, num_epoch):
+                run_epoch(epoch)
+            return None
+        from .. import supervisor as _supervisor
+        sup = _supervisor.for_module(self, supervised)
+        return sup.run(lambda epoch: run_epoch(epoch, sup=sup),
+                       begin_epoch=begin_epoch, num_epoch=num_epoch)
 
     def install_monitor(self, monitor):
         pass

@@ -20,6 +20,7 @@ import functools
 import logging
 import os
 import re
+import threading
 import time
 
 import jax
@@ -182,6 +183,13 @@ class CompiledTrainStep:
                 out_shardings={k: ef_sh for k in shapes})
             self._efs = alloc()
         self._jitted = None
+        self._build_count = 0
+        # zombie-step guard: a watchdog-abandoned step that later finishes
+        # must not apply its (stale) result over restored state.  Restores
+        # bump _generation under _state_lock; _step commits its new state
+        # only if the generation it started under is still current.
+        self._state_lock = threading.Lock()
+        self._generation = 0
 
     # -- sharding helpers -----------------------------------------------------
     def _value_shardings(self):
@@ -215,7 +223,11 @@ class CompiledTrainStep:
     def _build(self, n_batch_args):
         # every _build is a fresh jit program (first compile, or a batch-
         # arity change invalidating the old one) — the recompile-storm
-        # signal ops dashboards watch (docs/observability.md)
+        # signal ops dashboards watch (docs/observability.md).  Counted at
+        # ENTRY so a watchdog that times out during a long compile sees
+        # the counter already moved and grants compile grace
+        # (supervisor.run_with_deadline's grace_signal).
+        self._build_count += 1
         _telemetry.counter("train_step.recompiles").inc()
         net, loss_fn, opt = self.net, self.loss_fn, self.optimizer
         diff_keys = list(self._diff_keys)
@@ -539,9 +551,53 @@ class CompiledTrainStep:
                 donate_argnums=(0, 1) if self._donate else ())
             alloc_gacc(gacc_sh)
 
-    def step(self, *batch, lr=None):
-        """Run one step; batch = (*data_args, label) as NDArray/array."""
+    @property
+    def recompiles(self):
+        """How many jit programs THIS instance has built (the global
+        recompile-storm counter is `train_step.recompiles` in telemetry)."""
+        return self._build_count
+
+    def step(self, *batch, lr=None, deadline=None, compile_grace=120.0):
+        """Run one step; batch = (*data_args, label) as NDArray/array.
+
+        ``deadline=`` arms the hung-step watchdog (tpu_mx/supervisor.py):
+        the dispatch AND the loss readback run on a daemon thread joined
+        with the deadline, so a stalled collective — which jax's async
+        dispatch would otherwise surface as an eternal hang at the first
+        device read — raises a catchable ``WatchdogTimeout``
+        (a ``WorkerFailure``) instead.  The deadline is recompile-aware:
+        when a jit (re)build starts during the step, the watchdog grants
+        ``compile_grace`` extra seconds once rather than killing a
+        legitimate compile."""
+        if deadline is not None:
+            from ..supervisor import run_with_deadline
+            gen0 = self._generation
+
+            def call():
+                loss = self._step(batch, lr, expect_gen=gen0)
+                # force the async dispatch to completion INSIDE the
+                # watchdog thread — a hung collective parks here
+                jax.block_until_ready(loss._data)
+                return loss
+
+            count0 = self._build_count
+            return run_with_deadline(
+                call, deadline, name="train_step",
+                grace=compile_grace or 0.0,
+                grace_signal=lambda: self._build_count - count0,
+                message=f"train_step hung past its {deadline:.1f}s "
+                        "deadline (stalled collective or device); restart "
+                        "from the last checkpoint")
+        return self._step(batch, lr)
+
+    def _step(self, batch, lr, expect_gen=None):
         from .. import random as _random
+        if expect_gen is None:
+            # capture at entry: even un-watchdogged calls (the supervisor's
+            # sup.step(lambda: step.step(*batch)) path runs THIS method on
+            # the watchdog thread) discard their result if a restore
+            # supersedes them mid-flight
+            expect_gen = self._generation
         t_start = time.perf_counter()
         # None batch args pass through (optional model inputs like
         # valid_length); they contribute no leaves to the jitted signature
@@ -554,26 +610,49 @@ class CompiledTrainStep:
         key = _random.take_key()
         if self._accum > 1 and self._micro < self._accum - 1:
             # microbatch: accumulate grads, no optimizer application
-            self.values, self._gacc, loss = self._accum_jit(
+            new_vals, new_gacc, loss = self._accum_jit(
                 self.values, self._gacc, key, *raw)
-            self._micro += 1
+            with self._state_lock:
+                if self._stale(expect_gen):
+                    return NDArray(loss)
+                self.values, self._gacc = new_vals, new_gacc
+                self._micro += 1
             self._record_step(raw, t_start)
             return NDArray(loss)
-        self._t += 1
-        self._micro = 0
+        t_next = self._t + 1
         if lr is None:
             sched = self.optimizer.lr_scheduler
-            lr = sched(self._t) if sched else self.optimizer.lr
+            lr = sched(t_next) if sched else self.optimizer.lr
         gacc = self._gacc if self._accum > 1 else {}
-        (self.values, self.masters, self.opt_states, self._efs, gacc,
+        (new_vals, new_masters, new_states, new_efs, gacc,
          loss) = self._jitted(
             self.values, self.masters, self.opt_states, self._efs, gacc,
-            jnp.asarray(self._t, jnp.float32), jnp.asarray(lr, jnp.float32),
+            jnp.asarray(t_next, jnp.float32), jnp.asarray(lr, jnp.float32),
             key, *raw)
-        if self._accum > 1:
-            self._gacc = gacc
+        with self._state_lock:
+            if self._stale(expect_gen):
+                return NDArray(loss)
+            (self.values, self.masters, self.opt_states,
+             self._efs) = new_vals, new_masters, new_states, new_efs
+            self._t = t_next
+            self._micro = 0
+            if self._accum > 1:
+                self._gacc = gacc
         self._record_step(raw, t_start)
         return NDArray(loss)
+
+    def _stale(self, expect_gen):
+        """True when the train state was restored (generation bumped) while
+        this step ran past its watchdog deadline on an abandoned thread —
+        the stale result must be DISCARDED, not applied over the restored
+        weights (call with _state_lock held)."""
+        if expect_gen is not None and self._generation != expect_gen:
+            _logger.warning(
+                "train_step: discarding a stale step result — the train "
+                "state was restored while this step ran past its watchdog "
+                "deadline")
+            return True
+        return False
 
     @staticmethod
     def _record_step(raw, t_start):
@@ -594,6 +673,33 @@ class CompiledTrainStep:
         checkpointing through net.save_parameters, etc.)."""
         for k, p in self._params.items():
             p._data._rebind(self.values[k])
+
+    def sync_from_net(self):
+        """Inverse of `sync_to_net`: reload the device weights from the
+        Gluon parameters — the rollback path after `elastic.auto_resume`
+        restored `net` from a checkpoint, without rebuilding the jit
+        program.  Values are COPIED (donation would otherwise delete the
+        params' live buffers on the next step), masters re-derived from
+        the restored values, and in-flight gradient accumulation dropped
+        (partial grads against the old weights are invalid).  Optimizer
+        state is deliberately kept: the Gluon net carries none — restore
+        it via `load_state_dict`/`load_checkpoint` when exactness
+        matters."""
+        values = {k: jnp.copy(p.data()._data)
+                  for k, p in self._params.items()}
+        masters = {k: values[k].astype(jnp.float32)
+                   for k in self._mp_keys}
+        if self.mesh is not None:
+            vs = self._value_shardings()
+            values = {k: jax.device_put(v, vs[k])
+                      for k, v in values.items()}
+            masters = {k: jax.device_put(v, vs[k])
+                       for k, v in masters.items()}
+        with self._state_lock:
+            self._generation += 1  # invalidate any watchdog-abandoned step
+            self.values = values
+            self.masters = masters
+            self._reset_accumulation()
 
     def aot_compiled(self, *batch):
         """Lower + compile the step WITHOUT executing it and return the
@@ -632,15 +738,17 @@ class CompiledTrainStep:
         return sd
 
     def load_state_dict(self, sd):
-        self.values = sd["values"]
-        self.masters = sd.get("masters", {})
-        self.opt_states = sd["opt_states"]
-        efs = sd.get("efs")
-        if self._efs and efs and all(k in efs and efs[k].shape == v.shape
-                                     for k, v in self._efs.items()):
-            self._efs = efs  # same dp topology; otherwise keep fresh zeros
-        self._t = sd["t"]
-        self._reset_accumulation()
+        with self._state_lock:
+            self._generation += 1  # invalidate any watchdog-abandoned step
+            self.values = sd["values"]
+            self.masters = sd.get("masters", {})
+            self.opt_states = sd["opt_states"]
+            efs = sd.get("efs")
+            if self._efs and efs and all(k in efs and efs[k].shape == v.shape
+                                         for k, v in self._efs.items()):
+                self._efs = efs  # same dp topology; else keep fresh zeros
+            self._t = sd["t"]
+            self._reset_accumulation()
 
     def _reset_accumulation(self):
         """Discard in-flight microbatch state: restored weights invalidate
@@ -802,11 +910,13 @@ class CompiledTrainStep:
                                "" if last_resort else " — falling back")
                 errors.append(f"{ap}: {type(e).__name__}: {e}")
                 continue
-            self.values = state["values"]
-            self.masters = state.get("masters", {})
-            self.opt_states = state["opt_states"]
-            self._t = int(state["t"])
-            self._reset_accumulation()
+            with self._state_lock:
+                self._generation += 1  # invalidate abandoned steps
+                self.values = state["values"]
+                self.masters = state.get("masters", {})
+                self.opt_states = state["opt_states"]
+                self._t = int(state["t"])
+                self._reset_accumulation()
             return ap
         raise MXNetError("load_checkpoint: no restorable checkpoint among "
                          + "; ".join(errors))
