@@ -85,6 +85,10 @@ KNOWN_METRICS = frozenset({
     # kvstore eager path (tpu_mx/kvstore.py)
     "kvstore.pushes", "kvstore.pulls",
     "kvstore.push_bytes", "kvstore.pull_bytes",
+    # self-healing supervisor (tpu_mx/supervisor.py)
+    "supervisor.restarts", "supervisor.rollbacks",
+    "supervisor.batches_skipped", "supervisor.watchdog_fires",
+    "supervisor.degraded",
     # fault injection (tpu_mx/contrib/chaos.py)
     "chaos.injections",
     # module-API training (tpu_mx/callback.py)
