@@ -242,6 +242,277 @@ def test_device_prefetch_iter_matches_and_casts():
     assert len(list(it)) == 4
 
 
+# ----------------------------------------------- deterministic resume -----
+# state_dict/load_state_dict round trips (docs/robustness.md): a freshly
+# constructed identical iterator, loaded with a mid-run snapshot, must
+# produce exactly the not-yet-consumed batches — and identical shuffles on
+# every later reset (the RNG stream rides the state).
+
+def _drain_batches(it):
+    """Remaining batches as comparable (data, label, pad) numpy tuples."""
+    out = []
+    while True:
+        try:
+            b = it.next()
+        except StopIteration:
+            return out
+        out.append(([d.asnumpy() for d in b.data],
+                    [l.asnumpy() for l in b.label], b.pad))
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for (da, la, pa), (db, lb, pb) in zip(a, b):
+        assert pa == pb
+        for x, y in zip(da, db):
+            np.testing.assert_array_equal(x, y)
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(x, y)
+
+
+def _epoch_sequence(it, epochs=2):
+    """`epochs` full reset+drain cycles (proves the restored RNG stream
+    reproduces future shuffles, not just the current epoch's tail)."""
+    out = []
+    for _ in range(epochs):
+        it.reset()
+        out.extend(_drain_batches(it))
+    return out
+
+
+@pytest.mark.parametrize("shuffle", [False, True])
+@pytest.mark.parametrize("lbh", ["pad", "discard", "roll_over"])
+def test_ndarrayiter_state_roundtrip_midepoch(shuffle, lbh):
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+    label = np.arange(20, dtype=np.float32)
+
+    def make():
+        return mio.NDArrayIter(data, label, batch_size=6, shuffle=shuffle,
+                               last_batch_handle=lbh, seed=3)
+
+    it = make()
+    it.reset()
+    for _ in range(2):  # consume two batches, snapshot mid-epoch
+        it.next()
+    sd = it.state_dict()
+    expect = _drain_batches(it) + _epoch_sequence(it, epochs=2)
+    it2 = make()
+    it2.load_state_dict(sd)
+    got = _drain_batches(it2) + _epoch_sequence(it2, epochs=2)
+    _assert_batches_equal(expect, got)
+
+
+def test_ndarrayiter_state_roundtrip_at_epoch_boundary():
+    """Snapshot AFTER the last batch (the per-epoch capsule point): the
+    restored iterator is exhausted, and the next reset reshuffles with
+    the exact restored stream — incl. the roll_over leftover."""
+    data = np.arange(20, dtype=np.float32).reshape(20, 1)
+    for lbh in ("pad", "roll_over"):
+        def make():
+            return mio.NDArrayIter(data, None, batch_size=6, shuffle=True,
+                                   last_batch_handle=lbh, seed=5)
+        it = make()
+        it.reset()
+        _drain_batches(it)          # consume the whole epoch
+        sd = it.state_dict()
+        expect = _epoch_sequence(it, epochs=2)
+        it2 = make()
+        it2.load_state_dict(sd)
+        assert not it2.iter_next()  # restored at the boundary: exhausted
+        got = _epoch_sequence(it2, epochs=2)
+        _assert_batches_equal(expect, got)
+
+
+def test_ndarrayiter_load_rejects_wrong_iterator_state():
+    it = mio.NDArrayIter(np.zeros((8, 2), np.float32), batch_size=4)
+    rit = mio.ResizeIter(mio.NDArrayIter(np.zeros((8, 2), np.float32),
+                                         batch_size=4), 2)
+    with pytest.raises(mx.base.MXNetError, match="captured from"):
+        it.load_state_dict(rit.state_dict())
+
+
+def test_resize_iter_state_roundtrip():
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+
+    def make():
+        return mio.ResizeIter(
+            mio.NDArrayIter(data, None, batch_size=6, shuffle=True, seed=9),
+            7)
+
+    it = make()
+    it.reset()
+    for _ in range(3):
+        it.next()
+    sd = it.state_dict()
+    expect = _drain_batches(it)
+    it2 = make()
+    it2.load_state_dict(sd)
+    _assert_batches_equal(expect, _drain_batches(it2))
+
+
+def test_libsvmiter_state_roundtrip(tmp_path):
+    path = str(tmp_path / "d.svm")
+    rng = np.random.RandomState(0)
+    with open(path, "w") as f:
+        for i in range(11):
+            feats = " ".join(f"{j}:{rng.rand():.6f}"
+                             for j in sorted(rng.choice(8, 3, replace=False)))
+            f.write(f"{i % 2} {feats}\n")
+
+    def make():
+        return mio.LibSVMIter(data_libsvm=path, data_shape=(8,),
+                              batch_size=4)
+
+    def tolist(it):
+        out = []
+        while it.iter_next():
+            out.append((it.getdata()[0].asnumpy(),
+                        it.getlabel()[0].asnumpy(), it.getpad()))
+        return out
+
+    it = make()
+    it.reset()
+    it.iter_next()  # consume one batch, snapshot mid-epoch
+    sd = it.state_dict()
+    expect = tolist(it)
+    it2 = make()
+    it2.load_state_dict(sd)
+    got = tolist(it2)
+    assert len(expect) == len(got)
+    for (da, la, pa), (db, lb, pb) in zip(expect, got):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(la, lb)
+        assert pa == pb
+
+
+def test_image_record_iter_state_roundtrip(tmp_path):
+    rec, idx = str(tmp_path / "im.rec"), str(tmp_path / "im.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(12):
+        img = (rng.rand(36, 36, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    w.close()
+
+    def make():
+        return mio.ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx, data_shape=(3, 32, 32),
+            batch_size=4, shuffle=True, rand_crop=True, rand_mirror=True,
+            preprocess_threads=2, seed=7, use_native=False)
+
+    it = make()
+    it.reset()
+    it.next()  # mid-epoch snapshot: cursor + permutation + augment RNG
+    sd = it.state_dict()
+    expect = _drain_batches(it) + _epoch_sequence(it, epochs=1)
+    it2 = make()
+    it2.load_state_dict(sd)
+    got = _drain_batches(it2) + _epoch_sequence(it2, epochs=1)
+    _assert_batches_equal(expect, got)
+    it.close()
+    it2.close()
+
+
+def test_prefetching_iter_state_roundtrip_and_inflight_not_lost():
+    data = np.arange(80, dtype=np.float32).reshape(40, 2)
+    label = np.arange(40, dtype=np.float32)
+
+    def make():
+        return mio.PrefetchingIter(
+            mio.NDArrayIter(data, label, batch_size=5, shuffle=True,
+                            seed=13))
+
+    it = make()
+    it.reset()
+    for _ in range(2):
+        it.next()
+    sd = it.state_dict()  # drain-then-snapshot pauses the worker
+    assert sd["delivered"] == 2
+    # the live iterator keeps going and LOSES NOTHING: queued batches were
+    # buffered by the snapshot, the worker resumes lazily for the rest
+    expect = _drain_batches(it)
+    assert len(expect) == 6  # 8 batches/epoch, 2 consumed
+    it2 = make()
+    it2.load_state_dict(sd)  # epoch-start state + fast-forward replay
+    _assert_batches_equal(expect, _drain_batches(it2))
+    it.close()
+    it2.close()
+
+
+def test_prefetching_iter_boundary_snapshot_needs_no_replay():
+    """An end-of-epoch snapshot (the per-epoch capsule point) stores the
+    wrapped iterators' final state directly — restore must not replay the
+    whole epoch through decode/transfer just to advance cursors."""
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+
+    def make():
+        return mio.PrefetchingIter(
+            mio.NDArrayIter(data, None, batch_size=5, shuffle=True, seed=3))
+
+    it = make()
+    it.reset()
+    _drain_batches(it)  # consume the whole epoch
+    sd = it.state_dict()
+    assert sd["delivered"] == 0 and sd["exhausted"]  # no fast-forward
+    expect = _epoch_sequence(it, epochs=2)
+    it2 = make()
+    it2.load_state_dict(sd)
+    assert not it2.iter_next()  # restored at the boundary: exhausted
+    _assert_batches_equal(expect, _epoch_sequence(it2, epochs=2))
+    it.close()
+    it2.close()
+
+
+def test_prefetching_iter_close_joins_thread():
+    """close() joins the prefetch thread even when the consumer abandons
+    the epoch with the queue full (the pre-close leak: a blocked put)."""
+    it = mio.PrefetchingIter(
+        mio.NDArrayIter(np.zeros((100, 2), np.float32), batch_size=2),
+        depth=1)
+    it.next()  # worker running, queue refilling
+    t = it._thread
+    it.close()
+    assert t is not None and not t.is_alive()
+    assert it._thread is None
+    # idempotent, and iteration reports exhaustion rather than hanging
+    it.close()
+    assert not it.iter_next()
+
+
+def test_prefetching_iter_context_manager_and_exception_join():
+    class Boom(mio.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+
+        @property
+        def provide_data(self):
+            return [mio.DataDesc("data", (2, 2))]
+
+        def iter_next(self):
+            self.n += 1
+            if self.n > 2:
+                raise RuntimeError("decode failed")
+            return True
+
+        def getdata(self):
+            return [mx.nd.zeros((2, 2))]
+
+        def getlabel(self):
+            return []
+
+    with mio.PrefetchingIter(Boom(), depth=1) as it:
+        it.next()
+        with pytest.raises(RuntimeError, match="decode failed"):
+            while True:
+                it.next()
+        thread = it._thread
+    # the with-block exit closed it: no leaked prefetch thread
+    assert it._thread is None
+    assert thread is None or not thread.is_alive()
+
+
 def test_device_prefetch_iter_mesh_sharding():
     """Meshed training feed: device= accepts a NamedSharding so batches
     arrive dp-sharded, compatible with a meshed CompiledTrainStep."""

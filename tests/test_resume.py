@@ -1,0 +1,250 @@
+"""Deterministic-resume capsules (tpu_mx/resume.py) + the mx.random state
+token API — the unit layer under tests/test_supervisor.py's bit-identical
+resume proofs (docs/robustness.md "Deterministic resume")."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import checkpoint as ckpt, elastic, nd, resume, supervisor
+from tpu_mx import telemetry
+
+
+# ---------------------------------------------------------------------------
+# mx.random: observable, restorable state
+# ---------------------------------------------------------------------------
+def test_random_state_roundtrip_replays_both_streams():
+    mx.random.seed(5)
+    tok = mx.random.get_state()
+    k1 = np.asarray(mx.random.take_key())
+    n1 = np.random.rand(4)
+    mx.random.set_state(tok)
+    np.testing.assert_array_equal(np.asarray(mx.random.take_key()), k1)
+    np.testing.assert_array_equal(np.random.rand(4), n1)
+
+
+def test_seed_returns_prior_token():
+    mx.random.seed(1)
+    a1 = np.asarray(mx.random.take_key())  # advances the stream
+    tok = mx.random.seed(999)              # the prior token: post-a1 state
+    mx.random.take_key()
+    np.random.rand(3)
+    mx.random.set_state(tok)               # back to just-after-a1
+    a2 = np.asarray(mx.random.take_key())
+    assert not np.array_equal(a1, a2)      # the stream CONTINUED, no replay
+    mx.random.seed(1)
+    np.testing.assert_array_equal(np.asarray(mx.random.take_key()), a1)
+
+
+def test_random_state_survives_json_roundtrip():
+    """A capsule serializes the token through JSON: set_state must accept
+    the decoded (list-ified) form bit-exactly."""
+    mx.random.seed(17)
+    tok = mx.random.get_state()
+    decoded = resume.decode_state(
+        json.loads(json.dumps(resume.encode_state(tok))))
+    k1 = np.asarray(mx.random.take_key())
+    n1 = np.random.rand(2)
+    mx.random.set_state(decoded)
+    np.testing.assert_array_equal(np.asarray(mx.random.take_key()), k1)
+    np.testing.assert_array_equal(np.random.rand(2), n1)
+
+
+# ---------------------------------------------------------------------------
+# encode/decode
+# ---------------------------------------------------------------------------
+def test_encode_decode_exact_arrays():
+    state = {"a": np.arange(7, dtype=np.uint32),
+             "b": [np.float64(0.1), np.array([[1.5, -2.25]], np.float32)],
+             "c": {"nested": None, "s": "x", "i": 3, "f": 0.25,
+                   "t": (1, 2)}}
+    out = resume.decode_state(json.loads(json.dumps(
+        resume.encode_state(state))))
+    np.testing.assert_array_equal(out["a"], state["a"])
+    assert out["a"].dtype == np.uint32
+    assert out["b"][0] == 0.1
+    np.testing.assert_array_equal(out["b"][1], state["b"][1])
+    assert out["b"][1].dtype == np.float32
+    assert out["c"]["nested"] is None and out["c"]["s"] == "x"
+    assert out["c"]["t"] == [1, 2]  # tuples come back as lists (documented)
+
+
+def test_encode_rejects_opaque_objects():
+    with pytest.raises(mx.base.MXNetError, match="cannot encode"):
+        resume.encode_state({"bad": object()})
+
+
+# ---------------------------------------------------------------------------
+# epoch capsules ride the manifest
+# ---------------------------------------------------------------------------
+def _net():
+    from tpu_mx.gluon import nn
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    return net
+
+
+def test_epoch_capsule_rides_verified_manifest(tmp_path):
+    prefix = str(tmp_path / "ck")
+    it = mx.io.NDArrayIter(np.zeros((8, 4), np.float32), batch_size=4,
+                           shuffle=True, seed=1)
+    mgr = resume.CapsuleManager(prefix, iters=[it])
+    elastic.save_checkpoint(prefix, 0, net=_net(), capsule=mgr)
+    cap_path = resume.capsule_path(prefix, 0)
+    assert os.path.exists(cap_path)
+    man = ckpt.read_manifest(prefix, 0)
+    assert os.path.basename(cap_path) in man["files"]
+    assert ckpt.verify_checkpoint(prefix, 0)[0] == "verified"
+    cap = resume.read_capsule(cap_path)
+    assert cap["format"] == resume.CAPSULE_FORMAT and cap["epoch"] == 0
+    # a corrupted capsule flips the epoch to corrupt — it is VERIFIED state
+    with open(cap_path, "a") as f:
+        f.write(" ")
+    status, problems = ckpt.verify_checkpoint(prefix, 0)
+    assert status == "corrupt" and any("capsule" in p for p in problems)
+
+
+def test_unknown_capsule_format_is_ignored(tmp_path):
+    path = str(tmp_path / "x-step.capsule.json")
+    with open(path, "w") as f:
+        json.dump({"format": "tpu_mx-capsule-v999", "epoch": 0}, f)
+    assert resume.read_capsule(path) is None
+
+
+def test_epoch_capsule_restores_rng_and_iterator(tmp_path):
+    prefix = str(tmp_path / "ck")
+    data = np.arange(32, dtype=np.float32).reshape(16, 2)
+
+    def make():
+        return mx.io.NDArrayIter(data, batch_size=4, shuffle=True, seed=2)
+
+    it = make()
+    mgr = resume.CapsuleManager(prefix, iters=[it])
+    mx.random.seed(3)
+    for _ in range(2):
+        it.next()
+    mx.random.take_key()
+    elastic.save_checkpoint(prefix, 0, net=_net(), capsule=mgr)
+    expect_key = np.asarray(mx.random.take_key())
+    it.reset()
+    expect = [b.data[0].asnumpy() for b in it]
+
+    # a "fresh process": different RNG position, fresh iterator
+    mx.random.seed(999)
+    it2 = make()
+    mgr2 = resume.CapsuleManager(prefix, iters=[it2])
+    assert mgr2.restore(resume_from=1) == 1
+    np.testing.assert_array_equal(np.asarray(mx.random.take_key()),
+                                  expect_key)
+    it2.reset()
+    got = [b.data[0].asnumpy() for b in it2]
+    for a, b in zip(expect, got):
+        np.testing.assert_array_equal(a, b)
+    assert telemetry.gauge("resume.resume_step_gap").value == 0
+
+
+# ---------------------------------------------------------------------------
+# step capsule: sidecar verification + fallbacks
+# ---------------------------------------------------------------------------
+class _FakeState:
+    def __init__(self):
+        self.arr = np.zeros(3, np.float32)
+        self.loaded = None
+
+    def state_dict(self):
+        return {"arr": self.arr.copy()}
+
+    def load_state_dict(self, sd):
+        self.loaded = sd["arr"]
+
+
+class _FakeSup:
+    def __init__(self, epoch=1, step=2):
+        self._epoch = epoch
+        self.step_in_epoch = step
+        self.steps = step
+        self.batches_skipped = 0
+        self._pending_resume = None
+        self.sentinel = supervisor.NumericSentinel()
+
+
+def test_step_capsule_roundtrip_and_pending_resume(tmp_path):
+    prefix = str(tmp_path / "ck")
+    it = mx.io.NDArrayIter(np.zeros((8, 2), np.float32), batch_size=4)
+    st = _FakeState()
+    st.arr[:] = 7.5
+    mgr = resume.CapsuleManager(prefix, iters=[it], state=st, interval=1)
+    sup = _FakeSup(epoch=1, step=2)
+    sup.sentinel.observe(0.5)
+    mgr.write_step(sup)
+
+    st2 = _FakeState()
+    it2 = mx.io.NDArrayIter(np.zeros((8, 2), np.float32), batch_size=4)
+    mgr2 = resume.CapsuleManager(prefix, iters=[it2], state=st2, interval=1)
+    sup2 = _FakeSup(epoch=0, step=0)
+    out = mgr2.restore(sup2, resume_from=1)
+    assert out == 1 and sup2._pending_resume == (1, 2)
+    np.testing.assert_array_equal(st2.loaded, [7.5, 7.5, 7.5])
+    assert sup2.sentinel.last_good == 0.5  # the skip ledger rode along
+    assert telemetry.gauge("resume.resume_step_gap").value == 0
+
+
+def test_torn_sidecar_falls_back_to_epoch_capsule(tmp_path):
+    prefix = str(tmp_path / "ck")
+    it = mx.io.NDArrayIter(np.zeros((8, 2), np.float32), batch_size=4)
+    st = _FakeState()
+    mgr = resume.CapsuleManager(prefix, iters=[it], state=st, interval=1)
+    mgr.write_epoch_file(0)
+    mgr.write_step(_FakeSup(epoch=1, step=3))
+    with open(resume.step_state_path(prefix), "ab") as f:
+        f.write(b"torn")  # sidecar no longer matches the capsule's sha256
+    st2 = _FakeState()
+    sup2 = _FakeSup(epoch=0, step=0)
+    mgr2 = resume.CapsuleManager(prefix, iters=[it], state=st2, interval=1)
+    out = mgr2.restore(sup2, resume_from=1)
+    assert out == 1
+    assert sup2._pending_resume is None   # epoch-boundary resume instead
+    assert st2.loaded is None             # the torn sidecar was never applied
+
+
+def test_numeric_rollback_discards_step_capsule(tmp_path):
+    prefix = str(tmp_path / "ck")
+    st = _FakeState()
+    mgr = resume.CapsuleManager(prefix, state=st, interval=1)
+    mgr.write_epoch_file(0)
+    mgr.write_step(_FakeSup(epoch=1, step=2))
+    assert os.path.exists(resume.step_capsule_path(prefix))
+    mx.random.seed(999)
+    live_key = np.asarray(mx.random.get_state()["jax_key"])
+    sup = _FakeSup(epoch=0, step=0)
+    out = mgr.restore(sup, resume_from=1, use_step=False)
+    assert out == 1 and sup._pending_resume is None
+    # the diverged trajectory's capsule is gone — it cannot resurrect
+    assert not os.path.exists(resume.step_capsule_path(prefix))
+    assert not os.path.exists(resume.step_state_path(prefix))
+    # and the epoch capsule was deliberately NOT applied: rewinding the
+    # RNG would make the retry an exact replay that re-diverges — the
+    # live stream must keep running so the retried epoch re-randomizes
+    np.testing.assert_array_equal(
+        np.asarray(mx.random.get_state()["jax_key"]), live_key)
+
+
+def test_capsule_manager_fails_fast_on_unsnapshotable_iter():
+    class NoSnap(mx.io.DataIter):
+        pass
+
+    with pytest.raises(mx.base.MXNetError, match="cannot snapshot"):
+        resume.CapsuleManager("p", iters=[NoSnap()])
+
+
+def test_resume_step_gap_reported_without_capsules(tmp_path):
+    """No epoch capsule and an unusable step capsule (no sidecar): the
+    batches the dead run consumed are unreplayable — the gauge says so."""
+    prefix = str(tmp_path / "ck")
+    mgr = resume.CapsuleManager(prefix, interval=1)  # no state object
+    mgr.write_step(_FakeSup(epoch=0, step=5))
+    out = mgr.restore(_FakeSup(epoch=0, step=0), resume_from=0)
+    assert out == 0
+    assert telemetry.gauge("resume.resume_step_gap").value == 5

@@ -7,6 +7,8 @@ spike + grad-norm detection), failure classification, rollback to the
 last *good* epoch under injected divergence (in-process AND subprocess),
 transient restarts with resume, graceful degradation, and the
 module.fit(supervised=) integration."""
+import contextlib
+import json
 import math
 import os
 import subprocess
@@ -18,7 +20,8 @@ import numpy as np
 import pytest
 
 import tpu_mx as mx
-from tpu_mx import checkpoint as ckpt, elastic, nd, supervisor, telemetry
+from tpu_mx import checkpoint as ckpt, elastic, nd, resume, supervisor, \
+    telemetry
 from tpu_mx.contrib import chaos
 from tpu_mx.gluon import nn
 
@@ -351,6 +354,133 @@ def test_module_fit_supervised_rolls_back_on_divergence(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# deterministic resume: the bit-identical proof (ISSUE 5 tentpole)
+# ---------------------------------------------------------------------------
+def _det_build(seed):
+    """Fixed-seed net + compiled step + shuffled iterator — everything a
+    run's trajectory depends on."""
+    from tpu_mx import gluon
+    from tpu_mx.parallel import CompiledTrainStep
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 4)))
+    step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mx.optimizer.create("sgd", learning_rate=0.05))
+    R = np.random.RandomState(7)
+    X = R.rand(32, 4).astype(np.float32)
+    Y = (X.sum(1) > 2).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, shuffle=True,
+                           last_batch_handle="discard", seed=seed)
+    return net, step, it
+
+
+def _det_run(prefix, crash_at=None, epochs=3):
+    net, step, it = _det_build(11)
+    mgr = resume.CapsuleManager(prefix, iters=[it], state=step, interval=1)
+    sup = supervisor.Supervisor(capsule=mgr, backoff=0.01, seed=0)
+
+    def save_fn(e):
+        step.sync_to_net()
+        elastic.save_checkpoint(prefix, e, net=net, capsule=mgr)
+
+    def restore_fn():
+        e = elastic.auto_resume(prefix, net=net)
+        step.sync_from_net()
+        return e
+
+    sup.save_fn, sup.restore_fn = save_fn, restore_fn
+    losses = {}
+
+    def epoch_fn(epoch):
+        if not sup.resume_step(epoch):
+            it.reset()
+        for batch in it:
+            def one(b=batch):
+                v = float(step.step(b.data[0], b.label[0]).asnumpy().mean())
+                losses[(epoch, sup.step_in_epoch + 1)] = v
+                return v
+            sup.step(one)
+
+    ctx = chaos.enable(crash_at_step=crash_at, seed=0) if crash_at \
+        else contextlib.nullcontext()
+    with ctx:
+        res = sup.run(epoch_fn, begin_epoch=0, num_epoch=epochs)
+    assert res.ok, res.as_dict()
+    step.sync_to_net()
+    weights = [p.data().asnumpy().copy()
+               for p in net.collect_params().values()]
+    return losses, weights, res
+
+
+def test_bit_identical_resume_after_midepoch_crash(tmp_path):
+    """THE acceptance proof: run A trains uninterrupted; run B is
+    chaos-crashed mid-epoch (after step 6 of 12 commits) and supervised-
+    resumed through the step capsule.  Their per-step loss sequences and
+    final weights must match EXACTLY — the capsule restored the RNG
+    streams, the shuffle/cursor and the mid-epoch train state, so run B
+    re-fed nothing and skipped nothing."""
+    la, wa, _ = _det_run(str(tmp_path / "a"))
+    lb, wb, rb = _det_run(str(tmp_path / "b"), crash_at=6)
+    assert rb.restarts == 1
+    assert set(la) == {(e, s) for e in range(3) for s in range(1, 5)}
+    assert la == lb  # float-exact per-step loss trajectories
+    assert wa and all(np.array_equal(a, b) for a, b in zip(wa, wb))
+    assert telemetry.gauge("resume.resume_step_gap").value == 0
+
+
+def test_chaos_crash_at_step_fires_after_commit_and_disarms():
+    sup = _sup(restore_fn=lambda: 0)
+    seen = []
+    with chaos.enable(crash_at_step=3, seed=0) as cfg:
+        res = sup.run(lambda e: [sup.step(lambda: seen.append(1) or 1.0)
+                                 for _ in range(4)], num_epoch=2)
+        assert cfg.step_crashes == 1
+    assert res.ok and res.restarts == 1
+    # the 3rd step COMMITTED before the crash (raise-after-commit), then
+    # the restart re-ran epoch 0 (no capsule manager armed here)
+    assert len(seen) == 3 + 8
+    assert telemetry.get("chaos.injections", kind="crash_step").value >= 1
+
+
+def test_module_fit_capsule_resumes_midepoch_exactly(tmp_path):
+    """module.fit(supervised=Supervise(capsule=True, capsule_interval=1))
+    crashed mid-epoch resumes at the exact batch: final params are
+    bit-identical to the uninterrupted fixed-seed fit."""
+    def fit(prefix, crash_at=None):
+        mx.random.seed(4)
+        mod = mx.module.Module(_toy_symbol(), context=[mx.cpu()])
+        X = np.random.RandomState(1).rand(16, 4).astype(np.float32)
+        Y = (X.sum(1) > 2).astype(np.float32)
+        it = mx.io.NDArrayIter(X, Y, batch_size=4, shuffle=True, seed=4,
+                               label_name="softmax_label")
+        ctx = chaos.enable(crash_at_step=crash_at, seed=0) if crash_at \
+            else contextlib.nullcontext()
+        with ctx:
+            res = mod.fit(it, num_epoch=3,
+                          optimizer_params=(("learning_rate", 0.05),
+                                            ("momentum", 0.9)),
+                          supervised=supervisor.Supervise(
+                              prefix=prefix, capsule=True,
+                              capsule_interval=1, seed=0))
+        assert res.ok, res.as_dict()
+        arg, aux = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}, res
+
+    wa, _ = fit(str(tmp_path / "a"))
+    wb, rb = fit(str(tmp_path / "b"), crash_at=6)  # epoch 1, step 2 of 4
+    assert rb.restarts == 1
+    for k in wa:
+        np.testing.assert_array_equal(wa[k], wb[k])
+    # every epoch's manifest carries its verified capsule
+    man = ckpt.read_manifest(str(tmp_path / "b"), 2)
+    assert "b-0002.capsule.json" in man["files"]
+    assert ckpt.verify_checkpoint(str(tmp_path / "b"), 2)[0] == "verified"
+    assert telemetry.gauge("resume.resume_step_gap").value == 0
+
+
+# ---------------------------------------------------------------------------
 # the subprocess rollback proof (satellite)
 # ---------------------------------------------------------------------------
 _ROLLBACK_SCRIPT = """\
@@ -537,6 +667,124 @@ def test_train_step_zombie_thread_mid_flight_restore_discarded():
     assert step._t == t_restored
     for k, v in step.values.items():
         np.testing.assert_array_equal(np.asarray(v), vals0[k])
+
+
+# ---------------------------------------------------------------------------
+# the bit-identical-resume SUBPROCESS proof (ISSUE 5 acceptance)
+# ---------------------------------------------------------------------------
+_DETERMINISM_SCRIPT = """\
+import json
+import os
+import numpy as np
+import tpu_mx as mx
+from tpu_mx import elastic, nd, resume, supervisor, gluon
+from tpu_mx.contrib import chaos
+from tpu_mx.gluon import nn
+from tpu_mx.parallel import CompiledTrainStep
+
+MODE = os.environ["DET_MODE"]          # "run" or "crash"
+prefix = os.environ["DET_PREFIX"]
+out = os.environ.get("DET_OUT", "")
+
+mx.random.seed(11)
+net = nn.HybridSequential()
+net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+net.initialize()
+net(nd.ones((1, 4)))
+step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         mx.optimizer.create("sgd", learning_rate=0.05))
+R = np.random.RandomState(7)
+X = R.rand(32, 4).astype(np.float32)
+Y = (X.sum(1) > 2).astype(np.float32)
+it = mx.io.NDArrayIter(X, Y, batch_size=8, shuffle=True,
+                       last_batch_handle="discard", seed=11)
+
+mgr = resume.CapsuleManager(prefix, iters=[it], state=step, interval=1)
+sup = supervisor.Supervisor(capsule=mgr, backoff=0.01, seed=0)
+
+def save_fn(e):
+    step.sync_to_net()
+    elastic.save_checkpoint(prefix, e, net=net, capsule=mgr)
+
+def restore_fn():
+    e = elastic.auto_resume(prefix, net=net)
+    step.sync_from_net()
+    return e
+
+sup.save_fn, sup.restore_fn = save_fn, restore_fn
+losses = {}
+
+def epoch_fn(epoch):
+    if not sup.resume_step(epoch):
+        it.reset()
+    for batch in it:
+        def one(b=batch):
+            v = float(step.step(b.data[0], b.label[0]).asnumpy().mean())
+            losses["%d:%d" % (epoch, sup.step_in_epoch + 1)] = v
+            return v
+        sup.step(one)
+
+if MODE == "crash":
+    # a TRUE mid-epoch process death: os._exit(137) right after the 6th
+    # supervised step commits (its update applied, its capsule written)
+    with chaos.enable(crash_at_step=6, hard=1, seed=0):
+        sup.run(epoch_fn, begin_epoch=0, num_epoch=3)
+    raise SystemExit("crash_at_step did not fire")
+
+res = sup.run(epoch_fn, begin_epoch=0, num_epoch=3)
+assert res.ok, res.as_dict()
+step.sync_to_net()
+np.savez(out + ".npz", **{str(i): p.data().asnumpy() for i, p in
+                          enumerate(net.collect_params().values())})
+with open(out + ".json", "w") as f:
+    json.dump(losses, f)
+print("DET DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_subprocess_bit_identical_resume(tmp_path):
+    """The headline cross-process proof: run A trains 3 epochs
+    uninterrupted.  Run B is hard-killed (os._exit) mid-epoch after step
+    6 commits; a FRESH process resumes it through the step capsule.  The
+    resumed process's first recorded step is exactly step 7 (epoch 1,
+    step 3 — nothing re-fed, nothing skipped), its per-step losses match
+    run A's bit-for-bit, and so do the final weights."""
+    script = tmp_path / "det.py"
+    script.write_text(_DETERMINISM_SCRIPT)
+    env_base = dict(os.environ)
+    env_base["PALLAS_AXON_POOL_IPS"] = ""
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base["PYTHONPATH"] = REPO + os.pathsep + env_base.get("PYTHONPATH",
+                                                              "")
+    env_base.pop("TPUMX_CHAOS", None)
+
+    def run(mode, prefix, out=""):
+        env = dict(env_base, DET_MODE=mode, DET_PREFIX=prefix, DET_OUT=out)
+        return subprocess.run([sys.executable, str(script)], text=True,
+                              capture_output=True, timeout=240, env=env)
+
+    a = run("run", str(tmp_path / "a"), str(tmp_path / "out_a"))
+    assert a.returncode == 0, a.stdout + a.stderr
+    crash = run("crash", str(tmp_path / "b"))
+    assert crash.returncode == 137, crash.stdout + crash.stderr
+    b = run("run", str(tmp_path / "b"), str(tmp_path / "out_b"))
+    assert b.returncode == 0, b.stdout + b.stderr
+
+    la = json.loads((tmp_path / "out_a.json").read_text())
+    lb = json.loads((tmp_path / "out_b.json").read_text())
+    # the resumed process recorded ONLY steps 7..12: exact-batch resume —
+    # epoch 1 steps 1-2 (committed before the kill) were never re-fed
+    assert sorted(lb) == ["1:3", "1:4", "2:1", "2:2", "2:3", "2:4"], lb
+    for k, v in lb.items():
+        assert la[k] == v, (k, la[k], v)  # bit-identical losses
+    wa = np.load(str(tmp_path / "out_a.npz"))
+    wb = np.load(str(tmp_path / "out_b.npz"))
+    for k in wa.files:
+        np.testing.assert_array_equal(wa[k], wb[k])
+    for epoch in range(3):
+        assert ckpt.verify_checkpoint(str(tmp_path / "b"),
+                                      epoch)[0] == "verified"
 
 
 def test_for_module_rollback_reloads_optimizer_states(tmp_path):

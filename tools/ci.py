@@ -133,7 +133,11 @@ OBS_REQUIRED = ("fusion.flushes", "checkpoint.save_seconds",
 # The soak tier's workload: a REAL supervised training run under a
 # fixed-seed randomized fault schedule — hang, NaN streak, crash-mid-save,
 # torn write — that must end with a verified latest checkpoint, a finite
-# loss, and every recovery path provably taken (ISSUE 4 acceptance).
+# loss, and every recovery path provably taken (ISSUE 4 acceptance) —
+# followed by the deterministic-resume leg (ISSUE 5 acceptance): a
+# capsule-enabled run chaos-crashed mid-epoch must reproduce the
+# uninterrupted run's per-step loss trajectory and final weights EXACTLY,
+# with a zero resume_step_gap.
 # The schedule is derived from TPUMX_CHAOS_SEED so a red run reproduces.
 SOAK_SCRIPT = """
 import contextlib
@@ -231,14 +235,89 @@ assert ckpt.verify_checkpoint(prefix, epoch)[0] == "verified"
 # the torn epoch is on disk but detectably corrupt (manifest caught it)
 assert ckpt.verify_checkpoint(prefix, torn_epoch)[0] == "corrupt"
 assert ckpt.newest_verified_epoch(prefix) == EPOCHS - 1
+
+# ---- deterministic-resume leg (ISSUE 5 acceptance): a chaos-crashed-
+# then-capsule-resumed run must reproduce the uninterrupted fixed-seed
+# run's per-step loss trajectory and final weights EXACTLY — not just
+# "finite and completed".  Capsules restore the RNG streams, the data
+# iterator's shuffle/cursor and the mid-epoch train state, so the
+# trajectories are compared with ==, no tolerance.
+from tpu_mx import resume as tres
+from tpu_mx import random as trandom
+
+
+def det_build(seed):
+    trandom.seed(seed)
+    n = nn.HybridSequential()
+    n.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    n.initialize()
+    n(nd.ones((1, 4)))
+    s = CompiledTrainStep(n, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.create("sgd", learning_rate=0.05))
+    it = mx.io.NDArrayIter(X, Y, batch_size=BS, shuffle=True,
+                           last_batch_handle="discard", seed=seed)
+    return n, s, it
+
+
+def det_run(tag, crash_at=None):
+    pfx = prefix + "-det-" + tag
+    net, step, it = det_build(123)
+    mgr = tres.CapsuleManager(pfx, iters=[it], state=step, interval=1)
+    det_sup = Supervisor(capsule=mgr, backoff=0.01, seed=0)
+
+    def det_save(e):
+        step.sync_to_net()
+        elastic.save_checkpoint(pfx, e, net=net, capsule=mgr)
+
+    def det_restore():
+        e = elastic.auto_resume(pfx, net=net)
+        step.sync_from_net()
+        return e
+
+    det_sup.save_fn, det_sup.restore_fn = det_save, det_restore
+    losses = {}
+
+    def det_epoch(epoch):
+        if not det_sup.resume_step(epoch):
+            it.reset()
+        for batch in it:
+            def one(b=batch):
+                v = float(step.step(b.data[0], b.label[0]).asnumpy().mean())
+                losses[(epoch, det_sup.step_in_epoch + 1)] = v
+                return v
+            det_sup.step(one)
+
+    ctx = chaos.enable(crash_at_step=crash_at, seed=SEED) if crash_at \
+        else contextlib.nullcontext()
+    with ctx:
+        r = det_sup.run(det_epoch, 0, 3)
+    assert r.ok, r.as_dict()
+    step.sync_to_net()
+    return losses, [p.data().asnumpy() for p in
+                    net.collect_params().values()], r
+
+
+det_losses_a, det_w_a, _ = det_run("a")
+det_losses_b, det_w_b, det_res_b = det_run("b", crash_at=rng.randint(5, 10))
+assert det_res_b.restarts >= 1, det_res_b.as_dict()
+assert det_losses_a == det_losses_b, (det_losses_a, det_losses_b)
+for wa, wb in zip(det_w_a, det_w_b):
+    assert np.array_equal(wa, wb), "post-recovery weights diverged"
+# the soak tier FAILS if the resume left a replay gap (must be 0 under
+# capsules — an exact-batch or exact-replay resume, never lost batches)
+assert telemetry.get("resume.resume_step_gap").value == 0
+print("SOAK deterministic-resume leg OK", flush=True)
 telemetry.flush(final=True)
 print("SOAK OK", flush=True)
 """
 
-# "supervisor" is a telemetry_report require-preset expanding to the
+# "supervisor" / "resume" are telemetry_report require-presets: the
 # supervisor recovery counters (restarts/rollbacks/watchdog_fires/
 # batches_skipped — the degraded gauge is rightly 0 on a healthy soak)
-SOAK_REQUIRED = ("supervisor", "chaos.injections",
+# and the deterministic-resume counters (capsules written + a restore
+# that actually went through the capsule path; the resume_step_gap
+# gauge must be 0 and is asserted inside the soak script itself)
+SOAK_REQUIRED = ("supervisor", "resume", "chaos.injections",
                  "checkpoint.corrupt_detected", "train_step.steps")
 
 

@@ -36,11 +36,16 @@ import sys
 
 # --require presets: one token → a metric family.  "supervisor" gates the
 # soak tier: every recovery path must have actually fired (the degraded
-# gauge is deliberately absent — it is 0 on any healthy run).
+# gauge is deliberately absent — it is 0 on any healthy run).  "resume"
+# gates the deterministic-resume leg: capsules were written AND a restore
+# actually went through the capsule path (resume_step_gap is deliberately
+# absent — it must be 0 under capsules, asserted in the soak script).
 REQUIRE_PRESETS = {
     "supervisor": ("supervisor.restarts", "supervisor.rollbacks",
                    "supervisor.watchdog_fires",
                    "supervisor.batches_skipped"),
+    "resume": ("resume.capsules_written",
+               "resume.capsule_restore_seconds"),
 }
 
 

@@ -60,6 +60,7 @@ from . import engine
 from . import layout
 from . import checkpoint
 from . import elastic
+from . import resume
 from . import supervisor
 from . import operator
 from . import rtc

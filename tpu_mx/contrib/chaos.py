@@ -32,6 +32,12 @@ Injection kinds (all one process, no root, no LD_PRELOAD):
   (default 3600 — "forever" at test scale) before running, simulating a
   stalled collective/compile; the supervisor's hung-step watchdog must
   convert it into a catchable ``WorkerFailure``.  One-shot.
+- ``crash_at_step=N``: raise :class:`ChaosCrash` (or ``os._exit(137)``
+  with ``hard=1``) immediately AFTER the Nth supervised step *commits* —
+  its update applied and its capsule (tpu_mx/resume.py) written — the
+  mid-epoch process death the deterministic-resume proof provokes: a
+  capsule resume must continue at batch N+1 with the exact RNG stream,
+  never re-feeding batch N.  One-shot.
 - ``match=SUBSTR``: scope file-level faults to paths containing SUBSTR
   (e.g. ``match=.params`` tears the params file but not the manifest).
 
@@ -64,7 +70,7 @@ from .. import telemetry as _telemetry
 
 __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
            "wrap_file", "maybe_oserror", "peer_killed", "poison_loss",
-           "maybe_hang"]
+           "maybe_hang", "maybe_crash_step"]
 
 
 def _count_injection(kind):
@@ -88,12 +94,13 @@ class ChaosCrash(Exception):
 class _Config:
     _KINDS = ("crash_after_bytes", "torn_write", "slow_io",
               "transient_oserror", "kill_peer", "nan_after", "nan_streak",
-              "hang_step", "hang_seconds", "seed", "hard", "match")
+              "hang_step", "hang_seconds", "crash_at_step", "seed", "hard",
+              "match")
 
     def __init__(self, crash_after_bytes=None, torn_write=None, slow_io=None,
                  transient_oserror=0, kill_peer=False, nan_after=None,
                  nan_streak=1, hang_step=None, hang_seconds=3600.0,
-                 seed=None, hard=False, match=None):
+                 crash_at_step=None, seed=None, hard=False, match=None):
         if seed is None:
             seed = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
         self.crash_after_bytes = crash_after_bytes
@@ -105,6 +112,8 @@ class _Config:
         self.nan_streak = max(1, int(nan_streak))
         self.hang_step = None if hang_step is None else int(hang_step)
         self.hang_seconds = float(hang_seconds)
+        self.crash_at_step = None if crash_at_step is None \
+            else int(crash_at_step)
         self.seed = seed
         self.hard = bool(hard)
         self.match = match
@@ -118,8 +127,10 @@ class _Config:
         self.oserrors_fired = 0
         self.losses_seen = 0         # losses observed while nan_after armed
         self.steps_seen = 0          # steps observed while hang_step armed
+        self.commits_seen = 0        # committed steps while crash_at_step armed
         self.nans_fired = 0
         self.hangs = 0
+        self.step_crashes = 0
 
     def matches(self, path):
         return self.match is None or (path is not None
@@ -318,6 +329,33 @@ def poison_loss(value):
                 cfg.nan_after = None  # streak complete: disarm
             return float("nan")
     return value
+
+
+def maybe_crash_step():
+    """Raise :class:`ChaosCrash` after the Nth supervised step COMMITS —
+    the supervisor calls this right after a step's update and its capsule
+    write have both landed (``crash_at_step``).  Counting starts when the
+    fault is armed; one-shot, so the recovered run completes.  With
+    ``hard=1`` it is ``os._exit(137)`` — a true mid-epoch process death
+    for the cross-process deterministic-resume proof."""
+    cfg = _config
+    if cfg is None or cfg.crash_at_step is None:
+        return
+    with cfg.lock:
+        if cfg.crash_at_step is None:
+            return
+        cfg.commits_seen += 1
+        if cfg.commits_seen < cfg.crash_at_step:
+            return
+        cfg.crash_at_step = None  # one-shot: the resumed run finishes
+        cfg.step_crashes += 1
+        _count_injection("crash_step")
+        if cfg.hard:  # pragma: no cover - exercised via subprocess
+            os._exit(137)
+    raise ChaosCrash(
+        "chaos: simulated process death after supervised step "
+        f"{cfg.commits_seen} committed (crash_at_step fired) — resume "
+        "must continue at the NEXT batch with the exact RNG stream")
 
 
 def maybe_hang():

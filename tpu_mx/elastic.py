@@ -239,7 +239,7 @@ def auto_resume(prefix, net=None, module=None, trainer=None):
 
 
 def save_checkpoint(prefix, epoch, net=None, trainer=None, keep_last=None,
-                    attempts=4):
+                    attempts=4, capsule=None):
     """Durable counterpart of `auto_resume`: write the epoch's params (and
     trainer states) atomically, commit the manifest LAST, then apply
     retention.
@@ -249,6 +249,11 @@ def save_checkpoint(prefix, epoch, net=None, trainer=None, keep_last=None,
     the commit point, so a crash anywhere mid-save leaves the previous
     epoch as the newest *verified* checkpoint.  `keep_last=K` prunes older
     epochs (never the newest verified one).  Returns the params path.
+
+    `capsule=` (a `resume.CapsuleManager`) additionally commits the
+    epoch's training-state capsule — RNG streams + data-iterator cursors
+    (docs/robustness.md "Deterministic resume") — INSIDE the manifest, so
+    the capsule is size+sha256 verified with the checkpoint it belongs to.
 
     Module users: `module.save_checkpoint(prefix, epoch)` commits its own
     manifest through `model.save_checkpoint` — this helper is the Gluon
@@ -268,6 +273,9 @@ def save_checkpoint(prefix, epoch, net=None, trainer=None, keep_last=None,
             _ckpt.retry(lambda: trainer.save_states(states),
                         attempts=attempts)
             files.append(states)
+        if capsule is not None:
+            files.append(_ckpt.retry(
+                lambda: capsule.write_epoch_file(epoch), attempts=attempts))
         _ckpt.retry(lambda: _ckpt.write_manifest(prefix, epoch, files),
                     attempts=attempts)
         if keep_last:

@@ -15,10 +15,20 @@ TPU-first notes: iterators produce host numpy batches; transfer happens once
 per batch via ``nd.array`` (→ ``jax.device_put``), and ``PrefetchingIter``
 keeps the next batch decoding while the current one trains — the same
 pipeline shape the reference builds with dmlc::ThreadedIter.
+
+Deterministic resume (docs/robustness.md): every iterator here implements the
+``state_dict() / load_state_dict()`` protocol — epoch cursor, shuffle
+permutation and private RNG state — so a training-state capsule
+(`tpu_mx/resume.py`) can restore the data stream to the exact next batch
+after a crash instead of silently resetting it.  The reference had no analog
+(its `do_checkpoint` was epoch-granular and stateless about data;
+docs/DIVERGENCES.md #25).
 """
 from __future__ import annotations
 
+import copy as _copy
 import gzip
+import logging
 import os
 import queue
 import struct
@@ -27,6 +37,24 @@ from collections import namedtuple
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+
+def _np_rng_tuple(state):
+    """Normalize a (possibly JSON-round-tripped) numpy RandomState token
+    back into the exact tuple ``set_state`` wants — list elements become
+    the MT19937 array / ints / float they were."""
+    return (str(state[0]), np.asarray(state[1], dtype=np.uint32),
+            int(state[2]), int(state[3]), float(state[4]))
+
+
+def _check_state(state, cls_name):
+    got = state.get("iter") if isinstance(state, dict) else None
+    if got != cls_name:
+        raise MXNetError(
+            f"load_state_dict: state was captured from {got!r}, "
+            f"not {cls_name!r} — resume must reconstruct the same pipeline")
 
 from ..base import MXNetError, check
 from .. import ndarray as nd
@@ -62,7 +90,9 @@ class DataBatch:
 
 class DataIter:
     """Iterator protocol (reset / next / iter_next / getdata / getlabel /
-    getpad), identical surface to the reference's DataIter."""
+    getpad), identical surface to the reference's DataIter — plus the
+    resume protocol (``state_dict``/``load_state_dict``) and lifecycle
+    (``close``, context-manager) this framework adds."""
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -72,6 +102,32 @@ class DataIter:
 
     def reset(self):
         pass
+
+    # -- deterministic-resume protocol (docs/robustness.md) -------------
+    def state_dict(self):
+        """Snapshot of the iterator's position/RNG, taken BETWEEN batches.
+        Loading it into a freshly constructed identical iterator makes it
+        produce exactly the not-yet-consumed batches (and identical
+        shuffles on later resets)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement state_dict — "
+            "deterministic resume is unavailable for this iterator")
+
+    def load_state_dict(self, state):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement load_state_dict")
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self):
+        """Release background resources (threads, file handles).
+        Idempotent; the base iterator holds none."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def next(self):
         if self.iter_next():
@@ -198,6 +254,26 @@ class NDArrayIter(DataIter):
         self.cursor = n
         return True
 
+    def state_dict(self):
+        """Position + this epoch's permutation + the private RNG stream
+        (the data itself is reconstructed by the constructor)."""
+        return {"iter": type(self).__name__, "version": 1,
+                "cursor": int(self.cursor),
+                "idx": np.asarray(self.idx).copy(),
+                "leftover": (None if self._leftover is None
+                             else np.asarray(self._leftover).copy()),
+                "rng": self._rng.get_state()}
+
+    def load_state_dict(self, state):
+        _check_state(state, type(self).__name__)
+        self.idx = np.asarray(state["idx"], dtype=np.intp)
+        self.cursor = int(state["cursor"])
+        lo = state.get("leftover")
+        self._leftover = None if lo is None else np.asarray(lo, dtype=np.intp)
+        self._rng.set_state(_np_rng_tuple(state["rng"]))
+        self._sel = None
+        self._pad = 0
+
     def _take(self, arrs):
         return [nd.array(v[self._sel]) for _, v in arrs]
 
@@ -236,6 +312,19 @@ class ResizeIter(DataIter):
         if self.reset_internal:
             self.data_iter.reset()
 
+    def state_dict(self):
+        return {"iter": "ResizeIter", "version": 1, "cur": int(self.cur),
+                "internal": self.data_iter.state_dict()}
+
+    def load_state_dict(self, state):
+        _check_state(state, "ResizeIter")
+        self.cur = int(state["cur"])
+        self.data_iter.load_state_dict(state["internal"])
+        self.current_batch = None
+
+    def close(self):
+        self.data_iter.close()
+
     def iter_next(self):
         if self.cur == self.size:
             return False
@@ -259,7 +348,20 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Runs the wrapped iterator(s) on a background thread with a bounded
-    queue — REF:src/io/iter_prefetcher.h's double buffering, host-side."""
+    queue — REF:src/io/iter_prefetcher.h's double buffering, host-side.
+
+    Lifecycle: the prefetch thread's queue puts are stop-aware, so
+    ``close()`` (or leaving a ``with`` block, or ``reset``) always joins
+    the thread — a crashed epoch can no longer leak a prefetch thread
+    blocked on a full queue past supervisor degrade.
+
+    Resume: ``state_dict()`` drains the worker first (already-produced
+    batches stay buffered for the live consumer and are *re-produced* on
+    restore — nothing in flight is lost) and records the wrapped
+    iterators' epoch-start state plus how many batches the consumer has
+    taken; ``load_state_dict`` restores the epoch-start state and
+    fast-forwards that many batches, which is exact because the wrapped
+    iterators are deterministic under their restored RNG state."""
 
     def __init__(self, iters, depth=2):
         if not isinstance(iters, (list, tuple)):
@@ -269,6 +371,10 @@ class PrefetchingIter(DataIter):
         self.depth = depth
         self._queue = None
         self._thread = None
+        self._buffered = []      # drained-but-undelivered queue items
+        self._delivered = 0      # batches handed to the consumer this epoch
+        self._exhausted = False
+        self._epoch_state = self._capture_epoch_state()
         self._start()
 
     @property
@@ -279,22 +385,44 @@ class PrefetchingIter(DataIter):
     def provide_label(self):
         return sum([i.provide_label for i in self.iters], [])
 
+    def _capture_epoch_state(self):
+        try:
+            return [it.state_dict() for it in self.iters]
+        except NotImplementedError:
+            return None  # wrapped iter can't snapshot: resume unavailable
+
     def _start(self):
         self._queue = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
-        self._exhausted = False
+        self._overflow = []  # item in the worker's hand when a stop landed
+        stop, q, overflow = self._stop, self._queue, self._overflow
+
+        def put(item):
+            # stop-aware put: a full queue never wedges the worker past a
+            # close()/reset().  An already-produced item must not be
+            # dropped though — the wrapped iterator advanced past it — so
+            # a stopped handoff stashes it for _pause to collect.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            overflow.append(item)
+            return False
 
         def worker():
             try:
-                while not self._stop.is_set():
+                while not stop.is_set():
                     batches = []
                     for it in self.iters:
                         batches.append(it.next())
-                    self._queue.put(self._transform(batches))
+                    if not put(self._transform(batches)):
+                        return
             except StopIteration:
-                self._queue.put(None)
+                put(None)
             except Exception as e:  # surface errors on the consumer side
-                self._queue.put(e)
+                put(e)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -304,29 +432,151 @@ class PrefetchingIter(DataIter):
         (DevicePrefetchIter stages batches onto the device here)."""
         return batches
 
-    def reset(self):
-        self._stop.set()
+    def _drain(self):
+        if self._queue is None:
+            return
         try:
             while True:
-                self._queue.get_nowait()
+                self._buffered.append(self._queue.get_nowait())
         except queue.Empty:
             pass
-        self._thread.join(timeout=5)
+
+    def _pause(self, timeout=None, detach=False):
+        """Stop and join the prefetch thread, preserving already-produced
+        items in order.  Returns True when the thread is down.
+
+        On timeout (wrapped iterator wedged inside ``next()``): with
+        ``detach=True`` the daemon thread is abandoned — it exits on its
+        own once the blocked call returns, because its stop flag is set
+        and its queue/overflow are orphaned with it — else the caller
+        decides (``state_dict`` raises rather than race a live worker)."""
+        t = self._thread
+        if t is None:
+            return True
+        self._stop.set()
+        import time as _time
+        deadline = None if timeout is None else _time.time() + timeout
+        while t.is_alive():
+            self._drain()  # unblock a put-in-progress
+            t.join(timeout=0.1)
+            if deadline is not None and _time.time() > deadline:
+                _logger.warning(
+                    "PrefetchingIter: prefetch thread did not stop within "
+                    "%.1fs (wrapped iterator blocked?)%s", timeout,
+                    " — abandoning the daemon thread" if detach else "")
+                if detach:
+                    self._thread = None
+                    self._queue = None
+                return False
+        self._drain()
+        # queued items were produced before the worker's in-hand one, so
+        # the overflow goes last — order preserved for the live consumer
+        self._buffered.extend(self._overflow)
+        self._overflow = []
+        self._thread = None
+        self._queue = None
+        return True
+
+    def _pause_for_snapshot(self):
+        """Bounded pause for state_dict/load_state_dict: a wedged worker
+        must surface as a loud error, not an eternal hang (the supervisor
+        watchdog does not wrap capsule writes) and must never race the
+        restore's own use of the wrapped iterators."""
+        if not self._pause(timeout=30.0):
+            raise MXNetError(
+                "PrefetchingIter: prefetch worker did not stop within 30s "
+                "(wrapped iterator wedged in next()) — cannot snapshot or "
+                "restore while it may still be advancing the stream")
+
+    def close(self):
+        """Join the background prefetch thread and close the wrapped
+        iterators.  Idempotent; also runs on ``with``-block exit so an
+        exception unwinding the training loop cannot leak the thread."""
+        self._pause(timeout=10.0, detach=True)
+        self._buffered = []
+        self._exhausted = True
+        for it in self.iters:
+            it.close()
+
+    def __del__(self):  # best effort — close() is the contract
+        try:
+            self._pause(timeout=0.5)
+        except BaseException:
+            pass
+
+    def reset(self):
+        # bounded, as the pre-close()-era join was: a wedged worker is
+        # detached (its stop flag is set; it exits when next() returns)
+        # rather than hanging the training loop's epoch boundary forever
+        self._pause(timeout=10.0, detach=True)
+        self._buffered = []
         for it in self.iters:
             it.reset()
+        self._delivered = 0
+        self._exhausted = False
+        self._epoch_state = self._capture_epoch_state()
         self._start()
+
+    def state_dict(self):
+        """Drain-then-snapshot: pause the worker (queued batches stay
+        buffered for the live consumer — not lost, and re-produced on
+        restore since they were never delivered), then record epoch-start
+        state + delivered count.  The worker restarts lazily on the next
+        ``iter_next``."""
+        if self._epoch_state is None:
+            raise NotImplementedError(
+                "PrefetchingIter: wrapped iterator(s) do not implement "
+                "state_dict — deterministic resume unavailable")
+        self._pause_for_snapshot()
+        if self._exhausted and not self._buffered:
+            # epoch boundary (the per-epoch capsule point): the worker has
+            # exited and nothing is in flight, so the wrapped iterators'
+            # CURRENT state is exact — store it with delivered=0 and spare
+            # the restore a whole epoch of fast-forward decode/replay
+            return {"iter": type(self).__name__, "version": 1,
+                    "delivered": 0, "exhausted": True,
+                    "iters": [it.state_dict() for it in self.iters]}
+        return {"iter": type(self).__name__, "version": 1,
+                "delivered": int(self._delivered),
+                "exhausted": bool(self._exhausted),
+                "iters": _copy.deepcopy(self._epoch_state)}
+
+    def load_state_dict(self, state):
+        _check_state(state, type(self).__name__)
+        self._pause_for_snapshot()
+        self._buffered = []
+        for it, s in zip(self.iters, state["iters"]):
+            it.load_state_dict(s)
+        self._epoch_state = _copy.deepcopy(state["iters"])
+        delivered = int(state.get("delivered", 0))
+        for _ in range(delivered):
+            # fast-forward replay: the wrapped iterators deterministically
+            # re-produce (and we discard) the batches the consumer already
+            # trained on, landing the stream on the exact next batch
+            for it in self.iters:
+                it.next()
+        self._delivered = delivered
+        self._exhausted = bool(state.get("exhausted", False))
+        # worker restarts lazily on the next iter_next
 
     def iter_next(self):
         if self._exhausted:  # worker exited; a blocking get() would hang
             return False
-        item = self._queue.get()
+        if self._buffered:
+            item = self._buffered.pop(0)
+        else:
+            if self._thread is None:
+                self._start()  # paused by a snapshot/restore: resume
+            item = self._queue.get()
         if item is None:
             self._exhausted = True
             return False
         if isinstance(item, Exception):
             self._exhausted = True
+            self._pause(timeout=5.0)  # the worker already exited: join it
             raise item
         self._batches = item
+        self._delivered += 1
         return True
 
     def next(self):
@@ -517,6 +767,15 @@ class LibSVMIter(DataIter):
 
     def reset(self):
         self._cursor = 0
+        self._pad = 0
+
+    def state_dict(self):
+        return {"iter": "LibSVMIter", "version": 1,
+                "cursor": int(self._cursor)}
+
+    def load_state_dict(self, state):
+        _check_state(state, "LibSVMIter")
+        self._cursor = int(state["cursor"])
         self._pad = 0
 
     def iter_next(self):
@@ -715,6 +974,47 @@ class ImageRecordIter(DataIter):
             self.rng.shuffle(self._order)
         self._cursor = 0
         self._pending = []
+
+    def state_dict(self):
+        """Epoch cursor + shuffle permutation + augmentation RNG state.
+        Python pipeline only: the native C++ pipe keeps its cursors and
+        per-thread RNGs internal — construct with ``use_native=False``
+        when deterministic resume matters (docs/robustness.md)."""
+        if self._native is not None:
+            raise NotImplementedError(
+                "ImageRecordIter: state_dict is unsupported on the native "
+                "pipeline (internal decode-thread cursors) — pass "
+                "use_native=False for deterministic resume")
+        return {"iter": type(self).__name__, "version": 1,
+                "cursor": int(self._cursor),
+                "order": [int(i) for i in self._order],
+                "rng": self.rng.get_state()}
+
+    def load_state_dict(self, state):
+        _check_state(state, type(self).__name__)
+        if self._native is not None:
+            raise NotImplementedError(
+                "ImageRecordIter: load_state_dict is unsupported on the "
+                "native pipeline — pass use_native=False")
+        self._order = [int(i) for i in state["order"]]
+        self._cursor = int(state["cursor"])
+        self._pad = 0
+        self._pending = []
+        self.rng.set_state(_np_rng_tuple(state["rng"]))
+
+    def close(self):
+        """Shut down the decode pool and release the record reader."""
+        if self._native is not None:
+            return  # the native pipe owns its threads for its lifetime
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=True)
+        rec = getattr(self, "_rec", None)
+        if rec is not None:
+            try:
+                rec.close()
+            except Exception:  # already closed
+                pass
 
     def _read_raw(self, key):
         from ..recordio import MXIndexedRecordIO

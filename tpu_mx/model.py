@@ -16,11 +16,15 @@ from .module.module import BatchEndParam  # re-export (reference parity)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    remove_amp_cast=True):
+                    remove_amp_cast=True, extra_files=()):
     """Write the epoch's symbol + params atomically, then commit the
     durability manifest LAST (tpu_mx/checkpoint.py): a crash at any point
     mid-save leaves the previous epoch as the newest verified checkpoint
-    instead of a truncated .params file (docs/robustness.md)."""
+    instead of a truncated .params file (docs/robustness.md).
+
+    ``extra_files`` — already-atomically-written sidecars (e.g. the
+    epoch's training-state capsule, tpu_mx/resume.py) to fold into the
+    manifest's verified file table before the commit."""
     import os
     from . import checkpoint as _ckpt
     from . import telemetry as _telemetry
@@ -43,7 +47,8 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                           for k, v in (aux_params or {}).items()})
         params = f"{prefix}-{epoch:04d}.params"
         _nd.save(params, save_dict)
-        _ckpt.write_manifest(prefix, epoch, [params], extra=extra)
+        _ckpt.write_manifest(prefix, epoch, [params, *extra_files],
+                             extra=extra)
 
 
 def load_checkpoint(prefix, epoch):

@@ -174,7 +174,11 @@ class BaseModule:
         def run_epoch(epoch, sup=None):
             tic = time.time()
             eval_metric.reset()
-            train_data.reset()
+            if sup is None or not sup.resume_step(epoch):
+                # a mid-epoch capsule restore repositioned train_data at
+                # the exact next batch — resetting would re-feed the
+                # epoch head (docs/robustness.md "Deterministic resume")
+                train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
@@ -209,7 +213,7 @@ class BaseModule:
                 run_epoch(epoch)
             return None
         from .. import supervisor as _supervisor
-        sup = _supervisor.for_module(self, supervised)
+        sup = _supervisor.for_module(self, supervised, train_data=train_data)
         return sup.run(lambda epoch: run_epoch(epoch, sup=sup),
                        begin_epoch=begin_epoch, num_epoch=num_epoch)
 
@@ -471,10 +475,15 @@ class Module(BaseModule):
         eval_metric.update(labels, self.get_outputs())
 
     # -- checkpoint ---------------------------------------------------------
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        extra_files=()):
+        """``extra_files`` — already-written sidecar files (e.g. a
+        training-state capsule) to list in the epoch's manifest so they
+        are verified with the checkpoint."""
         from ..model import save_checkpoint
         arg, aux = self.get_params()
-        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux,
+                        extra_files=extra_files)
         if save_optimizer_states:
             from ..checkpoint import update_manifest
             states = f"{prefix}-{epoch:04d}.states"
@@ -626,6 +635,8 @@ class BucketingModule(BaseModule):
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         self._curr_module.update_metric(eval_metric, labels)
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        extra_files=()):
         self._curr_module.save_checkpoint(prefix, epoch,
-                                          save_optimizer_states)
+                                          save_optimizer_states,
+                                          extra_files=extra_files)

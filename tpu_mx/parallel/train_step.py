@@ -738,17 +738,29 @@ class CompiledTrainStep:
         return sd
 
     def load_state_dict(self, sd):
+        """Restore a `state_dict` snapshot.  Host-numpy leaves (a snapshot
+        that round-tripped through a resume capsule's pickled sidecar,
+        tpu_mx/resume.py) are accepted and placed back on device —
+        deterministic resume depends on this path restoring t, optimizer
+        state and weights bit-exactly."""
+        def dev(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x) if isinstance(x, np.ndarray)
+                else x, tree)
+
         with self._state_lock:
             self._generation += 1  # invalidate any watchdog-abandoned step
-            self.values = sd["values"]
-            self.masters = sd.get("masters", {})
-            self.opt_states = sd["opt_states"]
-            efs = sd.get("efs")
+            self.values = dev(sd["values"])
+            self.masters = dev(sd.get("masters", {}))
+            self.opt_states = dev(sd["opt_states"])
+            efs = dev(sd.get("efs") or {})
             if self._efs and efs and all(k in efs and efs[k].shape == v.shape
                                          for k, v in self._efs.items()):
                 self._efs = efs  # same dp topology; else keep fresh zeros
-            self._t = sd["t"]
+            self._t = int(sd["t"])
             self._reset_accumulation()
+        if self.mesh is not None:
+            self.place()  # host-restored leaves need their mesh shardings
 
     def _reset_accumulation(self):
         """Discard in-flight microbatch state: restored weights invalidate

@@ -5,6 +5,20 @@ The reference keeps per-device sampler states inside the ResourceManager
 eager mode; inside a `hybridize()` trace the active `KeyHolder` (installed by
 Block.apply) supplies *traced* subkeys so compiled graphs stay pure and
 reproducible — keys become explicit step-function inputs, the XLA-correct way.
+
+State is DATA (docs/robustness.md "Deterministic resume"): the stream is
+observable and restorable, not just reseedable.  :func:`get_state` returns an
+opaque token covering BOTH generators the framework draws from — the global
+JAX key and numpy's global state — and :func:`set_state` restores them
+bit-exactly, which is what lets a training-state capsule (`tpu_mx/resume.py`)
+make a crash-recovered run replay the exact RNG stream of the run that died.
+:func:`seed` returns the prior token so tests (and capsule writers) can
+save/restore the stream around themselves.
+
+The global key is genuinely process-global (one lock-guarded stream): a step
+function running on a watchdog daemon thread (`supervisor.run_with_deadline`)
+draws from the SAME stream the main thread would — a thread-local key would
+silently hand every watchdog thread its own fresh `PRNGKey(0)` replay.
 """
 from __future__ import annotations
 
@@ -13,11 +27,13 @@ import threading
 
 import jax
 
-__all__ = ["seed", "take_key", "KeyHolder", "key_scope"]
+__all__ = ["seed", "get_state", "set_state", "take_key", "KeyHolder",
+           "key_scope"]
 
 
-class _GlobalRNG(threading.local):
+class _GlobalRNG:
     def __init__(self):
+        self.lock = threading.Lock()
         self.key = jax.random.PRNGKey(0)
 
 
@@ -52,8 +68,39 @@ def take_key():
     holder = getattr(_HOLDER, "holder", None)
     if holder is not None:
         return holder.take()
-    _GLOBAL.key, sub = jax.random.split(_GLOBAL.key)
+    with _GLOBAL.lock:
+        _GLOBAL.key, sub = jax.random.split(_GLOBAL.key)
     return sub
+
+
+def get_state():
+    """Snapshot BOTH framework RNG streams as an opaque, picklable token.
+
+    Covers the global JAX key (device sampling — ``nd.random.*``, on-device
+    init, the compiled train step's per-step subkeys) and numpy's global
+    state (host-path initializers and any ``np.random``-backed iterator).
+    Per-iterator private ``RandomState``s are NOT included — each
+    ``DataIter.state_dict()`` carries its own.  Pass the token to
+    :func:`set_state` to restore the streams bit-exactly."""
+    import numpy as _np
+    with _GLOBAL.lock:
+        key = _np.asarray(_GLOBAL.key)
+    return {"jax_key": key, "numpy": _np.random.get_state()}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` / :func:`seed` token.
+
+    Tolerant of JSON round-trips (lists where the token had arrays/tuples):
+    a capsule that serialized the token can hand it straight back."""
+    import numpy as _np
+    key = _np.asarray(state["jax_key"], dtype=_np.uint32)
+    st = state["numpy"]
+    np_state = (str(st[0]), _np.asarray(st[1], dtype=_np.uint32),
+                int(st[2]), int(st[3]), float(st[4]))
+    with _GLOBAL.lock:
+        _GLOBAL.key = jax.numpy.asarray(key)
+    _np.random.set_state(np_state)
 
 
 def seed(seed_state, ctx="all"):
@@ -64,7 +111,18 @@ def seed(seed_state, ctx="all"):
     global state (the host-path initializers, e.g. Orthogonal/Bilinear,
     sample from np.random the way the reference's initializers sample
     from its own engine RNG — one seed call must make either path
-    deterministic)."""
+    deterministic).
+
+    Returns the PRIOR state token (see :func:`get_state`) so a caller can
+    save/restore the streams around itself::
+
+        tok = mx.random.seed(7)
+        ... deterministic block ...
+        mx.random.set_state(tok)        # outer stream continues untouched
+    """
     import numpy as _np
-    _GLOBAL.key = jax.random.PRNGKey(int(seed_state))
+    prior = get_state()
+    with _GLOBAL.lock:
+        _GLOBAL.key = jax.random.PRNGKey(int(seed_state))
     _np.random.seed(int(seed_state) % (2 ** 32))
+    return prior

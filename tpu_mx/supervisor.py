@@ -204,6 +204,21 @@ class NumericSentinel:
         self._recent.clear()
         self._consecutive_bad = 0
 
+    def state_dict(self):
+        """The skip ledger — capsules carry it (docs/robustness.md
+        "Deterministic resume") so a resumed run's spike baseline and
+        bad-streak position match the uninterrupted run's."""
+        return {"recent": [float(v) for v in self._recent],
+                "consecutive_bad": int(self._consecutive_bad),
+                "last_good": self.last_good}
+
+    def load_state_dict(self, state):
+        self._recent.clear()
+        self._recent.extend(float(v) for v in state.get("recent", ()))
+        self._consecutive_bad = int(state.get("consecutive_bad", 0))
+        lg = state.get("last_good")
+        self.last_good = None if lg is None else float(lg)
+
     def _why_bad(self, loss, grad_norm):
         if loss is not None and not math.isfinite(loss):
             return f"loss={loss}"
@@ -314,14 +329,22 @@ class Supervisor:
     ``deadline``/``compile_grace`` arm the hung-step watchdog (None = off).
     ``max_restarts``/``max_rollbacks`` bound the whole ``run()``;
     exhaustion degrades gracefully instead of looping forever.  See the
-    module docstring for the failure classification."""
+    module docstring for the failure classification.
+
+    ``capsule`` (a ``resume.CapsuleManager``) makes recovery
+    *deterministic* (docs/robustness.md "Deterministic resume"): every
+    epoch save also commits a training-state capsule (RNG streams, data
+    cursors, sentinel ledger) and, when the manager has a step interval,
+    a rolling mid-epoch step capsule — restarts and rollbacks then resume
+    at the exact batch with the exact RNG stream instead of re-feeding or
+    skipping data."""
 
     def __init__(self, save_fn=None, restore_fn=None, *, deadline=None,
                  compile_grace=120.0, max_restarts=3, max_rollbacks=3,
                  skip_limit=2, spike_factor=None, window=32,
                  max_grad_norm=None, cooldown=0.0, backoff=0.5,
                  max_backoff=30.0, jitter=0.5, transient=None, resume=True,
-                 seed=None, on_degraded=None):
+                 seed=None, on_degraded=None, capsule=None):
         self.save_fn = save_fn
         self.restore_fn = restore_fn
         self.deadline = deadline
@@ -347,12 +370,47 @@ class Supervisor:
         self.rollbacks = 0
         self.batches_skipped = 0
         self.watchdog_fires = 0
+        self.steps = 0               # committed steps across the whole run
+        self._step_in_epoch = 0      # committed steps in the current epoch
+        self._pending_resume = None  # (epoch, step) armed by a capsule
+        self.capsule = None
+        if capsule is not None:
+            self.attach_capsule(capsule)
         # bumped on every restore: step functions with side effects can
         # compare it across their own run to detect that a restore
         # superseded them while they ran on an abandoned watchdog thread
         # (CompiledTrainStep does this internally; module.fit's
         # sentinel_batch gates update() on it)
         self.generation = 0
+
+    @property
+    def sentinel(self):
+        """The numeric sentinel (its ``state_dict`` is the skip ledger
+        capsules carry)."""
+        return self._sentinel
+
+    @property
+    def step_in_epoch(self):
+        """Committed steps in the current epoch (the capsule loop cursor)."""
+        return self._step_in_epoch
+
+    def attach_capsule(self, manager):
+        """Wire a ``resume.CapsuleManager`` to this supervisor (also sets
+        the manager's back-reference); returns the manager."""
+        self.capsule = manager
+        manager.supervisor = self
+        return manager
+
+    def resume_step(self, epoch):
+        """Steps of ``epoch`` already committed by a mid-epoch capsule
+        restore (0 = start the epoch fresh).  Epoch functions use it to
+        decide whether to ``reset()`` their data iterator: nonzero means
+        the iterator was repositioned at the exact next batch and a reset
+        would re-feed the epoch head."""
+        pend = self._pending_resume
+        if pend is not None and pend[0] == int(epoch):
+            return pend[1]
+        return 0
 
     # -- one supervised step ------------------------------------------------
     def step(self, fn, name=None):
@@ -395,6 +453,16 @@ class Supervisor:
                     f"training diverged at epoch {self._epoch} "
                     f"(loss={loss}, grad_norm={grad_norm}) — rolling back "
                     "to the last verified checkpoint")
+        # the step is committed (its batch consumed, its update — or
+        # documented skip — applied): advance the loop cursor, let the
+        # capsule snapshot the exact post-step state, and only THEN give
+        # chaos its crash-after-commit point (crash_at_step), so a capsule
+        # resume continues at the next batch, never re-feeding this one
+        self._step_in_epoch += 1
+        self.steps += 1
+        if self.capsule is not None:
+            self.capsule.on_step(self)
+        chaos.maybe_crash_step()
         return value
 
     # -- the supervised loop ------------------------------------------------
@@ -413,6 +481,10 @@ class Supervisor:
         epoch = int(begin_epoch)
         if self.resume and self.restore_fn is not None:
             resumed = int(self.restore_fn() or 0)
+            if self.capsule is not None:
+                # a step capsule (fresh process resuming a crashed one)
+                # repositions RNG/data/train-state at the exact batch
+                resumed = self.capsule.restore(self, resumed)
             if resumed > epoch:
                 log.info("supervisor: resuming from checkpointed epoch %d "
                          "(requested begin_epoch=%d)", resumed, epoch)
@@ -420,10 +492,14 @@ class Supervisor:
         _telemetry.gauge("supervisor.degraded").set(0)
         while epoch < int(num_epoch):
             self._epoch = epoch
+            self._step_in_epoch = self.resume_step(epoch)
             try:
                 epoch_fn(epoch)
+                self._pending_resume = None
                 if self.save_fn is not None:
                     self.save_fn(epoch)
+                if self.capsule is not None:
+                    self.capsule.on_epoch(epoch, self)
             except BaseException as e:  # noqa: BLE001 — classified below
                 kind = classify(e, self.transient)
                 if kind == "fatal":
@@ -441,7 +517,7 @@ class Supervisor:
                                 "%.1fs", e, self.rollbacks,
                                 self.max_rollbacks, self.cooldown)
                     self._sentinel.reset()
-                    epoch = self._restore(epoch)
+                    epoch = self._restore(epoch, kind="numeric")
                     if self.cooldown:
                         time.sleep(self.cooldown)
                 else:  # transient
@@ -465,17 +541,29 @@ class Supervisor:
         return self._result("completed", begin_epoch, num_epoch,
                             int(num_epoch) - 1)
 
-    def _restore(self, current):
+    def _restore(self, current, kind="transient"):
         """Re-enter at the last verified checkpoint; without a restore_fn,
-        retry the current epoch on live state (lossy — documented)."""
+        retry the current epoch on live state (lossy — documented).
+
+        With a capsule manager, the restore is *deterministic*: a usable
+        step capsule resumes at the exact batch (transient faults only —
+        a numeric rollback discards it, since it holds the trajectory
+        that diverged), an epoch capsule at the epoch boundary with the
+        exact RNG stream."""
         self.generation += 1  # invalidate any watchdog-abandoned step
+        self._pending_resume = None
         if self.restore_fn is None:
             log.warning("supervisor: no restore_fn — retrying epoch %d on "
                         "live (possibly mid-step) state", current)
             return current
         resume_from = int(self.restore_fn() or 0)
-        log.warning("supervisor: restored; resuming from epoch %d",
-                    resume_from)
+        if self.capsule is not None:
+            resume_from = self.capsule.restore(
+                self, resume_from, use_step=(kind != "numeric"))
+        log.warning("supervisor: restored; resuming from epoch %d%s",
+                    resume_from,
+                    (f" at step {self._pending_resume[1]}"
+                     if self._pending_resume else ""))
         return resume_from
 
     def _degrade(self, epoch, err, budget):
@@ -527,23 +615,33 @@ class Supervise:
     ``prefix`` names the durable checkpoint prefix rollback resumes from;
     ``keep_last`` applies retention after each save (never pruning the
     newest verified epoch); ``save_optimizer_states`` folds the optimizer
-    ``.states`` into each epoch's manifest.  Every other keyword passes
-    through to :class:`Supervisor` (``deadline=``, ``max_restarts=``,
-    ``skip_limit=``, ...)."""
+    ``.states`` into each epoch's manifest.  ``capsule=True`` (or a
+    prebuilt ``resume.CapsuleManager``) makes recovery deterministic:
+    each epoch's manifest gains a training-state capsule (RNG + data
+    cursor + sentinel ledger) and ``capsule_interval=N`` additionally
+    writes a mid-epoch step capsule every N committed batches so restarts
+    resume at the exact batch (docs/robustness.md "Deterministic
+    resume"); the train iterator must implement ``state_dict`` (all
+    in-tree iterators do, except the native image pipeline).  Every other
+    keyword passes through to :class:`Supervisor` (``deadline=``,
+    ``max_restarts=``, ``skip_limit=``, ...)."""
 
     def __init__(self, prefix=None, keep_last=3, save_optimizer_states=False,
-                 **supervisor_kwargs):
+                 capsule=None, capsule_interval=0, **supervisor_kwargs):
         self.prefix = prefix
         self.keep_last = keep_last
         self.save_optimizer_states = bool(save_optimizer_states)
+        self.capsule = capsule
+        self.capsule_interval = int(capsule_interval)
         self.supervisor_kwargs = supervisor_kwargs
 
 
-def for_module(module, config):
+def for_module(module, config, train_data=None):
     """Build a :class:`Supervisor` wired to a Module's checkpoint flow:
     saves go through ``module.save_checkpoint`` (manifest-committing, with
     retention), rollback through ``elastic.auto_resume(module=...)``.
-    Called by ``BaseModule.fit(supervised=...)``."""
+    Called by ``BaseModule.fit(supervised=...)``, which passes the train
+    iterator so a capsule-enabled config can snapshot its position."""
     if isinstance(config, dict):
         config = Supervise(**config)
     if config is True:
@@ -559,10 +657,28 @@ def for_module(module, config):
             "(pass supervised=Supervise(prefix='ck'))")
     from . import elastic as _elastic
 
+    sup = Supervisor(**config.supervisor_kwargs)
+    if config.capsule or config.capsule_interval:
+        from . import resume as _resume
+        if hasattr(config.capsule, "restore"):  # a prebuilt manager
+            sup.attach_capsule(config.capsule)
+        else:
+            sup.attach_capsule(_resume.CapsuleManager(
+                config.prefix,
+                iters=[train_data] if train_data is not None else [],
+                state=_resume.ModuleState(module),
+                interval=config.capsule_interval))
+
     def save_fn(epoch):
+        extra = []
+        if sup.capsule is not None:
+            # capsule BEFORE the manifest commit: it rides the epoch's
+            # manifest and is size+sha256 verified with the checkpoint
+            extra.append(sup.capsule.write_epoch_file(epoch, sup))
         module.save_checkpoint(
             config.prefix, epoch,
-            save_optimizer_states=config.save_optimizer_states)
+            save_optimizer_states=config.save_optimizer_states,
+            extra_files=extra)
         if config.keep_last:
             _ckpt.apply_retention(config.prefix, config.keep_last,
                                   known_verified=epoch)
@@ -579,5 +695,6 @@ def for_module(module, config):
                 loader(states)
         return start
 
-    return Supervisor(save_fn=save_fn, restore_fn=restore_fn,
-                      **config.supervisor_kwargs)
+    sup.save_fn = save_fn
+    sup.restore_fn = restore_fn
+    return sup

@@ -89,6 +89,11 @@ KNOWN_METRICS = frozenset({
     "supervisor.restarts", "supervisor.rollbacks",
     "supervisor.batches_skipped", "supervisor.watchdog_fires",
     "supervisor.degraded",
+    # deterministic-resume capsules (tpu_mx/resume.py; resume_step_gap is
+    # the batches a recovery could NOT replay exactly — 0 under capsules,
+    # and the soak CI tier fails if it is ever nonzero)
+    "resume.capsules_written", "resume.capsule_restore_seconds",
+    "resume.resume_step_gap",
     # fault injection (tpu_mx/contrib/chaos.py)
     "chaos.injections",
     # module-API training (tpu_mx/callback.py)
