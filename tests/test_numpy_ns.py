@@ -430,3 +430,37 @@ def test_np_frexp_mantissa_gradient():
     onp.testing.assert_allclose(
         x.grad.asnumpy(), 2.0 / 2.0 ** e.asnumpy().astype(onp.float32),
         rtol=1e-6)
+
+
+def test_np_frexp_edge_values_bit_exact():
+    """The straight-through gradient must not perturb the VALUES: zero,
+    negatives, the extremes of the normal range and infinities return
+    numpy frexp's exact bits, and no input may produce a nan mantissa
+    (inf - inf in a naive straight-through would).  Subnormal inputs are
+    backend-FTZ — divergence #26 — so they are only required to match
+    raw jnp.frexp, nan-free."""
+    import jax.numpy as jnp
+    vals = onp.array([0.0, -0.0, 1e38, 2e-38, -3.0, onp.inf, -onp.inf],
+                     onp.float32)
+    m, e = mx.np.frexp(mx.np.array(vals))
+    em, ee = onp.frexp(vals)
+    onp.testing.assert_array_equal(m.asnumpy(), em)
+    onp.testing.assert_array_equal(e.asnumpy(), ee)
+    subs = onp.array([1e-40, -1e-40, onp.nan], onp.float32)
+    ms, es = mx.np.frexp(mx.np.array(subs))
+    jm, je = jnp.frexp(jnp.asarray(subs))
+    onp.testing.assert_array_equal(ms.asnumpy(), onp.asarray(jm))
+    onp.testing.assert_array_equal(es.asnumpy(), onp.asarray(je))
+    assert not onp.isnan(ms.asnumpy()[:2]).any()
+    # gradient stays finite and exact through the split half-power
+    # scaling down to the bottom of the normal exponent range (a single
+    # exp2(-e) factor would overflow there); the top of the range is
+    # excluded — its true gradient 2**-127 is itself subnormal, FTZ'd
+    x = mx.np.array([2.0e-38, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        m, e = mx.np.frexp(x)
+        m.sum().backward()
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(), 1.0 / 2.0 ** e.asnumpy().astype(onp.float32),
+        rtol=1e-6)

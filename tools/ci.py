@@ -6,7 +6,12 @@ snapshot-time check: `python tools/ci.py` exits nonzero with an
 unmissable banner when any test fails, and prints per-tier timing so the
 slowest tier stays visible.
 
-Tiers: core (`-m "not slow"`, <5 min), slow (virtual-mesh parallelism,
+Tiers: lint — tools/tpumx_lint.py, the framework-aware static analyzer
+enforcing the durability/determinism/sync-point/concurrency/telemetry
+contracts on every line including branches no fault schedule executes
+(docs/static_analysis.md; fastest tier, no device, runs FIRST so a
+contract violation fails before any test time is spent) — then core
+(`-m "not slow"`, <5 min), slow (virtual-mesh parallelism,
 full-model layout trains, op-audit sweep, native C++ tier), the example
 smokes, chaos (the fault-injection durability tests re-run under a fixed
 TPUMX_CHAOS_SEED, docs/robustness.md), native-asan — an
@@ -26,6 +31,7 @@ runs just the first for a quick gate.
 """
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import subprocess
@@ -47,6 +53,34 @@ TIERS = [
                "tests/test_supervisor.py",
                "-m", "not slow"], {"TPUMX_CHAOS_SEED": "20260804"}),
 ]
+
+
+def lint_tier():
+    """Run the static contract checker over the default tree; any
+    unsuppressed, non-baselined finding is a red tier.  JSON mode so the
+    gate parses the count rather than scraping human output."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        run = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "tpumx_lint.py"),
+             "--format", "json"],
+            capture_output=True, text=True, timeout=120, cwd=repo)
+    except subprocess.TimeoutExpired as e:
+        print(f"  lint: timed out: {e}")
+        return 1
+    if run.returncode != 0:
+        # surface the findings (re-rendered from JSON) in the CI log
+        try:
+            payload = json.loads(run.stdout)
+            for f in payload.get("findings", []):
+                print(f"  {f['path']}:{f['line']}: [{f['rule']}] "
+                      f"{f['message']}")
+            for e in payload.get("errors", []):
+                print(f"  lint error: {e}")
+        except ValueError:
+            print((run.stdout or "") + (run.stderr or ""))
+        return run.returncode or 1
+    return 0
 
 
 def native_asan():
@@ -407,6 +441,10 @@ def main():
     opts = ap.parse_args()  # unknown args fail fast, not silently run all
     tiers = TIERS[:1] if opts.core_only else TIERS
     results = []
+    # lint first, ALWAYS (core-only included): seconds of static checking
+    # that fails the build before any pytest time is spent
+    t0 = time.time()
+    results.append(("lint", lint_tier(), time.time() - t0))
     for name, args, env_extra in tiers:
         t0 = time.time()
         env = None

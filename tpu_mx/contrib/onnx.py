@@ -352,7 +352,8 @@ def export_model(sym, params, input_shapes=None, onnx_file_path="model.onnx",
     model.bytes(3, "3.0")                      # producer_version
     model.bytes(7, graph)
     model.bytes(8, Msg().bytes(1, "").int(2, opset))  # opset_import
-    with open(onnx_file_path, "wb") as f:
+    from ..checkpoint import atomic_write
+    with atomic_write(onnx_file_path) as f:
         f.write(model.tobytes())
     return onnx_file_path
 

@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import numpy as np
+from ...random import host_rng as _host_rng
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
@@ -31,7 +32,7 @@ class RandomSampler(Sampler):
         self._length = length
 
     def __iter__(self):
-        return iter(np.random.permutation(self._length))
+        return iter(_host_rng().permutation(self._length))
 
     def __len__(self):
         return self._length

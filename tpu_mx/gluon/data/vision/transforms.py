@@ -4,6 +4,7 @@ by design: TPU chips don't decode JPEGs; keep the host CPU pipeline lean)."""
 from __future__ import annotations
 
 import numpy as np
+from ....random import host_rng as _host_rng
 
 from ...block import Block
 from ....ndarray import NDArray, array
@@ -127,13 +128,13 @@ class RandomResizedCrop(_Transform):
         h, w = x.shape[:2]
         area = h * w
         for _ in range(10):
-            target_area = np.random.uniform(*self._scale) * area
-            aspect = np.random.uniform(*self._ratio)
+            target_area = _host_rng().uniform(*self._scale) * area
+            aspect = _host_rng().uniform(*self._ratio)
             cw = int(round(np.sqrt(target_area * aspect)))
             ch = int(round(np.sqrt(target_area / aspect)))
             if cw <= w and ch <= h:
-                x0 = np.random.randint(0, w - cw + 1)
-                y0 = np.random.randint(0, h - ch + 1)
+                x0 = _host_rng().randint(0, w - cw + 1)
+                y0 = _host_rng().randint(0, h - ch + 1)
                 crop = x[y0:y0 + ch, x0:x0 + cw]
                 return _resize(crop, self._size)
         return _resize(x, self._size)
@@ -142,13 +143,13 @@ class RandomResizedCrop(_Transform):
 class RandomFlipLeftRight(_Transform):
     def forward(self, x):
         x = _as_np(x)
-        return x[:, ::-1].copy() if np.random.rand() < 0.5 else x
+        return x[:, ::-1].copy() if _host_rng().rand() < 0.5 else x
 
 
 class RandomFlipTopBottom(_Transform):
     def forward(self, x):
         x = _as_np(x)
-        return x[::-1].copy() if np.random.rand() < 0.5 else x
+        return x[::-1].copy() if _host_rng().rand() < 0.5 else x
 
 
 class RandomBrightness(_Transform):
@@ -157,7 +158,7 @@ class RandomBrightness(_Transform):
         self._b = brightness
 
     def forward(self, x):
-        alpha = 1.0 + np.random.uniform(-self._b, self._b)
+        alpha = 1.0 + _host_rng().uniform(-self._b, self._b)
         return np.clip(_as_np(x).astype(np.float32) * alpha, 0, 255)
 
 
@@ -168,7 +169,7 @@ class RandomContrast(_Transform):
 
     def forward(self, x):
         x = _as_np(x).astype(np.float32)
-        alpha = 1.0 + np.random.uniform(-self._c, self._c)
+        alpha = 1.0 + _host_rng().uniform(-self._c, self._c)
         gray = x.mean()
         return np.clip(gray + alpha * (x - gray), 0, 255)
 
@@ -180,7 +181,7 @@ class RandomSaturation(_Transform):
 
     def forward(self, x):
         x = _as_np(x).astype(np.float32)
-        alpha = 1.0 + np.random.uniform(-self._s, self._s)
+        alpha = 1.0 + _host_rng().uniform(-self._s, self._s)
         gray = x.mean(axis=-1, keepdims=True)
         return np.clip(gray + alpha * (x - gray), 0, 255)
 
@@ -218,7 +219,7 @@ class RandomColorJitter(_Transform):
     def forward(self, x):
         # reference applies the jitters in RANDOM order per sample
         ts = list(self._ts)
-        np.random.shuffle(ts)
+        _host_rng().shuffle(ts)
         for t in ts:
             x = t.forward(_as_np(x))
         return x
@@ -238,6 +239,6 @@ class RandomLighting(_Transform):
 
     def forward(self, x):
         x = _as_np(x).astype(np.float32)
-        alpha = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        alpha = _host_rng().normal(0, self._alpha, 3).astype(np.float32)
         rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
         return np.clip(x + rgb, 0, 255)

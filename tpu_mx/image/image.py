@@ -15,6 +15,7 @@ import random as _pyrandom
 
 import numpy as np
 
+from ..random import host_rng as _host_rng
 from ..base import MXNetError
 from ..io.io import DataBatch, DataDesc, DataIter
 from ..ndarray import NDArray, array
@@ -344,7 +345,7 @@ class LightingAug(Augmenter):
         self.eigvec = np.asarray(eigvec, np.float32)
 
     def __call__(self, src):
-        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(
+        alpha = _host_rng().normal(0, self.alphastd, size=(3,)).astype(
             np.float32)
         rgb = self.eigvec @ (alpha * self.eigval)
         arr = (src.asnumpy() if isinstance(src, NDArray)

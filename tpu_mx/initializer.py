@@ -12,6 +12,7 @@ import os
 import numpy as np
 
 from .base import Registry
+from .random import host_rng as _host_rng
 
 __all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
            "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias", "registry"]
@@ -102,7 +103,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, shape):
-        return np.random.uniform(-self.scale, self.scale, size=shape)
+        return _host_rng().uniform(-self.scale, self.scale, size=shape)
 
     def _device_weight(self, key, shape):
         import jax
@@ -116,7 +117,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, shape):
-        return np.random.normal(0, self.sigma, size=shape)
+        return _host_rng().normal(0, self.sigma, size=shape)
 
     def _device_weight(self, key, shape):
         import jax
@@ -234,8 +235,8 @@ class Xavier(Initializer):
         factor = _fan(shape, self.factor_type)
         scale = math.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            return np.random.uniform(-scale, scale, size=shape)
-        return np.random.normal(0, scale, size=shape)
+            return _host_rng().uniform(-scale, scale, size=shape)
+        return _host_rng().normal(0, scale, size=shape)
 
     def _device_weight(self, key, shape):
         import jax
@@ -263,9 +264,9 @@ class Orthogonal(Initializer):
         rows = shape[0]
         cols = int(np.prod(shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (rows, cols))
+            tmp = _host_rng().uniform(-1.0, 1.0, (rows, cols))
         else:
-            tmp = np.random.normal(0.0, 1.0, (rows, cols))
+            tmp = _host_rng().normal(0.0, 1.0, (rows, cols))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == (rows, cols) else v
         return (self.scale * q).reshape(shape)

@@ -135,7 +135,7 @@ _WRAPPED = [
     "dot", "dsplit", "dstack",
     "ediff1d", "einsum", "equal", "exp", "exp2", "expand_dims", "expm1",
     "flatnonzero", "flip", "fliplr", "flipud", "floor", "floor_divide",
-    "fmax", "fmin", "fmod", "frexp", "gcd", "greater", "greater_equal",
+    "fmax", "fmin", "fmod", "gcd", "greater", "greater_equal",
     "histogram", "hsplit",
     "hstack", "hypot", "inner", "insert", "interp", "invert", "isclose",
     "isfinite", "isinf",
@@ -163,6 +163,37 @@ for _name in _WRAPPED:
 round = globals()["around"]
 concat = globals()["concatenate"]
 fix = globals()["trunc"]  # numpy fix == round toward zero (jnp.fix removed)
+
+
+def frexp(x):
+    """Mantissa/exponent decomposition with a DIFFERENTIABLE mantissa.
+
+    jnp.frexp is built from bitwise ops, so d(mantissa)/dx is silently
+    zero even in raw jax.  The exponent is piecewise constant in x, so
+    the true derivative is ``d(m)/dx = 2**-e``; it is attached
+    STRAIGHT-THROUGH: the returned VALUES are exactly jnp.frexp's bits
+    (the gradient path contributes an exact zero, clamped so inf/nan
+    inputs cannot leak a nan through ``inf - inf``), while the gradient
+    flows via ``x * 2**-e`` computed as two half-power scalings so
+    neither factor overflows across the full exponent range.  Subnormal
+    inputs follow the backend's flush-to-zero arithmetic — divergence
+    #26 in docs/DIVERGENCES.md."""
+    import jax as _jax
+
+    def call(v):
+        if not _jnp.issubdtype(v.dtype, _jnp.floating):
+            v = v.astype(_jnp.result_type(float))
+        m_exact, e = _jnp.frexp(v)
+        e_sg = _jax.lax.stop_gradient(e)
+        h = (-e_sg) // 2
+        scaled = (v * _jnp.exp2(h.astype(v.dtype))) \
+            * _jnp.exp2((-e_sg - h).astype(v.dtype))
+        # zero (not nan) straight-through delta for inf/nan inputs: the
+        # value must stay m_exact's bits there, with no gradient
+        scaled = _jnp.where(_jnp.isfinite(scaled), scaled, 0)
+        m = m_exact + (scaled - _jax.lax.stop_gradient(scaled))
+        return m, e
+    return _ops._apply(call, [x], "frexp")
 
 
 def zeros_like(a, dtype=None, **kw):
@@ -227,5 +258,5 @@ from . import random      # noqa: E402
 __all__ = (["array", "zeros", "ones", "full", "arange", "linspace", "eye",
             "identity", "zeros_like", "ones_like", "full_like", "ndarray", "fix",
             "newaxis", "pi", "e", "inf", "nan", "fft", "linalg", "random",
-            "shape", "ndim", "size", "round", "concat", "empty",
+            "shape", "ndim", "size", "round", "concat", "empty", "frexp",
             "empty_like", "logspace", "indices", "diag_indices"] + _WRAPPED)

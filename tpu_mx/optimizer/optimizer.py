@@ -491,6 +491,9 @@ class SGLD(Optimizer):
         g = self._preprocess(grad, weight, wd)
         # deterministic per-(t, shape) draw keyed off the framework stream
         # contract: traced inside the step, keyed on the step counter
+        # tpumx-lint: disable=determinism -- traced constant key folded with
+        # t: the noise is a pure function of the step counter, so a resume
+        # capsule replays it exactly without carrying any stream state
         key = jax.random.fold_in(jax.random.PRNGKey(0),
                                  jnp.asarray(t, jnp.int32))
         noise = jax.random.normal(key, weight.shape, jnp.float32)

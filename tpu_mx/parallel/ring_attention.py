@@ -166,6 +166,8 @@ def _ring_body(q, k, v, valid, seed, bias, *, axis_name, causal, scale,
     perm = [(i, (i + 1) % n) for i in range(n)]
     base_key = None
     if dropped:
+        # tpumx-lint: disable=determinism -- key is a pure function of the
+        # caller-provided seed input (traced), not a hidden fresh stream
         base_key = jax.random.PRNGKey(seed[0])
         for ax in key_axes:
             base_key = jax.random.fold_in(base_key, lax.axis_index(ax))

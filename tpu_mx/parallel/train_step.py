@@ -208,16 +208,22 @@ class CompiledTrainStep:
         if self.mesh is None:
             return
         vs = self._value_shardings()
-        self.values = {k: jax.device_put(v, vs[k])
-                       for k, v in self.values.items()}
-        self.masters = {k: jax.device_put(v, vs[k])
-                        for k, v in self.masters.items()}
+        values = {k: jax.device_put(v, vs[k])
+                  for k, v in self.values.items()}
+        masters = {k: jax.device_put(v, vs[k])
+                   for k, v in self.masters.items()}
         ss = self._state_shardings()
-        self.opt_states = {k: jax.device_put(s, ss[k])
-                           for k, s in self.opt_states.items()}
+        opt_states = {k: jax.device_put(s, ss[k])
+                      for k, s in self.opt_states.items()}
         ef_sh = sharding_for(self.mesh, P("dp"))
-        self._efs = {k: jax.device_put(v, ef_sh)
-                     for k, v in self._efs.items()}
+        efs = {k: jax.device_put(v, ef_sh)
+               for k, v in self._efs.items()}
+        # publish under the state lock: a watchdog-abandoned step's late
+        # result application (gated by _stale under this lock) must never
+        # interleave with re-placement of restored weights
+        with self._state_lock:
+            self.values, self.masters = values, masters
+            self.opt_states, self._efs = opt_states, efs
 
     # -- the compiled program -------------------------------------------------
     def _build(self, n_batch_args):
@@ -510,6 +516,9 @@ class CompiledTrainStep:
                 else ()
             shapes = {k: lead + self.values[k].shape
                       for k in self._diff_keys}
+            # tpumx-lint: disable=concurrency -- first-build-only init:
+            # runs before any step result exists that a restore could
+            # race, and fresh zeros are the correct post-restore value
             self._gacc = jax.jit(
                 lambda: {k: jnp.zeros(s, jnp.float32)
                          for k, s in shapes.items()},
@@ -717,6 +726,7 @@ class CompiledTrainStep:
         # a constant key: lowering only needs the shape/dtype, and an
         # introspection helper must not advance the global RNG stream
         # (that would silently change later dropout masks)
+        # tpumx-lint: disable=determinism -- lowering only needs shape/dtype
         key = jax.random.PRNGKey(0)
         gacc = self._gacc if self._accum > 1 else {}
         lowered = self._jitted.lower(
@@ -766,9 +776,13 @@ class CompiledTrainStep:
         """Discard in-flight microbatch state: restored weights invalidate
         partial gradients accumulated against the previous weights (the
         silent-corruption alternative is worse than dropping ≤K-1
-        microbatches)."""
+        microbatches).  Caller MUST hold _state_lock (both call sites —
+        sync_from_net, load_state_dict — do)."""
+        # tpumx-lint: disable=concurrency -- caller holds _state_lock (see
+        # docstring contract); the linter only sees lexical lock scopes
         self._micro = 0
         if self._gacc is not None:
+            # tpumx-lint: disable=concurrency -- same caller-holds-lock
             self._gacc = jax.tree_util.tree_map(
                 lambda a: jnp.zeros_like(a), self._gacc)
 

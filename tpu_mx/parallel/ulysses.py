@@ -65,6 +65,8 @@ def _ulysses_body(q, k, v, valid, seed, bias, *, axis_name, causal,
                         tiled=True)
     key = None
     if dropped:
+        # tpumx-lint: disable=determinism -- key is a pure function of the
+        # caller-provided seed input (traced), not a hidden fresh stream
         key = jax.random.PRNGKey(seed[0])
         for ax in key_axes:
             key = jax.random.fold_in(key, lax.axis_index(ax))

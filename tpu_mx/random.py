@@ -27,8 +27,8 @@ import threading
 
 import jax
 
-__all__ = ["seed", "get_state", "set_state", "take_key", "KeyHolder",
-           "key_scope"]
+__all__ = ["seed", "get_state", "set_state", "take_key", "host_rng",
+           "KeyHolder", "key_scope"]
 
 
 class _GlobalRNG:
@@ -71,6 +71,25 @@ def take_key():
     with _GLOBAL.lock:
         _GLOBAL.key, sub = jax.random.split(_GLOBAL.key)
     return sub
+
+
+def host_rng():
+    """The framework's blessed HOST-side RNG: numpy's global generator.
+
+    Library code that samples on the host (data-augmentation transforms,
+    host-path initializers, shufflers) must draw through this accessor
+    rather than calling ``np.random.*`` directly — same stream, but the
+    dependence on the capsule-covered state becomes explicit and
+    statically checkable (tools/tpumx_lint.py's determinism pass flags
+    direct global draws).  The returned generator is exactly what
+    :func:`seed` seeds and :func:`get_state`/:func:`set_state` snapshot
+    and restore, so every draw through it replays bit-exactly under a
+    resume capsule.  Iterators with their OWN ``RandomState(seed)`` plus
+    ``state_dict()`` coverage should keep it — a private stream is
+    stronger isolation, not a violation."""
+    import numpy as _np
+    # the module-level singleton behind np.random.* — NOT a new stream
+    return _np.random.mtrand._rand
 
 
 def get_state():

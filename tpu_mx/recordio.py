@@ -45,6 +45,10 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        # tpumx-lint: disable=durability -- streaming dataset writer, not
+        # recovery state: records append incrementally over a whole pack
+        # run (atomic_write cannot wrap an open-ended stream), and im2rec
+        # reruns rebuild a torn pack from source
         self.record = open(self.uri, "rb" if self.flag == "r" else "wb")
         self.is_open = True
 
@@ -142,6 +146,9 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.idx[key] = int(parts[1])
                     self.keys.append(key)
         if self.flag == "w":
+            # tpumx-lint: disable=durability -- index lines stream out in
+            # lockstep with the record pack above (same rebuild-on-rerun
+            # contract); see MXRecordIO.open
             self.fidx = open(self.idx_path, "w")
 
     def close(self):

@@ -121,6 +121,16 @@ def set_compilation_cache(directory, min_compile_time_secs=1.0):
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       float(min_compile_time_secs))
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax latches the cache-used decision at the FIRST compile of the
+    # process (compilation_cache._cache_checked); if anything compiled
+    # before this call — an earlier train step, another test — the new
+    # dir would be silently ignored forever.  reset_cache() unlatches so
+    # the next compile re-evaluates with the dir configured.
+    try:
+        from jax._src import compilation_cache
+        compilation_cache.reset_cache()
+    except (ImportError, AttributeError):  # private API moved: next
+        pass  # process picks the dir up at first compile as before
 
 
 def enable_shared_compilation_cache():

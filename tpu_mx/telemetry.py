@@ -345,6 +345,10 @@ def flush(path=None, final=False):
                 with atomic_write(path, "w") as f:
                     f.write(prev + payload)
             except ImportError:  # standalone module load: plain rewrite
+                # tpumx-lint: disable=durability -- degraded mode only:
+                # this module is loadable WITHOUT the package (no
+                # checkpoint layer to import); a torn JSONL tail is
+                # recoverable line-by-line
                 with open(path, "w", encoding="utf-8") as f:
                     f.write(prev + payload)
         else:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .random import host_rng as _host_rng
 from . import autograd, context
 from .ndarray import NDArray, array
 
@@ -74,7 +75,7 @@ def same(a, b):
 
 
 def rand_ndarray(shape, dtype="float32", ctx=None, low=-1.0, high=1.0):
-    data = np.random.uniform(low, high, size=shape).astype(dtype)
+    data = _host_rng().uniform(low, high, size=shape).astype(dtype)
     return array(data, ctx=ctx or default_context())
 
 
@@ -150,12 +151,12 @@ def assert_exception(fn, exception_type, *args, **kwargs):
 
 
 def rand_shape_2d(dim0=10, dim1=10):
-    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+    return (_host_rng().randint(1, dim0 + 1), _host_rng().randint(1, dim1 + 1))
 
 
 def rand_shape_3d(dim0=10, dim1=10, dim2=10):
-    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
-            np.random.randint(1, dim2 + 1))
+    return (_host_rng().randint(1, dim0 + 1), _host_rng().randint(1, dim1 + 1),
+            _host_rng().randint(1, dim2 + 1))
 
 
 def list_gpus():
