@@ -25,11 +25,13 @@ sys.path.insert(0, TOOLS)
 import tpumx_lint  # noqa: E402
 
 CATALOG = frozenset({"fusion.flushes", "train_step.steps"})
+EVENT_CATALOG = frozenset({"chaos.inject", "supervisor.restart"})
 
 
-def run(src, path, rules=None, known=CATALOG):
+def run(src, path, rules=None, known=CATALOG, known_events=EVENT_CATALOG):
     found, suppressed = tpumx_lint.lint_source(
-        textwrap.dedent(src), path, known_metrics=known, rules=rules)
+        textwrap.dedent(src), path, known_metrics=known, rules=rules,
+        known_events=known_events)
     return found, suppressed
 
 
@@ -434,6 +436,48 @@ def test_catalog_extraction_matches_the_live_module():
         assert name in known
 
 
+def test_tracing_catalog_fires_on_unknown_and_dynamic_event_names():
+    found, _ = run("""
+        from tpu_mx import tracing as _tracing
+
+        def instrument(name):
+            _tracing.emit("supervisor.restartz", n=1)   # typo
+            _tracing.emit(name, kind="hang")            # unverifiable
+        """, "tpu_mx/foo.py", rules={"telemetry-catalog"})
+    assert len(found) == 2
+    assert "supervisor.restartz" in found[0].message
+    assert "KNOWN_EVENTS" in found[0].message
+
+
+def test_tracing_catalog_silent_on_known_names_and_lookalikes():
+    found, _ = run("""
+        from tpu_mx import tracing
+        from tpu_mx.tracing import emit
+
+        def instrument(logger):
+            tracing.emit("chaos.inject", kind="hang")
+            emit("supervisor.restart", n=2)     # from-imported emitter
+            logger.emit("not.an.event")         # unrelated object's .emit
+        """, "tpu_mx/foo.py", rules={"telemetry-catalog"})
+    assert found == []
+    # the tracing module itself manipulates names generically: exempt
+    found, _ = run("""
+        from tpu_mx import tracing
+        tracing.emit("internal.name")
+        """, "tpu_mx/tracing.py", rules={"telemetry-catalog"})
+    assert found == []
+
+
+def test_event_catalog_extraction_matches_the_live_module():
+    known = tpumx_lint.load_known_events()
+    assert known is not None
+    import tpu_mx.tracing as live
+    assert known == frozenset(live.KNOWN_EVENTS)
+    for name in ("chaos.inject", "supervisor.watchdog_fire",
+                 "train_step.phase", "resume.capsule_restore"):
+        assert name in known
+
+
 # ---------------------------------------------------------------------------
 # suppression mechanism
 # ---------------------------------------------------------------------------
@@ -543,6 +587,15 @@ def test_cli_fails_closed_on_missing_target_and_lost_catalog(
                           str(tmp_path / "none.json")])
     assert rc == 2
     assert "KNOWN_METRICS" in capsys.readouterr().err
+    # the event catalog fails closed the same way (ISSUE 7: the
+    # telemetry-catalog pass covers tracing.KNOWN_EVENTS too)
+    monkeypatch.undo()
+    assert tpumx_lint.load_known_events(repo=str(tmp_path)) is None
+    monkeypatch.setattr(tpumx_lint, "load_known_events", lambda: None)
+    rc = tpumx_lint.main([str(ok), "--baseline",
+                          str(tmp_path / "none.json")])
+    assert rc == 2
+    assert "KNOWN_EVENTS" in capsys.readouterr().err
     # but a rules subset that excludes the catalog pass still runs
     rc = tpumx_lint.main([str(ok), "--rules", "durability",
                           "--baseline", str(tmp_path / "none.json")])
@@ -553,8 +606,10 @@ def test_repo_lints_clean():
     """The shipped tree must have zero unsuppressed findings — this is
     the same gate tools/ci.py's lint tier enforces."""
     known = tpumx_lint.load_known_metrics()
+    known_events = tpumx_lint.load_known_events()
     findings, suppressed, errors = tpumx_lint.lint_paths(
-        tpumx_lint.DEFAULT_TARGETS, known_metrics=known)
+        tpumx_lint.DEFAULT_TARGETS, known_metrics=known,
+        known_events=known_events)
     assert errors == []
     baseline = tpumx_lint.read_baseline(
         os.path.join(TOOLS, "tpumx_lint_baseline.json"))

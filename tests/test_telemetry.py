@@ -423,3 +423,84 @@ def test_known_metrics_catalog_covers_instrumentation():
         "speedometer.samples_per_sec",
     }
     assert emitted <= telemetry.KNOWN_METRICS
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report --diff (ISSUE 7 satellite): delta view between two
+# snapshot files — counters/histograms subtracted, gauges side by side
+# ---------------------------------------------------------------------------
+def _write_snapshot(path, mutate):
+    telemetry.reset()
+    mutate()
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in telemetry.snapshot():
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    telemetry.reset()
+
+
+def _run_report(*args):
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "telemetry_report.py"),
+         *args], capture_output=True, text=True, timeout=120)
+
+
+def test_report_diff_subtracts_counters_histograms_gauges(tmp_path):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+
+    def soak_a():
+        telemetry.counter("supervisor.restarts").inc(2)
+        telemetry.histogram("train_step.seconds").observe(0.1)
+        telemetry.gauge("train_step.examples_per_sec").set(100.0)
+
+    def soak_b():
+        telemetry.counter("supervisor.restarts").inc(7)
+        for v in (0.1, 0.2, 0.3):
+            telemetry.histogram("train_step.seconds").observe(v)
+        telemetry.gauge("train_step.examples_per_sec").set(250.0)
+        telemetry.counter("supervisor.rollbacks").inc()  # only in B
+
+    _write_snapshot(a, soak_a)
+    _write_snapshot(b, soak_b)
+    run = _run_report("--diff", a, b, "--validate")
+    assert run.returncode == 0, run.stdout + run.stderr
+    out = run.stdout
+    assert "supervisor.restarts" in out and "+5" in out  # 7 - 2
+    assert "(A=2, B=7)" in out
+    assert "count +2" in out            # 3 - 1 histogram observations
+    assert "A=100" in out and "B=250" in out  # gauges side by side
+    assert "(only in B)" in out and "supervisor.rollbacks" in out
+
+
+def test_report_diff_validates_and_needs_two_files(tmp_path):
+    a = str(tmp_path / "a.jsonl")
+    with open(a, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"name": "not.in.catalog", "type": "counter",
+                            "value": 1, "ts": 1.0}) + "\n")
+    # --validate surfaces the unknown name in EITHER file
+    run = _run_report("--diff", a, a, "--validate")
+    assert run.returncode == 1
+    assert "not.in.catalog" in run.stderr
+    # without --validate the diff still renders (rc 0)
+    assert _run_report("--diff", a, a).returncode == 0
+    # wrong arity is a usage error, not a crash
+    assert _run_report("--diff", a).returncode == 2
+    assert _run_report(a, a).returncode == 2
+
+
+def test_report_diff_honors_require_against_after_snapshot(tmp_path):
+    """--require composes with --diff (gating B, the "after" file) — a
+    soak comparison must not read green with its gate never evaluated."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_snapshot(a, lambda: telemetry.counter(
+        "supervisor.restarts").inc())
+    _write_snapshot(b, lambda: telemetry.counter(
+        "supervisor.rollbacks").inc())
+    run = _run_report("--diff", a, b, "--require", "supervisor.rollbacks")
+    assert run.returncode == 0, run.stdout + run.stderr
+    # restarts is present only in A: requiring it against B must fail
+    run = _run_report("--diff", a, b, "--require", "supervisor.restarts")
+    assert run.returncode == 1
+    assert "supervisor.restarts" in run.stderr

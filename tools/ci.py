@@ -22,7 +22,11 @@ SURVEY §5.2 / VERDICT r5 missing#6) — then obs: a tiny instrumented
 train loop run with TPUMX_TELEMETRY set, whose emitted JSONL must
 validate against the telemetry schema AND the stable metric-name catalog
 (tools/telemetry_report.py --validate; docs/observability.md — an
-accidental metric rename fails this tier) — and soak: a supervised
+accidental metric rename fails this tier), plus the flight-recorder leg:
+one chaos-crashed supervised run per failure class (hang, NaN streak,
+crash, SIGTERM) must leave a schema-valid black box whose timeline links
+injection -> detection -> decision, rendered by tools/blackbox_report.py
+under a poisoned jax import — and soak: a supervised
 training run under a fixed-seed randomized chaos schedule (hang, NaN
 streak, crash-mid-save, torn write) that must finish with a verified
 latest checkpoint, a finite loss, and ≥1 recorded restart, rollback and
@@ -164,6 +168,148 @@ OBS_REQUIRED = ("fusion.flushes", "checkpoint.save_seconds",
                 "train_step.recompiles", "train_step.steps")
 
 
+# The obs tier's flight-recorder leg (ISSUE 7): chaos-crash a supervised
+# run once per failure class — hang, NaN streak, crash-mid-save, SIGTERM
+# preemption — and assert each leaves a readable, schema-valid black box
+# whose timeline links injection -> detection -> supervisor decision by
+# shared (epoch, step, generation) trace context.  The rendering check
+# (blackbox_report.py must work WITHOUT jax) runs in the driver below.
+BLACKBOX_SCRIPT = """
+import json
+import os
+import signal
+import time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tpu_mx as mx
+from tpu_mx import checkpoint as ckpt, elastic, gluon, nd, tracing
+from tpu_mx.contrib import chaos
+from tpu_mx.gluon import nn
+from tpu_mx.parallel import CompiledTrainStep
+from tpu_mx.supervisor import Supervisor
+
+D = os.environ["TPUMX_BLACKBOX_DIR"]
+R = np.random.RandomState(0)
+X = R.rand(32, 4).astype(np.float32)
+Y = (X.sum(1) > 2).astype(np.float32)
+NB, BS = 4, 8
+
+
+def build():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 4)))
+    step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mx.optimizer.create("sgd", learning_rate=0.05))
+    return net, step
+
+
+def supervised(tag, fault, **sup_kw):
+    tracing.reset()
+    prefix = os.path.join(D, tag)
+    net, step = build()
+
+    def save_fn(e):
+        step.sync_to_net()
+        elastic.save_checkpoint(prefix, e, net=net)
+
+    def restore_fn():
+        e = elastic.auto_resume(prefix, net=net)
+        step.sync_from_net()
+        return e
+
+    sup = Supervisor(save_fn=save_fn, restore_fn=restore_fn,
+                     blackbox=prefix, backoff=0.05, cooldown=0.0, **sup_kw)
+
+    def epoch_fn(epoch):
+        for i in range(NB):
+            xb, yb = X[i * BS:(i + 1) * BS], Y[i * BS:(i + 1) * BS]
+            sup.step(lambda: step.step(nd.array(xb), nd.array(yb)))
+
+    with chaos.enable(**fault):
+        res = sup.run(epoch_fn, 0, 3)
+    assert res.ok, (tag, res.as_dict())
+    path = tracing.blackbox_path(prefix)
+    assert os.path.exists(path), (tag, "no black box dumped")
+    box = json.load(open(path))
+    tracing.validate_blackbox(box)
+    return box
+
+
+def chain(box, kind, *decisions):
+    # injection -> detection -> decision, joined on (epoch, generation):
+    # a NaN streak's divergence is declared a step after the first
+    # poisoned loss, so the step is recorded but not part of the join
+    evs = box["events"]
+    inj = [e for e in evs if e["event"] == "chaos.inject"
+           and e["data"]["kind"] == kind]
+    assert inj, (kind, [e["event"] for e in evs])
+    key = (inj[0]["epoch"], inj[0]["generation"])
+    assert inj[0]["step"] is not None, inj[0]
+    got = [e["event"] for e in evs
+           if (e["epoch"], e["generation"]) == key]
+    for want in decisions:
+        assert want in got, (kind, want, got)
+
+
+box = supervised("bb-hang", dict(hang_step=6, hang_seconds=30, seed=1),
+                 deadline=2.0, compile_grace=60.0)
+chain(box, "hang", "supervisor.watchdog_fire", "supervisor.classify",
+      "supervisor.restart")
+
+box = supervised("bb-nan", dict(nan_after=NB + 2, nan_streak=2, seed=1),
+                 skip_limit=1)
+chain(box, "nan", "supervisor.sentinel_skip", "supervisor.classify",
+      "supervisor.rollback")
+
+box = supervised("bb-crash",
+                 dict(crash_after_bytes=200, match=".params", seed=1))
+chain(box, "crash", "supervisor.classify", "supervisor.restart")
+
+# SIGTERM preemption: the handler's emergency save + black box, no exit
+tracing.reset()
+prefix = os.path.join(D, "bb-sigterm")
+net, step = build()
+
+
+def emergency():
+    step.sync_to_net()
+    elastic.save_checkpoint(prefix, 0, net=net)
+
+
+handle = ckpt.preemption_handler(emergency, exit=False,
+                                 blackbox_prefix=prefix)
+for i in range(2):
+    step.step(nd.array(X[:BS]), nd.array(Y[:BS]))
+os.kill(os.getpid(), signal.SIGTERM)
+for _ in range(100):  # delivery is prompt but asynchronous
+    if handle.triggered:
+        break
+    time.sleep(0.05)
+assert handle.triggered and handle.save_ok, (handle.triggered,
+                                             handle.save_ok)
+box = json.load(open(tracing.blackbox_path(prefix)))
+tracing.validate_blackbox(box)
+names = [e["event"] for e in box["events"]]
+assert "checkpoint.preemption" in names, names
+assert "checkpoint.save" in names, names
+print("BLACKBOX OK", flush=True)
+"""
+
+# what the rendered report must contain per failure-class box: the
+# injection, the detection and the matching decision in prose
+BLACKBOX_EXPECT = {
+    "bb-hang": ("chaos hang injected", "watchdog fired", "restart #"),
+    "bb-nan": ("chaos nan injected", "sentinel skipped batch",
+               "rollback #"),
+    "bb-crash": ("chaos crash injected", "classified transient",
+                 "restart #"),
+    "bb-sigterm": ("checkpoint.preemption", "save_ok=True"),
+}
+
+
 # The soak tier's workload: a REAL supervised training run under a
 # fixed-seed randomized fault schedule — hang, NaN streak, crash-mid-save,
 # torn write — that must end with a verified latest checkpoint, a finite
@@ -242,7 +388,7 @@ def restore_fn():
 sup = Supervisor(save_fn=save_fn, restore_fn=restore_fn,
                  deadline=20.0, compile_grace=60.0, max_restarts=5,
                  max_rollbacks=3, skip_limit=1, backoff=0.05,
-                 cooldown=0.0, seed=SEED)
+                 cooldown=0.0, seed=SEED, blackbox=prefix)
 
 
 def epoch_fn(epoch):
@@ -269,6 +415,44 @@ assert ckpt.verify_checkpoint(prefix, epoch)[0] == "verified"
 # the torn epoch is on disk but detectably corrupt (manifest caught it)
 assert ckpt.verify_checkpoint(prefix, torn_epoch)[0] == "corrupt"
 assert ckpt.newest_verified_epoch(prefix) == EPOCHS - 1
+
+# ---- flight-recorder leg (ISSUE 7 acceptance): every injected fault is
+# linked to its detection and the supervisor's decision by shared
+# (epoch, generation) trace context, in a schema-valid black box.  The
+# per-recovery boxes were dumped during the run; this final audit dump
+# captures the WHOLE timeline (the ring still holds it) including the
+# torn write, whose detection only happens at the verify above.
+import json as _json
+from tpu_mx import tracing
+bb_path = tracing.dump_blackbox(prefix, reason="soak post-run audit")
+bb = _json.load(open(bb_path))
+tracing.validate_blackbox(bb)
+EVS = bb["events"]
+
+
+def correlated(kind, *names):
+    inj = [e for e in EVS if e["event"] == "chaos.inject"
+           and e["data"]["kind"] == kind]
+    assert inj, (kind, sorted({e["event"] for e in EVS}))
+    key = (inj[0]["epoch"], inj[0]["generation"])
+    got = [e["event"] for e in EVS if (e["epoch"], e["generation"]) == key]
+    for n in names:
+        assert n in got, (kind, n, got)
+
+
+correlated("hang", "supervisor.watchdog_fire", "supervisor.classify",
+           "supervisor.restart")
+correlated("nan", "supervisor.sentinel_skip", "supervisor.classify",
+           "supervisor.rollback")
+correlated("crash", "supervisor.classify", "supervisor.restart")
+# torn write: no exception at injection time — the manifest verification
+# above is the detection, and both are on the same timeline
+assert any(e["event"] == "chaos.inject"
+           and e["data"]["kind"] == "torn_write" for e in EVS)
+assert any(e["event"] == "checkpoint.verify"
+           and e["data"].get("status") == "corrupt" for e in EVS)
+assert telemetry.get("tracing.blackbox_dumps").value >= 3  # per recovery
+print("SOAK blackbox leg OK", flush=True)
 
 # ---- deterministic-resume leg (ISSUE 5 acceptance): a chaos-crashed-
 # then-capsule-resumed run must reproduce the uninterrupted fixed-seed
@@ -352,7 +536,8 @@ print("SOAK OK", flush=True)
 # that actually went through the capsule path; the resume_step_gap
 # gauge must be 0 and is asserted inside the soak script itself)
 SOAK_REQUIRED = ("supervisor", "resume", "chaos.injections",
-                 "checkpoint.corrupt_detected", "train_step.steps")
+                 "checkpoint.corrupt_detected", "train_step.steps",
+                 "tracing.blackbox_dumps")
 
 
 def soak_tier():
@@ -365,6 +550,7 @@ def soak_tier():
         env = dict(os.environ, TPUMX_TELEMETRY=jsonl, JAX_PLATFORMS="cpu",
                    TPUMX_CHAOS_SEED="20260804")
         env.pop("TPUMX_CHAOS", None)  # the script arms its own schedule
+        env.pop("TPUMX_TRACING", None)  # the blackbox leg needs the recorder
         try:
             run = subprocess.run([sys.executable, "-c", SOAK_SCRIPT],
                                  env=env, cwd=repo, capture_output=True,
@@ -430,6 +616,61 @@ def obs_tier():
             print(f"  obs: telemetry validation failed "
                   f"(rc={val.returncode}):\n{out[-3000:]}")
             return val.returncode or 1
+        rc = _blackbox_leg(repo, env)
+        if rc != 0:
+            return rc
+    return 0
+
+
+def _blackbox_leg(repo, env):
+    """Chaos-crash a supervised run per failure class (hang, NaN streak,
+    crash, SIGTERM) and assert each leaves a schema-valid black box whose
+    timeline links injection -> detection -> decision — then render every
+    box with tools/blackbox_report.py under a POISONED jax import, the
+    proof the post-mortem path needs no accelerator stack."""
+    with tempfile.TemporaryDirectory() as d:
+        env = dict(env, TPUMX_BLACKBOX_DIR=d)
+        env.pop("TPUMX_TRACING", None)  # the recorder must be armed
+        try:
+            run = subprocess.run([sys.executable, "-c", BLACKBOX_SCRIPT],
+                                 env=env, cwd=repo, capture_output=True,
+                                 text=True, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            print(f"  obs: blackbox leg timed out: {e}")
+            return 1
+        if run.returncode != 0 or "BLACKBOX OK" not in (run.stdout or ""):
+            print(f"  obs: blackbox leg failed (rc={run.returncode}):\n"
+                  f"{((run.stdout or '') + (run.stderr or ''))[-4000:]}")
+            return run.returncode or 1
+        report = os.path.join(repo, "tools", "blackbox_report.py")
+        for tag, expect in BLACKBOX_EXPECT.items():
+            box = os.path.join(d, f"{tag}-blackbox.json")
+            # poison jax/tpu_mx in sys.modules: if the report tool (or
+            # anything it loads) tries to import either, it fails loudly
+            code = ("import sys, runpy; "
+                    "sys.modules['jax'] = None; "
+                    "sys.modules['tpu_mx'] = None; "
+                    f"sys.argv = ['blackbox_report.py', {box!r}, "
+                    "'--validate']; "
+                    f"runpy.run_path({report!r}, run_name='__main__')")
+            try:
+                ren = subprocess.run([sys.executable, "-c", code],
+                                     capture_output=True, text=True,
+                                     timeout=120)
+            except subprocess.TimeoutExpired as e:
+                print(f"  obs: blackbox report timed out on {tag}: {e}")
+                return 1
+            out = (ren.stdout or "") + (ren.stderr or "")
+            # runpy re-raises SystemExit(0) silently; nonzero -> rc != 0
+            if ren.returncode != 0:
+                print(f"  obs: blackbox report failed on {tag} "
+                      f"(rc={ren.returncode}):\n{out[-3000:]}")
+                return 1
+            missing = [m for m in expect if m not in out]
+            if missing:
+                print(f"  obs: blackbox report for {tag} is missing "
+                      f"timeline markers {missing}:\n{out[-3000:]}")
+                return 1
     return 0
 
 

@@ -7,11 +7,18 @@ ms), followed by counter and gauge sections.  Because each flush appends a
 CUMULATIVE snapshot, the report aggregates by taking the LAST record of
 every (name, labels) series.
 
-Modes (the ``obs`` tier of tools/ci.py runs both):
+Modes (the ``obs`` tier of tools/ci.py runs the first two):
 
     python tools/telemetry_report.py metrics.jsonl
     python tools/telemetry_report.py metrics.jsonl --validate \
         --require fusion.flushes,checkpoint.save_seconds
+    python tools/telemetry_report.py --diff A.jsonl B.jsonl
+
+``--diff`` renders the DELTA between two snapshots (soak runs, bench
+A/Bs): counter values and histogram count/sum are subtracted (B - A),
+gauges — last-written values, not accumulators — are shown side by side.
+Series present in only one file are marked ``(only in A/B)``.
+``--require`` composes: the gate applies to B, the "after" snapshot.
 
 ``--validate`` checks every record against the telemetry schema
 (name/type/value/ts present; histogram bucket monotonicity) and fails on
@@ -169,6 +176,65 @@ def render(series, n_snapshots, path):
     return "\n".join(lines)
 
 
+def render_diff(series_a, series_b, path_a, path_b):
+    """The delta view: counters/histograms subtracted (B - A), gauges
+    side-by-side — what a soak-vs-soak or bench A/B comparison needs
+    without hand-parsing two JSONL files."""
+    name_a = os.path.basename(path_a)
+    name_b = os.path.basename(path_b)
+    lines = [f"Telemetry diff: A={path_a}  B={path_b}",
+             f"  {len(series_a)} series in A, {len(series_b)} in B", ""]
+    keys = sorted(set(series_a) | set(series_b))
+
+    def sided(key):
+        a, b = series_a.get(key), series_b.get(key)
+        if a is None:
+            return b, "(only in B)"
+        if b is None:
+            return a, "(only in A)"
+        return None, None
+
+    rows_c, rows_h, rows_g = [], [], []
+    for key in keys:
+        label = _series_label(*key)
+        rec, only = sided(key)
+        kind = (rec or series_b.get(key) or series_a.get(key))["type"]
+        if only is not None:
+            val = rec["value"]
+            if kind == "histogram":
+                rows_h.append("  %-50s %s count=%s sum=%.6g"
+                              % (label, only, val, rec.get("sum", 0.0)))
+            elif kind == "counter":
+                rows_c.append("  %-50s %s value=%s" % (label, only, val))
+            else:
+                rows_g.append("  %-50s %s value=%g" % (label, only, val))
+            continue
+        a, b = series_a[key], series_b[key]
+        if kind == "counter":
+            rows_c.append("  %-50s %+d   (A=%d, B=%d)"
+                          % (label, b["value"] - a["value"],
+                             a["value"], b["value"]))
+        elif kind == "histogram":
+            dc = b["value"] - a["value"]
+            ds = b.get("sum", 0.0) - a.get("sum", 0.0)
+            mean = (ds / dc) if dc else 0.0
+            rows_h.append("  %-50s count %+d  sum %+.6g  mean %.6g"
+                          % (label, dc, ds, mean))
+        else:
+            rows_g.append("  %-50s A=%-12g B=%-12g"
+                          % (label, a["value"], b["value"]))
+    if rows_c:
+        lines += [f"Counters (B - A; A={name_a}, B={name_b}):",
+                  *rows_c, ""]
+    if rows_h:
+        lines += ["Histograms (count/sum deltas, mean of the delta):",
+                  *rows_h, ""]
+    if rows_g:
+        lines += ["Gauges (side by side — last-written values, "
+                  "not accumulators):", *rows_g, ""]
+    return "\n".join(lines)
+
+
 def check_required(series, required):
     """Names in `required` must exist with a nonzero value; returns the
     list of violation strings (empty = good)."""
@@ -191,18 +257,50 @@ def check_required(series, required):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("file", help="TPUMX_TELEMETRY JSONL file")
+    ap.add_argument("file", nargs="+",
+                    help="TPUMX_TELEMETRY JSONL file (two with --diff)")
     ap.add_argument("--validate", action="store_true",
                     help="fail on schema violations or unknown metric names")
     ap.add_argument("--require", default="",
                     help="comma-separated metric names (or preset tokens: "
                          f"{', '.join(REQUIRE_PRESETS)}) that must be "
                          "present and nonzero")
+    ap.add_argument("--diff", action="store_true",
+                    help="delta view between exactly two snapshot files "
+                         "(counters/histograms subtracted, gauges side "
+                         "by side)")
     opts = ap.parse_args(argv)
     telemetry = load_telemetry()
-    series, n_snapshots, errors = read_series(opts.file, telemetry,
+    if opts.diff:
+        if len(opts.file) != 2:
+            ap.error("--diff needs exactly two files: A.jsonl B.jsonl")
+        path_a, path_b = opts.file
+        series_a, _, errors_a = read_series(path_a, telemetry,
+                                            validate=opts.validate)
+        series_b, _, errors_b = read_series(path_b, telemetry,
+                                            validate=opts.validate)
+        print(render_diff(series_a, series_b, path_a, path_b))
+        errors = [f"A: {e}" for e in errors_a] + \
+                 [f"B: {e}" for e in errors_b]
+        # --require composes with --diff: the gate applies to B (the
+        # "after" snapshot) — silently ignoring it would let a soak
+        # comparison read green with its requirement never evaluated
+        errors += [f"B: {e}" for e in
+                   check_required(series_b,
+                                  expand_required(opts.require))]
+        if not (series_a or series_b) and not errors:
+            errors.append("neither file contains telemetry records")
+        if errors:
+            print("VALIDATION FAILED:", file=sys.stderr)
+            for e in errors:
+                print(f"  {e}", file=sys.stderr)
+            return 1
+        return 0
+    if len(opts.file) != 1:
+        ap.error("exactly one file expected (use --diff to compare two)")
+    series, n_snapshots, errors = read_series(opts.file[0], telemetry,
                                               validate=opts.validate)
-    print(render(series, n_snapshots, opts.file))
+    print(render(series, n_snapshots, opts.file[0]))
     required = expand_required(opts.require)
     errors += check_required(series, required)
     if not series and not errors:

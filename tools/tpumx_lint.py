@@ -680,40 +680,46 @@ class ConcurrencyPass(Pass):
 
 
 class TelemetryCatalogPass(Pass):
-    """Metric names at emission sites must be in telemetry.KNOWN_METRICS.
+    """Names at emission sites must be in their static catalog.
 
-    Checks the first argument of ``<telemetry>.counter/gauge/histogram/
-    span(...)`` calls (any alias whose import resolves to the telemetry
-    module, or functions imported from it).  A literal name outside the
-    catalog — even in a branch the obs CI tier never executes — fails;
-    a non-literal name is flagged as unverifiable.  The telemetry module
-    itself is exempt (it is the catalog's home and manipulates records
+    Two catalogs, one discipline (stable names are an API,
+    docs/observability.md): metric names at
+    ``<telemetry>.counter/gauge/histogram/span(...)`` call sites are
+    checked against ``telemetry.KNOWN_METRICS``, and flight-recorder
+    event names at ``<tracing>.emit(...)`` call sites against
+    ``tracing.KNOWN_EVENTS`` (any alias whose import resolves to the
+    respective module, or functions imported from it).  A literal name
+    outside the catalog — even in a branch the obs CI tier never
+    executes — fails; a non-literal name is flagged as unverifiable.
+    Each catalog's home module is exempt (it manipulates records
     generically).
     """
 
     name = "telemetry-catalog"
 
     EMITTERS = frozenset({"counter", "gauge", "histogram", "span"})
+    TRACE_EMITTERS = frozenset({"emit"})
 
-    def __init__(self, known_metrics):
+    def __init__(self, known_metrics, known_events=None):
         self.known = known_metrics
+        self.known_events = known_events
 
-    def _telemetry_aliases(self, ctx):
+    @staticmethod
+    def _aliases(ctx, module, emitters):
         mods = {alias for alias, mod in ctx.mod_alias.items()
-                if mod.split(".")[-1] == "telemetry"}
+                if mod.split(".")[-1] == module}
         # `from tpu_mx import telemetry [as _telemetry]` — the module is
         # the imported NAME here, not the from-module path
         mods |= {alias for alias, (_, name) in ctx.from_imports.items()
-                 if name == "telemetry"}
+                 if name == module}
         funcs = {alias for alias, (mod, name) in ctx.from_imports.items()
-                 if name in self.EMITTERS
-                 and mod.split(".")[-1] == "telemetry"}
+                 if name in emitters and mod.split(".")[-1] == module}
         return mods, funcs
 
-    def run(self, ctx):
-        if ctx.path == "tpu_mx/telemetry.py" or self.known is None:
+    def _check(self, ctx, module, emitters, known, catalog_name):
+        if ctx.path == f"tpu_mx/{module}.py" or known is None:
             return
-        mods, funcs = self._telemetry_aliases(ctx)
+        mods, funcs = self._aliases(ctx, module, emitters)
         if not mods and not funcs:
             return
         for node in ast.walk(ctx.tree):
@@ -721,7 +727,7 @@ class TelemetryCatalogPass(Pass):
                 continue
             is_emit = False
             if (isinstance(node.func, ast.Attribute)
-                    and node.func.attr in self.EMITTERS
+                    and node.func.attr in emitters
                     and isinstance(node.func.value, ast.Name)
                     and node.func.value.id in mods):
                 is_emit = True
@@ -733,26 +739,33 @@ class TelemetryCatalogPass(Pass):
             if name is None:
                 yield ctx.finding(
                     self.name, node,
-                    f"metric name {expr_text(node.args[0])!r} is not a "
-                    "string literal — the catalog cannot verify it "
-                    "statically; emit a literal name (labels carry the "
-                    "dynamic part)")
-            elif name not in self.known:
+                    f"name {expr_text(node.args[0])!r} is not a string "
+                    f"literal — {catalog_name} cannot verify it "
+                    "statically; emit a literal name (labels/payload "
+                    "fields carry the dynamic part)")
+            elif name not in known:
                 yield ctx.finding(
                     self.name, node,
-                    f'metric name "{name}" is not in '
-                    "telemetry.KNOWN_METRICS — dashboards will never see "
+                    f'name "{name}" is not in {catalog_name} — '
+                    "dashboards and the black-box schema will never see "
                     "it; add it to the catalog (and "
                     "docs/observability.md) or fix the typo")
+
+    def run(self, ctx):
+        yield from self._check(ctx, "telemetry", self.EMITTERS,
+                               self.known, "telemetry.KNOWN_METRICS")
+        yield from self._check(ctx, "tracing", self.TRACE_EMITTERS,
+                               self.known_events, "tracing.KNOWN_EVENTS")
 
 
 # ---------------------------------------------------------------------------
 # catalog extraction (static — never imports tpu_mx)
 # ---------------------------------------------------------------------------
-def load_known_metrics(repo=REPO):
-    """Extract KNOWN_METRICS from tpu_mx/telemetry.py by parsing it —
-    no package import, so the linter needs no jax and runs anywhere."""
-    path = os.path.join(repo, "tpu_mx", "telemetry.py")
+def _load_catalog(repo, module, var):
+    """Extract a literal catalog assignment from tpu_mx/<module>.py by
+    parsing it — no package import, so the linter needs no jax and runs
+    anywhere.  Dict literals yield their key set."""
+    path = os.path.join(repo, "tpu_mx", f"{module}.py")
     try:
         with open(path, encoding="utf-8") as f:
             tree = ast.parse(f.read(), filename=path)
@@ -760,7 +773,7 @@ def load_known_metrics(repo=REPO):
         return None
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "KNOWN_METRICS"
+                isinstance(t, ast.Name) and t.id == var
                 for t in node.targets):
             value = node.value
             if (isinstance(value, ast.Call)
@@ -772,6 +785,18 @@ def load_known_metrics(repo=REPO):
             except ValueError:
                 return None
     return None
+
+
+def load_known_metrics(repo=REPO):
+    """KNOWN_METRICS from tpu_mx/telemetry.py (statically parsed)."""
+    return _load_catalog(repo, "telemetry", "KNOWN_METRICS")
+
+
+def load_known_events(repo=REPO):
+    """KNOWN_EVENTS names from tpu_mx/tracing.py (statically parsed;
+    the catalog is a dict of name -> typed payload fields — the event
+    NAMES are what emit() call sites are checked against)."""
+    return _load_catalog(repo, "tracing", "KNOWN_EVENTS")
 
 
 # ---------------------------------------------------------------------------
@@ -831,18 +856,20 @@ def write_baseline(path, findings):
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
-def build_passes(known_metrics):
+def build_passes(known_metrics, known_events=None):
     return [DurabilityPass(), DeterminismPass(), SyncPointPass(),
-            ConcurrencyPass(), TelemetryCatalogPass(known_metrics)]
+            ConcurrencyPass(),
+            TelemetryCatalogPass(known_metrics, known_events)]
 
 
-def lint_source(source, relpath, known_metrics=None, rules=None):
+def lint_source(source, relpath, known_metrics=None, rules=None,
+                known_events=None):
     """Lint one in-memory file; returns (findings, suppressed) lists.
     `relpath` decides scoping (library vs tools vs hot path), so tests
     can exercise any scope with fixture paths."""
     ctx = FileCtx(relpath, source)
     findings, suppressed = [], []
-    for p in build_passes(known_metrics):
+    for p in build_passes(known_metrics, known_events):
         if rules and p.name not in rules:
             continue
         for f in p.run(ctx):
@@ -875,7 +902,8 @@ def iter_files(targets, repo=REPO, missing=None):
                         yield os.path.join(dirpath, fname)
 
 
-def lint_paths(targets, repo=REPO, known_metrics=None, rules=None):
+def lint_paths(targets, repo=REPO, known_metrics=None, rules=None,
+               known_events=None):
     all_findings, all_suppressed, errors = [], [], []
     missing = []
     for path in iter_files(targets, repo, missing=missing):
@@ -883,7 +911,8 @@ def lint_paths(targets, repo=REPO, known_metrics=None, rules=None):
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
-            found, sup = lint_source(source, rel, known_metrics, rules)
+            found, sup = lint_source(source, rel, known_metrics, rules,
+                                     known_events=known_events)
         except SyntaxError as e:
             errors.append(f"{rel}: syntax error: {e}")
             continue
@@ -922,18 +951,24 @@ def main(argv=None):
                      f"(valid: {sorted(valid)})")
 
     known = load_known_metrics()
-    if known is None and (rules is None or "telemetry-catalog" in rules):
+    known_events = load_known_events()
+    if (known is None or known_events is None) \
+            and (rules is None or "telemetry-catalog" in rules):
         # failing OPEN here would silently disable the whole catalog
-        # pass (e.g. after a refactor that makes KNOWN_METRICS a
-        # computed expression the static extractor can't evaluate)
-        print("tpumx-lint: could not extract KNOWN_METRICS from "
-              "tpu_mx/telemetry.py — the telemetry-catalog pass cannot "
-              "run; keep the catalog a literal frozenset({...}) or "
-              "update load_known_metrics()", file=sys.stderr)
+        # pass (e.g. after a refactor that makes KNOWN_METRICS /
+        # KNOWN_EVENTS a computed expression the static extractor can't
+        # evaluate)
+        missing = "KNOWN_METRICS from tpu_mx/telemetry.py" \
+            if known is None else "KNOWN_EVENTS from tpu_mx/tracing.py"
+        print(f"tpumx-lint: could not extract {missing} — the "
+              "telemetry-catalog pass cannot run; keep the catalog a "
+              "literal frozenset({...}) / dict and update "
+              "load_known_metrics()/load_known_events()", file=sys.stderr)
         return 2
 
     findings, suppressed, errors = lint_paths(
-        opts.targets, known_metrics=known, rules=rules)
+        opts.targets, known_metrics=known, rules=rules,
+        known_events=known_events)
 
     if opts.write_baseline:
         write_baseline(opts.baseline, findings)
@@ -952,6 +987,7 @@ def main(argv=None):
             "suppressed": len(suppressed),
             "errors": errors,
             "known_metrics_loaded": known is not None,
+            "known_events_loaded": known_events is not None,
         }, indent=1, sort_keys=True))
     else:
         for f in fresh:

@@ -52,6 +52,7 @@ from . import io
 from . import image
 from . import test_utils
 from . import telemetry
+from . import tracing
 from . import profiler
 from . import monitor
 from . import runtime

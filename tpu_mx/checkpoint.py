@@ -52,6 +52,7 @@ import time
 
 from .base import MXNetError
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["atomic_write", "retry", "sha256_file", "manifest_path",
            "write_manifest", "update_manifest", "read_manifest",
@@ -230,6 +231,8 @@ def retry(fn, attempts=4, backoff=0.05, max_backoff=2.0, jitter=0.5,
             if attempt >= attempts:
                 raise
             _telemetry.counter("checkpoint.retries").inc()
+            _tracing.emit("checkpoint.retry", attempt=attempt,
+                          error=f"{type(e).__name__}: {e}")
             sleep = delay * (1.0 + float(jitter) * rng.random())
             log.warning("retry %d/%d: %s: %s (backing off %.3fs)",
                         attempt, attempts, type(e).__name__, e, sleep)
@@ -355,6 +358,8 @@ def verify_checkpoint(prefix, epoch):
         time.perf_counter() - t_start)
     if status == "corrupt":
         _telemetry.counter("checkpoint.corrupt_detected").inc()
+    _tracing.emit("checkpoint.verify", prefix=os.path.basename(str(prefix)),
+                  epoch=int(epoch), status=status)
     return status, problems
 
 
@@ -489,11 +494,13 @@ class PreemptionHandler:
     Use :func:`preemption_handler` to construct; call ``uninstall()`` when
     the training loop exits normally."""
 
-    def __init__(self, save_fn, signals, exit, exit_code):
+    def __init__(self, save_fn, signals, exit, exit_code,
+                 blackbox_prefix=None):
         self._save_fn = save_fn
         self._signals = tuple(signals)
         self._exit = exit
         self._exit_code = exit_code
+        self._blackbox_prefix = blackbox_prefix
         self._prev = {}
         self._lock = threading.Lock()
         self.triggered = False
@@ -525,6 +532,19 @@ class PreemptionHandler:
         except BaseException:
             self.save_ok = False
             log.exception("emergency checkpoint failed; exiting anyway")
+        _tracing.emit("checkpoint.preemption", signum=int(signum),
+                      save_ok=bool(self.save_ok))
+        if self._blackbox_prefix:
+            # the preemption black box: what the run was doing when the
+            # platform killed it (a dump failure must not eat the grace
+            # window's remaining seconds — the emergency save landed)
+            try:
+                _tracing.dump_blackbox(
+                    self._blackbox_prefix,
+                    reason=f"preemption signal {signum} "
+                           f"(emergency save_ok={self.save_ok})")
+            except Exception:
+                log.exception("preemption black-box dump failed")
         self.uninstall()
         if self._exit:
             code = self._exit_code if self._exit_code is not None \
@@ -533,7 +553,7 @@ class PreemptionHandler:
 
 
 def preemption_handler(save_fn, signals=(signal.SIGTERM, signal.SIGINT),
-                       exit=True, exit_code=None):
+                       exit=True, exit_code=None, blackbox_prefix=None):
     """Install SIGTERM/SIGINT hooks that run one emergency atomic save.
 
     ``save_fn`` should be a zero-arg durable saver, e.g.::
@@ -542,6 +562,11 @@ def preemption_handler(save_fn, signals=(signal.SIGTERM, signal.SIGINT),
             lambda: elastic.save_checkpoint(prefix, epoch_box[0],
                                             net=net, trainer=trainer))
 
-    Returns the installed :class:`PreemptionHandler` (``.uninstall()`` on
-    clean shutdown; ``.triggered`` / ``.save_ok`` for inspection)."""
-    return PreemptionHandler(save_fn, signals, exit, exit_code).install()
+    ``blackbox_prefix=`` additionally dumps a flight-recorder black box
+    (``<prefix>-blackbox.json``, docs/observability.md) after the
+    emergency save, so a preempted run leaves its last-N-steps timeline
+    behind, not just its weights.  Returns the installed
+    :class:`PreemptionHandler` (``.uninstall()`` on clean shutdown;
+    ``.triggered`` / ``.save_ok`` for inspection)."""
+    return PreemptionHandler(save_fn, signals, exit, exit_code,
+                             blackbox_prefix=blackbox_prefix).install()

@@ -67,6 +67,7 @@ import threading
 import time
 
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 
 __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
            "wrap_file", "maybe_oserror", "peer_killed", "poison_loss",
@@ -76,8 +77,11 @@ __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
 def _count_injection(kind):
     """Every fault actually FIRED lands in the telemetry registry tagged by
     kind — chaos tests assert the *observability* of faults, not just
-    survival (ISSUE 3)."""
+    survival (ISSUE 3) — and on the flight-recorder timeline with the
+    step-scoped trace context, so the injection and the recovery it
+    provokes correlate in the black box (docs/observability.md)."""
     _telemetry.counter("chaos.injections", kind=kind).inc()
+    _tracing.emit("chaos.inject", kind=kind)
 
 log = logging.getLogger(__name__)
 

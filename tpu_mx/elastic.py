@@ -40,10 +40,12 @@ import logging
 import os
 import pickle
 import re
+import time as _time
 
 from .base import MXNetError
 from . import checkpoint as _ckpt
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 from .checkpoint import preemption_handler  # noqa: F401  (re-export)
 
 __all__ = ["WorkerFailure", "barrier", "latest_checkpoint",
@@ -129,6 +131,9 @@ def _screened_checkpoints(prefix):
         elif status == "legacy":
             if newest_manifested is not None and epoch > newest_manifested:
                 _telemetry.counter("elastic.epochs_skipped_corrupt").inc()
+                _tracing.emit("elastic.epoch_skipped", epoch=epoch,
+                              reason="manifest-less newer than a "
+                                     "manifested epoch (interrupted save)")
                 log.warning(
                     "checkpoint epoch %d of %s has no manifest although "
                     "older epochs of this prefix do: treating it as a save "
@@ -143,6 +148,8 @@ def _screened_checkpoints(prefix):
             yield epoch, found[epoch], status
         else:
             _telemetry.counter("elastic.epochs_skipped_corrupt").inc()
+            _tracing.emit("elastic.epoch_skipped", epoch=epoch,
+                          reason="; ".join(problems)[:200])
             log.warning("skipping corrupt checkpoint epoch %d of %s: %s",
                         epoch, prefix, "; ".join(problems))
 
@@ -228,6 +235,7 @@ def auto_resume(prefix, net=None, module=None, trainer=None):
                     "(%s: %s) — falling back a checkpoint", epoch, states,
                     type(e).__name__, e)
                 continue
+        _tracing.emit("elastic.resume", resume_from=epoch + 1)
         return epoch + 1
     if mutated:
         raise MXNetError(
@@ -261,6 +269,7 @@ def save_checkpoint(prefix, epoch, net=None, trainer=None, keep_last=None,
     `preemption_handler`."""
     if net is None and trainer is None:
         raise MXNetError("save_checkpoint: pass net= and/or trainer=")
+    t_save = _time.perf_counter()
     with _telemetry.span("checkpoint.save_seconds"):
         files = []
         params = f"{prefix}-{epoch:04d}.params"
@@ -283,4 +292,7 @@ def save_checkpoint(prefix, epoch, net=None, trainer=None, keep_last=None,
             # the full from-disk re-hash the newest-verified scan would
             # otherwise do
             _ckpt.apply_retention(prefix, keep_last, known_verified=epoch)
+        _tracing.emit("checkpoint.save", t0=t_save, t1=_time.perf_counter(),
+                      prefix=os.path.basename(str(prefix)),
+                      epoch=int(epoch))
         return params

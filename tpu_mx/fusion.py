@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as _np
 
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["enabled", "flush", "stats", "reset_stats", "pending_ops",
            "cache_stats", "FusionSegment"]
@@ -443,6 +444,9 @@ def _execute(seg, reason):
     # the number that tells an operator what the engine actually won
     _telemetry.counter("fusion.flushes").inc()
     _telemetry.counter("fusion.ops_fused").inc(len(seg.fns))
+    # flight-recorder event at flush granularity (never per-op): the
+    # black box can attribute a flush storm to the step that caused it
+    _tracing.emit("fusion.flush", cause=reason, ops=len(seg.fns))
 
     # ---- autograd: the whole segment becomes ONE tape node -------------
     # Only inexact outputs of DIFF nodes join the tape: integer outputs

@@ -26,8 +26,11 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     epoch's training-state capsule, tpu_mx/resume.py) to fold into the
     manifest's verified file table before the commit."""
     import os
+    import time
     from . import checkpoint as _ckpt
     from . import telemetry as _telemetry
+    from . import tracing as _tracing
+    t_save = time.perf_counter()
     with _telemetry.span("checkpoint.save_seconds"):
         extra = None
         if symbol is not None:
@@ -49,6 +52,8 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
         _nd.save(params, save_dict)
         _ckpt.write_manifest(prefix, epoch, [params, *extra_files],
                              extra=extra)
+    _tracing.emit("checkpoint.save", t0=t_save, t1=time.perf_counter(),
+                  prefix=os.path.basename(str(prefix)), epoch=int(epoch))
 
 
 def load_checkpoint(prefix, epoch):

@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 from ..ndarray import NDArray
 
 __all__ = ["CompiledTrainStep", "fsdp_rules", "sharding_for", "apply_rules"]
@@ -586,7 +587,11 @@ class CompiledTrainStep:
                 loss = self._step(batch, lr, expect_gen=gen0)
                 # force the async dispatch to completion INSIDE the
                 # watchdog thread — a hung collective parks here
+                t_read = time.perf_counter()
                 jax.block_until_ready(loss._data)
+                _tracing.emit("train_step.phase", t0=t_read,
+                              t1=time.perf_counter(),
+                              phase="loss_readback")
                 return loss
 
             count0 = self._build_count
@@ -613,14 +618,27 @@ class CompiledTrainStep:
         raw = tuple(b._data if isinstance(b, NDArray)
                     else (None if b is None else jnp.asarray(b))
                     for b in batch)
+        # flight-recorder phase events (docs/observability.md): the step
+        # histogram split into its host-side stations — the device-side
+        # forward+backward+optimizer is ONE XLA program, so "dispatch"
+        # covers its (async) enqueue and "loss_readback" (emitted at the
+        # read sites) the block on its result
+        t_data = time.perf_counter()
+        _tracing.emit("train_step.phase", t0=t_start, t1=t_data,
+                      phase="data_wait")
         if self._jitted is None:
             self._build(len(raw))
             self.place()
+            _tracing.emit("train_step.phase", t0=t_data,
+                          t1=time.perf_counter(), phase="recompile")
         key = _random.take_key()
         if self._accum > 1 and self._micro < self._accum - 1:
             # microbatch: accumulate grads, no optimizer application
+            t_disp = time.perf_counter()
             new_vals, new_gacc, loss = self._accum_jit(
                 self.values, self._gacc, key, *raw)
+            _tracing.emit("train_step.phase", t0=t_disp,
+                          t1=time.perf_counter(), phase="dispatch")
             with self._state_lock:
                 if self._stale(expect_gen):
                     return NDArray(loss)
@@ -633,11 +651,15 @@ class CompiledTrainStep:
             sched = self.optimizer.lr_scheduler
             lr = sched(t_next) if sched else self.optimizer.lr
         gacc = self._gacc if self._accum > 1 else {}
+        t_disp = time.perf_counter()
         (new_vals, new_masters, new_states, new_efs, gacc,
          loss) = self._jitted(
             self.values, self.masters, self.opt_states, self._efs, gacc,
             jnp.asarray(t_next, jnp.float32), jnp.asarray(lr, jnp.float32),
             key, *raw)
+        t_done = time.perf_counter()
+        _tracing.emit("train_step.phase", t0=t_disp, t1=t_done,
+                      phase="dispatch")
         with self._state_lock:
             if self._stale(expect_gen):
                 return NDArray(loss)
@@ -647,6 +669,11 @@ class CompiledTrainStep:
             self._micro = 0
             if self._accum > 1:
                 self._gacc = gacc
+        # the optimizer's device work is inside the fused program; this
+        # phase is the host-side commit of its result (the new train
+        # state becoming THE state, under the zombie-step lock)
+        _tracing.emit("train_step.phase", t0=t_done,
+                      t1=time.perf_counter(), phase="optimizer_update")
         self._record_step(raw, t_start)
         return NDArray(loss)
 

@@ -62,6 +62,7 @@ from .base import MXNetError
 from . import checkpoint as _ckpt
 from . import random as _random
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["CAPSULE_FORMAT", "CapsuleManager", "ModuleState",
            "encode_state", "decode_state", "capsule_path",
@@ -261,6 +262,8 @@ class CapsuleManager:
             f.write(json.dumps(body, sort_keys=True))
         self._written_epoch = int(epoch)
         _telemetry.counter("resume.capsules_written", kind="epoch").inc()
+        _tracing.emit("resume.capsule_write", kind="epoch",
+                      epoch=int(epoch), step=int(step))
         return path
 
     def on_epoch(self, epoch, sup=None):
@@ -298,6 +301,8 @@ class CapsuleManager:
         with _ckpt.atomic_write(step_capsule_path(self.prefix), "w") as f:
             f.write(json.dumps(body, sort_keys=True))
         _telemetry.counter("resume.capsules_written", kind="step").inc()
+        _tracing.emit("resume.capsule_write", kind="step",
+                      epoch=int(epoch or 0), step=int(step))
 
     def _discard_step_capsule(self):
         for p in (step_capsule_path(self.prefix),
@@ -369,8 +374,11 @@ class CapsuleManager:
         t0 = time.perf_counter()
         gap = 0
         out = int(resume_from)
+        used = "none"
+        resumed_step = 0
         try:
             if not use_step:
+                used = "discarded"
                 log.warning(
                     "numeric rollback: discarding the step capsule (it "
                     "holds the diverged trajectory) and keeping the live "
@@ -386,6 +394,8 @@ class CapsuleManager:
                 self.state.load_state_dict(
                     _load_sidecar(step_state_path(self.prefix)))
                 out = int(step_cap["epoch"])
+                used = "step"
+                resumed_step = int(step_cap["step"])
                 if sup is not None:
                     sup._pending_resume = (out, int(step_cap["step"]))
                 log.info("capsule: resuming mid-epoch at epoch %d, step %d "
@@ -399,6 +409,7 @@ class CapsuleManager:
                     if resume_from > 0 else None
                 if epoch_cap is not None:
                     self._apply(epoch_cap, sup)
+                    used = "epoch"
                     log.info("capsule: resuming at the epoch %d boundary "
                              "with the exact RNG stream", resume_from)
                 elif step_cap is not None:
@@ -410,6 +421,8 @@ class CapsuleManager:
             _telemetry.gauge("resume.resume_step_gap").set(gap)
             _telemetry.histogram("resume.capsule_restore_seconds").observe(
                 time.perf_counter() - t0)
+            _tracing.emit("resume.capsule_restore", used=used,
+                          epoch=int(out), step=resumed_step, gap=int(gap))
         return out
 
 

@@ -71,6 +71,7 @@ from collections import deque
 from .base import MXNetError
 from . import checkpoint as _ckpt
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 from .contrib.chaos import ChaosCrash
 from .elastic import WorkerFailure
 
@@ -168,6 +169,8 @@ def run_with_deadline(fn, deadline, name="step", grace=0.0,
                 grace)
         if not (in_grace and done.wait(grace)) and not done.is_set():
             _telemetry.counter("supervisor.watchdog_fires").inc()
+            _tracing.emit("supervisor.watchdog_fire", name=str(name),
+                          deadline_seconds=float(deadline))
             raise WatchdogTimeout(
                 message or f"watchdog: {name} hung past its "
                 f"{deadline:.1f}s deadline (stalled collective or compile) "
@@ -337,16 +340,27 @@ class Supervisor:
     cursors, sentinel ledger) and, when the manager has a step interval,
     a rolling mid-epoch step capsule — restarts and rollbacks then resume
     at the exact batch with the exact RNG stream instead of re-feeding or
-    skipping data."""
+    skipping data.
+
+    ``blackbox`` (a checkpoint prefix) arms the flight recorder's crash
+    black box (docs/observability.md): every restart, rollback and
+    degrade dumps the last-N-steps event timeline, a telemetry snapshot
+    and an environment fingerprint to ``<prefix>-blackbox.json`` through
+    ``checkpoint.atomic_write``; render it with
+    ``tools/blackbox_report.py``."""
 
     def __init__(self, save_fn=None, restore_fn=None, *, deadline=None,
                  compile_grace=120.0, max_restarts=3, max_rollbacks=3,
                  skip_limit=2, spike_factor=None, window=32,
                  max_grad_norm=None, cooldown=0.0, backoff=0.5,
                  max_backoff=30.0, jitter=0.5, transient=None, resume=True,
-                 seed=None, on_degraded=None, capsule=None):
+                 seed=None, on_degraded=None, capsule=None, blackbox=None):
         self.save_fn = save_fn
         self.restore_fn = restore_fn
+        # flight-recorder black box (docs/observability.md): a checkpoint
+        # prefix; every recovery decision and degrade dumps the last-N-
+        # steps timeline + telemetry snapshot to <prefix>-blackbox.json
+        self.blackbox = blackbox
         self.deadline = deadline
         self.compile_grace = compile_grace
         self.max_restarts = int(max_restarts)
@@ -423,6 +437,15 @@ class Supervisor:
         thread (before ``fn``), ``nan_after`` poisons the observed loss."""
         from .contrib import chaos
 
+        # stamp the trace context BEFORE anything can fail: every event
+        # this step emits — chaos injections, watchdog fires (on the
+        # watchdog thread: the context is process-global by design),
+        # phase timings, the classification — carries the in-flight
+        # step's (epoch, step, generation) identity
+        _tracing.set_context(epoch=self._epoch,
+                             step=self._step_in_epoch + 1,
+                             generation=self.generation)
+
         def call():
             chaos.maybe_hang()
             value = fn()
@@ -431,7 +454,11 @@ class Supervisor:
             # device read below is where a hung collective actually
             # blocks, and it must block on the watchdog's thread, not the
             # supervisor's
-            return value, _observable(value)
+            t_read = time.perf_counter()
+            obs = _observable(value)
+            _tracing.emit("train_step.phase", t0=t_read,
+                          t1=time.perf_counter(), phase="loss_readback")
+            return value, obs
 
         try:
             value, (loss, grad_norm) = run_with_deadline(
@@ -448,6 +475,9 @@ class Supervisor:
             if verdict == "skip":
                 self.batches_skipped += 1
                 _telemetry.counter("supervisor.batches_skipped").inc()
+                _tracing.emit(
+                    "supervisor.sentinel_skip", loss=float(loss),
+                    consecutive_bad=int(self._sentinel._consecutive_bad))
             elif verdict == "diverge":
                 raise NumericDivergence(
                     f"training diverged at epoch {self._epoch} "
@@ -493,6 +523,8 @@ class Supervisor:
         while epoch < int(num_epoch):
             self._epoch = epoch
             self._step_in_epoch = self.resume_step(epoch)
+            _tracing.set_context(epoch=epoch, step=self._step_in_epoch,
+                                 generation=self.generation)
             try:
                 epoch_fn(epoch)
                 self._pending_resume = None
@@ -502,6 +534,16 @@ class Supervisor:
                     self.capsule.on_epoch(epoch, self)
             except BaseException as e:  # noqa: BLE001 — classified below
                 kind = classify(e, self.transient)
+                # the classification IS the supervisor's decision: it goes
+                # on the timeline under the FAILING step's trace context
+                # (the context advances only at the next step/epoch top,
+                # so the restart/rollback events below — emitted after the
+                # restore — still share it; that shared (epoch, step,
+                # generation) is what lets the black box link
+                # injection → detection → decision)
+                _tracing.emit("supervisor.classify", kind=kind,
+                              error=type(e).__name__,
+                              message=str(e)[:300])
                 if kind == "fatal":
                     log.error("supervisor: fatal %s at epoch %d — "
                               "propagating (programming errors are not "
@@ -518,6 +560,12 @@ class Supervisor:
                                 self.max_rollbacks, self.cooldown)
                     self._sentinel.reset()
                     epoch = self._restore(epoch, kind="numeric")
+                    _tracing.emit("supervisor.rollback", n=self.rollbacks,
+                                  resume_epoch=int(epoch))
+                    self._dump_blackbox(
+                        f"{type(e).__name__}: {e} — rollback "
+                        f"{self.rollbacks}/{self.max_rollbacks} to "
+                        f"epoch {epoch}")
                     if self.cooldown:
                         time.sleep(self.cooldown)
                 else:  # transient
@@ -534,6 +582,13 @@ class Supervisor:
                                 self.max_restarts, sleep, e)
                     time.sleep(sleep)
                     epoch = self._restore(epoch)
+                    _tracing.emit("supervisor.restart", n=self.restarts,
+                                  backoff_seconds=float(sleep),
+                                  resume_epoch=int(epoch))
+                    self._dump_blackbox(
+                        f"{type(e).__name__}: {e} — restart "
+                        f"{self.restarts}/{self.max_restarts} from "
+                        f"epoch {epoch}")
                 _telemetry.flush()
             else:
                 epoch += 1
@@ -580,6 +635,8 @@ class Supervisor:
         log.error("supervisor: %s budget exhausted at epoch %d (%s: %s) — "
                   "entering degraded shutdown",
                   budget, epoch, type(err).__name__, err)
+        _tracing.emit("supervisor.degrade", budget=budget,
+                      error=f"{type(err).__name__}: {err}"[:300])
         if classify(err, self.transient) == "numeric":
             if self.restore_fn is not None:
                 try:
@@ -595,10 +652,24 @@ class Supervisor:
                           save_err)
         if self.on_degraded is not None:
             self.on_degraded(self, err)
+        self._dump_blackbox(f"degraded: {budget} budget exhausted "
+                            f"({type(err).__name__}: {err})")
         _telemetry.flush()
         return self._result("degraded", None, None, epoch,
                             reason=f"{budget} exhausted: "
                                    f"{type(err).__name__}: {err}")
+
+    def _dump_blackbox(self, reason):
+        """Persist the flight-recorder black box (no-op without a
+        ``blackbox`` prefix).  A dump failure is logged, never raised —
+        forensics must not mask the fault being recorded."""
+        if not self.blackbox:
+            return None
+        try:
+            return _tracing.dump_blackbox(self.blackbox, reason=reason)
+        except Exception as dump_err:  # noqa: BLE001 — best effort
+            log.warning("supervisor: black-box dump failed: %s", dump_err)
+            return None
 
     def _result(self, status, begin_epoch, num_epoch, last_epoch,
                 reason=None):
@@ -657,7 +728,12 @@ def for_module(module, config, train_data=None):
             "(pass supervised=Supervise(prefix='ck'))")
     from . import elastic as _elastic
 
-    sup = Supervisor(**config.supervisor_kwargs)
+    sup_kwargs = dict(config.supervisor_kwargs)
+    # the flight recorder rides the checkpoint prefix by default: every
+    # recovery decision leaves <prefix>-blackbox.json behind (pass
+    # blackbox=None through Supervise to opt out)
+    sup_kwargs.setdefault("blackbox", config.prefix)
+    sup = Supervisor(**sup_kwargs)
     if config.capsule or config.capsule_interval:
         from . import resume as _resume
         if hasattr(config.capsule, "restore"):  # a prebuilt manager

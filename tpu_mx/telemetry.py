@@ -96,6 +96,9 @@ KNOWN_METRICS = frozenset({
     "resume.resume_step_gap",
     # fault injection (tpu_mx/contrib/chaos.py)
     "chaos.injections",
+    # flight recorder (tpu_mx/tracing.py; event NAMES live in its own
+    # KNOWN_EVENTS catalog — this counts black boxes persisted)
+    "tracing.blackbox_dumps",
     # module-API training (tpu_mx/callback.py)
     "speedometer.samples_per_sec",
 })
