@@ -1025,6 +1025,151 @@ def bench_fusion(smoke):
     }
 
 
+def bench_serve(smoke):
+    """Serving A/B: continuous batching vs naive static batching over a
+    synthetic heavy-traffic trace (ISSUE 8 acceptance).
+
+    Fixed-seed workload: Poisson arrivals (exponential inter-arrival
+    gaps in engine-step units), mixed prompt lengths and heavy-tailed
+    output lengths — the regime where static batching pads every slot to
+    its batch's slowest member while continuous batching refills freed
+    slots on the next step.  Both arms run the SAME trace through the
+    SAME model/engine/cache config; only the scheduler differs
+    (tpu_mx/serving/scheduler.py).  Reported: tokens/s per arm, the
+    continuous/static speedup (acceptance bar: >= 2x), p50/p99 TTFT and
+    ITL (exact percentiles off the per-request timestamps — the
+    telemetry histograms are the production view, bucket-granular), and
+    the O(1) receipt: per-token decode latency early vs late in a long
+    generation (flat = the paged cache's append cost does not grow with
+    generated length at this scale; the dense-gather O(context) term is
+    below host overhead here, docs/serving.md)."""
+    import numpy as np
+    from tpu_mx import serving
+
+    seed = 20260804
+    n_req = 16 if smoke else 64
+    long_gen = 64 if smoke else 256
+    # 16-wide batches: wide enough that the static baseline's
+    # pad-to-slowest waste is the realistic one (the wider the batch,
+    # the worse the max-over-batch padding — and the better continuous
+    # amortizes its fixed per-step cost)
+    max_batch = 16
+    rng = np.random.RandomState(seed)
+    prompts = [list(1 + rng.randint(0, 120, size=int(n)))
+               for n in rng.choice([8, 16, 32], size=n_req)]
+    # heavy-tailed outputs: the 96-token tail is what static batching
+    # pads every batch member to
+    outs = [int(v) for v in rng.choice(
+        [4, 8, 16, 96], size=n_req, p=[0.30, 0.30, 0.25, 0.15])]
+    arrival_step = np.floor(np.cumsum(
+        rng.exponential(0.5, size=n_req))).astype(int)
+    model = serving.TinyLM(vocab_size=128, embed_dim=64, num_heads=4,
+                           num_layers=2, seed=0)
+
+    def pct(vals, q):
+        return float(np.percentile(np.asarray(vals, np.float64), q))
+
+    def run_arm(sched_cls):
+        srv = serving.Server(
+            model, scheduler=sched_cls(max_pending=n_req + 1,
+                                       max_batch=max_batch,
+                                       max_tokens=10 ** 9),
+            num_blocks=4096, block_size=16)
+        reqs, i, step = [], 0, 0
+        t0 = time.perf_counter()
+        while i < n_req or not srv.scheduler.idle():
+            while i < n_req and arrival_step[i] <= step:
+                reqs.append(srv.submit(prompts[i], max_new_tokens=outs[i]))
+                i += 1
+            srv.step()
+            step += 1
+        wall = time.perf_counter() - t0
+        total = sum(len(r.tokens) for r in reqs)
+        assert total == sum(outs), "lost tokens"
+        ttft = [r.ttft * 1e3 for r in reqs]
+        itl = [dt * 1e3
+               for r in reqs
+               for dt in np.diff(r.token_times)] or [0.0]
+        return {"tokens_per_sec": round(total / wall, 1),
+                "steps": step, "wall_s": round(wall, 3),
+                "ttft_ms_p50": round(pct(ttft, 50), 2),
+                "ttft_ms_p99": round(pct(ttft, 99), 2),
+                "itl_ms_p50": round(pct(itl, 50), 3),
+                "itl_ms_p99": round(pct(itl, 99), 3)}
+
+    # warm both code paths before timing either arm: the first prefill/
+    # decode at each shape pays one-time numpy/dispatch setup (measured
+    # ~6ms vs ~0.8ms for an L=32 prefill) that would otherwise be billed
+    # entirely to whichever arm runs first — same discipline as the
+    # fusion leg's dual-arm warmup
+    wsrv = serving.Server(model, num_blocks=4096, block_size=16,
+                          max_batch=max_batch)
+    for p in ([8, 9] * 4, [8, 9] * 8, [8, 9] * 16):
+        wsrv.submit(list(p), max_new_tokens=8)
+    wsrv.run_until_idle()
+
+    log(f"serve: {n_req}-request Poisson trace, continuous arm...")
+    cont = run_arm(serving.ContinuousBatchingScheduler)
+    log(f"  continuous: {cont['tokens_per_sec']} tok/s in "
+        f"{cont['steps']} steps; ttft p50/p99 "
+        f"{cont['ttft_ms_p50']}/{cont['ttft_ms_p99']} ms")
+    log("serve: static arm...")
+    stat = run_arm(serving.StaticBatchingScheduler)
+    log(f"  static:     {stat['tokens_per_sec']} tok/s in "
+        f"{stat['steps']} steps")
+    speedup = cont["tokens_per_sec"] / max(stat["tokens_per_sec"], 1e-9)
+
+    # O(1) receipt: one long generation, ITL early vs late.  The paged
+    # append is O(1); at this scale the dense-gather O(context) term
+    # stays under host dispatch noise — the ratio must sit near 1.
+    # two probe runs, window MEDIANS, min-of-pairs: a single
+    # preempted-by-the-OS token (or one noisy run) would otherwise fake
+    # or hide growth — same min-of-repeats discipline as the other legs
+    early = late = None
+    for _ in range(2):
+        srv = serving.Server(model, num_blocks=4096, block_size=16)
+        lr = srv.submit(prompts[0], max_new_tokens=long_gen)
+        srv.run_until_idle()
+        d = np.diff(lr.token_times) * 1e6
+        e = float(np.median(d[8:40]))
+        l = float(np.median(d[-32:]))
+        early = e if early is None else min(early, e)
+        late = l if late is None else min(late, l)
+    log(f"serve: per-token decode early {early:.0f}us late {late:.0f}us "
+        f"(x{late / early:.2f} over {long_gen} tokens)")
+
+    return {
+        "metric": "serve_continuous_tokens_per_sec"
+        if not smoke else "serve_smoke_tokens_per_sec",
+        "value": cont["tokens_per_sec"],
+        "unit": "tok/s",
+        "vs_baseline": None,
+        "speedup_vs_static": round(speedup, 2),
+        "continuous": cont,
+        "static": stat,
+        # O(1)-append receipt.  A cache-less (recompute-the-prefix)
+        # decode's per-token cost scales ~linearly with context —
+        # "linear_would_be" is the late/early CONTEXT ratio such a decode
+        # would show; the small measured residual is the documented
+        # dense-gather O(context) fallback term (docs/DIVERGENCES.md
+        # #27) riding on an O(1) paged append.
+        "per_token_flat": {"early_itl_us": round(early, 1),
+                           "late_itl_us": round(late, 1),
+                           "late_over_early": round(late / early, 3),
+                           "generated": long_gen,
+                           "linear_would_be": round(
+                               (len(prompts[0]) + long_gen - 16)
+                               / (len(prompts[0]) + 24), 1)},
+        "n_requests": n_req,
+        "max_batch": max_batch,
+        "trace_seed": seed,
+        "model": {"vocab": model.vocab_size, "embed": model.embed_dim,
+                  "heads": model.num_heads, "layers": model.num_layers},
+        "platform": "host",   # numpy data plane; the dense-gather decode
+                              # fallback is the measured path (#27)
+    }
+
+
 def bench_scaling(smoke):
     """Weak-scaling efficiency over all visible devices (BASELINE metric 3
     'scaling efficiency' — the full 8→256-chip number needs a pod slice;
@@ -1088,7 +1233,7 @@ def inner():
                              "resnet50,bert,bert512,lstm,ssd").split(",")
               if m.strip()]
     unknown = set(models) - {"resnet50", "bert", "bert512", "scaling",
-                             "lstm", "ssd", "fusion"}
+                             "lstm", "ssd", "fusion", "serve"}
     if unknown or not models:
         raise SystemExit(f"BENCH_MODELS: unknown/empty model list {models}")
     log(f"inner start (smoke={smoke}, layout={layout}, stem={stem}, "
@@ -1206,6 +1351,7 @@ def inner():
         "bert512": "bert_base_seq512_train_seqs_per_sec_per_chip",
         "lstm": "lstm_ptb_train_tokens_per_sec_per_chip",
         "fusion": "imperative_pointwise_fusion_speedup",
+        "serve": "serve_continuous_tokens_per_sec",
         "ssd": "ssd512_train_images_per_sec_per_chip"
         if ssd_backbone == "vgg16_reduced"
         else f"ssd512_{ssd_backbone}_train_images_per_sec_per_chip"}
@@ -1226,11 +1372,13 @@ def inner():
     # inside that compile burned the rest of a 15-minute window while
     # lstm/ssd were still unmeasured — the riskiest leg must not sit in
     # front of cheap ones
-    for name, fn_extra in (("fusion", bench_fusion), ("lstm", bench_lstm),
-                           ("ssd", bench_ssd), ("bert512", bench_bert512)):
+    for name, fn_extra in (("fusion", bench_fusion), ("serve", bench_serve),
+                           ("lstm", bench_lstm), ("ssd", bench_ssd),
+                           ("bert512", bench_bert512)):
         if name not in models:
             continue
-        if skip_fresh and name != "fusion":  # fusion re-measures in seconds
+        # fusion and serve re-measure in seconds: never carry them
+        if skip_fresh and name not in ("fusion", "serve"):
             # lstm/ssd honor BENCH_ITERS too, so they need the same
             # short-timing-record gate as resnet — keyed on the CANONICAL
             # full-run counts, not the env-derived value (ADVICE r5 low);

@@ -329,6 +329,91 @@ def test_concurrency_join_rule_ignores_path_and_string_joins():
     assert found == []
 
 
+def test_concurrency_module_level_lock_dict_pair_fires():
+    # the checkpoint._intended shape (ROADMAP limitation closed in
+    # ISSUE 8): module-level lock/state pairs, not just class-scoped
+    found, _ = run("""
+        import threading
+
+        _lock = threading.Lock()
+        _intended = {}
+        _count = 0
+
+        def put(key, info):
+            with _lock:
+                _intended[key] = info
+
+        def evict(key):
+            _intended[key] = None       # lock-free subscript mutation
+
+        def bump():
+            global _count
+            with _lock:
+                _count += 1
+
+        def reset():
+            global _count
+            _count = 0                  # lock-free global rebind
+        """, "tpu_mx/foo.py", rules={"concurrency"})
+    assert len(found) == 2
+    msgs = " ".join(f.message for f in found)
+    assert "_intended" in msgs and "_count" in msgs
+    assert "module global" in msgs
+
+
+def test_concurrency_module_level_silent_on_look_alikes():
+    found, _ = run("""
+        import threading
+
+        _lock = threading.Lock()
+        _intended = {}
+        _env_parsed = False
+
+        _intended["init"] = 1           # import time: pre-publication
+
+        class Boot:
+            _intended_copy = dict(_intended)   # class body: import time
+
+        def put(key, info):
+            with _lock:
+                _intended[key] = info
+
+        def parse():
+            # never lock-guarded anywhere: single-discipline, fine
+            global _env_parsed
+            _env_parsed = True
+
+        def local_shadow(_intended):
+            _intended["x"] = 1          # parameter shadows the global
+
+        def local_rebind():
+            _intended = {}              # no global decl: a local
+            _intended["x"] = 1
+        """, "tpu_mx/foo.py", rules={"concurrency"})
+    assert found == []
+
+
+def test_concurrency_module_level_closure_under_lock_still_unguarded():
+    # defining a function under a lock does not RUN it under the lock
+    found, _ = run("""
+        import threading
+
+        _lock = threading.Lock()
+        _state = {}
+
+        def guarded(k, v):
+            with _lock:
+                _state[k] = v
+
+        def maker():
+            with _lock:
+                def inner(k):
+                    _state[k] = 0       # runs later, lock-free
+                return inner
+        """, "tpu_mx/foo.py", rules={"concurrency"})
+    assert len(found) == 1 and "_state" in found[0].message
+
+
 def test_concurrency_thread_alias_and_annotated_assign():
     # `from threading import Thread as T` must still be detected, and an
     # ANNOTATED lock-free assignment of a guarded attr must still flag

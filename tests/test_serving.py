@@ -1,0 +1,658 @@
+"""Inference serving runtime (tpu_mx/serving/) — ISSUE 8.
+
+Covers: the block allocator (exhaustion -> backpressure never OOM,
+free-on-completion reuse, double-free detection, state under concurrent
+alloc/free), the paged KV cache (block-table correctness vs a dense
+reference cache — BIT-identical gathers and logits), the
+continuous-batching scheduler (admission budget, bounded-queue
+reject-with-reason, immediate eviction, requeue), the request front-end
+(submit/stream, deterministic greedy generation), and the self-healing
+paths (hung decode -> watchdog -> classified engine restart with zero
+lost requests; NaN logits -> restart; chaos reject_storm; degraded
+shutdown fails requests loudly)."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_mx import telemetry, tracing
+from tpu_mx.base import MXNetError
+from tpu_mx.contrib import chaos
+from tpu_mx import serving
+from tpu_mx.serving import (AdmissionReject, BlockAllocator, CacheExhausted,
+                            ContinuousBatchingScheduler, EngineCore,
+                            PagedKVCache, Request, Server,
+                            StaticBatchingScheduler, TinyLM)
+from tpu_mx.serving.attention import decode_attention, dense_attention
+from tpu_mx.supervisor import NumericDivergence
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Tracing/telemetry state is process-global — isolate every test."""
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+def tiny(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("embed_dim", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("seed", 0)
+    return TinyLM(**kw)
+
+
+# ---------------------------------------------------------------------------
+# block allocator
+# ---------------------------------------------------------------------------
+def test_allocator_roundtrip_and_exhaustion_is_backpressure():
+    a = BlockAllocator(4)
+    got = a.alloc(3)
+    assert len(got) == 3 and a.available == 1
+    # exhaustion raises CacheExhausted (backpressure), all-or-nothing:
+    # the one free block must NOT leak on the failed 2-block grab
+    with pytest.raises(CacheExhausted):
+        a.alloc(2)
+    assert a.available == 1
+    a.free(got)
+    assert a.available == 4 and a.used == 0
+
+
+def test_allocator_free_reuse_is_copy_free_lifo():
+    a = BlockAllocator(8)
+    first = a.alloc(2)
+    a.free(first)
+    # the freed blocks are handed out again (reuse, no compaction)
+    again = a.alloc(2)
+    assert set(again) == set(first)
+
+
+def test_allocator_double_free_is_loud():
+    a = BlockAllocator(2)
+    got = a.alloc(1)
+    a.free(got)
+    with pytest.raises(MXNetError):
+        a.free(got)
+    with pytest.raises(MXNetError):
+        a.free([99])
+
+
+def test_allocator_concurrent_alloc_free_invariants():
+    """Hammer alloc/free from several threads: no block is ever held by
+    two owners, nothing leaks, and the final free count is exact."""
+    a = BlockAllocator(64)
+    owned = [[] for _ in range(4)]
+    errs = []
+
+    def worker(i, iters=300):
+        rng = np.random.RandomState(i)
+        try:
+            for _ in range(iters):
+                if owned[i] and rng.rand() < 0.5:
+                    a.free([owned[i].pop()])
+                else:
+                    try:
+                        owned[i].extend(a.alloc(int(rng.randint(1, 4))))
+                    except CacheExhausted:
+                        if owned[i]:
+                            a.free([owned[i].pop()])
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs
+    held = [b for lst in owned for b in lst]
+    assert len(held) == len(set(held))          # no double ownership
+    assert a.used == len(held)                  # exact accounting
+    for lst in owned:
+        a.free(lst)
+    assert a.available == 64
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache vs a dense reference cache
+# ---------------------------------------------------------------------------
+def test_prefill_gather_roundtrip_bit_identical():
+    cache = PagedKVCache(num_layers=2, num_heads=2, head_dim=4,
+                         block_size=4, num_blocks=16)
+    rng = np.random.RandomState(0)
+    k = rng.rand(2, 10, 2, 4).astype(np.float32)   # L=10 -> 3 blocks
+    v = rng.rand(2, 10, 2, 4).astype(np.float32)
+    cache.prefill("s", k, v)
+    assert len(cache.block_table("s")) == 3
+    assert cache.length("s") == 10
+    for layer in range(2):
+        gk, gv = cache.gather("s", layer)
+        assert np.array_equal(gk, k[layer])
+        assert np.array_equal(gv, v[layer])
+
+
+def test_append_is_o1_and_block_table_grows_by_block_size():
+    cache = PagedKVCache(num_layers=1, num_heads=1, head_dim=2,
+                         block_size=4, num_blocks=8)
+    cache.prefill("s", np.zeros((1, 1, 1, 2), np.float32),
+                  np.zeros((1, 1, 1, 2), np.float32))
+    for i in range(11):
+        pos = cache.reserve("s")
+        assert pos == 1 + i
+        cache.write("s", 0, np.full((1, 2), i, np.float32),
+                    np.full((1, 2), -i, np.float32))
+    assert cache.length("s") == 12
+    assert len(cache.block_table("s")) == 3     # ceil(12/4)
+    gk, _ = cache.gather("s", 0)
+    assert np.array_equal(gk[1:, 0, 0], np.arange(11))
+
+
+def test_gather_batch_matches_dense_reference_after_interleaved_churn():
+    """Block tables stay correct when sequences alloc/free around each
+    other: the paged gather must be BIT-identical to a dense per-seq
+    reference cache."""
+    rng = np.random.RandomState(1)
+    cache = PagedKVCache(num_layers=2, num_heads=2, head_dim=4,
+                         block_size=4, num_blocks=32)
+    ref = {}
+
+    def add(seq, length):
+        k = rng.rand(2, length, 2, 4).astype(np.float32)
+        v = rng.rand(2, length, 2, 4).astype(np.float32)
+        cache.prefill(seq, k, v)
+        ref[seq] = [k, v]
+
+    def append(seq):
+        k = rng.rand(2, 1, 2, 4).astype(np.float32)
+        v = rng.rand(2, 1, 2, 4).astype(np.float32)
+        cache.reserve(seq)
+        for layer in range(2):
+            cache.write(seq, layer, k[layer, 0], v[layer, 0])
+        ref[seq] = [np.concatenate([ref[seq][0], k], axis=1),
+                    np.concatenate([ref[seq][1], v], axis=1)]
+
+    add("a", 6)
+    add("b", 3)
+    append("a")
+    cache.free_sequence("b")       # frees mid-pool blocks
+    del ref["b"]
+    add("c", 9)                    # reuses b's blocks
+    for _ in range(5):
+        append("c")
+        append("a")
+    kd, vd, lens = cache.gather_batch(["a", "c"], 1)
+    assert list(lens) == [12, 14]
+    for i, seq in enumerate(("a", "c")):
+        assert np.array_equal(kd[i, :lens[i]], ref[seq][0][1])
+        assert np.array_equal(vd[i, :lens[i]], ref[seq][1][1])
+        # beyond `lens` is PADDING (may carry stale block tails — the
+        # attention mask zeroes it); only finiteness is guaranteed
+        assert np.all(np.isfinite(kd[i, lens[i]:]))
+
+
+def test_free_on_completion_reuses_blocks():
+    cache = PagedKVCache(num_layers=1, num_heads=1, head_dim=2,
+                         block_size=2, num_blocks=4)
+    z = np.zeros((1, 4, 1, 2), np.float32)
+    cache.prefill("a", z, z)                     # takes 2 of 4 blocks
+    cache.prefill("b", z, z)                     # pool now full
+    with pytest.raises(CacheExhausted):
+        cache.prefill("c", z, z)
+    assert cache.free_sequence("a") == 2
+    cache.prefill("c", z, z)                     # a's blocks, reused
+    assert cache.allocator.available == 0
+    assert cache.free_sequence("missing") == 0   # idempotent
+
+
+def test_paged_decode_logits_bit_identical_to_dense_cache():
+    """The tentpole correctness claim: generation through the paged
+    cache (block-table gather) reproduces a dense contiguous reference
+    cache's logits BIT-for-bit, even after other sequences churned the
+    pool."""
+    model = tiny()
+    prompt = [3, 1, 4, 1, 5]
+    steps = 12
+
+    # dense reference: contiguous K/V, same attention math
+    k, v, logits = model.prefill(prompt)
+    dk, dv = k.copy(), v.copy()                   # (N, L, H, D)
+    ref_tokens, ref_logits = [int(np.argmax(logits))], []
+    for _ in range(steps):
+        pos = dk.shape[1]
+        h = model.embed(np.array([ref_tokens[-1]]), np.array([pos]))
+        nk = np.empty((model.num_layers, 1, model.num_heads,
+                       model.head_dim), np.float32)
+        nv = np.empty_like(nk)
+        for i in range(model.num_layers):
+            q, ki, vi = model.layer_qkv(i, h)
+            nk[i], nv[i] = ki, vi
+            kcat = np.concatenate([dk[i], ki], axis=0)[None]
+            vcat = np.concatenate([dv[i], vi], axis=0)[None]
+            attn = decode_attention(q, kcat, vcat,
+                                    np.array([pos + 1], np.int32))
+            h = model.layer_combine(i, h, attn)
+        dk = np.concatenate([dk, nk], axis=1)
+        dv = np.concatenate([dv, nv], axis=1)
+        lg = model.logits(h)[0]
+        ref_logits.append(lg)
+        ref_tokens.append(int(np.argmax(lg)))
+
+    # paged run, with churn from a second sequence sharing the pool
+    eng = EngineCore(model, block_size=4, num_blocks=64)
+    req = Request(prompt, max_new_tokens=steps + 1, request_id="main")
+    other = Request([9, 9, 9], max_new_tokens=steps + 1,
+                    request_id="other")
+    first = eng.prefill(req)
+    eng.prefill(other)
+    assert first == ref_tokens[0]
+    got = [first]
+    ot = [eng.decode([(other, 9)])[0][other.id]]
+    for step in range(steps):
+        if step == 4:
+            eng.evict(other)                      # churn: free mid-run
+        items = [(req, got[-1])]
+        if step < 4:
+            items.append((other, ot[-1]))
+        res, pre = eng.decode(items)
+        assert not pre
+        got.append(res[req.id])
+        if step < 4:
+            ot.append(res[other.id])
+    assert got == ref_tokens
+
+
+# ---------------------------------------------------------------------------
+# attention fallback
+# ---------------------------------------------------------------------------
+def test_dense_attention_respects_lengths_and_causality():
+    rng = np.random.RandomState(0)
+    q = rng.rand(2, 1, 2, 4).astype(np.float32)
+    k = rng.rand(2, 6, 2, 4).astype(np.float32)
+    v = rng.rand(2, 6, 2, 4).astype(np.float32)
+    lens = np.array([3, 6], np.int32)
+    out = dense_attention(q, k, v, lengths=lens)
+    # row 0 must ignore keys >= 3: garbage there cannot change the output
+    k2, v2 = k.copy(), v.copy()
+    k2[0, 3:] = 1e6
+    v2[0, 3:] = -1e6
+    out2 = dense_attention(q, k2, v2, lengths=lens)
+    assert np.array_equal(out[0], out2[0])
+    assert np.array_equal(out[1], out2[1])
+    # causal prefill: position i must ignore keys > i
+    q3 = rng.rand(1, 4, 2, 4).astype(np.float32)
+    k3 = rng.rand(1, 4, 2, 4).astype(np.float32)
+    v3 = rng.rand(1, 4, 2, 4).astype(np.float32)
+    full = dense_attention(q3, k3, v3, causal=True)
+    k3[0, 3] = 77.0                                # future key for rows 0-2
+    again = dense_attention(q3, k3, v3, causal=True)
+    assert np.array_equal(full[0, :3], again[0, :3])
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+def test_scheduler_bounded_queue_rejects_with_reason():
+    s = ContinuousBatchingScheduler(max_pending=2, max_batch=2,
+                                    max_tokens=100)
+    s.submit(Request([1], 4))
+    s.submit(Request([1], 4))
+    with pytest.raises(AdmissionReject) as e:
+        s.submit(Request([1], 4))
+    assert e.value.reason == "queue_full"
+    with pytest.raises(AdmissionReject) as e:
+        s.submit(Request([1] * 80, 40))
+    assert e.value.reason == "request_too_large"
+    # rejected requests are failed loudly, not left hanging
+    assert e.value.reason in ("request_too_large",)
+
+
+def test_scheduler_admission_respects_token_budget_and_batch():
+    s = ContinuousBatchingScheduler(max_pending=8, max_batch=8,
+                                    max_tokens=30)
+    for _ in range(4):
+        s.submit(Request([1] * 6, 6))             # 12 budget tokens each
+    first = s.take_prefills()
+    assert len(first) == 2                        # 24 <= 30 < 36
+    for r in first:
+        s.mark_running(r)
+    assert s.take_prefills() == []                # budget holds
+    s.finish(first[0])                            # immediate eviction
+    assert len(s.take_prefills()) == 1            # slot refilled next step
+
+
+def test_scheduler_requeue_discards_generation_and_fronts():
+    s = ContinuousBatchingScheduler(max_pending=4, max_batch=4,
+                                    max_tokens=1000)
+    a, b = Request([1], 4, request_id="a"), Request([2], 4, request_id="b")
+    s.submit(a)
+    s.submit(b)
+    for r in s.take_prefills():
+        s.mark_running(r)
+    a.record_token(7)
+    s.requeue_all_running()
+    assert a.tokens == [] and a.requeues == 1 and a.state == "queued"
+    # fronted in arrival order: a decodes before b again
+    assert [r.id for r in s.take_prefills()] == ["a", "b"]
+
+
+def test_static_scheduler_waits_for_drain():
+    s = StaticBatchingScheduler(max_pending=8, max_batch=2,
+                                max_tokens=1000)
+    for i in range(4):
+        s.submit(Request([1], 2, request_id=f"r{i}"))
+    batch = s.take_prefills()
+    assert len(batch) == 2
+    for r in batch:
+        s.mark_running(r)
+    assert s.take_prefills() == []                # no refill mid-batch
+    assert s.finish(batch[0]) == []               # no eviction either
+    assert len(s.decode_batch()) == 2             # finished slot = padding
+    evicted = s.finish(batch[1])                  # drain -> evict both
+    assert set(r.id for r in evicted) == {"r0", "r1"}
+    assert len(s.take_prefills()) == 2            # next batch admitted
+
+
+# ---------------------------------------------------------------------------
+# server: the front-end
+# ---------------------------------------------------------------------------
+def test_server_generates_deterministically_and_streams():
+    srv = Server(tiny(), num_blocks=64, max_batch=4)
+    r1 = srv.submit([5, 6, 7], max_new_tokens=8)
+    srv.run_until_idle()
+    assert r1.state == "done" and len(r1.tokens) == 8
+    # same prompt through stream() reproduces the greedy tokens exactly
+    srv2 = Server(tiny(), num_blocks=64, max_batch=4)
+    assert list(srv2.stream([5, 6, 7], max_new_tokens=8)) == r1.tokens
+    # latency bookkeeping for the SLO metrics
+    assert r1.ttft is not None and r1.ttft >= 0
+    assert len(r1.token_times) == 8
+
+
+def test_server_eos_finishes_early():
+    srv = Server(tiny(), num_blocks=64)
+    probe = srv.submit([5, 6, 7], max_new_tokens=4)
+    srv.run_until_idle()
+    eos = probe.tokens[1]
+    srv2 = Server(tiny(), num_blocks=64, eos_id=eos)
+    req = srv2.submit([5, 6, 7], max_new_tokens=10)
+    srv2.run_until_idle()
+    assert req.finish_reason == "eos"
+    assert len(req.tokens) == 2
+
+
+def test_server_cache_exhaustion_backpressures_and_completes_all():
+    """A pool far too small for the offered load must serialize the work
+    via preemption/requeue — every request still completes, nothing
+    OOMs."""
+    srv = Server(tiny(), num_blocks=6, block_size=2, max_batch=4,
+                 max_tokens=1000)
+    reqs = [srv.submit([1, 2, 3], max_new_tokens=6) for _ in range(5)]
+    srv.run_until_idle()
+    for r in reqs:
+        assert r.state == "done" and len(r.tokens) == 6, r
+    assert srv.engine.cache.stats()["used_blocks"] == 0
+    # and all requests produced identical tokens (same prompt, greedy)
+    assert all(r.tokens == reqs[0].tokens for r in reqs)
+
+
+def test_server_request_events_carry_request_context():
+    srv = Server(tiny(), num_blocks=64)
+    req = srv.submit([1, 2], max_new_tokens=3)
+    srv.run_until_idle()
+    evs = tracing.snapshot()
+    pre = [e for e in evs if e["event"] == "serve.prefill"]
+    ev = [e for e in evs if e["event"] == "serve.evict"]
+    assert pre and pre[0]["request"] == req.id
+    assert ev and ev[0]["request"] == req.id
+    dec = [e for e in evs if e["event"] == "serve.decode"]
+    assert dec and dec[0]["request"] is None      # batch-scoped
+    for e in evs:
+        tracing.validate_event(e)
+
+
+def test_server_telemetry_names_are_cataloged():
+    telemetry.reset()
+    try:
+        srv = Server(tiny(), num_blocks=64)
+        srv.submit([1, 2], max_new_tokens=3)
+        srv.run_until_idle()
+        recs = telemetry.snapshot()
+        assert recs
+        for rec in recs:
+            telemetry.validate_record(rec)
+            assert rec["name"] in telemetry.KNOWN_METRICS, rec["name"]
+        names = {r["name"] for r in recs}
+        assert {"serve.ttft_seconds", "serve.itl_seconds",
+                "serve.generated_tokens", "serve.queue_depth",
+                "serve.cache_utilization"} <= names
+    finally:
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# self-healing: the supervisor patterns under the server
+# ---------------------------------------------------------------------------
+def test_hung_decode_watchdog_restart_zero_lost_requests(tmp_path):
+    prefix = str(tmp_path / "sv")
+    srv = Server(tiny(), num_blocks=64, max_batch=4, deadline=0.5,
+                 backoff=0.0, blackbox=prefix)
+    reqs = [srv.submit([1, 2, 3], max_new_tokens=5) for _ in range(3)]
+    with chaos.enable(slow_decode_step=2, slow_decode_seconds=30) as cfg:
+        srv.run_until_idle()
+    assert cfg.slow_decodes == 1
+    assert srv.restarts == 1
+    for r in reqs:
+        assert r.state == "done" and len(r.tokens) == 5, r
+    # the re-run reproduced the same greedy tokens it would have without
+    # the fault (deterministic recovery)
+    clean = Server(tiny(), num_blocks=64, max_batch=4)
+    ref = clean.submit([1, 2, 3], max_new_tokens=5)
+    clean.run_until_idle()
+    assert all(r.tokens == ref.tokens for r in reqs)
+    # black box: schema-valid, injection and restart share the context
+    box = json.load(open(tracing.blackbox_path(prefix)))
+    tracing.validate_blackbox(box)
+    inj = [e for e in box["events"] if e["event"] == "chaos.inject"
+           and e["data"]["kind"] == "slow_decode_step"]
+    rst = [e for e in box["events"] if e["event"] == "serve.restart"]
+    assert inj and rst
+    assert (inj[0]["step"], inj[0]["generation"]) == \
+        (rst[0]["step"], rst[0]["generation"])
+
+
+def test_nan_logits_classified_restart(tmp_path):
+    """chaos nan_after poisons the decode health scalar -> the engine
+    raises NumericDivergence -> classified restart; requests survive."""
+    prefix = str(tmp_path / "nan")
+    srv = Server(tiny(), num_blocks=64, max_batch=4, backoff=0.0,
+                 blackbox=prefix)
+    reqs = [srv.submit([4, 5], max_new_tokens=4) for _ in range(2)]
+    with chaos.enable(nan_after=2) as cfg:
+        srv.run_until_idle()
+    assert cfg.nans_fired >= 1
+    assert srv.restarts == 1
+    for r in reqs:
+        assert r.state == "done" and len(r.tokens) == 4
+    box = json.load(open(tracing.blackbox_path(prefix)))
+    tracing.validate_blackbox(box)
+    names = [e["event"] for e in box["events"]]
+    assert "serve.restart" in names
+
+
+def test_restart_budget_exhaustion_degrades_loudly():
+    srv = Server(tiny(), num_blocks=64, max_restarts=1, backoff=0.0,
+                 deadline=0.3)
+    reqs = [srv.submit([1], max_new_tokens=3) for _ in range(2)]
+    with chaos.enable(nan_after=1, nan_streak=100):
+        with pytest.raises(MXNetError):
+            # every decode poisons -> restarts 1, 2 -> budget exceeded
+            for _ in range(50):
+                srv.step()
+                if srv.degraded:
+                    raise MXNetError("degraded")
+    assert srv.degraded
+    for r in reqs:
+        assert r.state == "failed" and "degraded" in r.finish_reason
+    with pytest.raises(AdmissionReject) as e:
+        srv.submit([1], max_new_tokens=1)
+    assert e.value.reason == "degraded"
+
+
+def test_reject_storm_counts_and_resubmit_succeeds():
+    srv = Server(tiny(), num_blocks=64)
+    with chaos.enable(reject_storm=2) as cfg:
+        for _ in range(2):
+            with pytest.raises(AdmissionReject) as e:
+                srv.submit([1, 2], max_new_tokens=2)
+            assert e.value.reason == "reject_storm"
+        req = srv.submit([1, 2], max_new_tokens=2)   # storm exhausted
+        srv.run_until_idle()
+    assert cfg.rejects_forced == 2
+    assert req.state == "done"
+    rejects = [e for e in tracing.snapshot()
+               if e["event"] == "serve.reject"]
+    assert len(rejects) == 2
+    assert all(e["data"]["reason"] == "reject_storm" for e in rejects)
+
+
+def test_concurrent_submit_while_serving():
+    """submit() from other threads while the step thread admits/evicts:
+    allocator and scheduler stay consistent, every request completes."""
+    srv = Server(tiny(), num_blocks=48, block_size=4, max_batch=4,
+                 max_pending=200, max_tokens=100000)
+    out, errs = [], []
+
+    def feeder(i):
+        try:
+            for j in range(10):
+                out.append(srv.submit([1 + i, 2 + j], max_new_tokens=3))
+                time.sleep(0.0005)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=feeder, args=(i,), daemon=True)
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 60
+    while (any(t.is_alive() for t in threads)
+           or not srv.scheduler.idle()):
+        srv.step()
+        assert time.time() < deadline, "serving wedged"
+    for t in threads:
+        t.join(10)
+    assert not errs, errs
+    assert len(out) == 30
+    for r in out:
+        assert r.state == "done" and len(r.tokens) == 3, r
+    assert srv.engine.cache.stats()["used_blocks"] == 0
+
+
+def test_degraded_rejects_are_counted_and_on_the_timeline():
+    """A degraded-window reject must be observable like any other:
+    counted in serve.requests{state=rejected} and emitted as a
+    serve.reject event with reason 'degraded'."""
+    srv = Server(tiny(), num_blocks=64, max_restarts=0, backoff=0.0)
+    srv.submit([1], max_new_tokens=2)
+    with chaos.enable(nan_after=1, nan_streak=100):
+        for _ in range(5):
+            if srv.degraded:
+                break
+            srv.step()
+    assert srv.degraded
+    telemetry.reset()
+    with pytest.raises(AdmissionReject) as e:
+        srv.submit([1], max_new_tokens=1)
+    assert e.value.reason == "degraded"
+    assert telemetry.get("serve.requests", state="rejected").value == 1
+    rej = [ev for ev in tracing.snapshot() if ev["event"] == "serve.reject"]
+    assert rej and rej[-1]["data"]["reason"] == "degraded"
+    telemetry.reset()
+
+
+def test_degrade_fails_each_request_once_without_requeue_counts():
+    telemetry.reset()
+    try:
+        srv = Server(tiny(), num_blocks=64, max_restarts=0, backoff=0.0)
+        running = srv.submit([1, 2], max_new_tokens=6)
+        queued = srv.submit([3] * 200, max_new_tokens=6)  # over budget: waits
+        with chaos.enable(nan_after=1, nan_streak=100):
+            srv.step()   # prefill + first poisoned decode -> degrade
+            if not srv.degraded:
+                srv.step()
+        assert srv.degraded
+        assert running.state == "failed" and queued.state == "failed"
+        # failed-at-degrade requests were never RE-ADMITTED: no requeue
+        # counts, no double fail
+        assert running.requeues == 0
+        assert telemetry.get("serve.requests", state="requeued") is None
+    finally:
+        telemetry.reset()
+
+
+def test_prefill_backpressure_defers_without_requeue_count():
+    """Admissions bounced by prefill-time cache exhaustion were never
+    started: they are deferred, not requeued — the handle's requeues
+    ledger stays 0 unless a real preemption/restart re-ran it."""
+    srv = Server(tiny(), num_blocks=4, block_size=2, max_batch=4,
+                 max_tokens=1000)
+    reqs = [srv.submit([1, 2, 3], max_new_tokens=2) for _ in range(4)]
+    srv.run_until_idle()
+    assert all(r.state == "done" and len(r.tokens) == 2 for r in reqs)
+    # prompt(3)+gen(2)=5 tokens = 3 blocks of 2; pool of 4 serializes
+    # admissions via DEFER (never-started) — decode-time preemption can
+    # still requeue, but at least one deferred-only request stays at 0
+    assert min(r.requeues for r in reqs) == 0
+
+
+def test_static_scheduler_survives_cache_preemption_of_padding_slots():
+    """Regression (review finding): under StaticBatchingScheduler a
+    finished batch member occupies its slot as padding; when the pool
+    runs dry the engine must evict the PADDING first — never corrupt
+    the done handle, never requeue it, and the run must complete."""
+    srv = Server(tiny(), scheduler=StaticBatchingScheduler(
+        max_pending=16, max_batch=3, max_tokens=100000),
+        num_blocks=6, block_size=4)
+    outs = [2, 2, 12]
+    reqs = [srv.submit([1, 2, 3], max_new_tokens=n) for n in outs]
+    srv.run_until_idle()
+    for r, n in zip(reqs, outs):
+        assert r.state == "done" and len(r.tokens) == n, r
+    # the short (finished-early) members kept their delivered tokens and
+    # were never flipped back to queued by a padding preemption
+    assert reqs[0].requeues == 0 and reqs[1].requeues == 0
+    evs = [e for e in tracing.snapshot() if e["event"] == "serve.evict"]
+    assert any(e["data"]["reason"] == "padding" for e in evs)
+    assert srv.engine.cache.stats()["used_blocks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# continuous vs static batching (the mechanism; the bench measures time)
+# ---------------------------------------------------------------------------
+def test_continuous_batching_fills_slots_static_wastes_them():
+    """With mixed output lengths, the static baseline burns decode-step
+    slots on finished padding; continuous refills immediately — counted
+    in engine decode steps, the deterministic proxy for the bench's
+    wall-clock A/B."""
+    def run(sched_cls):
+        model = tiny()
+        srv = Server(model, scheduler=sched_cls(max_pending=64,
+                                                max_batch=2,
+                                                max_tokens=100000),
+                     num_blocks=256, block_size=4)
+        outs = [2, 8, 2, 8]
+        reqs = [srv.submit([1, 2, 3], max_new_tokens=n) for n in outs]
+        srv.run_until_idle()
+        assert all(r.state == "done" and len(r.tokens) == n
+                   for r, n in zip(reqs, outs))
+        return srv._steps
+
+    continuous = run(ContinuousBatchingScheduler)
+    static = run(StaticBatchingScheduler)
+    assert continuous < static, (continuous, static)

@@ -133,6 +133,20 @@ def recovery_chains(events):
                 parts.append(f"preempted (signal {d.get('signum', '?')}, "
                              f"emergency save_ok={d.get('save_ok', '?')})")
                 break
+            # serving runtime decisions (tpu_mx/serving/, ISSUE 8): the
+            # engine-step context has no epoch (None) but the same
+            # generation join applies — a decode fault and the engine
+            # restart it provoked share (step, generation)
+            elif name == "serve.reject":
+                parts.append("admission rejected "
+                             f"({d.get('reason', '?')}, "
+                             f"request {d.get('request', '?')})")
+                break
+            elif name == "serve.restart":
+                parts.append(f"engine restart #{d.get('n', '?')} "
+                             f"(requeued {d.get('requeued', '?')} "
+                             "in-flight requests)")
+                break
         chains.append("  epoch %s step %s: %s" % (
             "-" if e.get("epoch") is None else e["epoch"],
             "-" if e.get("step") is None else e["step"],

@@ -30,8 +30,12 @@ under a poisoned jax import — and soak: a supervised
 training run under a fixed-seed randomized chaos schedule (hang, NaN
 streak, crash-mid-save, torn write) that must finish with a verified
 latest checkpoint, a finite loss, and ≥1 recorded restart, rollback and
-watchdog fire (tpu_mx/supervisor.py; docs/robustness.md).  `--core-only`
-runs just the first for a quick gate.
+watchdog fire (tpu_mx/supervisor.py; docs/robustness.md) — and serve: a
+fixed-seed request storm against the serving runtime (tpu_mx/serving/,
+docs/serving.md) under reject_storm, slow_decode_step and NaN-logits
+chaos, which must end with ZERO lost requests, a schema-valid black box
+per injected fault (rendered without jax), and catalog-valid serving
+metrics.  `--core-only` runs just the first for a quick gate.
 """
 from __future__ import annotations
 
@@ -540,6 +544,170 @@ SOAK_REQUIRED = ("supervisor", "resume", "chaos.injections",
                  "tracing.blackbox_dumps")
 
 
+# The serve tier's workload (ISSUE 8): a fixed-seed request storm
+# against the serving runtime with every serving chaos knob armed in
+# turn — reject_storm (admission backpressure + client resubmit), a
+# hung decode (slow_decode_step -> watchdog -> classified engine
+# restart) and NaN logits (nan_after -> NumericDivergence -> restart).
+# Hard assertions: ZERO lost requests (every submission eventually
+# completes with its full token budget), a schema-valid black box per
+# injected fault whose timeline correlates injection -> decision by
+# shared (step, generation), and catalog-valid serving metrics.
+SERVE_SCRIPT = """
+import json
+import os
+import random
+from tpu_mx import serving, telemetry, tracing
+from tpu_mx.contrib import chaos
+from tpu_mx.serving import AdmissionReject
+
+D = os.environ["TPUMX_SERVE_DIR"]
+SEED = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
+rng = random.Random(SEED)
+model = serving.TinyLM(vocab_size=64, embed_dim=32, num_heads=2,
+                       num_layers=2, seed=SEED % 997)
+
+
+def storm(tag, fault, n_req=12, **srv_kw):
+    tracing.reset()
+    prefix = os.path.join(D, tag)
+    srv = serving.Server(model, num_blocks=96, block_size=8, max_batch=4,
+                         max_pending=64, max_tokens=100000, backoff=0.0,
+                         blackbox=prefix, **srv_kw)
+    todo = [([1 + rng.randrange(40) for _ in range(rng.randint(2, 10))],
+             rng.randint(2, 8)) for _ in range(n_req)]
+    reqs = []
+    with chaos.enable(seed=SEED, **fault):
+        for prompt, mnt in todo:
+            while True:   # backpressure contract: a reject is a signal
+                try:      # to drain and RESUBMIT, never a lost request
+                    reqs.append(srv.submit(prompt, max_new_tokens=mnt))
+                    break
+                except AdmissionReject as e:
+                    assert e.reason in ("reject_storm", "queue_full"), e
+                    srv.run_until_idle()
+        srv.run_until_idle()
+    for (prompt, mnt), r in zip(todo, reqs):   # ZERO lost requests
+        assert r.state == "done", (tag, r)
+        assert len(r.tokens) == mnt, (tag, r, mnt)
+    path = tracing.blackbox_path(prefix)
+    if not os.path.exists(path):   # faults with no restart (reject
+        tracing.dump_blackbox(prefix, reason=f"serve {tag} audit")
+    box = json.load(open(path))
+    tracing.validate_blackbox(box)
+    return srv, box
+
+
+def correlated(box, kind, *names):
+    evs = box["events"]
+    inj = [e for e in evs if e["event"] == "chaos.inject"
+           and e["data"]["kind"] == kind]
+    assert inj, (kind, sorted({e["event"] for e in evs}))
+    key = (inj[0]["step"], inj[0]["generation"])
+    got = [e["event"] for e in evs
+           if (e["step"], e["generation"]) == key]
+    for n in names:
+        assert n in got, (kind, n, got)
+
+
+srv, box = storm("sv-reject", dict(reject_storm=3))
+assert srv.restarts == 0
+correlated(box, "reject_storm", "serve.reject")
+
+srv, box = storm("sv-hang", dict(slow_decode_step=5,
+                                 slow_decode_seconds=30), deadline=1.0)
+assert srv.restarts == 1, srv.restarts
+correlated(box, "slow_decode_step", "serve.restart")
+
+srv, box = storm("sv-nan", dict(nan_after=4))
+assert srv.restarts == 1, srv.restarts
+correlated(box, "nan", "serve.restart")
+
+assert telemetry.get("serve.engine_restarts").value == 2
+assert telemetry.get("serve.requests", state="requeued").value >= 1
+telemetry.flush(final=True)
+print("SERVE OK", flush=True)
+"""
+
+SERVE_REQUIRED = ("serve", "chaos.injections")
+
+# per-box markers the RENDERED report (tools/blackbox_report.py, run
+# under a poisoned jax import) must contain: the injection and the
+# decision in prose
+SERVE_BOX_EXPECT = {
+    "sv-reject": ("chaos reject_storm injected", "admission rejected"),
+    "sv-hang": ("chaos slow_decode_step injected", "engine restart #"),
+    "sv-nan": ("chaos nan injected", "engine restart #"),
+}
+
+
+def serve_tier():
+    """Run the chaos request storm against the serving runtime, then
+    validate its telemetry (serve preset: SLO histograms populated,
+    restarts actually driven) and render every fault's black box without
+    jax."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as d:
+        jsonl = os.path.join(d, "telemetry.jsonl")
+        env = dict(os.environ, TPUMX_TELEMETRY=jsonl, JAX_PLATFORMS="cpu",
+                   TPUMX_CHAOS_SEED="20260804", TPUMX_SERVE_DIR=d)
+        env.pop("TPUMX_CHAOS", None)    # the script arms its own faults
+        env.pop("TPUMX_TRACING", None)  # the black boxes need the recorder
+        try:
+            run = subprocess.run([sys.executable, "-c", SERVE_SCRIPT],
+                                 env=env, cwd=repo, capture_output=True,
+                                 text=True, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            print(f"  serve: request storm timed out: {e}")
+            return 1
+        if run.returncode != 0 or "SERVE OK" not in (run.stdout or ""):
+            print(f"  serve: request storm failed (rc={run.returncode}):\n"
+                  f"{((run.stdout or '') + (run.stderr or ''))[-4000:]}")
+            return run.returncode or 1
+        try:
+            val = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "telemetry_report.py"),
+                 jsonl, "--validate", "--require",
+                 ",".join(SERVE_REQUIRED)],
+                capture_output=True, text=True, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  serve: telemetry validation timed out: {e}")
+            return 1
+        if val.returncode != 0:
+            print(f"  serve: telemetry validation failed "
+                  f"(rc={val.returncode}):\n"
+                  f"{((val.stdout or '') + (val.stderr or ''))[-3000:]}")
+            return val.returncode or 1
+        report = os.path.join(repo, "tools", "blackbox_report.py")
+        for tag, expect in SERVE_BOX_EXPECT.items():
+            box = os.path.join(d, f"{tag}-blackbox.json")
+            code = ("import sys, runpy; "
+                    "sys.modules['jax'] = None; "
+                    "sys.modules['tpu_mx'] = None; "
+                    f"sys.argv = ['blackbox_report.py', {box!r}, "
+                    "'--validate']; "
+                    f"runpy.run_path({report!r}, run_name='__main__')")
+            try:
+                ren = subprocess.run([sys.executable, "-c", code],
+                                     capture_output=True, text=True,
+                                     timeout=120)
+            except subprocess.TimeoutExpired as e:
+                print(f"  serve: blackbox report timed out on {tag}: {e}")
+                return 1
+            out = (ren.stdout or "") + (ren.stderr or "")
+            if ren.returncode != 0:
+                print(f"  serve: blackbox report failed on {tag} "
+                      f"(rc={ren.returncode}):\n{out[-3000:]}")
+                return 1
+            missing = [m for m in expect if m not in out]
+            if missing:
+                print(f"  serve: blackbox report for {tag} is missing "
+                      f"timeline markers {missing}:\n{out[-3000:]}")
+                return 1
+    return 0
+
+
 def soak_tier():
     """Run the supervised chaos-soak training job with a FIXED chaos seed
     and bounded wall-clock, then validate its telemetry (the supervisor
@@ -702,6 +870,8 @@ def main():
         results.append(("obs", obs_tier(), time.time() - t0))
         t0 = time.time()
         results.append(("soak", soak_tier(), time.time() - t0))
+        t0 = time.time()
+        results.append(("serve", serve_tier(), time.time() - t0))
     print()
     red = False
     for name, rc, dt in results:

@@ -53,6 +53,13 @@ REQUIRE_PRESETS = {
                    "supervisor.batches_skipped"),
     "resume": ("resume.capsules_written",
                "resume.capsule_restore_seconds"),
+    # "serve" gates the serve tier: the SLO histograms must have samples,
+    # throughput must be nonzero, and the chaos schedule must have
+    # actually driven an engine restart (queue_depth/cache_utilization
+    # are deliberately absent — both are rightly 0 once a run drains)
+    "serve": ("serve.requests", "serve.ttft_seconds", "serve.itl_seconds",
+              "serve.generated_tokens", "serve.decode_steps",
+              "serve.tokens_per_sec", "serve.engine_restarts"),
 }
 
 

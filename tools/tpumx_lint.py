@@ -538,6 +538,17 @@ class ConcurrencyPass(Pass):
     lock-free at another site (``__init__`` excepted — before the object
     escapes, no thread can see it).  Mixed discipline is exactly the
     zombie-step class of race.
+    (c) Per MODULE: a module-level global that is assigned/mutated under
+    a ``with <module_lock>:`` block at ANY site must not be mutated
+    lock-free in another function (module top level — import time,
+    single-threaded — excepted).  The ``checkpoint._intended`` /
+    ``_intended_lock`` shape, and the serving KV-cache free list's:
+    the PR-6 linter only saw class-scoped pairs (ROADMAP limitation,
+    closed in ISSUE 8).  Covered mutations: ``global X; X = ...``,
+    ``X[...] = ...`` and ``X.attr = ...`` where X is a module-level
+    name (plus their aug/annotated forms); method CALLS
+    (``X.append(...)``) are not assignments and stay out of scope —
+    lexical analysis, same bar as the class rule.
     """
 
     name = "concurrency"
@@ -545,6 +556,7 @@ class ConcurrencyPass(Pass):
     def run(self, ctx):
         yield from self._threads(ctx)
         yield from self._lock_discipline(ctx)
+        yield from self._module_lock_discipline(ctx)
 
     @staticmethod
     def _thread_joins(ctx):
@@ -677,6 +689,132 @@ class ConcurrencyPass(Pass):
                         "mixed discipline races exactly like the PR-4 "
                         "zombie-step bug; take the lock (or document why "
                         "this site is single-threaded)")
+
+
+    # -- (c) module-level lock/global discipline -----------------------------
+    def _is_module_lock_with(self, item):
+        d = dotted(item.context_expr) or ""
+        return d and not d.startswith("self.") and "lock" in d.lower()
+
+    @staticmethod
+    def _locals_of(fn):
+        """(local names, declared globals) of a function: parameters plus
+        bare-Name assignment/loop targets anywhere inside (nested scopes
+        included — over-approximating locals under-approximates findings,
+        the safe direction for a lexical rule)."""
+        if fn is None:
+            return frozenset(), frozenset()
+        args = fn.args
+        params = {a.arg for a in (args.args + args.kwonlyargs
+                                  + getattr(args, "posonlyargs", []))}
+        if args.vararg:
+            params.add(args.vararg.arg)
+        if args.kwarg:
+            params.add(args.kwarg.arg)
+        declared_global, assigned = set(), set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Global):
+                declared_global.update(n.names)
+            elif isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for t in ConcurrencyPass._flat_targets(n):
+                    if isinstance(t, ast.Name):
+                        assigned.add(t.id)
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        assigned.add(t.id)
+            elif isinstance(n, ast.comprehension):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        assigned.add(t.id)
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if item.optional_vars is not None:
+                        for t in ast.walk(item.optional_vars):
+                            if isinstance(t, ast.Name):
+                                assigned.add(t.id)
+        return params | (assigned - declared_global), declared_global
+
+    def _module_lock_discipline(self, ctx):
+        mod_globals = set()
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for t in self._flat_targets(node):
+                    if isinstance(t, ast.Name):
+                        mod_globals.add(t.id)
+        # names declared `global` anywhere also count (first assignment
+        # may happen inside a function)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Global):
+                mod_globals.update(node.names)
+        if not mod_globals:
+            return
+        guarded = {}    # global name -> first guarded-mutation node
+        unguarded = {}  # global name -> [unguarded-mutation nodes]
+        locals_cache = {}
+
+        def target_global(t, fn):
+            """The module-global name this target mutates, or None."""
+            if id(fn) not in locals_cache:
+                locals_cache[id(fn)] = self._locals_of(fn)
+            local_names, declared_global = locals_cache[id(fn)]
+            if isinstance(t, ast.Name):
+                # a bare-name rebind targets the module global only
+                # under an explicit `global` declaration
+                return t.id if (t.id in declared_global
+                                and t.id in mod_globals) else None
+            node = t
+            while isinstance(node, (ast.Subscript, ast.Attribute)):
+                node = node.value
+            if isinstance(node, ast.Name) and node.id in mod_globals \
+                    and node.id not in local_names:
+                return node.id
+            return None
+
+        def visit(node, locked, exempt, fn):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # function bodies run post-import (not exempt); a
+                    # function DEFINED under a lock does not RUN under it
+                    visit(child, False, False, child)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    # a class BODY executes at import time (exempt like
+                    # module level); its methods hit the branch above
+                    visit(child, False, exempt, fn)
+                    continue
+                child_locked = locked
+                if isinstance(child, (ast.With, ast.AsyncWith)) and any(
+                        self._is_module_lock_with(i) for i in child.items):
+                    child_locked = True
+                if isinstance(child, (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign)) and not (
+                        isinstance(child, ast.AnnAssign)
+                        and child.value is None):  # bare annotation
+                    for t in self._flat_targets(child):
+                        name = target_global(t, fn)
+                        if name is None:
+                            continue
+                        if locked:
+                            guarded.setdefault(name, child)
+                        elif not exempt:
+                            unguarded.setdefault(name, []).append(child)
+                visit(child, child_locked, exempt, fn)
+
+        visit(ctx.tree, False, True, None)
+        for name, sites in unguarded.items():
+            if name not in guarded:
+                continue
+            g = guarded[name]
+            for site in sites:
+                yield ctx.finding(
+                    self.name, site,
+                    f"module global {name!r} is mutated under a lock at "
+                    f"{ctx.path}:{g.lineno} but lock-free here — mixed "
+                    "discipline on module-level shared state (the "
+                    "checkpoint._intended shape); take the lock (or "
+                    "document why this site is single-threaded)")
 
 
 class TelemetryCatalogPass(Pass):

@@ -38,6 +38,16 @@ Injection kinds (all one process, no root, no LD_PRELOAD):
   mid-epoch process death the deterministic-resume proof provokes: a
   capsule resume must continue at batch N+1 with the exact RNG stream,
   never re-feeding batch N.  One-shot.
+- ``slow_decode_step=N``: the Nth serving *decode* step (counted since
+  arming) blocks for ``slow_decode_seconds`` (default 3600 — "forever"
+  at test scale) inside the serving engine's watchdog thread, simulating
+  a wedged decode dispatch; the server must convert it into a classified
+  engine restart with every queued request surviving
+  (tpu_mx/serving/server.py, docs/serving.md).  One-shot.
+- ``reject_storm=K``: the next K scheduler admissions are force-rejected
+  with reason ``"reject_storm"`` — drives the front-end's backpressure /
+  reject-with-reason path and the client resubmit loop without needing a
+  genuinely full queue.
 - ``match=SUBSTR``: scope file-level faults to paths containing SUBSTR
   (e.g. ``match=.params`` tears the params file but not the manifest).
 
@@ -71,7 +81,8 @@ from .. import tracing as _tracing
 
 __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
            "wrap_file", "maybe_oserror", "peer_killed", "poison_loss",
-           "maybe_hang", "maybe_crash_step"]
+           "maybe_hang", "maybe_crash_step", "maybe_slow_decode",
+           "forced_reject"]
 
 
 def _count_injection(kind):
@@ -98,13 +109,16 @@ class ChaosCrash(Exception):
 class _Config:
     _KINDS = ("crash_after_bytes", "torn_write", "slow_io",
               "transient_oserror", "kill_peer", "nan_after", "nan_streak",
-              "hang_step", "hang_seconds", "crash_at_step", "seed", "hard",
-              "match")
+              "hang_step", "hang_seconds", "crash_at_step",
+              "slow_decode_step", "slow_decode_seconds", "reject_storm",
+              "seed", "hard", "match")
 
     def __init__(self, crash_after_bytes=None, torn_write=None, slow_io=None,
                  transient_oserror=0, kill_peer=False, nan_after=None,
                  nan_streak=1, hang_step=None, hang_seconds=3600.0,
-                 crash_at_step=None, seed=None, hard=False, match=None):
+                 crash_at_step=None, slow_decode_step=None,
+                 slow_decode_seconds=3600.0, reject_storm=0, seed=None,
+                 hard=False, match=None):
         if seed is None:
             seed = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
         self.crash_after_bytes = crash_after_bytes
@@ -118,6 +132,10 @@ class _Config:
         self.hang_seconds = float(hang_seconds)
         self.crash_at_step = None if crash_at_step is None \
             else int(crash_at_step)
+        self.slow_decode_step = None if slow_decode_step is None \
+            else int(slow_decode_step)
+        self.slow_decode_seconds = float(slow_decode_seconds)
+        self.reject_storm = int(reject_storm)
         self.seed = seed
         self.hard = bool(hard)
         self.match = match
@@ -135,6 +153,10 @@ class _Config:
         self.nans_fired = 0
         self.hangs = 0
         self.step_crashes = 0
+        self.decode_steps_seen = 0   # decode steps while slow_decode armed
+        self.slow_decodes = 0
+        self.rejects_left = self.reject_storm
+        self.rejects_forced = 0
 
     def matches(self, path):
         return self.match is None or (path is not None
@@ -196,7 +218,7 @@ def configure_from_env():
             continue
         if key == "match":
             kwargs[key] = val
-        elif key in ("slow_io", "hang_seconds"):
+        elif key in ("slow_io", "hang_seconds", "slow_decode_seconds"):
             kwargs[key] = float(val)
         elif key in ("kill_peer", "hard"):
             kwargs[key] = val in ("", "1", "true", "yes", "on")
@@ -360,6 +382,49 @@ def maybe_crash_step():
         "chaos: simulated process death after supervised step "
         f"{cfg.commits_seen} committed (crash_at_step fired) — resume "
         "must continue at the NEXT batch with the exact RNG stream")
+
+
+def maybe_slow_decode():
+    """Block for ``slow_decode_seconds`` when the ``slow_decode_step``
+    fault says this is the wedged decode step (the serving engine calls
+    this at the top of every decode step, INSIDE the server's watchdog
+    thread — the sleep simulates a stalled decode dispatch the server
+    must convert into a classified engine restart with zero lost
+    requests, docs/serving.md).  One-shot; counting starts when armed."""
+    cfg = _config
+    if cfg is None or cfg.slow_decode_step is None:
+        return
+    secs = None
+    with cfg.lock:
+        if cfg.slow_decode_step is None:
+            return
+        cfg.decode_steps_seen += 1
+        if cfg.decode_steps_seen >= cfg.slow_decode_step:
+            cfg.slow_decode_step = None  # one-shot: the retried step runs
+            cfg.slow_decodes += 1
+            _count_injection("slow_decode_step")
+            secs = cfg.slow_decode_seconds
+    if secs:
+        log.warning("chaos: stalling this decode step for %.0fs "
+                    "(slow_decode_step fired)", secs)
+        time.sleep(secs)
+
+
+def forced_reject():
+    """True when the ``reject_storm`` fault says this admission must be
+    rejected (the scheduler checks it before its real admission logic and
+    rejects with reason ``"reject_storm"``).  Decrements the storm budget;
+    returns False once exhausted so resubmitted requests get through."""
+    cfg = _config
+    if cfg is None or not cfg.reject_storm:
+        return False
+    with cfg.lock:
+        if cfg.rejects_left > 0:
+            cfg.rejects_left -= 1
+            cfg.rejects_forced += 1
+            _count_injection("reject_storm")
+            return True
+    return False
 
 
 def maybe_hang():

@@ -1,0 +1,42 @@
+"""Inference serving runtime: paged KV cache, continuous batching, and a
+self-healing request front-end (docs/serving.md).
+
+The training stack (PRs 1-7) runs epochs of batches; this package runs
+**streams of requests** — the "millions of users" workload the ROADMAP
+names.  Layer map, bottom up:
+
+- :mod:`.kv_cache` — fixed-size block pool + free-list allocator +
+  per-sequence block tables; O(1) append per token, exhaustion is
+  backpressure (:class:`CacheExhausted`), never OOM.
+- :mod:`.attention` — flash-kernel prefill on supported TPU shapes,
+  dense-gather decode fallback everywhere (docs/DIVERGENCES.md #27).
+- :mod:`.model` — :class:`TinyLM`, the deterministic decode-protocol
+  reference model tests/CI/bench drive.
+- :mod:`.scheduler` — split prefill/decode queues, per-step continuous
+  admission under a max-tokens budget, reject-with-reason backpressure,
+  plus the naive :class:`StaticBatchingScheduler` baseline the bench
+  measures against.
+- :mod:`.engine` — model + cache = prefill/decode compute; chaos fault
+  surface (``slow_decode_step``, NaN-poisoned logits health).
+- :mod:`.server` — ``submit``/``stream``/``step``; watchdog +
+  classified engine restart reusing ``tpu_mx.supervisor``'s patterns —
+  queued requests survive a restart and re-run.
+
+Telemetry (``serve.*`` in ``telemetry.KNOWN_METRICS``) and the request
+lifecycle events (``serve.admit/prefill/decode/evict/reject/restart`` in
+``tracing.KNOWN_EVENTS``, stamped with the request-scoped trace context)
+make every claim here observable; ``tools/ci.py``'s ``serve`` tier
+storms a chaos-faulted server and asserts zero lost requests.
+"""
+from .kv_cache import BlockAllocator, CacheExhausted, PagedKVCache
+from .attention import dense_attention, decode_attention, prefill_attention
+from .model import TinyLM
+from .scheduler import (AdmissionReject, ContinuousBatchingScheduler,
+                        Request, StaticBatchingScheduler)
+from .engine import EngineCore
+from .server import Server
+
+__all__ = ["BlockAllocator", "CacheExhausted", "PagedKVCache",
+           "dense_attention", "decode_attention", "prefill_attention",
+           "TinyLM", "AdmissionReject", "ContinuousBatchingScheduler",
+           "Request", "StaticBatchingScheduler", "EngineCore", "Server"]

@@ -1,0 +1,127 @@
+"""Serving attention: flash prefill on TPU, dense-gather decode fallback.
+
+Two shapes of attention exist in a serving engine and they want different
+kernels:
+
+- **Prefill** — the whole prompt at once: a (L, H, D) causal
+  self-attention, exactly the shape ``tpu_mx/kernels/flash_attention.py``
+  was built for.  :func:`prefill_attention` routes through the Pallas
+  kernel whenever the backend is a real TPU and the shape passes
+  ``flash_attention.supported()`` (head_dim % 64, L % 128); everything
+  else — including the CPU tier-1 suite — runs the dense reference.
+- **Decode** — one new token per sequence against the paged cache: a
+  (B, 1, H, D) query over block-scattered K/V.  The flash kernel's grid
+  assumes contiguous (BH, T, D) operands and T % 128; a single-token
+  query is the wrong shape for it, and a true paged-attention kernel
+  (block-table indexing inside the kernel) is future TPU work recorded
+  as docs/DIVERGENCES.md #27.  :func:`decode_attention` therefore runs
+  the **dense-gather fallback** everywhere: the cache gathers each
+  sequence's blocks into a padded dense batch
+  (``PagedKVCache.gather_batch``) and the scores are masked by the true
+  lengths — bit-identical to a contiguous cache, O(total context) per
+  step on the host.
+
+Both paths keep softmax statistics in f32 (same discipline as the
+kernel); the dense reference is pure numpy so the serving data plane
+stays importable and testable without jax.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["dense_attention", "prefill_attention", "decode_attention"]
+
+# mask value for padded/causal-excluded score entries; matches the
+# kernel's NEG_INF discipline (finite: exp() underflows to exactly 0
+# without generating inf-inf=nan corners in the f32 stats)
+_NEG_INF = -1e30
+
+
+def dense_attention(q, k, v, lengths=None, causal=False):
+    """Reference attention: ``softmax(q·kᵀ/√D  [+masks]) · v``.
+
+    ``q``: (B, Tq, H, D); ``k``/``v``: (B, Tk, H, D); ``lengths``:
+    optional int (B,) — key positions >= length are masked out (the
+    padded dense-gather batch).  ``causal`` aligns the LAST query to the
+    LAST valid key (prefill: Tq == Tk; decode: Tq == 1 attending to all
+    cached keys).  f32 scores/softmax, output cast back to q.dtype."""
+    q = np.asarray(q)
+    k = np.asarray(k)
+    v = np.asarray(v)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    # asarray, not astype: the hot path is already f32 and astype would
+    # COPY the O(context) operands every decode step
+    s = np.einsum("bqhd,bkhd->bhqk", np.asarray(q, np.float32),
+                  np.asarray(k, np.float32)) * scale
+    kpos = np.arange(tk)
+    if lengths is not None:
+        # mask by slice-assigning ONLY the padding tail per row: O(pad)
+        # instead of a full O(B·Tk) where-pass — the decode hot path
+        # calls this every token (bit-identical result: the same
+        # entries end up _NEG_INF)
+        lens_arr = np.asarray(lengths, np.int64).reshape(b)
+        for i in range(b):
+            if lens_arr[i] < tk:
+                s[i, :, :, lens_arr[i]:] = _NEG_INF
+    if causal:
+        # query i sits at absolute position (valid_len - Tq + i)
+        lens = (np.asarray(lengths, np.int64).reshape(b, 1, 1, 1)
+                if lengths is not None else
+                np.full((b, 1, 1, 1), tk, np.int64))
+        qpos = lens - tq + np.arange(tq).reshape(1, 1, tq, 1)
+        s = np.where(kpos.reshape(1, 1, 1, tk) <= qpos, s, _NEG_INF)
+    m = np.max(s, axis=-1, keepdims=True)
+    p = np.exp(s - m, out=s)
+    p /= np.sum(p, axis=-1, keepdims=True)
+    out = np.einsum("bhqk,bkhd->bqhd", p, np.asarray(v, np.float32))
+    return np.asarray(out, q.dtype)
+
+
+def _tpu_flash_ok(length, head_dim, dtype):
+    """Whether the Pallas flash kernel should take this prefill: a real
+    TPU backend (interpret mode is correctness-only — orders of magnitude
+    slower than numpy for a single prompt) and a supported shape."""
+    try:
+        import jax
+        from ..kernels import flash_attention as _fa
+    except ImportError:  # serving data plane must run without jax
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    return _fa.supported((length, head_dim), dtype)
+
+
+def prefill_attention(q, k, v):
+    """Causal self-attention over one prompt: ``q``/``k``/``v`` are
+    (L, H, D); returns (L, H, D).
+
+    TPU + supported shape → the Pallas flash kernel ((H, L, D) folded
+    layout, O(L) memory); otherwise the dense numpy reference (the CPU
+    fallback tier-1 tests, docs/DIVERGENCES.md #27)."""
+    q = np.asarray(q)
+    length, heads, dim = q.shape
+    if _tpu_flash_ok(length, dim, q.dtype):
+        import jax.numpy as jnp
+        from ..kernels.flash_attention import flash_attention as _flash
+        fold = lambda x: jnp.asarray(x).transpose(1, 0, 2)  # (H, L, D)
+        out = _flash(fold(q), fold(k), fold(v), causal=True)
+        return np.asarray(out).transpose(1, 0, 2)
+    return dense_attention(q[None], np.asarray(k)[None],
+                           np.asarray(v)[None], causal=True)[0]
+
+
+def decode_attention(q, keys, values, lengths):
+    """One decode step's attention for a batch of sequences.
+
+    ``q``: (B, H, D) — each sequence's single new-token query; ``keys``/
+    ``values``: (B, Lmax, H, D) — the padded dense gather of each
+    sequence's block table (``PagedKVCache.gather_batch``, new token's
+    K/V already written at position length-1); ``lengths``: (B,) true
+    context lengths.  Returns (B, H, D)."""
+    out = dense_attention(np.asarray(q)[:, None], keys, values,
+                          lengths=lengths)
+    return out[:, 0]

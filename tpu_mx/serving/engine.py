@@ -1,0 +1,151 @@
+"""EngineCore: model + paged cache = prefill/decode compute (no policy).
+
+One engine generation owns one :class:`~tpu_mx.serving.kv_cache.
+PagedKVCache` and runs two operations for the server:
+
+- :meth:`prefill` — one sequence's whole prompt: the model computes every
+  layer's K/V (flash kernel on supported TPU shapes), the cache is
+  bulk-filled in one call, and the first generated token comes back.
+- :meth:`decode` — ONE token for a whole batch of sequences: reserve the
+  O(1) cache slot per sequence, then interleave the model's layer loop
+  with per-layer cache writes and block-table-gathered attention
+  (``decode_attention`` — the dense-gather fallback, docs/DIVERGENCES.md
+  #27).  Sequences whose slot reservation hits :class:`CacheExhausted`
+  are returned as *preempted* — the scheduler requeues them; the rest of
+  the batch proceeds.  Never OOM.
+
+Fault surface (what the server's watchdog/sentinel wrap): the chaos
+``slow_decode_step`` injection fires at the top of :meth:`decode` —
+INSIDE the server's watchdog thread, like ``hang_step`` does for the
+training supervisor — and the logits-health scalar routes through
+``chaos.poison_loss`` so ``nan_after`` can poison a decode step
+deterministically.  Non-finite logits raise
+:class:`~tpu_mx.supervisor.NumericDivergence`, the same exception class
+the training sentinel escalates with, so ``supervisor.classify`` sorts
+serving faults with the training rules unchanged.
+
+The engine is DISPOSABLE: an engine restart builds a fresh EngineCore
+(new cache, same model weights) and the old one — possibly still being
+mutated by an abandoned watchdog thread — is garbage.  That is the whole
+zombie-step story for serving: hung threads only ever touch a dead
+engine's private state, never the scheduler or the request handles
+(tpu_mx/serving/server.py).
+"""
+from __future__ import annotations
+
+import math
+import time
+
+import numpy as np
+
+from .. import tracing as _tracing
+from ..contrib import chaos as _chaos
+from ..supervisor import NumericDivergence
+from .attention import decode_attention
+from .kv_cache import CacheExhausted, PagedKVCache
+
+__all__ = ["EngineCore"]
+
+
+class EngineCore:
+    """See module docstring.  ``model`` implements the decode protocol
+    (tpu_mx/serving/model.py); cache geometry comes from it."""
+
+    def __init__(self, model, block_size=16, num_blocks=256,
+                 dtype=np.float32):
+        self.model = model
+        self.cache = PagedKVCache(
+            model.num_layers, model.num_heads, model.head_dim,
+            block_size=block_size, num_blocks=num_blocks, dtype=dtype)
+
+    # -- prefill -------------------------------------------------------------
+    def prefill(self, req):
+        """Run ``req``'s prompt, bulk-fill its cache blocks, return the
+        first generated token.  :class:`CacheExhausted` propagates with
+        the cache unchanged (the scheduler's backpressure path); NaN/Inf
+        logits raise :class:`NumericDivergence`."""
+        t0 = time.perf_counter()
+        k, v, logits = self.model.prefill(req.prompt)
+        self.cache.prefill(req.id, k, v)
+        health = float(np.max(np.abs(logits)))
+        if not math.isfinite(health):
+            raise NumericDivergence(
+                f"serving: non-finite logits in prefill of {req.id} "
+                f"(health={health}) — restarting the engine")
+        _tracing.emit("serve.prefill", request=req.id,
+                      tokens=len(req.prompt), t0=t0,
+                      t1=time.perf_counter())
+        return int(np.argmax(logits))
+
+    # -- decode --------------------------------------------------------------
+    def decode(self, items):
+        """One token for each ``(req, last_token)`` in ``items``.
+
+        Returns ``(results, preempted)``: ``results`` maps request id →
+        next token for every sequence that decoded; ``preempted`` lists
+        the requests evicted to make room — the scheduler requeues them
+        (re-run), the rest of the batch proceeds.  Raises
+        :class:`NumericDivergence` on non-finite logits (real or
+        chaos-poisoned).
+
+        Preemption picks FINISHED batch members first (static-batching
+        padding slots — their cache is pure waste and their handles are
+        already done), then YOUNGEST-first among the unfinished
+        not-yet-reserved members; the reservation is retried after each
+        eviction, so the oldest live sequence always makes progress and
+        an over-admitted batch drains instead of livelocking on mutual
+        preemption (``items`` arrive in admission order from the
+        scheduler)."""
+        _chaos.maybe_slow_decode()
+        live, preempted = [], []
+        remaining = [(req, int(last)) for req, last in items]
+        while remaining:
+            req, last = remaining.pop(0)
+            while True:
+                try:
+                    self.cache.reserve(req.id)
+                    live.append((req, last))
+                    break
+                except CacheExhausted:
+                    # backpressure, never OOM: free a victim's blocks
+                    # (an unfinished victim re-runs from its prompt
+                    # later) and retry
+                    victim = None
+                    for j in range(len(remaining) - 1, -1, -1):
+                        if remaining[j][0].done:
+                            victim = remaining.pop(j)[0]
+                            break
+                    if victim is None:
+                        victim = remaining.pop()[0] if remaining else req
+                    self.cache.free_sequence(victim.id)
+                    preempted.append(victim)
+                    if victim is req:
+                        break
+        if not live:
+            return {}, preempted
+        tokens = np.array([t for _, t in live], np.int64)
+        # the reserved slot IS the new token's position (length - 1)
+        positions = np.array(
+            [self.cache.length(r.id) - 1 for r, _ in live], np.int64)
+        seq_ids = [r.id for r, _ in live]
+        h = self.model.embed(tokens, positions)
+        for i in range(self.model.num_layers):
+            q, k, v = self.model.layer_qkv(i, h)
+            for b, sid in enumerate(seq_ids):
+                self.cache.write(sid, i, k[b], v[b])
+            kd, vd, lens = self.cache.gather_batch(seq_ids, i)
+            attn = decode_attention(q, kd, vd, lens)
+            h = self.model.layer_combine(i, h, attn)
+        logits = self.model.logits(h)
+        health = _chaos.poison_loss(float(np.max(np.abs(logits))))
+        if not math.isfinite(health):
+            raise NumericDivergence(
+                f"serving: non-finite logits in decode batch of "
+                f"{len(live)} (health={health}) — restarting the engine")
+        out = np.argmax(logits, axis=-1)
+        return ({req.id: int(out[b]) for b, (req, _) in enumerate(live)},
+                preempted)
+
+    def evict(self, req):
+        """Free a sequence's blocks (idempotent)."""
+        return self.cache.free_sequence(req.id)

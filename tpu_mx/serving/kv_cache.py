@@ -1,0 +1,325 @@
+"""Paged KV cache: fixed-size blocks, a free-list allocator, block tables.
+
+The serving runtime's memory manager (docs/serving.md).  A training step
+owns one batch for its whole lifetime; a serving engine juggles thousands
+of concurrent sequences whose lengths are unknown at admission.  Naive
+per-sequence contiguous KV buffers either over-reserve (max_len for every
+request — most of it never used) or reallocate-and-copy as sequences grow.
+The paged design (vLLM's PagedAttention insight, applied to this stack's
+layout) fixes both:
+
+- **Blocks**: K and V live in ONE preallocated pool per layer, shaped
+  ``(num_blocks, block_size, num_heads, head_dim)``.  A sequence's cache
+  is a list of block ids — its **block table** — plus a length; logically
+  contiguous, physically scattered.
+- **Free-list allocator**: :class:`BlockAllocator` hands out block ids
+  from a LIFO free list under one lock.  Exhaustion raises
+  :class:`CacheExhausted` — the scheduler's backpressure signal (requeue /
+  reject), NEVER an allocation attempt that OOMs the process.
+- **O(1) append**: generating one token costs at most one free-list pop
+  (amortized ``1/block_size`` pops) and one slot write — independent of
+  how long the sequence already is.
+- **Copy-free reuse**: finishing a sequence pushes its blocks straight
+  back on the free list; the next sequence overwrites them.  No zeroing,
+  no compaction, no copies.
+
+Storage is host numpy here — the CPU-testable layout tier-1 exercises;
+on TPU the same block tables drive the flash prefill path and the pool
+would live in HBM (docs/DIVERGENCES.md #27 records the gap).  All public
+methods are thread-safe: the allocator has its own lock and the table map
+is guarded by the cache lock, so a scheduler thread can admit/evict while
+tests hammer alloc/free concurrently (tests/test_serving.py).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["CacheExhausted", "BlockAllocator", "PagedKVCache"]
+
+
+class CacheExhausted(MXNetError):
+    """The block pool has no room for this allocation.  This is the
+    BACKPRESSURE signal, not an error to crash on: the scheduler catches
+    it and requeues (decode append) or defers admission (prefill) —
+    docs/serving.md "Backpressure"."""
+
+
+class BlockAllocator:
+    """LIFO free-list allocator over ``num_blocks`` fixed-size blocks.
+
+    ``alloc(n)`` is all-or-nothing: either all ``n`` ids are handed out
+    or :class:`CacheExhausted` is raised and the free list is untouched —
+    a partial grab would leak blocks on the error path.  ``free`` rejects
+    ids the allocator did not hand out (double-free corrupts the pool
+    silently; loud is the only acceptable failure mode)."""
+
+    def __init__(self, num_blocks):
+        if int(num_blocks) < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._lock = threading.Lock()
+        # LIFO: recently freed blocks are re-handed first (their pages are
+        # the warmest — copy-free reuse on sequence completion)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._held = set()
+
+    def alloc(self, n=1):
+        """``n`` block ids, or raise :class:`CacheExhausted` (free list
+        untouched — all-or-nothing)."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                raise CacheExhausted(
+                    f"KV cache exhausted: need {n} block(s), "
+                    f"{len(self._free)}/{self.num_blocks} free — "
+                    "backpressure, not OOM: requeue or reject")
+            ids = [self._free.pop() for _ in range(n)]
+            self._held.update(ids)
+        return ids
+
+    def free(self, block_ids):
+        """Return blocks to the free list (copy-free: contents are left
+        in place for the next owner to overwrite)."""
+        with self._lock:
+            for bid in block_ids:
+                if bid not in self._held:
+                    raise MXNetError(
+                        f"BlockAllocator.free: block {bid} is not held "
+                        "(double free or foreign id) — the pool would be "
+                        "silently corrupted")
+                self._held.discard(bid)
+                self._free.append(bid)
+
+    @property
+    def available(self):
+        """Blocks currently on the free list."""
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used(self):
+        with self._lock:
+            return len(self._held)
+
+    def utilization(self):
+        """Used fraction of the pool, in [0, 1]."""
+        with self._lock:
+            return len(self._held) / self.num_blocks
+
+
+class _Sequence:
+    __slots__ = ("blocks", "length")
+
+    def __init__(self):
+        self.blocks = []
+        self.length = 0
+
+
+class PagedKVCache:
+    """Block-pooled K/V storage for many concurrent sequences.
+
+    One pool pair per call site::
+
+        cache = PagedKVCache(num_layers=2, num_heads=4, head_dim=16,
+                             block_size=16, num_blocks=256)
+        cache.prefill("req-1", k, v)        # bulk-fill: k/v (N, L, H, D)
+        pos = cache.reserve("req-1")        # O(1) append: one slot
+        cache.write("req-1", layer, k1, v1) # fill the reserved slot
+        kd, vd, lens = cache.gather_batch(["req-1", ...], layer)
+        cache.free_sequence("req-1")        # blocks back to the free list
+
+    ``reserve`` + per-layer ``write`` split the append because a decoder
+    computes layer i's K/V only after layer i-1's attention — the slot is
+    reserved once per token (the O(1) step), then each layer writes its
+    projection into it as the forward proceeds.
+
+    ``gather_batch`` is the dense-gather decode fallback: it materializes
+    a padded ``(B, Lmax, H, D)`` view by copying block slices — O(total
+    context) per call, the documented CPU cost of serving attention
+    without a native paged kernel (docs/DIVERGENCES.md #27).
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, block_size=16,
+                 num_blocks=256, dtype=np.float32):
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        if self.block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.allocator = BlockAllocator(num_blocks)
+        shape = (self.num_layers, self.allocator.num_blocks,
+                 self.block_size, self.num_heads, self.head_dim)
+        self.k_blocks = np.zeros(shape, dtype)
+        self.v_blocks = np.zeros(shape, dtype)
+        self._lock = threading.RLock()
+        self._seqs = {}
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _entry(self, seq_id):
+        try:
+            return self._seqs[seq_id]
+        except KeyError:
+            raise MXNetError(f"PagedKVCache: unknown sequence {seq_id!r} "
+                             "(never prefilled, or already freed)") from None
+
+    def has_sequence(self, seq_id):
+        with self._lock:
+            return seq_id in self._seqs
+
+    def length(self, seq_id):
+        """Tokens currently cached for ``seq_id`` (reserved slots count)."""
+        with self._lock:
+            return self._entry(seq_id).length
+
+    def block_table(self, seq_id):
+        """The sequence's block-id table (a copy), in position order."""
+        with self._lock:
+            return list(self._entry(seq_id).blocks)
+
+    def num_sequences(self):
+        with self._lock:
+            return len(self._seqs)
+
+    def utilization(self):
+        return self.allocator.utilization()
+
+    def blocks_for(self, num_tokens):
+        """Blocks a ``num_tokens``-long prefill needs (admission math)."""
+        return -(-int(num_tokens) // self.block_size)
+
+    # -- writes --------------------------------------------------------------
+    def prefill(self, seq_id, k, v):
+        """Bulk-fill a new sequence's blocks in one call.
+
+        ``k``/``v``: ``(num_layers, L, num_heads, head_dim)``.  Allocates
+        exactly ``ceil(L / block_size)`` blocks all-or-nothing — on
+        :class:`CacheExhausted` nothing is registered, so the scheduler
+        can requeue the request and retry after an eviction."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        want = (self.num_layers, k.shape[1], self.num_heads, self.head_dim)
+        if k.shape != want or v.shape != want:
+            raise ValueError(
+                f"prefill: k/v must be (num_layers={self.num_layers}, L, "
+                f"H={self.num_heads}, D={self.head_dim}); got {k.shape} / "
+                f"{v.shape}")
+        length = k.shape[1]
+        if length < 1:
+            raise ValueError("prefill: empty prompt")
+        with self._lock:
+            if seq_id in self._seqs:
+                raise MXNetError(f"prefill: sequence {seq_id!r} already "
+                                 "cached (free it first)")
+            blocks = self.allocator.alloc(self.blocks_for(length))
+            # fill BEFORE publishing in _seqs: a concurrent gather must
+            # never see a registered-but-empty sequence (all-zero K/V
+            # would be silently wrong logits, not an error)
+            bs = self.block_size
+            for i, bid in enumerate(blocks):
+                lo = i * bs
+                hi = min(lo + bs, length)
+                self.k_blocks[:, bid, :hi - lo] = k[:, lo:hi]
+                self.v_blocks[:, bid, :hi - lo] = v[:, lo:hi]
+            entry = _Sequence()
+            entry.blocks = blocks
+            entry.length = length
+            self._seqs[seq_id] = entry
+
+    def reserve(self, seq_id):
+        """Reserve the next token's slot: the O(1) append.  At most one
+        free-list pop (when the tail block is full); returns the position
+        index the per-layer :meth:`write` calls will fill.  On
+        :class:`CacheExhausted` the sequence is unchanged — the caller
+        preempts it (free + requeue), never crashes."""
+        with self._lock:
+            entry = self._entry(seq_id)
+            if entry.length % self.block_size == 0:
+                entry.blocks.extend(self.allocator.alloc(1))
+            pos = entry.length
+            entry.length = pos + 1
+            return pos
+
+    def write(self, seq_id, layer, k, v):
+        """Write one layer's K/V projection into the newest reserved slot
+        (``k``/``v``: ``(num_heads, head_dim)``)."""
+        with self._lock:
+            entry = self._entry(seq_id)
+            pos = entry.length - 1
+            bid = entry.blocks[pos // self.block_size]
+            off = pos % self.block_size
+            self.k_blocks[layer, bid, off] = k
+            self.v_blocks[layer, bid, off] = v
+
+    def free_sequence(self, seq_id):
+        """Evict: push the sequence's blocks back on the free list
+        (copy-free — contents stay until reuse).  Returns the number of
+        blocks released."""
+        with self._lock:
+            entry = self._seqs.pop(seq_id, None)
+            if entry is None:
+                return 0
+            self.allocator.free(entry.blocks)
+            return len(entry.blocks)
+
+    # -- reads (the dense-gather fallback) -----------------------------------
+    def gather(self, seq_id, layer):
+        """One sequence's dense ``(L, H, D)`` K/V for ``layer`` — the
+        block table resolved in one fancy-index gather (a copy)."""
+        with self._lock:
+            entry = self._entry(seq_id)
+            blocks = list(entry.blocks)
+            length = entry.length
+        bs = self.block_size
+        k = self.k_blocks[layer, blocks].reshape(-1, self.num_heads,
+                                                 self.head_dim)
+        v = self.v_blocks[layer, blocks].reshape(-1, self.num_heads,
+                                                 self.head_dim)
+        return k[:length], v[:length]
+
+    def gather_batch(self, seq_ids, layer):
+        """Padded dense K/V for a decode batch: ``(B, Lpad, H, D)`` pair
+        plus the int32 ``(B,)`` true lengths.
+
+        ONE rectangular fancy-index gather for the whole batch (not a
+        per-block or per-sequence loop): the O(context) term of the
+        dense fallback is a single numpy memcpy pass per pool, which is
+        what keeps the measured per-token decode cost near-flat at bench
+        scale (docs/serving.md).  Positions >= length are padding — tail
+        blocks and block-0-padded rows ride along stale-but-finite, fine
+        BY CONTRACT: the attention mask excludes every key/value column
+        past ``lengths`` exactly (finite garbage in, exactly-0
+        probability out; blocks only ever hold finite writes)."""
+        tables = []
+        with self._lock:
+            for s in seq_ids:
+                entry = self._entry(s)
+                tables.append((list(entry.blocks), entry.length))
+        bs = self.block_size
+        b = len(tables)
+        nbmax = max(len(blocks) for blocks, _ in tables)
+        # every table padded to nbmax with block 0 makes the whole batch
+        # ONE rectangular fancy-index gather (a single memcpy pass per
+        # pool) — the padding rows are arbitrary-but-finite real block
+        # contents the length mask excludes exactly
+        ids = np.zeros((b, nbmax), np.intp)
+        for i, (blocks, _) in enumerate(tables):
+            ids[i, :len(blocks)] = blocks
+        shape = (b, nbmax * bs, self.num_heads, self.head_dim)
+        k = self.k_blocks[layer, ids.ravel()].reshape(shape)
+        v = self.v_blocks[layer, ids.ravel()].reshape(shape)
+        lengths = np.array([length for _, length in tables], np.int32)
+        return k, v, lengths
+
+    def stats(self):
+        """``{sequences, used_blocks, free_blocks, utilization}``."""
+        with self._lock:
+            n = len(self._seqs)
+        return {"sequences": n,
+                "used_blocks": self.allocator.used,
+                "free_blocks": self.allocator.available,
+                "utilization": self.allocator.utilization()}

@@ -1,0 +1,131 @@
+"""TinyLM: a deterministic attention decoder for the serving runtime.
+
+The serving engine (docs/serving.md) is model-agnostic — it drives any
+object implementing the small decode protocol below — but the tests, the
+``serve`` CI tier and the bench leg need a REAL autoregressive attention
+model whose correctness is checkable bit-for-bit: same tokens in, same
+logits out, on any host, with no trained weights to ship.  TinyLM is
+that: a multi-layer pre-activation attention decoder with
+seed-deterministic random weights (numpy ``RandomState``), positional
+embeddings, residual connections and a bounded ``tanh`` nonlinearity so
+hundreds of autoregressive steps stay finite.  It is NOT a trained
+language model; it is the workload that makes the cache/scheduler/server
+claims falsifiable (block-table gather must reproduce the dense cache's
+logits exactly — tests/test_serving.py).
+
+Decode protocol (what the engine calls; any model serving real traffic
+implements the same surface):
+
+- attributes ``num_layers``, ``num_heads``, ``head_dim``, ``vocab_size``
+- ``prefill(tokens) -> (k, v, logits_last)`` — the whole prompt in one
+  call: per-layer K/V ``(num_layers, L, H, D)`` for the cache bulk-fill
+  and the last position's logits ``(V,)``
+- ``embed(tokens, positions) -> (B, E)`` — batched decode entry
+- ``layer_qkv(i, h) -> (q, k, v)`` each ``(B, H, D)``
+- ``layer_combine(i, h, attn) -> (B, E)`` — residual + output proj
+- ``logits(h) -> (B, V)``
+
+The per-layer split exists because layer i's K/V projection is a
+function of layer i-1's attention output: the engine must interleave
+cache writes with the forward, which is exactly what the
+``reserve``/``write`` cache API models.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import attention as _attn
+
+__all__ = ["TinyLM"]
+
+
+class TinyLM:
+    """Seed-deterministic attention decoder (see module docstring)."""
+
+    def __init__(self, vocab_size=128, embed_dim=64, num_heads=4,
+                 num_layers=2, max_positions=4096, seed=0):
+        if embed_dim % num_heads:
+            raise ValueError(f"embed_dim {embed_dim} must divide by "
+                             f"num_heads {num_heads}")
+        self.vocab_size = int(vocab_size)
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.head_dim = self.embed_dim // self.num_heads
+        self.num_layers = int(num_layers)
+        self.max_positions = int(max_positions)
+        rng = np.random.RandomState(seed)
+        scale = 1.0 / np.sqrt(self.embed_dim)
+
+        def mat(*shape):
+            return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+        self.tok_emb = mat(self.vocab_size, self.embed_dim)
+        self.pos_emb = mat(self.max_positions, self.embed_dim)
+        self.layers = [
+            {"wq": mat(self.embed_dim, self.embed_dim),
+             "wk": mat(self.embed_dim, self.embed_dim),
+             "wv": mat(self.embed_dim, self.embed_dim),
+             "wo": mat(self.embed_dim, self.embed_dim)}
+            for _ in range(self.num_layers)]
+        self.w_out = mat(self.embed_dim, self.vocab_size)
+
+    # -- shared projections ---------------------------------------------------
+    def _split_heads(self, x):
+        # (..., E) -> (..., H, D)
+        return x.reshape(x.shape[:-1] + (self.num_heads, self.head_dim))
+
+    def embed(self, tokens, positions):
+        """(B,) int tokens at (B,) int absolute positions -> (B, E)."""
+        tokens = np.asarray(tokens, np.int64)
+        positions = np.asarray(positions, np.int64)
+        if np.any(positions >= self.max_positions):
+            raise ValueError(
+                f"position {int(positions.max())} >= max_positions="
+                f"{self.max_positions} — raise max_positions or cap "
+                "prompt+generation length at admission")
+        return self.tok_emb[tokens % self.vocab_size] + self.pos_emb[positions]
+
+    def layer_qkv(self, i, h):
+        """(B, E) -> q, k, v each (B, H, D)."""
+        lay = self.layers[i]
+        return (self._split_heads(h @ lay["wq"]),
+                self._split_heads(h @ lay["wk"]),
+                self._split_heads(h @ lay["wv"]))
+
+    def layer_combine(self, i, h, attn):
+        """Residual + output projection + bounded nonlinearity.
+
+        ``tanh`` keeps hidden magnitudes in [-1, 1] so arbitrarily long
+        untrained-weight generations never overflow — the engine's NaN
+        sentinel must fire on *injected* faults, not on the toy model's
+        own drift."""
+        flat = attn.reshape(attn.shape[0], self.embed_dim)
+        return np.tanh(h + flat @ self.layers[i]["wo"])
+
+    def logits(self, h):
+        """(B, E) -> (B, V)."""
+        return h @ self.w_out
+
+    # -- prefill --------------------------------------------------------------
+    def prefill(self, tokens):
+        """The whole prompt in one call.
+
+        Returns ``(k, v, logits_last)`` with ``k``/``v`` shaped
+        ``(num_layers, L, H, D)`` — the cache bulk-fill payload — and the
+        last position's ``(V,)`` logits (the first generated token's
+        distribution).  Attention routes through
+        :func:`serving.attention.prefill_attention` (flash on supported
+        TPU shapes, dense reference elsewhere)."""
+        tokens = np.asarray(tokens, np.int64)
+        length = tokens.shape[0]
+        h = self.embed(tokens, np.arange(length))          # (L, E)
+        ks = np.empty((self.num_layers, length, self.num_heads,
+                       self.head_dim), np.float32)
+        vs = np.empty_like(ks)
+        for i in range(self.num_layers):
+            q, k, v = self.layer_qkv(i, h)                 # (L, H, D)
+            ks[i] = k
+            vs[i] = v
+            attn = _attn.prefill_attention(q, k, v)
+            h = self.layer_combine(i, h, attn)
+        return ks, vs, self.logits(h[-1:])[0]

@@ -1,0 +1,372 @@
+"""Request admission + continuous batching policy (docs/serving.md).
+
+The scheduler is pure policy: it owns the **pending** queue (bounded —
+the backpressure boundary) and the **running** set (the live decode
+batch), and decides, each engine step, which requests to prefill and
+which sequences to decode.  It never touches the model or the cache
+contents; the server composes it with the engine.
+
+- **Continuous batching** (:class:`ContinuousBatchingScheduler`): new
+  requests are admitted into the running batch on EVERY step as slots
+  and token budget allow, and finished sequences leave immediately —
+  the batch never waits for its slowest member.  This is the ≥2×
+  throughput claim the bench ``serve`` leg measures against the static
+  baseline.
+- **Admission control**: three reject-with-reason gates *before* any
+  memory is committed — ``queue_full`` (bounded pending queue),
+  ``request_too_large`` (one request can never fit the token budget),
+  and the chaos ``reject_storm`` injection.  A reject is an
+  :class:`AdmissionReject` the caller sees with ``.reason``; nothing is
+  silently dropped and nothing OOMs.
+- **Token budget**: admission stops while the in-flight worst case
+  (``sum(len(prompt) + max_new_tokens)`` over running) would exceed
+  ``max_tokens`` — the knob that keeps cache demand bounded.
+- **Static baseline** (:class:`StaticBatchingScheduler`): the naive
+  policy real systems started from — admit a full batch, run it until
+  EVERY member finishes (finished sequences keep burning their slot,
+  cache and compute as padding), only then admit the next batch.  Kept
+  in-tree so the continuous-batching win is measured against a real
+  implementation, not a strawman description.
+
+Thread-safety: all public methods take the scheduler lock; ``submit``
+may be called from any thread while the server's step thread admits and
+evicts (tests/test_serving.py hammers this).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..base import MXNetError
+from .. import telemetry as _telemetry
+from .. import tracing as _tracing
+from ..contrib import chaos as _chaos
+
+__all__ = ["Request", "AdmissionReject", "ContinuousBatchingScheduler",
+           "StaticBatchingScheduler"]
+
+_req_counter = itertools.count()
+
+
+class AdmissionReject(MXNetError):
+    """The front-end refused this request; ``reason`` says why
+    (``queue_full`` / ``request_too_large`` / ``reject_storm``).  This is
+    backpressure, not failure: the client resubmits later."""
+
+    def __init__(self, reason, detail=""):
+        super().__init__(f"request rejected: {reason}"
+                         + (f" ({detail})" if detail else ""))
+        self.reason = reason
+
+
+class Request:
+    """One generation request and its lifecycle record (the handle the
+    front-end returns).
+
+    States: ``queued`` → ``running`` → ``done`` (or ``failed``).  A
+    requeued request (engine restart, cache preemption) goes back to
+    ``queued`` with its generated tokens DISCARDED — re-run-from-prompt
+    is the restart contract (docs/serving.md); ``requeues`` counts how
+    often that happened.  Latency bookkeeping (``submitted_at``,
+    ``first_token_at``, ``token_times``) feeds the TTFT/ITL telemetry
+    and the bench percentiles."""
+
+    def __init__(self, prompt, max_new_tokens, request_id=None):
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("Request: empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("Request: max_new_tokens must be >= 1")
+        self.id = request_id or f"req-{next(_req_counter):06d}"
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.state = "queued"
+        self.tokens = []
+        self.finish_reason = None
+        self.requeues = 0
+        self.submitted_at = time.perf_counter()
+        self.first_token_at = None
+        self.token_times = []
+        self._done = threading.Event()
+
+    @property
+    def budget_tokens(self):
+        """Worst-case in-flight footprint: prompt + full generation."""
+        return len(self.prompt) + self.max_new_tokens
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    @property
+    def ttft(self):
+        """Submit → first token, seconds (None before the first token)."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    def record_token(self, token):
+        now = time.perf_counter()
+        if self.first_token_at is None:
+            self.first_token_at = now
+            _telemetry.histogram("serve.ttft_seconds").observe(self.ttft)
+        else:
+            _telemetry.histogram("serve.itl_seconds").observe(
+                now - self.token_times[-1])
+        self.token_times.append(now)
+        self.tokens.append(int(token))
+
+    def reset_generation(self):
+        """Discard generated state for a re-run (restart/preemption)."""
+        self.tokens = []
+        self.token_times = []
+        self.first_token_at = None
+        self.requeues += 1
+        self.state = "queued"
+
+    def finish(self, reason="length"):
+        self.state = "done"
+        self.finish_reason = reason
+        self._done.set()
+
+    def fail(self, reason):
+        self.state = "failed"
+        self.finish_reason = reason
+        self._done.set()
+
+    def wait(self, timeout=None):
+        """Block until done/failed; returns the terminal state reached."""
+        self._done.wait(timeout)
+        return self.state
+
+    def __repr__(self):
+        return (f"Request({self.id}, state={self.state}, "
+                f"prompt={len(self.prompt)}t, "
+                f"generated={len(self.tokens)}/{self.max_new_tokens})")
+
+
+class ContinuousBatchingScheduler:
+    """Split prefill/decode queues with per-step continuous admission
+    (policy details in the module docstring)."""
+
+    def __init__(self, max_pending=64, max_batch=8, max_tokens=8192):
+        self.max_pending = int(max_pending)
+        self.max_batch = int(max_batch)
+        self.max_tokens = int(max_tokens)
+        self._lock = threading.RLock()
+        self._pending = []
+        self._running = []
+
+    # -- admission (any thread) ----------------------------------------------
+    def submit(self, req):
+        """Enqueue ``req`` or raise :class:`AdmissionReject`."""
+        if _chaos.forced_reject():
+            self.reject(req, "reject_storm",
+                         "chaos reject_storm injection armed")
+        if req.budget_tokens > self.max_tokens:
+            self.reject(
+                req, "request_too_large",
+                f"prompt+max_new = {req.budget_tokens} tokens > "
+                f"max_tokens = {self.max_tokens}")
+        with self._lock:
+            if len(self._pending) >= self.max_pending:
+                self.reject(
+                    req, "queue_full",
+                    f"{len(self._pending)} pending >= max_pending = "
+                    f"{self.max_pending}")
+            self._pending.append(req)
+        _telemetry.counter("serve.requests", state="admitted").inc()
+        _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
+        _tracing.emit("serve.admit", request=req.id,
+                      prompt_tokens=len(req.prompt),
+                      max_new_tokens=req.max_new_tokens)
+        return req
+
+    def reject(self, req, reason, detail=""):
+        """Refuse ``req`` with full bookkeeping — fail the handle, count
+        it, put it on the timeline — then raise :class:`AdmissionReject`.
+        The ONE reject implementation; the server's own gates (pool-size,
+        degraded) route through it too."""
+        req.fail(f"rejected: {reason}")
+        _telemetry.counter("serve.requests", state="rejected").inc()
+        _tracing.emit("serve.reject", request=req.id, reason=reason)
+        raise AdmissionReject(reason, detail)
+
+    # -- per-step policy (the server's step thread) --------------------------
+    def budget_used(self):
+        with self._lock:
+            return sum(r.budget_tokens for r in self._running)
+
+    def take_prefills(self):
+        """Pop the pending requests admissible THIS step: batch slots
+        free and the worst-case token budget respected.  Continuous: runs
+        every step, so a finishing sequence's slot is refilled on the
+        very next iteration."""
+        out = []
+        with self._lock:
+            used = sum(r.budget_tokens for r in self._running)
+            while (self._pending
+                   and len(self._running) + len(out) < self.max_batch
+                   and used + self._pending[0].budget_tokens
+                   <= self.max_tokens):
+                req = self._pending.pop(0)
+                used += req.budget_tokens
+                out.append(req)
+        if out:
+            _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
+        return out
+
+    def mark_running(self, req):
+        with self._lock:
+            req.state = "running"
+            self._running.append(req)
+
+    def decode_batch(self):
+        """The sequences to decode this step (continuous: every running,
+        unfinished request — finished ones were already evicted)."""
+        with self._lock:
+            return list(self._running)
+
+    def finish(self, req, reason="length"):
+        """Mark ``req`` finished; returns the requests whose cache should
+        be evicted NOW (continuous: immediately — the block pool is the
+        scarce resource and a finished sequence holds it for no one)."""
+        with self._lock:
+            req.finish(reason)
+            if req in self._running:
+                self._running.remove(req)
+        return [req]
+
+    def requeue(self, req, front=True):
+        """Bounce a running request back to pending for a re-run
+        (engine restart, cache preemption).  Its generated tokens are
+        discarded; ``front=True`` preserves arrival order fairness."""
+        with self._lock:
+            if req in self._running:
+                self._running.remove(req)
+            req.reset_generation()
+            if front:
+                self._pending.insert(0, req)
+            else:
+                self._pending.append(req)
+        _telemetry.counter("serve.requests", state="requeued").inc()
+        _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
+
+    def defer(self, reqs):
+        """Push admissions that never STARTED back to the queue front
+        (prefill hit cache backpressure).  Unlike :meth:`requeue` this
+        neither resets generation nor counts a requeue — a deferred
+        request was not re-run, merely not admitted yet."""
+        with self._lock:
+            self._pending[0:0] = list(reqs)
+        _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
+
+    def requeue_all_running(self):
+        """Engine restart: every in-flight sequence survives by going
+        back to pending (newest first so fronted order stays FIFO)."""
+        with self._lock:
+            running = list(self._running)
+        for req in reversed(running):
+            self.requeue(req, front=True)
+        return running
+
+    def drain_running(self):
+        """Remove and return every UNFINISHED in-flight request without
+        requeueing (degraded shutdown: the server fails them — they were
+        never re-admitted, so nothing counts as requeued)."""
+        with self._lock:
+            out = list(self._running)
+            self._running = []
+        return out
+
+    def discard(self, req):
+        """Drop a request from the scheduler's books with NO state
+        change on the handle (a finished padding slot whose cache was
+        preempted away — it already delivered its tokens)."""
+        with self._lock:
+            if req in self._running:
+                self._running.remove(req)
+
+    def drain_pending(self):
+        """Remove and return EVERY pending request (degraded shutdown:
+        the server fails them loudly instead of leaving them queued
+        forever)."""
+        with self._lock:
+            out = list(self._pending)
+            self._pending = []
+        _telemetry.gauge("serve.queue_depth").set(0)
+        return out
+
+    # -- observables ---------------------------------------------------------
+    def queue_depth(self):
+        with self._lock:
+            return len(self._pending)
+
+    def running_count(self):
+        with self._lock:
+            return len(self._running)
+
+    def idle(self):
+        with self._lock:
+            return not self._pending and not self._running
+
+
+class StaticBatchingScheduler(ContinuousBatchingScheduler):
+    """The naive static-batching baseline (bench A/B arm — module
+    docstring).  Admission waits for a FULL drain; finished sequences
+    stay in the decode batch as padding (their decode output is
+    discarded by the server) and their cache is only freed when the
+    whole batch completes."""
+
+    def __init__(self, max_pending=64, max_batch=8, max_tokens=8192):
+        super().__init__(max_pending=max_pending, max_batch=max_batch,
+                         max_tokens=max_tokens)
+        self._finished = []
+
+    def take_prefills(self):
+        with self._lock:
+            if self._running or self._finished:
+                return []   # static: the whole batch must drain first
+        return super().take_prefills()
+
+    def decode_batch(self):
+        # finished members keep their slot (and their padding decodes)
+        # until the batch drains — the waste continuous batching removes
+        with self._lock:
+            return list(self._running) + list(self._finished)
+
+    def finish(self, req, reason="length"):
+        with self._lock:
+            req.finish(reason)
+            if req in self._running:
+                self._running.remove(req)
+                self._finished.append(req)
+            if self._running:
+                return []
+            drained = list(self._finished)
+            self._finished = []
+        return drained
+
+    def requeue_all_running(self):
+        with self._lock:
+            # padding members' cache is freed by the server on restart
+            # like everyone else's; only unfinished ones re-run
+            self._finished = []
+        return super().requeue_all_running()
+
+    def drain_running(self):
+        with self._lock:
+            self._finished = []   # done already — nothing to fail
+        return super().drain_running()
+
+    def discard(self, req):
+        with self._lock:
+            if req in self._finished:
+                self._finished.remove(req)
+        super().discard(req)
+
+    def idle(self):
+        with self._lock:
+            return (not self._pending and not self._running
+                    and not self._finished)

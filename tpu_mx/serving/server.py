@@ -1,0 +1,341 @@
+"""The request front-end: streams of requests in, supervised decode out.
+
+:class:`Server` turns a stream of generation requests into scheduler and
+engine work (docs/serving.md):
+
+- ``submit(prompt, max_new_tokens)`` admits a request (any thread) and
+  returns its :class:`~tpu_mx.serving.scheduler.Request` handle, or
+  raises :class:`~tpu_mx.serving.scheduler.AdmissionReject` with a
+  reason — the bounded-queue backpressure contract.
+- ``step()`` runs ONE engine iteration: admit + prefill newly admissible
+  requests, decode the running batch one token, evict finished sequences
+  immediately.  The caller drives the loop (``run_until_idle()``), which
+  keeps the data plane single-threaded and deterministic under a fixed
+  seed — the property every serving test and the bench A/B lean on.
+- ``stream(prompt, ...)`` submits and yields tokens as they are
+  generated, driving ``step()`` underneath.
+
+**Self-healing** (the supervisor's patterns, reused — tpu_mx/supervisor
+.py): every engine compute call runs under ``run_with_deadline`` (a hung
+decode — chaos ``slow_decode_step``, a wedged dispatch — becomes a
+catchable ``WatchdogTimeout``); non-finite logits raise
+``NumericDivergence`` exactly like the training sentinel; both are
+sorted by ``supervisor.classify`` and anything transient/numeric
+triggers a **classified engine restart**: the engine (cache included) is
+rebuilt from scratch, every in-flight request is requeued and re-runs
+from its prompt, a black box is dumped (``blackbox=`` prefix, same
+flight-recorder format the training supervisor writes), and a bounded
+restart budget degrades gracefully — queued requests are failed with a
+reason, never silently lost.  Abandoned watchdog threads only ever touch
+the DISCARDED engine's private cache (the zombie-step discipline:
+scheduler and request handles are mutated exclusively by the caller's
+step thread).
+
+Trace context: each step stamps ``step``/``generation`` (engine
+generation = restart count) and per-request work stamps ``request`` —
+the serving analog of the training step context, so a slow request's
+black box reconstructs its admit → prefill → decode → evict timeline
+(docs/observability.md).
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import telemetry as _telemetry
+from .. import tracing as _tracing
+from ..supervisor import classify, run_with_deadline
+from .engine import EngineCore
+from .kv_cache import CacheExhausted
+from .scheduler import ContinuousBatchingScheduler, Request
+
+__all__ = ["Server"]
+
+log = logging.getLogger(__name__)
+
+
+class Server:
+    """See module docstring.
+
+    ``model`` implements the decode protocol (tpu_mx/serving/model.py);
+    ``scheduler`` defaults to a :class:`ContinuousBatchingScheduler`
+    built from ``max_pending``/``max_batch``/``max_tokens``;
+    ``block_size``/``num_blocks`` size the paged cache; ``deadline``
+    arms the hung-step watchdog (seconds, None = off); ``max_restarts``
+    bounds the self-healing budget; ``blackbox`` (a path prefix) arms
+    the crash black box; ``eos_id`` optionally ends generation early."""
+
+    def __init__(self, model, *, scheduler=None, max_pending=64,
+                 max_batch=8, max_tokens=8192, block_size=16,
+                 num_blocks=256, deadline=None, max_restarts=3,
+                 backoff=0.05, blackbox=None, eos_id=None,
+                 dtype=np.float32):
+        self.model = model
+        self.scheduler = scheduler if scheduler is not None else \
+            ContinuousBatchingScheduler(max_pending=max_pending,
+                                        max_batch=max_batch,
+                                        max_tokens=max_tokens)
+        self._block_size = int(block_size)
+        self._num_blocks = int(num_blocks)
+        self._dtype = dtype
+        self.deadline = deadline
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.blackbox = blackbox
+        self.eos_id = eos_id
+        self.engine = EngineCore(model, block_size=block_size,
+                                 num_blocks=num_blocks, dtype=dtype)
+        self.generation = 0        # engine generation (restart count)
+        self.restarts = 0
+        self.degraded = False
+        self._steps = 0
+        self._tokens_generated = 0
+        self._t_first_work = None
+
+    # -- admission (any thread) ----------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, request_id=None):
+        """Admit one request; returns its handle or raises
+        :class:`AdmissionReject` (reason on the exception — resubmit
+        later).  A degraded server rejects everything."""
+        req = Request(prompt, max_new_tokens, request_id=request_id)
+        # both server-side gates route through the scheduler's ONE
+        # reject implementation, so a degraded-window or oversized
+        # submit is counted and lands on the timeline like any other
+        if self.degraded:
+            self.scheduler.reject(req, "degraded",
+                                  "restart budget exhausted; server is "
+                                  "in degraded shutdown")
+        # a request whose WORST CASE can never fit the block pool would
+        # preempt-loop forever — reject it at the door with the reason
+        need = self.engine.cache.blocks_for(req.budget_tokens)
+        if need > self._num_blocks:
+            self.scheduler.reject(
+                req, "request_too_large",
+                f"prompt+max_new needs {need} cache blocks > pool of "
+                f"{self._num_blocks}")
+        return self.scheduler.submit(req)
+
+    # -- the engine loop (one driver thread) ---------------------------------
+    def step(self):
+        """One engine iteration (admit → prefill → decode → evict).
+        Returns True when any work was done.  Transient/numeric faults
+        restart the engine in place; fatal ones propagate."""
+        if self.degraded:
+            raise MXNetError("serving: server is degraded — no further "
+                             "steps will run")
+        self._steps += 1
+        _tracing.set_context(step=self._steps, generation=self.generation)
+        try:
+            return self._step_guarded()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            kind = classify(e)
+            if kind == "fatal":
+                raise
+            self._restart(e)
+            return True
+
+    def _step_guarded(self):
+        worked = False
+        # --- admit + prefill (split prefill queue) -------------------------
+        admits = self.scheduler.take_prefills()
+        for i, req in enumerate(admits):
+            if self._t_first_work is None:
+                self._t_first_work = time.perf_counter()
+            _tracing.set_context(request=req.id)
+            try:
+                first = run_with_deadline(
+                    lambda r=req: self.engine.prefill(r),
+                    self.deadline, name=f"serve-prefill-{req.id}")
+            except CacheExhausted:
+                # backpressure: this request (and the rest of this
+                # step's admissions) goes back to the queue front — a
+                # DEFER, not a requeue: none of them started, so nothing
+                # is reset or counted — and the step FALLS THROUGH to
+                # decode, whose progress (and evictions) is what will
+                # free the blocks; an early return here would starve
+                # decode and livelock
+                self.scheduler.defer(admits[i:])
+                _tracing.set_context(request=None)
+                break
+            finally:
+                _tracing.set_context(request=None)
+            self.scheduler.mark_running(req)
+            self._commit_token(req, first)
+            worked = True
+        # --- decode (one token across the running batch) -------------------
+        batch = self.scheduler.decode_batch()
+        if batch:
+            if self._t_first_work is None:
+                self._t_first_work = time.perf_counter()
+            items = [(r, r.tokens[-1] if r.tokens else r.prompt[-1])
+                     for r in batch]
+            t0 = time.perf_counter()
+            results, preempted = run_with_deadline(
+                lambda: self.engine.decode(items), self.deadline,
+                name=f"serve-decode-step{self._steps}")
+            fresh = 0
+            for req in batch:
+                token = results.get(req.id)
+                if token is None or req.done:
+                    continue   # preempted, or a static-padding slot
+                fresh += 1
+                self._commit_token(req, token)
+            for req in preempted:
+                # a FINISHED victim was a static-batching padding slot:
+                # its tokens were already delivered, so it is simply
+                # dropped from the books — requeueing it would corrupt a
+                # done handle and re-decode a completed request
+                done_padding = req.done
+                _tracing.set_context(request=req.id)
+                _tracing.emit("serve.evict", request=req.id,
+                              reason="padding" if done_padding
+                              else "preempted",
+                              generated=len(req.tokens))
+                _tracing.set_context(request=None)
+                if done_padding:
+                    self.scheduler.discard(req)
+                else:
+                    self.scheduler.requeue(req, front=True)
+            _telemetry.counter("serve.decode_steps").inc()
+            _tracing.emit("serve.decode", batch=len(items), tokens=fresh,
+                          t0=t0, t1=time.perf_counter())
+            worked = True
+        self._update_gauges()
+        return worked
+
+    def _commit_token(self, req, token):
+        """Record one generated token and finish/evict when done."""
+        req.record_token(token)
+        self._tokens_generated += 1
+        _telemetry.counter("serve.generated_tokens").inc()
+        done_len = len(req.tokens) >= req.max_new_tokens
+        done_eos = self.eos_id is not None and int(token) == self.eos_id
+        if done_len or done_eos:
+            reason = "eos" if done_eos else "length"
+            for ev in self.scheduler.finish(req, reason):
+                self._evict(ev)
+
+    def _evict(self, req):
+        """Free a finished sequence's cache immediately (continuous
+        batching's whole point) and close out its telemetry."""
+        self.engine.evict(req)
+        _telemetry.counter("serve.requests", state="completed").inc()
+        _tracing.set_context(request=req.id)
+        _tracing.emit("serve.evict", request=req.id,
+                      reason=req.finish_reason or "length",
+                      generated=len(req.tokens))
+        _tracing.set_context(request=None)
+
+    def _update_gauges(self):
+        _telemetry.gauge("serve.cache_utilization").set(
+            self.engine.cache.utilization())
+        _telemetry.gauge("serve.queue_depth").set(
+            self.scheduler.queue_depth())
+        if self._t_first_work is not None:
+            dt = time.perf_counter() - self._t_first_work
+            if dt > 0:
+                _telemetry.gauge("serve.tokens_per_sec").set(
+                    self._tokens_generated / dt)
+
+    # -- self-healing --------------------------------------------------------
+    def _restart(self, err):
+        """Classified engine restart: fresh engine + cache, every
+        in-flight request requeued (re-runs from its prompt), black box
+        dumped; budget exhaustion degrades — queued requests are failed
+        loudly, never silently lost."""
+        self.restarts += 1
+        reason = f"{type(err).__name__}: {err}"[:300]
+        log.warning("serving: engine fault (%s) — restart %d/%d",
+                    reason, self.restarts, self.max_restarts)
+        if self.restarts > self.max_restarts:
+            self._degrade(err)
+            return
+        requeued = self.scheduler.requeue_all_running()
+        # the old engine (and any watchdog thread still wedged inside
+        # it) is garbage from here: threads touching its private cache
+        # mutate nothing the new generation reads
+        self.engine = EngineCore(self.model, block_size=self._block_size,
+                                 num_blocks=self._num_blocks,
+                                 dtype=self._dtype)
+        self.generation += 1
+        _telemetry.counter("serve.engine_restarts").inc()
+        _tracing.emit("serve.restart", n=self.restarts, reason=reason,
+                      requeued=len(requeued))
+        self._dump_blackbox(f"serving engine restart "
+                            f"{self.restarts}/{self.max_restarts}: "
+                            f"{reason}")
+        _tracing.set_context(generation=self.generation)
+        _telemetry.flush()
+        if self.backoff:
+            time.sleep(min(30.0, self.backoff * 2 ** (self.restarts - 1)))
+
+    def _degrade(self, err):
+        """Restart budget exhausted: fail every queued + running request
+        with a reason (the client sees it; nothing hangs forever)."""
+        self.degraded = True
+        reason = (f"degraded: restart budget exhausted "
+                  f"({type(err).__name__}: {err})")[:300]
+        log.error("serving: %s", reason)
+        # drain, don't requeue: these requests are being FAILED, so a
+        # requeue would both double-count them as "requeued" and leave
+        # each one processed twice
+        failed = self.scheduler.drain_running()
+        failed.extend(self.scheduler.drain_pending())
+        for req in failed:
+            req.fail(reason)
+        self._dump_blackbox(reason)
+        _telemetry.flush()
+
+    def _dump_blackbox(self, reason):
+        if not self.blackbox:
+            return None
+        try:
+            return _tracing.dump_blackbox(self.blackbox, reason=reason)
+        except Exception as dump_err:  # noqa: BLE001 — best effort
+            log.warning("serving: black-box dump failed: %s", dump_err)
+            return None
+
+    # -- drivers -------------------------------------------------------------
+    def run_until_idle(self, max_steps=1_000_000):
+        """Drive ``step()`` until no request is pending or running;
+        returns the number of steps taken."""
+        from ..contrib import chaos as _chaos
+        _chaos.configure_from_env()   # arm TPUMX_CHAOS faults, like run()
+        n = 0
+        while not self.scheduler.idle():
+            if n >= max_steps:
+                raise MXNetError(
+                    f"serving: run_until_idle exceeded {max_steps} steps "
+                    "with work still queued — wedged scheduler?")
+            self.step()
+            n += 1
+        _telemetry.flush()
+        return n
+
+    def stream(self, prompt, max_new_tokens=16, request_id=None):
+        """Submit and yield tokens as they are generated (drives the
+        engine loop from the consuming thread)."""
+        req = self.submit(prompt, max_new_tokens, request_id=request_id)
+        seen = 0
+        guard = 0
+        while True:
+            # an engine restart resets req.tokens and re-runs from the
+            # prompt; greedy decode is deterministic, so the regenerated
+            # prefix matches what was already yielded — wait for the
+            # length to catch back up to `seen` instead of re-yielding
+            while seen < len(req.tokens):
+                yield req.tokens[seen]
+                seen += 1
+            if req.done:
+                if req.state == "failed":
+                    raise MXNetError(
+                        f"serving: request {req.id} failed: "
+                        f"{req.finish_reason}")
+                return
+            guard += 1
+            if guard > 1_000_000:
+                raise MXNetError("serving: stream wedged — no progress")
+            self.step()

@@ -99,6 +99,17 @@ KNOWN_METRICS = frozenset({
     # flight recorder (tpu_mx/tracing.py; event NAMES live in its own
     # KNOWN_EVENTS catalog — this counts black boxes persisted)
     "tracing.blackbox_dumps",
+    # inference serving runtime (tpu_mx/serving/; docs/serving.md).  The
+    # SLO pair: ttft = submit→first token (queueing + prefill), itl = the
+    # gap between consecutive generated tokens — p50/p99 read off the
+    # fixed latency buckets.  requests{state} counts every admission
+    # outcome (admitted/rejected/completed/requeued); decode_steps and
+    # generated_tokens are the throughput numerators; queue_depth /
+    # cache_utilization are the backpressure observables.
+    "serve.ttft_seconds", "serve.itl_seconds",
+    "serve.tokens_per_sec", "serve.queue_depth", "serve.cache_utilization",
+    "serve.requests", "serve.engine_restarts",
+    "serve.decode_steps", "serve.generated_tokens",
     # module-API training (tpu_mx/callback.py)
     "speedometer.samples_per_sec",
 })

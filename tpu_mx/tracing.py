@@ -101,6 +101,20 @@ KNOWN_EVENTS = {
     # fault injection (tpu_mx/contrib/chaos.py): the injection and the
     # recovery it provokes share one timeline
     "chaos.inject": {"kind": "str"},
+    # inference serving runtime (tpu_mx/serving/, docs/serving.md): the
+    # request lifecycle.  Per-request events (admit/prefill/evict/reject)
+    # are additionally stamped with the request-scoped `request` context
+    # field (set_context(request=...) — the serving analog of the
+    # training loop's step context), so a slow request's black box is
+    # reconstructible; decode is batch-scoped and rides the engine-step
+    # `step`/`generation` context like a train step.
+    "serve.admit": {"request": "str", "prompt_tokens": "int",
+                    "max_new_tokens": "int"},
+    "serve.reject": {"request": "str", "reason": "str"},
+    "serve.prefill": {"request": "str", "tokens": "int", "seconds": "float"},
+    "serve.decode": {"batch": "int", "tokens": "int", "seconds": "float"},
+    "serve.evict": {"request": "str", "reason": "str", "generated": "int"},
+    "serve.restart": {"n": "int", "reason": "str", "requeued": "int"},
 }
 
 # the documented values of train_step.phase's `phase` field (the whole
@@ -132,6 +146,12 @@ _context = {
     "epoch": None,
     "step": None,
     "generation": 0,
+    # request-scoped context (tpu_mx/serving/): the id of the request an
+    # event belongs to, or None outside per-request work.  The serving
+    # engine stamps it around admit/prefill/evict exactly like the
+    # supervisor stamps epoch/step around a train step; batch-scoped
+    # decode events leave it None and correlate via step/generation.
+    "request": None,
 }
 
 
@@ -158,10 +178,12 @@ def configure(enabled=None, capacity=None):
 
 def set_context(**fields):
     """Update the process-wide trace context (``run_id``, ``epoch``,
-    ``step``, ``generation``).  The training loop owns this: the
-    supervisor stamps epoch/step/generation around every supervised step,
-    and every event emitted anywhere in the process — including on the
-    watchdog daemon thread — carries the values current at emit time."""
+    ``step``, ``generation``, ``request``).  The training loop owns the
+    first four: the supervisor stamps epoch/step/generation around every
+    supervised step; the serving engine stamps step/generation per engine
+    step and ``request`` around per-request work.  Every event emitted
+    anywhere in the process — including on the watchdog daemon thread —
+    carries the values current at emit time."""
     unknown = set(fields) - set(_context)
     if unknown:
         raise ValueError(f"unknown trace-context field(s) {sorted(unknown)} "
@@ -241,6 +263,12 @@ def validate_event(rec):
     if not isinstance(rec.get("generation"), int) \
             or isinstance(rec.get("generation"), bool):
         raise ValueError(f"{name}: missing int 'generation'")
+    # `request` joined the context with the serving runtime; events
+    # recorded by older builds simply lack the key (still valid)
+    req = rec.get("request")
+    if req is not None and not isinstance(req, str):
+        raise ValueError(f"{name}: 'request' must be str or None, "
+                         f"got {req!r}")
     data = rec.get("data")
     if not isinstance(data, dict):
         raise ValueError(f"{name}: missing 'data' payload object")
@@ -326,7 +354,7 @@ def reset():
         _ring.clear()
         _emitted = 0
         _dropped = 0
-        _context.update(epoch=None, step=None, generation=0)
+        _context.update(epoch=None, step=None, generation=0, request=None)
 
 
 # ---------------------------------------------------------------------------
