@@ -1025,9 +1025,73 @@ def bench_fusion(smoke):
     }
 
 
+def measure_decode_micro(contexts, block_size=16, batch=4, heads=4,
+                         dim=16, seed=20260804, repeats=2):
+    """decode_attention micro-arm (ISSUE 9): one decode step's attention,
+    paged arm (device-resident pool + block-table kernel/XLA twin) vs
+    the dense-gather reference arm (host pool + padded host gather), at
+    several context lengths.
+
+    Each arm gets its OWN cache in its production storage mode, filled
+    with identical fixed-seed K/V, so the A/B is the real data-plane
+    swap and not a storage-mode hybrid.  Per-context receipt: per-call
+    and per-sequence-token µs for both arms, min of ``repeats`` means
+    (the standard min-of-repeats discipline).  Shared by the bench serve
+    leg and tools/paged_sweep.py."""
+    import numpy as np
+    from tpu_mx.serving import attention as _sattn
+    from tpu_mx.serving.kv_cache import PagedKVCache
+
+    rng = np.random.RandomState(seed)
+    rows = []
+    for ctx in contexts:
+        nblocks = batch * (-(-int(ctx) // block_size)) + 8
+        caches = {
+            "dense": PagedKVCache(1, heads, dim, block_size=block_size,
+                                  num_blocks=nblocks, storage="host"),
+            "paged": PagedKVCache(1, heads, dim, block_size=block_size,
+                                  num_blocks=nblocks, storage="device"),
+        }
+        ids = [f"s{i}" for i in range(batch)]
+        for i in range(batch):
+            k = rng.rand(1, ctx, heads, dim).astype(np.float32)
+            v = rng.rand(1, ctx, heads, dim).astype(np.float32)
+            for cache in caches.values():
+                cache.prefill(ids[i], k, v)
+        q = rng.rand(batch, heads, dim).astype(np.float32)
+        iters = max(8, min(64, (1 << 18) // int(ctx)))
+        row = {"context": int(ctx), "batch": batch, "heads": heads,
+               "dim": dim, "block_size": block_size, "iters": iters}
+        for kind, cache in caches.items():
+            fn = lambda: _sattn.decode_attention(q, cache, ids, 0,
+                                                 kind=kind)
+            fn()                       # warm (jit compile / first-touch)
+            best = None
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = fn()
+                dt = (time.perf_counter() - t0) / iters
+                best = dt if best is None else min(best, dt)
+            assert np.all(np.isfinite(out))
+            row[f"{kind}_us_per_call"] = round(best * 1e6, 1)
+            row[f"{kind}_us_per_seq"] = round(best * 1e6 / batch, 2)
+        row["paged_speedup"] = round(
+            row["dense_us_per_call"] / row["paged_us_per_call"], 3)
+        rows.append(row)
+        log(f"  decode micro ctx={ctx}: dense "
+            f"{row['dense_us_per_call']}us paged "
+            f"{row['paged_us_per_call']}us "
+            f"({row['paged_speedup']}x)")
+    return rows
+
+
 def bench_serve(smoke):
     """Serving A/B: continuous batching vs naive static batching over a
-    synthetic heavy-traffic trace (ISSUE 8 acceptance).
+    synthetic heavy-traffic trace (ISSUE 8 acceptance), plus the ISSUE 9
+    paged-decode receipts: the long-generation per-token-flat probe in
+    BOTH decode modes and the decode_attention micro-arm (paged kernel /
+    XLA twin vs dense-gather at 3+ context lengths).
 
     Fixed-seed workload: Poisson arrivals (exponential inter-arrival
     gaps in engine-step units), mixed prompt lengths and heavy-tailed
@@ -1119,24 +1183,45 @@ def bench_serve(smoke):
         f"{stat['steps']} steps")
     speedup = cont["tokens_per_sec"] / max(stat["tokens_per_sec"], 1e-9)
 
-    # O(1) receipt: one long generation, ITL early vs late.  The paged
-    # append is O(1); at this scale the dense-gather O(context) term
-    # stays under host dispatch noise — the ratio must sit near 1.
-    # two probe runs, window MEDIANS, min-of-pairs: a single
-    # preempted-by-the-OS token (or one noisy run) would otherwise fake
-    # or hide growth — same min-of-repeats discipline as the other legs
-    early = late = None
-    for _ in range(2):
-        srv = serving.Server(model, num_blocks=4096, block_size=16)
-        lr = srv.submit(prompts[0], max_new_tokens=long_gen)
-        srv.run_until_idle()
-        d = np.diff(lr.token_times) * 1e6
-        e = float(np.median(d[8:40]))
-        l = float(np.median(d[-32:]))
-        early = e if early is None else min(early, e)
-        late = l if late is None else min(late, l)
-    log(f"serve: per-token decode early {early:.0f}us late {late:.0f}us "
-        f"(x{late / early:.2f} over {long_gen} tokens)")
+    # O(1) receipt, BOTH decode modes: one long generation, ITL early vs
+    # late.  The paged append is O(1); the dense arm additionally pays
+    # the O(context) host gather, the paged arm only the in-program
+    # block walk.  Two probe runs, window MEDIANS, min-of-pairs: a
+    # single preempted-by-the-OS token (or one noisy run — or, on the
+    # paged arm, a block-bucket jit compile) would otherwise fake or
+    # hide growth — same min-of-repeats discipline as the other legs
+    def flat_probe(mode):
+        prior = os.environ.get("TPUMX_PAGED_DECODE")
+        os.environ["TPUMX_PAGED_DECODE"] = mode
+        try:
+            early = late = None
+            for _ in range(2):
+                srv = serving.Server(model, num_blocks=4096,
+                                     block_size=16)
+                lr = srv.submit(prompts[0], max_new_tokens=long_gen)
+                srv.run_until_idle()
+                d = np.diff(lr.token_times) * 1e6
+                e = float(np.median(d[8:40]))
+                l = float(np.median(d[-32:]))
+                early = e if early is None else min(early, e)
+                late = l if late is None else min(late, l)
+            return early, late
+        finally:
+            if prior is None:
+                os.environ.pop("TPUMX_PAGED_DECODE", None)
+            else:
+                os.environ["TPUMX_PAGED_DECODE"] = prior
+
+    early, late = flat_probe("0")
+    log(f"serve: dense per-token decode early {early:.0f}us late "
+        f"{late:.0f}us (x{late / early:.2f} over {long_gen} tokens)")
+    pearly, plate = flat_probe("1")
+    log(f"serve: paged per-token decode early {pearly:.0f}us late "
+        f"{plate:.0f}us (x{plate / pearly:.2f} over {long_gen} tokens)")
+
+    # decode_attention micro-arm: the data-plane A/B at fixed contexts
+    micro = measure_decode_micro((64, 128, 256) if smoke
+                                 else (128, 512, 2048))
 
     return {
         "metric": "serve_continuous_tokens_per_sec"
@@ -1160,6 +1245,17 @@ def bench_serve(smoke):
                            "linear_would_be": round(
                                (len(prompts[0]) + long_gen - 16)
                                / (len(prompts[0]) + 24), 1)},
+        # the same receipt on the paged decode path (TPUMX_PAGED_DECODE=1,
+        # device-resident pool): acceptance bar late/early <= 1.15 over
+        # the same >=4x context growth (ISSUE 9)
+        "per_token_flat_paged": {"early_itl_us": round(pearly, 1),
+                                 "late_itl_us": round(plate, 1),
+                                 "late_over_early": round(plate / pearly,
+                                                          3)},
+        # decode_attention micro-arm: paged (device pool, block-table
+        # program) vs dense-gather (host pool) per decode step at fixed
+        # contexts — the bar is paged winning at the LONGEST context
+        "decode_micro": micro,
         "n_requests": n_req,
         "max_batch": max_batch,
         "trace_seed": seed,

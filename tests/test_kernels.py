@@ -372,3 +372,117 @@ def test_attention_env_knob(monkeypatch):
     monkeypatch.setenv("TPUMX_ATTENTION", "dense")
     out = local_flash_attention(q, q, q)
     assert out.shape == q.shape
+
+
+# ---------------------------------------------------------------------------
+# paged-attention decode kernel (ISSUE 9) — interpret mode on CPU
+# ---------------------------------------------------------------------------
+def _paged_numpy_ref(q, k_pool, v_pool, tables, lengths):
+    """Per-sequence dense truth: resolve each block table by hand."""
+    b, h, d = q.shape
+    bs = k_pool.shape[1]
+    out = np.zeros_like(q)
+    for i in range(b):
+        length = int(lengths[i])
+        nb = -(-length // bs)
+        k = k_pool[tables[i, :nb]].reshape(-1, h, d)[:length]
+        v = v_pool[tables[i, :nb]].reshape(-1, h, d)[:length]
+        s = np.einsum("hd,khd->hk", q[i].astype(np.float64),
+                      k.astype(np.float64)) / math.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[i] = np.einsum("hk,khd->hd", p, v.astype(np.float64))
+    return out
+
+
+def _paged_case(seed=0, nblocks=24, bs=4, h=2, d=8, specs=((10, (7, 2, 9)),
+                                                          (3, (5,)),
+                                                          (16, (11, 1, 4, 8)))):
+    """Fragmented tables, ragged lengths, rows 0-padded to a shared NB."""
+    rng = np.random.RandomState(seed)
+    kp = rng.randn(nblocks, bs, h, d).astype(np.float32)
+    vp = rng.randn(nblocks, bs, h, d).astype(np.float32)
+    b = len(specs)
+    nb = max(len(t) for _, t in specs)
+    tables = np.zeros((b, nb), np.int32)
+    lens = np.zeros(b, np.int32)
+    for i, (length, tab) in enumerate(specs):
+        tables[i, :len(tab)] = tab
+        lens[i] = length
+    q = rng.randn(b, h, d).astype(np.float32)
+    return q, kp, vp, tables, lens
+
+
+@pytest.mark.parametrize("arm", ["kernel", "xla"])
+def test_paged_attention_matches_reference(arm):
+    from tpu_mx.kernels.paged_attention import (paged_attention,
+                                                paged_attention_reference)
+    q, kp, vp, tables, lens = _paged_case()
+    fn = paged_attention if arm == "kernel" else paged_attention_reference
+    out = np.asarray(fn(q, kp, vp, tables, lens))
+    ref = _paged_numpy_ref(q, kp, vp, tables, lens)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_attention_padding_blocks_cannot_leak():
+    """Entries past a row's real blocks (0-padding) and slots past
+    `lengths` inside the last block must be EXACTLY invisible: poison
+    them and the output may not move a single bit."""
+    from tpu_mx.kernels.paged_attention import paged_attention
+    q, kp, vp, tables, lens = _paged_case()
+    base = np.asarray(paged_attention(q, kp, vp, tables, lens))
+    kp2, vp2 = kp.copy(), vp.copy()
+    kp2[0] = 1e9          # block 0 backs every padded table entry
+    vp2[0] = -1e9
+    kp2[9, 2:] = 1e9      # row 0: length 10 ends 2 slots into block 9
+    vp2[9, 2:] = -1e9
+    kp2[5, 3:] = 1e9      # row 1: length 3 ends inside block 5
+    vp2[5, 3:] = -1e9
+    again = np.asarray(paged_attention(q, kp2, vp2, tables, lens))
+    np.testing.assert_array_equal(base, again)
+
+
+def test_paged_attention_accepts_single_token_axis():
+    from tpu_mx.kernels.paged_attention import paged_attention
+    q, kp, vp, tables, lens = _paged_case()
+    out3 = np.asarray(paged_attention(q, kp, vp, tables, lens))
+    out4 = np.asarray(paged_attention(q[:, None], kp, vp, tables, lens))
+    assert out4.shape == (q.shape[0], 1) + q.shape[1:]
+    np.testing.assert_array_equal(out4[:, 0], out3)
+    with pytest.raises(ValueError, match="one token per sequence"):
+        paged_attention(np.zeros((2, 3, 2, 8), np.float32), kp, vp,
+                        tables[:2], lens[:2])
+
+
+def test_paged_attention_bf16_pool():
+    import jax.numpy as jnp
+    from tpu_mx.kernels.paged_attention import paged_attention
+    q, kp, vp, tables, lens = _paged_case()
+    out = np.asarray(paged_attention(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(kp, jnp.bfloat16),
+        jnp.asarray(vp, jnp.bfloat16), tables, lens), np.float32)
+    ref = _paged_numpy_ref(q, kp, vp, tables, lens)
+    np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.05)
+
+
+def test_paged_attention_rejects_mismatched_operands():
+    from tpu_mx.kernels.paged_attention import paged_attention
+    q, kp, vp, tables, lens = _paged_case()
+    with pytest.raises(ValueError, match="pool heads/dim"):
+        paged_attention(q[:, :1], kp, vp, tables, lens)
+    with pytest.raises(ValueError, match="block_tables"):
+        paged_attention(q, kp, vp, tables[:2], lens)
+    with pytest.raises(ValueError, match="lengths"):
+        paged_attention(q, kp, vp, tables, lens[:2])
+
+
+def test_paged_supported_gate():
+    """Interpret mode accepts anything (correctness-only); the real-TPU
+    constraints are shape/dtype gates the dispatcher consults."""
+    import jax
+    from tpu_mx.kernels import paged_attention as pk
+    if jax.default_backend() != "tpu":
+        assert pk.supported(8, np.float32)
+    else:
+        assert pk.supported(64, np.float32, 16)
+        assert not pk.supported(8, np.float32, 16)

@@ -26,7 +26,9 @@ from tpu_mx.serving import (AdmissionReject, BlockAllocator, CacheExhausted,
                             ContinuousBatchingScheduler, EngineCore,
                             PagedKVCache, Request, Server,
                             StaticBatchingScheduler, TinyLM)
-from tpu_mx.serving.attention import decode_attention, dense_attention
+from tpu_mx.serving.attention import (decode_attention, dense_attention,
+                                      dense_decode_attention,
+                                      resolve_decode_path)
 from tpu_mx.supervisor import NumericDivergence
 
 
@@ -234,8 +236,8 @@ def test_paged_decode_logits_bit_identical_to_dense_cache():
             nk[i], nv[i] = ki, vi
             kcat = np.concatenate([dk[i], ki], axis=0)[None]
             vcat = np.concatenate([dv[i], vi], axis=0)[None]
-            attn = decode_attention(q, kcat, vcat,
-                                    np.array([pos + 1], np.int32))
+            attn = dense_decode_attention(q, kcat, vcat,
+                                          np.array([pos + 1], np.int32))
             h = model.layer_combine(i, h, attn)
         dk = np.concatenate([dk, nk], axis=1)
         dv = np.concatenate([dv, nv], axis=1)
@@ -292,6 +294,202 @@ def test_dense_attention_respects_lengths_and_causality():
     k3[0, 3] = 77.0                                # future key for rows 0-2
     again = dense_attention(q3, k3, v3, causal=True)
     assert np.array_equal(full[0, :3], again[0, :3])
+
+
+# ---------------------------------------------------------------------------
+# paged decode: the kernel / device-pool arms (ISSUE 9)
+# ---------------------------------------------------------------------------
+# Attention-output tolerance between the dense-gather arm (numpy) and the
+# paged arms (Pallas kernel / jitted XLA): identical math, f32 softmax
+# stats on every arm, different reduction orders.  Documented in
+# docs/DIVERGENCES.md #27; greedy argmax equivalence is asserted exactly.
+PAGED_ATOL = 2e-5
+
+
+def churned_cache(storage, seed=7):
+    """A cache whose block tables are FRAGMENTED: interleaved prefills,
+    appends and a mid-pool free leave sequences scattered (and block 0
+    live inside a sequence, so padded table rows point at real, finite
+    pool contents).  Returns (cache, ref) with ref the dense per-seq
+    truth."""
+    rng = np.random.RandomState(seed)
+    cache = PagedKVCache(num_layers=2, num_heads=2, head_dim=4,
+                         block_size=4, num_blocks=32, storage=storage)
+    ref = {}
+
+    def add(seq, length):
+        k = rng.rand(2, length, 2, 4).astype(np.float32)
+        v = rng.rand(2, length, 2, 4).astype(np.float32)
+        cache.prefill(seq, k, v)
+        ref[seq] = [k, v]
+
+    def append(seq):
+        k = rng.rand(2, 1, 2, 4).astype(np.float32)
+        v = rng.rand(2, 1, 2, 4).astype(np.float32)
+        cache.reserve(seq)
+        for layer in range(2):
+            cache.write(seq, layer, k[layer, 0], v[layer, 0])
+        ref[seq] = [np.concatenate([ref[seq][0], k], axis=1),
+                    np.concatenate([ref[seq][1], v], axis=1)]
+
+    add("a", 6)                    # takes block 0 (LIFO free list)
+    add("b", 3)
+    append("a")
+    cache.free_sequence("b")       # frees mid-pool blocks
+    del ref["b"]
+    add("c", 9)                    # reuses b's blocks
+    add("d", 2)                    # ragged short row
+    for _ in range(5):
+        append("c")
+        append("a")
+    return cache, ref
+
+
+@pytest.mark.parametrize("storage", ["host", "device"])
+def test_device_pool_matches_host_pool_after_churn(storage):
+    """Both storage modes must expose identical bytes through every
+    reader: gather, gather_batch and the raw pool-by-table view."""
+    cache, ref = churned_cache(storage)
+    for layer in range(2):
+        for seq in ("a", "c", "d"):
+            gk, gv = cache.gather(seq, layer)
+            assert np.array_equal(gk, ref[seq][0][layer])
+            assert np.array_equal(gv, ref[seq][1][layer])
+    kd, vd, lens = cache.gather_batch(["a", "c", "d"], 1)
+    assert list(lens) == [12, 14, 2]
+    tables, lens2 = cache.batch_tables(["a", "c", "d"])
+    assert np.array_equal(lens, lens2)
+    assert tables.shape[1] == 4                   # pow2-padded (max 3+1)
+    assert tables.dtype == np.int32
+    # table rows resolved against the pool reproduce the gather exactly
+    kp, vp = cache.pool(1)
+    kp = np.asarray(kp)
+    for i, seq in enumerate(("a", "c", "d")):
+        nb = cache.blocks_for(lens[i])
+        resolved = kp[tables[i, :nb]].reshape(-1, 2, 4)[:lens[i]]
+        assert np.array_equal(resolved, ref[seq][0][1])
+
+
+@pytest.mark.parametrize("storage", ["host", "device"])
+@pytest.mark.parametrize("kind", ["paged", "paged-kernel"])
+def test_paged_decode_attention_parity_after_churn(storage, kind):
+    """The tentpole parity claim: the paged arms (XLA twin and the real
+    Pallas kernel in interpret mode) reproduce the dense-gather arm over
+    fragmented block tables, ragged lengths and block-0-padded rows,
+    within the documented f32-stats tolerance."""
+    cache, _ = churned_cache(storage)
+    rng = np.random.RandomState(3)
+    seq_ids = ["a", "c", "d"]                      # ragged: 12 / 14 / 2
+    q = rng.rand(3, 2, 4).astype(np.float32)
+    want = decode_attention(q, cache, seq_ids, 0, kind="dense")
+    got = decode_attention(q, cache, seq_ids, 0, kind=kind)
+    assert got.shape == want.shape and got.dtype == want.dtype
+    np.testing.assert_allclose(got, want, rtol=PAGED_ATOL, atol=PAGED_ATOL)
+    # garbage beyond `lengths` cannot leak through the kernel's mask:
+    # corrupt every free block and re-run (host pool mutated in place)
+    if storage == "host":
+        free = set(range(32)) - {b for s in seq_ids
+                                 for b in cache.block_table(s)}
+        cache.k_blocks[:, sorted(free)] = 1e9
+        cache.v_blocks[:, sorted(free)] = -1e9
+        again = decode_attention(q, cache, seq_ids, 0, kind=kind)
+        np.testing.assert_allclose(again, got, rtol=0, atol=0)
+
+
+def test_decode_attention_counts_kind_and_dispatches_env(monkeypatch):
+    cache, _ = churned_cache("host")
+    q = np.zeros((1, 2, 4), np.float32)
+    telemetry.reset()
+    try:
+        monkeypatch.delenv("TPUMX_PAGED_DECODE", raising=False)
+        assert resolve_decode_path() == "dense"
+        monkeypatch.setenv("TPUMX_PAGED_DECODE", "1")
+        assert resolve_decode_path() == "paged"
+        monkeypatch.setenv("TPUMX_PAGED_DECODE", "kernel")
+        assert resolve_decode_path() == "paged-kernel"
+        # a typo'd arm must fail LOUDLY, never silently pick another arm
+        # (a mis-spelled 'kernel' passing parity without running the
+        # kernel would be an invisible hole in the CI gate)
+        monkeypatch.setenv("TPUMX_PAGED_DECODE", "kernal")
+        with pytest.raises(ValueError, match="TPUMX_PAGED_DECODE"):
+            resolve_decode_path()
+        monkeypatch.setenv("TPUMX_PAGED_DECODE", "kernel")
+        decode_attention(q, cache, ["a"], 0)       # env-dispatched
+        decode_attention(q, cache, ["a"], 0, kind="dense")
+        assert telemetry.get("serve.decode_attention",
+                             kind="paged-kernel").value == 1
+        assert telemetry.get("serve.decode_attention",
+                             kind="dense").value == 1
+    finally:
+        telemetry.reset()
+
+
+@pytest.mark.parametrize("mode", ["1", "kernel"])
+def test_server_token_streams_identical_across_decode_paths(monkeypatch,
+                                                            mode):
+    """Greedy decode through the full Server path must produce the SAME
+    token stream on the paged arms as on the dense reference arm —
+    the acceptance bar for routing production decode through the
+    kernel."""
+    prompts = [[5, 6, 7], [9, 2], [1] * 7]
+    monkeypatch.delenv("TPUMX_PAGED_DECODE", raising=False)
+    srv = Server(tiny(), num_blocks=64, max_batch=4)
+    ref = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    srv.run_until_idle()
+
+    monkeypatch.setenv("TPUMX_PAGED_DECODE", mode)
+    srv2 = Server(tiny(), num_blocks=64, max_batch=4)
+    assert srv2.engine.cache.device_resident
+    got = [srv2.submit(p, max_new_tokens=6) for p in prompts]
+    srv2.run_until_idle()
+    for r, g in zip(ref, got):
+        assert g.state == "done" and g.tokens == r.tokens
+    gauge = telemetry.get("serve.pool_device_resident")
+    assert gauge is not None and gauge.value == 1.0
+    evs = [e for e in tracing.snapshot()
+           if e["event"] == "serve.decode_path"]
+    assert evs and evs[-1]["data"]["storage"] == "device"
+    for e in evs:
+        tracing.validate_event(e)
+
+
+def test_paged_engine_restart_blackbox_records_decode_path(monkeypatch,
+                                                           tmp_path):
+    """A restarted paged engine must land its decode path on the black
+    box timeline: one serve.decode_path per engine generation, and the
+    post-restart run still completes on the paged arm with zero lost
+    requests."""
+    monkeypatch.setenv("TPUMX_PAGED_DECODE", "1")
+    prefix = str(tmp_path / "pg")
+    srv = Server(tiny(), num_blocks=64, max_batch=4, backoff=0.0,
+                 blackbox=prefix)
+    reqs = [srv.submit([4, 5], max_new_tokens=4) for _ in range(2)]
+    with chaos.enable(nan_after=2):
+        srv.run_until_idle()
+    assert srv.restarts == 1
+    for r in reqs:
+        assert r.state == "done" and len(r.tokens) == 4
+    box = json.load(open(tracing.blackbox_path(prefix)))
+    tracing.validate_blackbox(box)
+    paths = [e for e in box["events"] if e["event"] == "serve.decode_path"]
+    assert len(paths) == 2                         # one per generation
+    assert all(e["data"]["path"] == "paged" for e in paths)
+    assert paths[1]["generation"] == paths[0]["generation"] + 1
+
+
+def test_paged_cache_exhaustion_still_backpressures(monkeypatch):
+    """The exhaustion-is-backpressure contract is storage-independent:
+    an over-committed DEVICE pool serializes via preemption/requeue and
+    every request completes."""
+    monkeypatch.setenv("TPUMX_PAGED_DECODE", "1")
+    srv = Server(tiny(), num_blocks=6, block_size=2, max_batch=4,
+                 max_tokens=1000)
+    reqs = [srv.submit([1, 2, 3], max_new_tokens=6) for _ in range(5)]
+    srv.run_until_idle()
+    for r in reqs:
+        assert r.state == "done" and len(r.tokens) == 6, r
+    assert srv.engine.cache.stats()["used_blocks"] == 0
+    assert all(r.tokens == reqs[0].tokens for r in reqs)
 
 
 # ---------------------------------------------------------------------------

@@ -625,8 +625,65 @@ correlated(box, "nan", "serve.restart")
 
 assert telemetry.get("serve.engine_restarts").value == 2
 assert telemetry.get("serve.requests", state="requeued").value >= 1
+
+# the decode-path observables must record the arm this leg actually ran
+# on: every decode_attention call counted under the right kind, and the
+# black boxes carrying serve.decode_path for the restarted generations
+kind = ("paged" if os.environ.get("TPUMX_PAGED_DECODE", "0")
+        not in ("", "0") else "dense")
+assert telemetry.get("serve.decode_attention", kind=kind) is not None, kind
+paths = [e for e in box["events"] if e["event"] == "serve.decode_path"]
+assert paths and all(e["data"]["path"] == kind for e in paths), (kind, paths)
 telemetry.flush(final=True)
 print("SERVE OK", flush=True)
+"""
+
+# Kernel-parity gate (ISSUE 9): a fixed trace decoded through the dense
+# reference arm and through the FORCED Pallas kernel (interpret mode on
+# CPU — the real kernel code path) must produce identical greedy token
+# streams through the Server path, and the raw attention outputs must
+# agree within the documented f32-stats tolerance (DIVERGENCES #27).
+SERVE_PARITY_SCRIPT = """
+import os
+import numpy as np
+from tpu_mx import serving
+from tpu_mx.serving.attention import decode_attention
+
+SEED = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
+model = serving.TinyLM(vocab_size=64, embed_dim=32, num_heads=2,
+                       num_layers=2, seed=SEED % 997)
+prompts = [[5, 6, 7], [9, 2], [1] * 7]
+
+
+def run(mode):
+    os.environ["TPUMX_PAGED_DECODE"] = mode
+    srv = serving.Server(model, num_blocks=64, max_batch=4)
+    reqs = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    srv.run_until_idle()
+    return [r.tokens for r in reqs]
+
+
+dense = run("0")
+kernel = run("kernel")
+assert dense == kernel, (dense, kernel)
+
+# raw-logits tolerance on a shared churned cache (both arms, same pool)
+os.environ["TPUMX_PAGED_DECODE"] = "0"
+eng = serving.EngineCore(model, block_size=4, num_blocks=32)
+rng = np.random.RandomState(SEED % 2311)
+for i, length in enumerate((6, 3, 9)):
+    k = rng.rand(2, length, 2, 16).astype(np.float32)
+    eng.cache.prefill(f"s{i}", k, k * 0.5)
+eng.cache.free_sequence("s1")
+k = rng.rand(2, 5, 2, 16).astype(np.float32)
+eng.cache.prefill("s3", k, -k)
+q = rng.rand(3, 2, 16).astype(np.float32)
+ids = ["s0", "s2", "s3"]
+want = decode_attention(q, eng.cache, ids, 1, kind="dense")
+got = decode_attention(q, eng.cache, ids, 1, kind="paged-kernel")
+drift = float(np.max(np.abs(got - want)))
+assert drift <= 2e-5, drift
+print(f"SERVE PARITY OK drift={drift:.2e}", flush=True)
 """
 
 SERVE_REQUIRED = ("serve", "chaos.injections")
@@ -641,16 +698,18 @@ SERVE_BOX_EXPECT = {
 }
 
 
-def serve_tier():
-    """Run the chaos request storm against the serving runtime, then
-    validate its telemetry (serve preset: SLO histograms populated,
-    restarts actually driven) and render every fault's black box without
-    jax."""
+def _serve_storm_leg(mode):
+    """One full chaos-storm pass (the three faults) with the decode arm
+    pinned to `mode` ("0" = dense-gather reference, "1" = paged:
+    device-resident pool + block-table program), then telemetry
+    validation and jax-less black-box rendering."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tag_mode = "dense" if mode in ("", "0") else "paged"
     with tempfile.TemporaryDirectory() as d:
         jsonl = os.path.join(d, "telemetry.jsonl")
         env = dict(os.environ, TPUMX_TELEMETRY=jsonl, JAX_PLATFORMS="cpu",
-                   TPUMX_CHAOS_SEED="20260804", TPUMX_SERVE_DIR=d)
+                   TPUMX_CHAOS_SEED="20260804", TPUMX_SERVE_DIR=d,
+                   TPUMX_PAGED_DECODE=mode)
         env.pop("TPUMX_CHAOS", None)    # the script arms its own faults
         env.pop("TPUMX_TRACING", None)  # the black boxes need the recorder
         try:
@@ -658,10 +717,11 @@ def serve_tier():
                                  env=env, cwd=repo, capture_output=True,
                                  text=True, timeout=600)
         except subprocess.TimeoutExpired as e:
-            print(f"  serve: request storm timed out: {e}")
+            print(f"  serve[{tag_mode}]: request storm timed out: {e}")
             return 1
         if run.returncode != 0 or "SERVE OK" not in (run.stdout or ""):
-            print(f"  serve: request storm failed (rc={run.returncode}):\n"
+            print(f"  serve[{tag_mode}]: request storm failed "
+                  f"(rc={run.returncode}):\n"
                   f"{((run.stdout or '') + (run.stderr or ''))[-4000:]}")
             return run.returncode or 1
         try:
@@ -672,10 +732,11 @@ def serve_tier():
                  ",".join(SERVE_REQUIRED)],
                 capture_output=True, text=True, timeout=120)
         except subprocess.TimeoutExpired as e:
-            print(f"  serve: telemetry validation timed out: {e}")
+            print(f"  serve[{tag_mode}]: telemetry validation timed out: "
+                  f"{e}")
             return 1
         if val.returncode != 0:
-            print(f"  serve: telemetry validation failed "
+            print(f"  serve[{tag_mode}]: telemetry validation failed "
                   f"(rc={val.returncode}):\n"
                   f"{((val.stdout or '') + (val.stderr or ''))[-3000:]}")
             return val.returncode or 1
@@ -693,18 +754,51 @@ def serve_tier():
                                      capture_output=True, text=True,
                                      timeout=120)
             except subprocess.TimeoutExpired as e:
-                print(f"  serve: blackbox report timed out on {tag}: {e}")
+                print(f"  serve[{tag_mode}]: blackbox report timed out "
+                      f"on {tag}: {e}")
                 return 1
             out = (ren.stdout or "") + (ren.stderr or "")
             if ren.returncode != 0:
-                print(f"  serve: blackbox report failed on {tag} "
-                      f"(rc={ren.returncode}):\n{out[-3000:]}")
+                print(f"  serve[{tag_mode}]: blackbox report failed on "
+                      f"{tag} (rc={ren.returncode}):\n{out[-3000:]}")
                 return 1
             missing = [m for m in expect if m not in out]
             if missing:
-                print(f"  serve: blackbox report for {tag} is missing "
-                      f"timeline markers {missing}:\n{out[-3000:]}")
+                print(f"  serve[{tag_mode}]: blackbox report for {tag} "
+                      f"is missing timeline markers {missing}:"
+                      f"\n{out[-3000:]}")
                 return 1
+    return 0
+
+
+def serve_tier():
+    """Run the chaos request storm against the serving runtime in BOTH
+    decode modes (dense-gather reference and TPUMX_PAGED_DECODE=1 —
+    ISSUE 9: the self-healing contract is data-plane-independent), then
+    the kernel-parity gate: the forced Pallas kernel (interpret on CPU)
+    must reproduce the dense arm's greedy tokens exactly and its logits
+    within the documented tolerance."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for mode in ("0", "1"):
+        rc = _serve_storm_leg(mode)
+        if rc != 0:
+            return rc
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPUMX_CHAOS_SEED="20260804")
+    env.pop("TPUMX_CHAOS", None)
+    try:
+        par = subprocess.run([sys.executable, "-c", SERVE_PARITY_SCRIPT],
+                             env=env, cwd=repo, capture_output=True,
+                             text=True, timeout=600)
+    except subprocess.TimeoutExpired as e:
+        print(f"  serve: kernel-parity gate timed out: {e}")
+        return 1
+    if par.returncode != 0 or "SERVE PARITY OK" not in (par.stdout or ""):
+        print(f"  serve: kernel-parity gate failed "
+              f"(rc={par.returncode}):\n"
+              f"{((par.stdout or '') + (par.stderr or ''))[-4000:]}")
+        return par.returncode or 1
+    print(f"  {(par.stdout or '').strip().splitlines()[-1]}")
     return 0
 
 

@@ -1,0 +1,140 @@
+"""Paged-decode KV block-size sweep + dense/flash crossover disposition.
+
+ISSUE 9's tuning satellite, on the bench harness's decode_attention
+micro-arm (bench.measure_decode_micro — the same fixed-seed A/B the
+serve leg persists):
+
+- **Block-size sweep**: the serving KV block size trades free-list
+  churn (amortized ``1/block_size`` pops per token) against padded-tail
+  waste, table length and gather granularity.  Each (block_size,
+  context) cell measures the paged arm (device pool, block-table
+  program) and the dense-gather arm per decode step.  The default lives
+  at ``tpu_mx/kernels/paged_attention.py DEFAULT_BLOCK_SIZE``; update it
+  only with receipts from this tool.
+- **TPUMX_DENSE_MAX_KV crossover**: the dense/flash dispatch constant
+  (ring_attention, default 512, pinned by BENCH_INTERIM_r04 on chip and
+  flagged "expected to move" after the r5 native-dtype dot change) is a
+  TPU-kernel-vs-XLA-dense crossover: it CANNOT be measured off-TPU
+  (interpret-mode Pallas timing is meaningless).  On a TPU backend this
+  tool defers to tools/flash_sweep.py — the existing per-(block_q,
+  block_k) sweep — and records that pointer; on CPU it records an
+  explicit ``skipped`` disposition so a TPU-less round leaves an honest
+  artifact instead of silence.
+
+Artifact-protocol semantics (tools/artifact_protocol.py): rows merge on
+rerun, writes are atomic, and a TPU-less run refuses to clobber a
+platform=tpu artifact.
+
+    TPUMX_ROUND=r08 python tools/paged_sweep.py \
+        [--block-sizes 8,16,32,64] [--contexts 256,1024] [--batch 4]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from artifact_protocol import (artifact, load_prior,  # noqa: E402
+                               merge_prior_sections, refuses_clobber,
+                               write_atomic)
+
+DEFAULT_BLOCK_SIZES = (8, 16, 32, 64)
+DEFAULT_CONTEXTS = (256, 1024)
+
+
+def log(msg):
+    print(f"[paged_sweep {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--block-sizes", default=",".join(
+        str(b) for b in DEFAULT_BLOCK_SIZES))
+    ap.add_argument("--contexts", default=",".join(
+        str(c) for c in DEFAULT_CONTEXTS))
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--out", default=artifact("PAGED_SWEEP"))
+    args = ap.parse_args()
+    block_sizes = [int(b) for b in args.block_sizes.split(",") if b]
+    contexts = [int(c) for c in args.contexts.split(",") if c]
+
+    import jax
+    import bench
+
+    platform = jax.default_backend()
+    prior = load_prior(args.out)
+    if refuses_clobber(prior, platform):
+        log(f"{args.out} holds platform=tpu rows; this {platform} run "
+            "refuses to clobber them (artifact protocol)")
+        return 1
+
+    record = {
+        "platform": platform,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "git_head": bench._git_head(),
+        "geometry": {"batch": args.batch, "heads": args.heads,
+                     "dim": args.dim},
+        "rows": {},
+    }
+    # graft prior rows in BEFORE the first per-row write: the row-at-a-
+    # time durability writes below must never clobber sibling rows from
+    # an earlier (e.g. partial-retry) run — this run's rows still win
+    # their own keys as they land (merge-on-write contract)
+    merge_prior_sections(record, prior, ["rows"],
+                         require_platform=platform)
+    for bs in block_sizes:
+        # contexts must tile meaningfully: skip block sizes larger than
+        # the shortest context rather than measuring a 1-block table
+        usable = [c for c in contexts if c >= bs * 2]
+        if not usable:
+            log(f"block_size={bs}: no usable context (all < 2 blocks), "
+                "skipped")
+            continue
+        log(f"block_size={bs}: contexts {usable}")
+        rows = bench.measure_decode_micro(usable, block_size=bs,
+                                          batch=args.batch,
+                                          heads=args.heads, dim=args.dim)
+        for row in rows:
+            record["rows"][f"bs{bs}_ctx{row['context']}"] = row
+            write_atomic(args.out, record)  # row-at-a-time durability
+
+    # honest disposition for the dense/flash crossover constant
+    if platform == "tpu":
+        record["dense_max_kv_crossover"] = {
+            "status": "measure_with_flash_sweep",
+            "note": "run tools/flash_sweep.py on this chip; "
+                    "TPUMX_DENSE_MAX_KV moves only on its receipts "
+                    "(BENCH_INTERIM_r04 pinned 512)",
+        }
+    else:
+        record["dense_max_kv_crossover"] = {
+            "status": "skipped",
+            "note": f"backend={platform}: the dense/flash crossover is a "
+                    "TPU Mosaic-vs-XLA property; interpret-mode timing "
+                    "is meaningless.  Constant stands at 512 "
+                    "(BENCH_INTERIM_r04 receipts) until a chip round "
+                    "reruns tools/flash_sweep.py post-r5-native-dtype.",
+        }
+    write_atomic(args.out, record)
+    if not record["rows"]:
+        log(f"done: 0 rows (every block size skipped for the given "
+            f"contexts) -> {args.out} holds the disposition only")
+        return 0
+    best = min(record["rows"].values(),
+               key=lambda r: r["paged_us_per_seq"])
+    log(f"done: {len(record['rows'])} rows -> {args.out}; best "
+        f"paged us/seq: bs{best['block_size']}@ctx{best['context']} = "
+        f"{best['paged_us_per_seq']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,5 +2,8 @@
 (the analog of the reference's cuDNN/hand-CUDA kernels under
 REF:src/operator/ — here written against the MXU/VMEM model)."""
 from . import flash_attention
+from . import paged_attention
 from .flash_attention import flash_attention as flash_attention_fn
 from .flash_attention import mha_flash_attention
+from .paged_attention import paged_attention as paged_attention_fn
+from .paged_attention import paged_attention_reference

@@ -29,7 +29,9 @@ make every claim here observable; ``tools/ci.py``'s ``serve`` tier
 storms a chaos-faulted server and asserts zero lost requests.
 """
 from .kv_cache import BlockAllocator, CacheExhausted, PagedKVCache
-from .attention import dense_attention, decode_attention, prefill_attention
+from .attention import (dense_attention, dense_decode_attention,
+                        decode_attention, decode_path, prefill_attention,
+                        resolve_decode_path)
 from .model import TinyLM
 from .scheduler import (AdmissionReject, ContinuousBatchingScheduler,
                         Request, StaticBatchingScheduler)
@@ -37,6 +39,7 @@ from .engine import EngineCore
 from .server import Server
 
 __all__ = ["BlockAllocator", "CacheExhausted", "PagedKVCache",
-           "dense_attention", "decode_attention", "prefill_attention",
+           "dense_attention", "dense_decode_attention", "decode_attention",
+           "decode_path", "resolve_decode_path", "prefill_attention",
            "TinyLM", "AdmissionReject", "ContinuousBatchingScheduler",
            "Request", "StaticBatchingScheduler", "EngineCore", "Server"]

@@ -1,4 +1,4 @@
-"""Serving attention: flash prefill on TPU, dense-gather decode fallback.
+"""Serving attention: flash prefill, paged-kernel or dense-gather decode.
 
 Two shapes of attention exist in a serving engine and they want different
 kernels:
@@ -10,28 +10,38 @@ kernels:
   ``flash_attention.supported()`` (head_dim % 64, L % 128); everything
   else — including the CPU tier-1 suite — runs the dense reference.
 - **Decode** — one new token per sequence against the paged cache: a
-  (B, 1, H, D) query over block-scattered K/V.  The flash kernel's grid
-  assumes contiguous (BH, T, D) operands and T % 128; a single-token
-  query is the wrong shape for it, and a true paged-attention kernel
-  (block-table indexing inside the kernel) is future TPU work recorded
-  as docs/DIVERGENCES.md #27.  :func:`decode_attention` therefore runs
-  the **dense-gather fallback** everywhere: the cache gathers each
-  sequence's blocks into a padded dense batch
-  (``PagedKVCache.gather_batch``) and the scores are masked by the true
-  lengths — bit-identical to a contiguous cache, O(total context) per
-  step on the host.
+  (B, 1, H, D) query over block-scattered K/V.  :func:`decode_attention`
+  dispatches between two arms behind the ``TPUMX_PAGED_DECODE`` knob:
+
+  * **dense-gather** (default, the always-available reference arm): the
+    cache resolves each sequence's block table into a padded dense
+    batch (``PagedKVCache.gather_batch``) and the scores are masked by
+    the true lengths — bit-identical to a contiguous cache, O(total
+    context) of host memcpy per step (docs/DIVERGENCES.md #27).
+  * **paged** (``TPUMX_PAGED_DECODE=1``): the raw block tables go to
+    ``tpu_mx/kernels/paged_attention.py`` — the Pallas kernel on a real
+    TPU (pool resident in HBM, tables scalar-prefetched into the
+    BlockSpec index maps), the same algorithm as one jitted XLA program
+    off-TPU.  ``TPUMX_PAGED_DECODE=kernel`` forces the Pallas kernel
+    everywhere (interpret mode off-TPU) — the parity-test/CI arm that
+    exercises the real kernel code path on CPU.
 
 Both paths keep softmax statistics in f32 (same discipline as the
-kernel); the dense reference is pure numpy so the serving data plane
-stays importable and testable without jax.
+kernels); the dense reference is pure numpy so the serving data plane
+stays importable and testable without jax — a paged request on a
+jax-less host resolves to the dense arm, never an ImportError.
 """
 from __future__ import annotations
 
 import math
+import os
 
 import numpy as np
 
-__all__ = ["dense_attention", "prefill_attention", "decode_attention"]
+from .. import telemetry as _telemetry
+
+__all__ = ["dense_attention", "prefill_attention", "decode_attention",
+           "dense_decode_attention", "decode_path", "resolve_decode_path"]
 
 # mask value for padded/causal-excluded score entries; matches the
 # kernel's NEG_INF discipline (finite: exp() underflows to exactly 0
@@ -114,8 +124,8 @@ def prefill_attention(q, k, v):
                            np.asarray(v)[None], causal=True)[0]
 
 
-def decode_attention(q, keys, values, lengths):
-    """One decode step's attention for a batch of sequences.
+def dense_decode_attention(q, keys, values, lengths):
+    """The dense-gather decode arm (and the paged arms' parity oracle).
 
     ``q``: (B, H, D) — each sequence's single new-token query; ``keys``/
     ``values``: (B, Lmax, H, D) — the padded dense gather of each
@@ -125,3 +135,106 @@ def decode_attention(q, keys, values, lengths):
     out = dense_attention(np.asarray(q)[:, None], keys, values,
                           lengths=lengths)
     return out[:, 0]
+
+
+# -- decode dispatch ---------------------------------------------------------
+_PAGED_ENV = "TPUMX_PAGED_DECODE"
+
+
+def decode_path():
+    """The decode arm ``TPUMX_PAGED_DECODE`` requests (no availability
+    check): ``"dense"`` (unset/``0``), ``"paged"`` (``1``/``auto`` —
+    Pallas kernel on a supported TPU shape, the jitted XLA same-algorithm
+    arm otherwise) or ``"paged-kernel"`` (``kernel`` — force the Pallas
+    kernel, interpret mode off-TPU; the parity/CI arm).  Unknown values
+    raise: a typo'd ``kernel`` silently falling back to another arm
+    would let a "kernel parity" run pass without ever executing the
+    kernel (same loud-config discipline as ``PagedKVCache(storage=)``
+    and ``TPUMX_ATTENTION``)."""
+    v = os.environ.get(_PAGED_ENV, "0").strip().lower()
+    if v in ("", "0", "dense", "off"):
+        return "dense"
+    if v in ("kernel", "interpret"):
+        return "paged-kernel"
+    if v in ("1", "auto", "paged", "xla", "on"):
+        return "paged"
+    raise ValueError(
+        f"{_PAGED_ENV}={v!r} is not a recognized decode arm — use 0 "
+        "(dense-gather reference), 1 (paged: kernel on TPU / XLA twin "
+        "off-TPU) or kernel (force the Pallas kernel, interpret off-TPU)")
+
+
+def resolve_decode_path():
+    """:func:`decode_path`, downgraded to ``"dense"`` when jax is not
+    importable — the paged arms need it, the reference arm must not."""
+    kind = decode_path()
+    if kind != "dense":
+        try:
+            import jax  # noqa: F401 — availability probe only
+        except ImportError:
+            return "dense"
+    return kind
+
+
+def _paged_decode(q, cache, seq_ids, layer, kind, batch=None):
+    """Run one decode step's attention through the paged kernel (or its
+    jitted XLA twin): raw block tables + the resident pool, no host
+    gather.  The batch axis is padded to a power of two (dummy rows:
+    block-0 table, length 1 — finite real pool contents sliced away
+    below) so jitted consumers see log2-many shapes, not one per batch
+    composition.  ``batch`` is an optional precomputed ``(tables,
+    lengths)`` pair — tables cannot change between the layers of one
+    decode step, so the engine builds them once per step instead of
+    once per layer."""
+    import jax
+    from ..kernels import paged_attention as _pk
+    from .kv_cache import _next_pow2
+
+    tables, lengths = (cache.batch_tables(seq_ids) if batch is None
+                       else batch)
+    kp, vp = cache.pool(layer)
+    b = q.shape[0]
+    bpad = _next_pow2(b)
+    if bpad != b:
+        q_in = np.concatenate(
+            [np.asarray(q), np.zeros((bpad - b,) + q.shape[1:], q.dtype)])
+        tables = np.concatenate(
+            [tables, np.zeros((bpad - b, tables.shape[1]), tables.dtype)])
+        lengths = np.concatenate(
+            [lengths, np.ones(bpad - b, lengths.dtype)])
+    else:
+        q_in = q
+    use_kernel = kind == "paged-kernel" or (
+        jax.default_backend() == "tpu"
+        and _pk.supported(q.shape[-1], q_in.dtype, cache.block_size))
+    fn = _pk.paged_attention if use_kernel else _pk.paged_attention_reference
+    out = np.asarray(fn(q_in, kp, vp, tables, lengths))
+    return out[:b]
+
+
+def decode_attention(q, cache, seq_ids, layer, kind=None, batch=None):
+    """One decode step's attention for a batch of sequences, against the
+    paged cache.
+
+    ``q``: (B, H, D) — each sequence's single new-token query, the new
+    token's K/V already written at position length-1; ``cache``: the
+    :class:`~tpu_mx.serving.kv_cache.PagedKVCache`; ``seq_ids``: the
+    batch's sequence ids in row order; ``layer``: the layer whose pool
+    to read.  ``kind`` pins the arm (an engine resolves the env knob
+    once per generation so a black box records one truth); defaults to
+    :func:`resolve_decode_path`.  ``batch``: optional precomputed
+    ``cache.batch_tables(seq_ids)`` result for the paged arms — the
+    tables are layer-invariant within a step, so per-layer callers
+    build them once.  Returns (B, H, D).
+
+    Every call counts ``serve.decode_attention{kind=...}`` — the
+    observable that says which arm a production decode actually took."""
+    kind = resolve_decode_path() if kind is None else kind
+    q = np.asarray(q)
+    if kind == "dense":
+        kd, vd, lens = cache.gather_batch(seq_ids, layer)
+        out = dense_decode_attention(q, kd, vd, lens)
+    else:
+        out = _paged_decode(q, cache, seq_ids, layer, kind, batch=batch)
+    _telemetry.counter("serve.decode_attention", kind=kind).inc()
+    return out

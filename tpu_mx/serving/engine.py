@@ -8,10 +8,15 @@ PagedKVCache` and runs two operations for the server:
   bulk-filled in one call, and the first generated token comes back.
 - :meth:`decode` — ONE token for a whole batch of sequences: reserve the
   O(1) cache slot per sequence, then interleave the model's layer loop
-  with per-layer cache writes and block-table-gathered attention
-  (``decode_attention`` — the dense-gather fallback, docs/DIVERGENCES.md
-  #27).  Sequences whose slot reservation hits :class:`CacheExhausted`
-  are returned as *preempted* — the scheduler requeues them; the rest of
+  with per-layer batched cache writes and block-table attention
+  (``decode_attention`` — the paged kernel or the dense-gather reference
+  arm, picked ONCE per engine generation from ``TPUMX_PAGED_DECODE`` so
+  a restarted engine's black box records which path it was on via the
+  ``serve.decode_path`` event; docs/DIVERGENCES.md #27).  A paged engine
+  builds its cache with ``storage="device"`` — the pool lives on the
+  accelerator and decode never round-trips it through the host.
+  Sequences whose slot reservation hits :class:`CacheExhausted` are
+  returned as *preempted* — the scheduler requeues them; the rest of
   the batch proceeds.  Never OOM.
 
 Fault surface (what the server's watchdog/sentinel wrap): the chaos
@@ -41,7 +46,7 @@ import numpy as np
 from .. import tracing as _tracing
 from ..contrib import chaos as _chaos
 from ..supervisor import NumericDivergence
-from .attention import decode_attention
+from .attention import decode_attention, resolve_decode_path
 from .kv_cache import CacheExhausted, PagedKVCache
 
 __all__ = ["EngineCore"]
@@ -54,9 +59,18 @@ class EngineCore:
     def __init__(self, model, block_size=16, num_blocks=256,
                  dtype=np.float32):
         self.model = model
+        # the decode arm is resolved ONCE per engine generation: a knob
+        # flip mid-flight cannot leave half a batch on each path, and
+        # the serve.decode_path event below is the black box's record of
+        # which arm a (possibly restarted) engine was on
+        self.decode_kind = resolve_decode_path()
+        storage = "device" if self.decode_kind != "dense" else "host"
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
-            block_size=block_size, num_blocks=num_blocks, dtype=dtype)
+            block_size=block_size, num_blocks=num_blocks, dtype=dtype,
+            storage=storage)
+        _tracing.emit("serve.decode_path", path=self.decode_kind,
+                      storage=storage)
 
     # -- prefill -------------------------------------------------------------
     def prefill(self, req):
@@ -129,12 +143,15 @@ class EngineCore:
             [self.cache.length(r.id) - 1 for r, _ in live], np.int64)
         seq_ids = [r.id for r, _ in live]
         h = self.model.embed(tokens, positions)
+        # block tables are layer-invariant within a step (the slots were
+        # reserved above): build them once, not once per layer
+        batch = (self.cache.batch_tables(seq_ids)
+                 if self.decode_kind != "dense" else None)
         for i in range(self.model.num_layers):
             q, k, v = self.model.layer_qkv(i, h)
-            for b, sid in enumerate(seq_ids):
-                self.cache.write(sid, i, k[b], v[b])
-            kd, vd, lens = self.cache.gather_batch(seq_ids, i)
-            attn = decode_attention(q, kd, vd, lens)
+            self.cache.write_batch(seq_ids, i, k, v)
+            attn = decode_attention(q, self.cache, seq_ids, i,
+                                    kind=self.decode_kind, batch=batch)
             h = self.model.layer_combine(i, h, attn)
         logits = self.model.logits(h)
         health = _chaos.poison_loss(float(np.max(np.abs(logits))))

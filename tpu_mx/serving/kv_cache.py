@@ -23,15 +23,32 @@ layout) fixes both:
   back on the free list; the next sequence overwrites them.  No zeroing,
   no compaction, no copies.
 
-Storage is host numpy here — the CPU-testable layout tier-1 exercises;
-on TPU the same block tables drive the flash prefill path and the pool
-would live in HBM (docs/DIVERGENCES.md #27 records the gap).  All public
-methods are thread-safe: the allocator has its own lock and the table map
-is guarded by the cache lock, so a scheduler thread can admit/evict while
-tests hammer alloc/free concurrently (tests/test_serving.py).
+Two storage modes share the allocator/table semantics (``storage=``):
+
+- ``"host"`` (default): pools are host numpy — the CPU-testable layout
+  tier-1 exercises, read through the dense-gather fallback.
+- ``"device"``: pools are per-layer **device-resident** jax arrays
+  (HBM on TPU); ``prefill``/``write``/``write_batch`` mutate them with
+  jitted in-place index updates (buffer-donated where the backend
+  supports donation) and the paged-attention decode kernel indexes them
+  by raw block table (``tpu_mx/kernels/paged_attention.py``) — the
+  cache never round-trips through the host on the decode path
+  (docs/DIVERGENCES.md #27).  Same allocator, same block-table
+  bookkeeping, same exhaustion-is-backpressure contract.
+
+All public methods are thread-safe for BOOKKEEPING: the allocator has
+its own lock and the table map is guarded by the cache lock, so a
+scheduler thread can admit/evict while tests hammer alloc/free
+concurrently (tests/test_serving.py).  Device-pool ARRAY access (writes
+and :meth:`pool` readers) additionally belongs to the single engine
+step thread: donation invalidates the previous buffer, so a reader
+holding a stale pool reference across a write would observe a consumed
+array — the serving data plane is single-threaded by design
+(docs/serving.md), which is exactly this discipline.
 """
 from __future__ import annotations
 
+import functools
 import threading
 
 import numpy as np
@@ -39,6 +56,43 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["CacheExhausted", "BlockAllocator", "PagedKVCache"]
+
+
+def _next_pow2(n):
+    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
+
+
+# Jitted device-pool updaters, built on first device-mode cache.  Python
+# scalars/arrays trace as arguments, so repeated writes share one
+# compilation per operand shape; donating the pool makes the update
+# genuinely in-place (measured ~9us vs ~6ms copy-on-write for a 16 MiB
+# pool on this host's CPU backend) — which is why pool handles are
+# step-thread-owned: the pre-write array object is CONSUMED by every
+# write (module docstring).
+_DEV_OPS = None
+
+
+def _dev_ops():
+    global _DEV_OPS
+    if _DEV_OPS is None:
+        import jax
+
+        donate = (0,)
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def write_slot(pool, bid, off, val):
+            return pool.at[bid, off].set(val.astype(pool.dtype))
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def write_rows(pool, bids, offs, vals):
+            return pool.at[bids, offs].set(vals.astype(pool.dtype))
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def write_blocks(pool, bids, chunk):
+            return pool.at[bids].set(chunk.astype(pool.dtype))
+
+        _DEV_OPS = (write_slot, write_rows, write_blocks)
+    return _DEV_OPS
 
 
 class CacheExhausted(MXNetError):
@@ -139,12 +193,17 @@ class PagedKVCache:
 
     ``gather_batch`` is the dense-gather decode fallback: it materializes
     a padded ``(B, Lmax, H, D)`` view by copying block slices — O(total
-    context) per call, the documented CPU cost of serving attention
-    without a native paged kernel (docs/DIVERGENCES.md #27).
+    context) per call, the documented cost of serving attention without
+    the paged kernel (docs/DIVERGENCES.md #27).  The paged decode path
+    instead reads :meth:`batch_tables` + :meth:`pool` and indexes the
+    pool in-kernel.
     """
 
     def __init__(self, num_layers, num_heads, head_dim, block_size=16,
-                 num_blocks=256, dtype=np.float32):
+                 num_blocks=256, dtype=np.float32, storage="host"):
+        if storage not in ("host", "device"):
+            raise ValueError(f"storage must be 'host' or 'device', "
+                             f"got {storage!r}")
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
@@ -152,12 +211,38 @@ class PagedKVCache:
         if self.block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.allocator = BlockAllocator(num_blocks)
-        shape = (self.num_layers, self.allocator.num_blocks,
-                 self.block_size, self.num_heads, self.head_dim)
-        self.k_blocks = np.zeros(shape, dtype)
-        self.v_blocks = np.zeros(shape, dtype)
+        self.storage = storage
+        layer_shape = (self.allocator.num_blocks, self.block_size,
+                       self.num_heads, self.head_dim)
+        if storage == "device":
+            try:
+                import jax.numpy as jnp
+            except ImportError:
+                raise MXNetError(
+                    "PagedKVCache: storage='device' needs jax — use the "
+                    "default host storage (dense-gather decode) without "
+                    "it") from None
+            # per-layer pools (not one (L, N, ...) array): layer reads on
+            # the decode hot path must be O(1) handle lookups, never a
+            # per-step slice copy of the whole pool
+            self._k_dev = [jnp.zeros(layer_shape, dtype)
+                           for _ in range(self.num_layers)]
+            self._v_dev = [jnp.zeros(layer_shape, dtype)
+                           for _ in range(self.num_layers)]
+            self.k_blocks = self.v_blocks = None
+        else:
+            shape = (self.num_layers,) + layer_shape
+            self.k_blocks = np.zeros(shape, dtype)
+            self.v_blocks = np.zeros(shape, dtype)
         self._lock = threading.RLock()
         self._seqs = {}
+
+    @property
+    def device_resident(self):
+        """True when the block pools live on the accelerator (jax
+        arrays) rather than in host numpy — the `serve.
+        pool_device_resident` gauge's source of truth."""
+        return self.storage == "device"
 
     # -- bookkeeping ---------------------------------------------------------
     def _entry(self, seq_id):
@@ -220,11 +305,30 @@ class PagedKVCache:
             # never see a registered-but-empty sequence (all-zero K/V
             # would be silently wrong logits, not an error)
             bs = self.block_size
-            for i, bid in enumerate(blocks):
-                lo = i * bs
-                hi = min(lo + bs, length)
-                self.k_blocks[:, bid, :hi - lo] = k[:, lo:hi]
-                self.v_blocks[:, bid, :hi - lo] = v[:, lo:hi]
+            if self.storage == "device":
+                _, _, write_blocks = _dev_ops()
+                nb = len(blocks)
+                pad = nb * bs - length
+                bids = np.asarray(blocks, np.int32)
+                for layer in range(self.num_layers):
+                    # one scatter per pool per layer: the prompt's K/V
+                    # crosses to the device once, zero-padded to whole
+                    # blocks (the tail slots are this sequence's own
+                    # future append slots)
+                    ck = np.pad(k[layer], ((0, pad), (0, 0), (0, 0)))
+                    cv = np.pad(v[layer], ((0, pad), (0, 0), (0, 0)))
+                    self._k_dev[layer] = write_blocks(
+                        self._k_dev[layer], bids,
+                        ck.reshape(nb, bs, *ck.shape[1:]))
+                    self._v_dev[layer] = write_blocks(
+                        self._v_dev[layer], bids,
+                        cv.reshape(nb, bs, *cv.shape[1:]))
+            else:
+                for i, bid in enumerate(blocks):
+                    lo = i * bs
+                    hi = min(lo + bs, length)
+                    self.k_blocks[:, bid, :hi - lo] = k[:, lo:hi]
+                    self.v_blocks[:, bid, :hi - lo] = v[:, lo:hi]
             entry = _Sequence()
             entry.blocks = blocks
             entry.length = length
@@ -252,8 +356,44 @@ class PagedKVCache:
             pos = entry.length - 1
             bid = entry.blocks[pos // self.block_size]
             off = pos % self.block_size
-            self.k_blocks[layer, bid, off] = k
-            self.v_blocks[layer, bid, off] = v
+            if self.storage == "device":
+                # numpy operands cross the jit boundary on the C++ fast
+                # path; an eager jnp.asarray per operand costs ~73us of
+                # dispatch each and dominated the per-token write cost
+                write_slot, _, _ = _dev_ops()
+                self._k_dev[layer] = write_slot(
+                    self._k_dev[layer], bid, off, np.asarray(k))
+                self._v_dev[layer] = write_slot(
+                    self._v_dev[layer], bid, off, np.asarray(v))
+            else:
+                self.k_blocks[layer, bid, off] = k
+                self.v_blocks[layer, bid, off] = v
+
+    def write_batch(self, seq_ids, layer, k, v):
+        """Write one layer's K/V for a whole decode batch into each
+        sequence's newest reserved slot (``k``/``v``: ``(B, num_heads,
+        head_dim)``).  On device storage this is ONE scatter per pool —
+        the decode hot path's per-step write cost — instead of B
+        round-trips; host storage loops the per-sequence slot writes."""
+        with self._lock:
+            slots = []
+            for s in seq_ids:
+                entry = self._entry(s)
+                pos = entry.length - 1
+                slots.append((entry.blocks[pos // self.block_size],
+                              pos % self.block_size))
+            if self.storage == "device":
+                _, write_rows, _ = _dev_ops()
+                bids = np.asarray([b for b, _ in slots], np.int32)
+                offs = np.asarray([o for _, o in slots], np.int32)
+                self._k_dev[layer] = write_rows(
+                    self._k_dev[layer], bids, offs, np.asarray(k))
+                self._v_dev[layer] = write_rows(
+                    self._v_dev[layer], bids, offs, np.asarray(v))
+            else:
+                for i, (bid, off) in enumerate(slots):
+                    self.k_blocks[layer, bid, off] = k[i]
+                    self.v_blocks[layer, bid, off] = v[i]
 
     def free_sequence(self, seq_id):
         """Evict: push the sequence's blocks back on the free list
@@ -266,19 +406,63 @@ class PagedKVCache:
             self.allocator.free(entry.blocks)
             return len(entry.blocks)
 
+    # -- reads: the paged-kernel operands ------------------------------------
+    def pool(self, layer):
+        """``layer``'s ``(num_blocks, block_size, H, D)`` K and V pools —
+        the paged-attention kernel's HBM operands.  Device storage
+        returns the resident jax arrays (an O(1) handle, no copy); host
+        storage returns numpy views (the kernel's interpret-mode /
+        parity-test arm pays the host->device copy per call, which is
+        why production paged decode pairs with ``storage='device'``)."""
+        if self.storage == "device":
+            return self._k_dev[layer], self._v_dev[layer]
+        return self.k_blocks[layer], self.v_blocks[layer]
+
+    def batch_tables(self, seq_ids):
+        """The decode batch's raw block tables: int32 ``(B, NBpad)`` ids
+        plus int32 ``(B,)`` true lengths — what the paged kernel walks.
+
+        Rows are padded with block 0 past each sequence's real blocks
+        (valid pool indices by construction — the kernel contract: the
+        padded fetches are finite garbage the length mask excludes
+        exactly), and NBpad is the batch max rounded up to a BUCKET —
+        power of two up to 4 blocks, then multiples of 4 — so jitted
+        consumers see a bounded set of shapes instead of recompiling at
+        every block-boundary crossing.  The bucket is deliberately fine:
+        pow2 buckets made the padded gather tail up to 2x the true
+        context, which alone pushed the long-generation per-token
+        receipt past the <=1.15x flatness bar (ROUND8_NOTES.md); at
+        mult-4 the tail is <=3 blocks and a 4096-block pool still
+        compiles at most ~1k shapes over its whole lifetime."""
+        with self._lock:
+            entries = [self._entry(s) for s in seq_ids]
+            tables = [(list(e.blocks), e.length) for e in entries]
+        nb = max(len(blocks) for blocks, _ in tables)
+        nbpad = _next_pow2(nb) if nb <= 4 else -(-nb // 4) * 4
+        ids = np.zeros((len(tables), nbpad), np.int32)
+        for i, (blocks, _) in enumerate(tables):
+            ids[i, :len(blocks)] = blocks
+        lengths = np.array([length for _, length in tables], np.int32)
+        return ids, lengths
+
     # -- reads (the dense-gather fallback) -----------------------------------
     def gather(self, seq_id, layer):
         """One sequence's dense ``(L, H, D)`` K/V for ``layer`` — the
-        block table resolved in one fancy-index gather (a copy)."""
+        block table resolved in one fancy-index gather (a copy; device
+        storage gathers on-device, then fetches the result)."""
         with self._lock:
             entry = self._entry(seq_id)
             blocks = list(entry.blocks)
             length = entry.length
-        bs = self.block_size
-        k = self.k_blocks[layer, blocks].reshape(-1, self.num_heads,
-                                                 self.head_dim)
-        v = self.v_blocks[layer, blocks].reshape(-1, self.num_heads,
-                                                 self.head_dim)
+        kp, vp = self.pool(layer)
+        if self.storage == "device":
+            import jax.numpy as jnp
+            idx = jnp.asarray(blocks, jnp.int32)
+            kp, vp = np.asarray(kp[idx]), np.asarray(vp[idx])
+        else:
+            kp, vp = kp[blocks], vp[blocks]
+        k = kp.reshape(-1, self.num_heads, self.head_dim)
+        v = vp.reshape(-1, self.num_heads, self.head_dim)
         return k[:length], v[:length]
 
     def gather_batch(self, seq_ids, layer):
@@ -310,8 +494,18 @@ class PagedKVCache:
         for i, (blocks, _) in enumerate(tables):
             ids[i, :len(blocks)] = blocks
         shape = (b, nbmax * bs, self.num_heads, self.head_dim)
-        k = self.k_blocks[layer, ids.ravel()].reshape(shape)
-        v = self.v_blocks[layer, ids.ravel()].reshape(shape)
+        kp, vp = self.pool(layer)
+        if self.storage == "device":
+            # reference arm on a device pool: gather on-device by table,
+            # fetch the (B, Lpad, H, D) result once — the parity tests'
+            # honest dense baseline against the same resident pool
+            import jax.numpy as jnp
+            idx = jnp.asarray(ids.ravel(), jnp.int32)
+            k = np.asarray(kp[idx]).reshape(shape)
+            v = np.asarray(vp[idx]).reshape(shape)
+        else:
+            k = kp[ids.ravel()].reshape(shape)
+            v = vp[ids.ravel()].reshape(shape)
         lengths = np.array([length for _, length in tables], np.int32)
         return k, v, lengths
 

@@ -232,6 +232,8 @@ class Server:
     def _update_gauges(self):
         _telemetry.gauge("serve.cache_utilization").set(
             self.engine.cache.utilization())
+        _telemetry.gauge("serve.pool_device_resident").set(
+            float(self.engine.cache.device_resident))
         _telemetry.gauge("serve.queue_depth").set(
             self.scheduler.queue_depth())
         if self._t_first_work is not None:
@@ -254,20 +256,25 @@ class Server:
             self._degrade(err)
             return
         requeued = self.scheduler.requeue_all_running()
+        _telemetry.counter("serve.engine_restarts").inc()
+        # serve.restart lands under the FAILING step's (step, generation)
+        # context — the injection->decision correlation the serve CI tier
+        # asserts; only then does the context advance to the new
+        # generation, so the fresh engine's serve.decode_path event is
+        # stamped with the generation it will actually run as
+        _tracing.emit("serve.restart", n=self.restarts, reason=reason,
+                      requeued=len(requeued))
+        self.generation += 1
+        _tracing.set_context(generation=self.generation)
         # the old engine (and any watchdog thread still wedged inside
         # it) is garbage from here: threads touching its private cache
         # mutate nothing the new generation reads
         self.engine = EngineCore(self.model, block_size=self._block_size,
                                  num_blocks=self._num_blocks,
                                  dtype=self._dtype)
-        self.generation += 1
-        _telemetry.counter("serve.engine_restarts").inc()
-        _tracing.emit("serve.restart", n=self.restarts, reason=reason,
-                      requeued=len(requeued))
         self._dump_blackbox(f"serving engine restart "
                             f"{self.restarts}/{self.max_restarts}: "
                             f"{reason}")
-        _tracing.set_context(generation=self.generation)
         _telemetry.flush()
         if self.backoff:
             time.sleep(min(30.0, self.backoff * 2 ** (self.restarts - 1)))

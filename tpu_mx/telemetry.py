@@ -110,6 +110,10 @@ KNOWN_METRICS = frozenset({
     "serve.tokens_per_sec", "serve.queue_depth", "serve.cache_utilization",
     "serve.requests", "serve.engine_restarts",
     "serve.decode_steps", "serve.generated_tokens",
+    # decode data plane (ISSUE 9): which attention arm each call took
+    # (kind=dense/paged/paged-kernel) and whether the KV block pool is
+    # device-resident (1.0) or host numpy (0.0)
+    "serve.decode_attention", "serve.pool_device_resident",
     # module-API training (tpu_mx/callback.py)
     "speedometer.samples_per_sec",
 })

@@ -115,6 +115,11 @@ KNOWN_EVENTS = {
     "serve.decode": {"batch": "int", "tokens": "int", "seconds": "float"},
     "serve.evict": {"request": "str", "reason": "str", "generated": "int"},
     "serve.restart": {"n": "int", "reason": "str", "requeued": "int"},
+    # emitted once per engine construction (so once per generation): the
+    # decode-attention arm this engine resolved (dense / paged /
+    # paged-kernel) and where its KV pool lives (host / device) — a
+    # restarted engine's black box records which data plane it was on
+    "serve.decode_path": {"path": "str", "storage": "str"},
 }
 
 # the documented values of train_step.phase's `phase` field (the whole
