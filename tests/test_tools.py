@@ -11,6 +11,33 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 cv2 = pytest.importorskip("cv2")
 
 
+def _multiprocess_collectives_supported():
+    """Whether the jax backend can run CROSS-PROCESS collectives.  The
+    CPU backend cannot: any 2-process psum/barrier raises
+    INVALID_ARGUMENT "Multiprocess computations aren't implemented on
+    the CPU backend" (jax 0.4.37) — process-group formation and virtual
+    single-process meshes work, the collective dispatch itself does not.
+    Capability-keyed (not env-keyed) so the skip lifts itself the moment
+    these tests run against a real TPU/GPU backend."""
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:  # no jax at all: the tests below cannot run either
+        return False
+
+
+# The three 2-process tests below exercise REAL cross-process collectives
+# (elastic barrier death detection, dist_sync kvstore reduce, multi-host
+# CompiledTrainStep).  They failed on every CPU-backend run since the
+# seed — a backend capability gap, not a regression — and were carried as
+# "fails at seed too" folklore until ISSUE 10 made the condition explicit.
+_needs_multiprocess_collectives = pytest.mark.skipif(
+    not _multiprocess_collectives_supported(),
+    reason="needs cross-process collectives: the CPU jax backend raises "
+           "'Multiprocess computations aren't implemented on the CPU "
+           "backend' (capability gap, present at seed; runs on TPU/GPU)")
+
+
 def _env_cpu():
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = ""
@@ -77,6 +104,7 @@ def test_launch_local_spmd(tmp_path):
 
 
 @pytest.mark.slow
+@_needs_multiprocess_collectives
 def test_elastic_barrier_detects_dead_rank(tmp_path):
     """A killed rank in a 2-process run produces a clean WorkerFailure within
     the timeout instead of an indefinite hang (SURVEY §5.3)."""
@@ -170,6 +198,7 @@ def test_ssh_launcher_command_construction(tmp_path):
 
 
 @pytest.mark.slow
+@_needs_multiprocess_collectives
 def test_dist_sync_kvstore_cross_process_sum(tmp_path):
     """Eager dist_sync push/pull performs a REAL cross-process reduce
     (REF:tests/nightly/dist_sync_kvstore.py): pulled values can only arise
@@ -343,6 +372,7 @@ def test_strict_kvstore_flag_raises_on_eager_dist(monkeypatch):
 
 
 @pytest.mark.slow
+@_needs_multiprocess_collectives
 def test_launch_two_process_compiled_train_step(tmp_path):
     """Full multi-host SPMD path: TWO processes x 4 virtual devices form
     one dp=8 mesh and run the SAME CompiledTrainStep — both ranks must

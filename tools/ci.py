@@ -6,11 +6,14 @@ snapshot-time check: `python tools/ci.py` exits nonzero with an
 unmissable banner when any test fails, and prints per-tier timing so the
 slowest tier stays visible.
 
-Tiers: lint — tools/tpumx_lint.py, the framework-aware static analyzer
-enforcing the durability/determinism/sync-point/concurrency/telemetry
-contracts on every line including branches no fault schedule executes
-(docs/static_analysis.md; fastest tier, no device, runs FIRST so a
-contract violation fails before any test time is spent) — then core
+Tiers: lint — tools/tpumx_lint.py, the framework-aware two-phase static
+analyzer (project index + call graph, then the rule passes) enforcing
+the durability/determinism/sync-point/concurrency/telemetry/
+hot-path-purity contracts on every line including branches no fault
+schedule executes (docs/static_analysis.md; fastest tier, no device,
+runs FIRST so a contract violation fails before any test time is spent,
+and asserts LINT_BUDGET_SECONDS so the index phase can never silently
+blow up tier runtime) — then core
 (`-m "not slow"`, <5 min), slow (virtual-mesh parallelism,
 full-model layout trains, op-audit sweep, native C++ tier), the example
 smokes, chaos (the fault-injection durability tests re-run under a fixed
@@ -63,11 +66,23 @@ TIERS = [
 ]
 
 
+# Hard wall-clock budget for the whole-tree lint (index build included).
+# The two-phase analyzer measures ~5 s on this host (ISSUE 10: phase 1
+# index + phase 2 passes; was ~3 s lexical-only); the budget is sized to
+# ride out CI-host scheduling noise while still failing LOUDLY if the
+# index phase ever regresses to per-file re-parsing or superlinear call
+# graph work — a silent 10x here would eat the whole tier's cheapness.
+LINT_BUDGET_SECONDS = 15.0
+
+
 def lint_tier():
     """Run the static contract checker over the default tree; any
-    unsuppressed, non-baselined finding is a red tier.  JSON mode so the
-    gate parses the count rather than scraping human output."""
+    unsuppressed, non-baselined finding is a red tier, and so is blowing
+    the LINT_BUDGET_SECONDS wall-clock budget (the index phase must stay
+    cheap — this tier runs FIRST on every CI invocation).  JSON mode so
+    the gate parses the count rather than scraping human output."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.time()
     try:
         run = subprocess.run(
             [sys.executable, os.path.join(repo, "tools", "tpumx_lint.py"),
@@ -75,6 +90,13 @@ def lint_tier():
             capture_output=True, text=True, timeout=120, cwd=repo)
     except subprocess.TimeoutExpired as e:
         print(f"  lint: timed out: {e}")
+        return 1
+    elapsed = time.time() - t0
+    if elapsed > LINT_BUDGET_SECONDS:
+        print(f"  lint: whole-tree run took {elapsed:.1f}s — over the "
+              f"{LINT_BUDGET_SECONDS:.0f}s tier budget; the index phase "
+              "has regressed (profile tools/lint/index.py before raising "
+              "the budget)")
         return 1
     if run.returncode != 0:
         # surface the findings (re-rendered from JSON) in the CI log

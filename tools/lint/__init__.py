@@ -1,0 +1,45 @@
+"""tpumx-lint: framework-aware static analysis for the tpu-mx contracts.
+
+PR 6 shipped the linter as five independent per-file AST walks in one
+module; ISSUE 10 grew it into a two-phase analyzer and split it into
+this package:
+
+- ``lint.core``   — findings, the per-file context, suppressions,
+  baseline I/O, static catalog extraction;
+- ``lint.index``  — phase 1: the project-wide symbol table, call graph,
+  per-function summaries, lock-context propagation, hot-path
+  reachability, and the serialized index cache;
+- ``lint.passes`` — phase 2: the rule passes (durability, determinism,
+  sync-point, concurrency, telemetry-catalog, hot-path-purity);
+- ``lint.cli``    — the driver (``lint_source``/``lint_sources``/
+  ``lint_paths``/``main``), including ``--changed-only``.
+
+``tools/tpumx_lint.py`` remains the entry point and the public import
+surface (tests and CI use it); it re-exports everything below, so
+``import tpumx_lint`` keeps working unchanged.  See
+docs/static_analysis.md.
+"""
+from .core import (DEFAULT_TARGETS, LINT_FORMAT, REPO, FileCtx, Finding,
+                   call_name, const_str, dotted, expr_text,
+                   load_known_events, load_known_metrics, read_baseline,
+                   strings_in, suppressed_rules, write_baseline)
+from .index import (HOT_ROOTS, INDEX_FORMAT, ProjectIndex, build_index,
+                    read_index, summarize_file, write_index)
+from .passes import (ConcurrencyPass, DeterminismPass, DurabilityPass,
+                     HotPathPurityPass, Pass, SyncPointPass,
+                     TelemetryCatalogPass, build_passes)
+from .cli import (DEFAULT_INDEX, git_changed_files, iter_files,
+                  lint_paths, lint_source, lint_sources, main)
+
+__all__ = [
+    "DEFAULT_INDEX", "DEFAULT_TARGETS", "HOT_ROOTS", "INDEX_FORMAT",
+    "LINT_FORMAT", "REPO", "FileCtx", "Finding", "ProjectIndex",
+    "ConcurrencyPass", "DeterminismPass", "DurabilityPass",
+    "HotPathPurityPass", "Pass", "SyncPointPass", "TelemetryCatalogPass",
+    "build_index", "build_passes", "call_name", "const_str", "dotted",
+    "expr_text", "git_changed_files", "iter_files", "lint_paths",
+    "lint_source", "lint_sources", "load_known_events",
+    "load_known_metrics", "main", "read_baseline", "read_index",
+    "strings_in", "summarize_file", "suppressed_rules", "write_baseline",
+    "write_index",
+]

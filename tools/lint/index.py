@@ -1,0 +1,709 @@
+"""tpumx-lint phase 1: the project-wide index.
+
+One pass over every scanned file builds, per function, a *summary* —
+calls made (and whether each call site sits under a lock), implicit
+device→host syncs, raw parameter-path writes, jit-boundary and
+memoization markers — plus per-file symbol tables (functions, classes,
+``self.attr`` constructor types, import aliases).  ``link()`` then
+resolves call sites into a project call graph and derives the facts the
+interprocedural passes (``tools/lint/passes.py``) consume:
+
+- **lock context propagation** — ``always_locked(fn)`` is a greatest
+  fixpoint over the call graph: a function is proven to run under a lock
+  when every project call site either sits lexically inside a
+  ``with <lock>:`` or belongs to a function that is itself always
+  locked.  Cycles are resolved optimistically (a recursive helper whose
+  only external entries are locked is locked).  Zero callers → not
+  provable, the lexical finding stands.
+- **hot-path reachability** — BFS from the decode/train/fusion hot-path
+  roots (``HOT_ROOTS``); every reached function carries one example call
+  chain for the finding message.
+- **one-hop helper summaries** — the sync-point and durability passes
+  look up a callee's summary at the call site (a wrapper around
+  ``open(path, "w")`` or a helper hiding an ``.item()`` is no longer a
+  blind spot).
+- **emitter alias closure** — names that resolve, transitively through
+  re-exporting modules, to ``tpu_mx.telemetry`` / ``tpu_mx.tracing`` or
+  their emitter functions, so the catalog pass checks aliased
+  cross-module call sites.
+
+Call resolution is deliberately lightweight (this is a linter, not a
+compiler): ``self.m()`` → same-class method; ``self.attr.m()`` via
+``self.attr = ClassName(...)`` constructor assignments; bare names via
+lexical nesting, module scope, then (re-exported) imports; dotted names
+through import aliases and submodules.  As a last resort a method name
+defined by **exactly one** project class resolves to it (the
+unique-method heuristic), except for generic names (``COMMON_METHODS``)
+where a wrong edge would be likely.  Unresolved calls simply contribute
+no edge — the analysis under-approximates, which for lock *proofs* is
+the safe direction (an unproven helper keeps its finding) and for
+reachability trades recall for a zero-false-positive default.
+
+The index serializes to JSON next to the baseline
+(``tools/tpumx_lint_index.json``, sha-keyed per file) so
+``--changed-only`` re-summarizes only dirty files and re-analyzes just
+the dirty call-graph region (the changed files' strongly-connected
+components plus their direct callers/callees).
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+
+from .core import (SYNC_ATTRS, SYNC_REDUCTIONS, FileCtx, call_name, dotted,
+                   expr_text, flat_targets, jnp_names, numpy_names,
+                   strings_in, suppressed_rules)
+
+INDEX_FORMAT = "tpumx-lint-index-v1"
+
+# The hot-path roots: the per-token / per-step loops whose transitive
+# callees must stay pure (no eager host↔device traffic) — the
+# hot-path-purity pass (docs/static_analysis.md, docs/performance.md).
+HOT_ROOTS = (
+    ("tpu_mx/serving/engine.py", "EngineCore.decode"),
+    ("tpu_mx/serving/attention.py", "decode_attention"),
+    ("tpu_mx/parallel/train_step.py", "CompiledTrainStep.step"),
+    ("tpu_mx/parallel/train_step.py", "CompiledTrainStep._step"),
+    ("tpu_mx/fusion.py", "flush"),
+    ("tpu_mx/fusion.py", "realize"),
+)
+
+# method names too generic for the unique-method fallback: an edge from
+# `fh.write(...)` to some class's `write` would poison the call graph
+COMMON_METHODS = frozenset({
+    "write", "read", "get", "set", "pop", "append", "extend", "update",
+    "close", "open", "run", "start", "stop", "join", "items", "keys",
+    "values", "copy", "add", "clear", "flush", "emit", "put", "send",
+    "next", "reset", "step", "save", "load", "free", "alloc",
+})
+
+_MEMO_TEST_RE = re.compile(r"is (not )?None\b")
+
+
+def module_of(relpath):
+    """'tpu_mx/serving/engine.py' -> ('tpu_mx.serving.engine', is_pkg)."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") \
+        else relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        return ".".join(parts[:-1]), True
+    return ".".join(parts), False
+
+
+def _is_lock_with(item):
+    d = dotted(item.context_expr) or ""
+    return bool(d) and "lock" in d.lower()
+
+
+def _decorator_names(node):
+    out = []
+    for dec in node.decorator_list:
+        d = dotted(dec)
+        if d is None and isinstance(dec, ast.Call):
+            d = dotted(dec.func)
+            if d in ("functools.partial", "partial") and dec.args:
+                inner = dotted(dec.args[0])
+                if inner:
+                    out.append(inner)
+        if d:
+            out.append(d)
+    return out
+
+
+def _param_names(fn):
+    a = fn.args
+    names = {p.arg for p in (a.args + a.kwonlyargs
+                             + getattr(a, "posonlyargs", []))}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def summarize_file(ctx):
+    """Phase-1 summary of one parsed file: plain-data (JSON-able) dict."""
+    np_aliases = numpy_names(ctx)
+    jnp_aliases = jnp_names(ctx)
+    funcs = {}       # qualname -> summary dict
+    classes = {}     # class qualname -> {"methods": [...], "attr_types": {}}
+    jit_names = set()  # function NAMES referenced inside jax.jit/pallas_call
+
+    # -- collect jit-referenced names (file-wide) ---------------------------
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = call_name(node) or ""
+        base = d.split(".")[-1]
+        if base in ("jit", "pjit", "pallas_call"):
+            for arg in node.args[:1]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        jit_names.add(sub.id)
+
+    def qual_of(node):
+        parent = ctx.qualname(node)
+        return f"{parent}.{node.name}" if parent else node.name
+
+    # -- classes + self.attr constructor types ------------------------------
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            q = qual_of(node)
+            methods = [c.name for c in node.body
+                       if isinstance(c, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            classes[q] = {"methods": methods, "attr_types": {}}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        ctor = call_name(node.value)
+        klass = ctx.class_of.get(id(node))
+        if klass is None or ctor is None:
+            continue
+        for t in flat_targets(node):
+            d = dotted(t) or ""
+            if d.startswith("self.") and d.count(".") == 1:
+                cq = qual_of(klass)
+                if cq in classes:
+                    classes[cq]["attr_types"][d.split(".", 1)[1]] = ctor
+
+    # -- per-function walk: calls / syncs / raw writes ----------------------
+    def visit(node, fn_stack, locked):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = qual_of(child)
+                decs = _decorator_names(child)
+                body_src = " ".join(
+                    expr_text(n.test) for n in ast.walk(child)
+                    if isinstance(n, ast.If))
+                funcs[q] = {
+                    "name": child.name,
+                    "lineno": child.lineno,
+                    "cls": (qual_of(ctx.class_of[id(child)])
+                            if ctx.class_of.get(id(child)) is not None
+                            and ctx.func_of.get(id(child))
+                            is ctx.func_of.get(id(ctx.class_of[id(child)]))
+                            else None),
+                    "jitted": (child.name in jit_names
+                               or any(dn.split(".")[-1] in ("jit", "pjit")
+                                      for dn in decs)),
+                    "memo_guard": (bool(_MEMO_TEST_RE.search(body_src))
+                                   or any(dn.split(".")[-1] in
+                                          ("lru_cache", "cache")
+                                          for dn in decs)),
+                    "params": sorted(_param_names(child)),
+                    "calls": [],
+                    "syncs": [],
+                    "raw_writes": [],
+                }
+                # a function DEFINED under a lock does not RUN under it
+                visit(child, fn_stack + [(q, child)], False)
+                continue
+            if isinstance(child, ast.Lambda):
+                # same rule for lambdas: one defined under `with lock:`
+                # can be stored and invoked later, off-lock (the
+                # deferred-callback shape) — recording its calls as
+                # locked would let always_locked() prove a helper safe
+                # that actually races; unlocked is the safe direction
+                visit(child, fn_stack, False)
+                continue
+            child_locked = locked
+            if isinstance(child, (ast.With, ast.AsyncWith)) and any(
+                    _is_lock_with(i) for i in child.items):
+                child_locked = True
+            if isinstance(child, ast.Call) and fn_stack:
+                q, fn_node = fn_stack[-1]
+                _record_call(ctx, funcs[q], fn_node, child, locked,
+                             np_aliases, jnp_aliases)
+            visit(child, fn_stack, child_locked)
+
+    visit(ctx.tree, [], False)
+    module, is_pkg = module_of(ctx.path)
+    return {
+        "sha": hashlib.sha256(ctx.source.encode("utf-8")).hexdigest(),
+        "module": module,
+        "is_pkg": is_pkg,
+        "mod_alias": dict(ctx.mod_alias),
+        "from_imports": {k: list(v) for k, v in ctx.from_imports.items()},
+        "functions": funcs,
+        "classes": classes,
+    }
+
+
+def _record_call(ctx, summary, fn_node, call, locked, np_aliases,
+                 jnp_aliases):
+    d = call_name(call)
+    if d is not None:
+        summary["calls"].append([d, call.lineno, bool(locked)])
+    sup = None
+
+    def suppressed(rule):
+        nonlocal sup
+        if sup is None:
+            sup = suppressed_rules(ctx, call.lineno)
+        return rule in sup or "all" in sup
+
+    # implicit device→host syncs a one-hop caller inherits
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in SYNC_ATTRS
+            and not call.args and not call.keywords):
+        summary["syncs"].append(
+            [f".{call.func.attr}()", call.lineno,
+             suppressed("sync-point")])
+    elif (isinstance(call.func, ast.Name)
+          and call.func.id in ("float", "bool", "int") and call.args
+          and isinstance(call.args[0], ast.Call)
+          and isinstance(call.args[0].func, ast.Attribute)
+          and call.args[0].func.attr in SYNC_REDUCTIONS
+          and not (isinstance(call.args[0].func.value, ast.Name)
+                   and call.args[0].func.value.id in np_aliases)):
+        summary["syncs"].append(
+            [f"{call.func.id}({expr_text(call.args[0])})", call.lineno,
+             suppressed("sync-point")])
+
+    # raw writes of a PARAMETER path (the wrapper-around-open shape).
+    # Functions named like the durability layer itself (atomic_write /
+    # write_atomic) are the structural allowlist: they ARE tmp+rename
+    # commit layers, not bypasses of one.
+    if "atomic" in fn_node.name:
+        return
+    params = _param_names(fn_node)
+
+    def param_in(expr):
+        return any(isinstance(n, ast.Name) and n.id in params
+                   for n in ast.walk(expr))
+
+    sink, kind = None, None
+    if d == "open" and call.args:
+        mode = call.args[1] if len(call.args) >= 2 else None
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is not None and any(
+                m.startswith("w") for m in strings_in(mode)):
+            sink, kind = call.args[0], "open(..., 'w')"
+    elif d is not None and d.endswith("pickle.dump") and len(call.args) >= 2:
+        sink, kind = call.args[1], "pickle.dump"
+    elif d is not None and call.args and any(
+            d == f"{a}.{s}" for a in np_aliases
+            for s in ("save", "savez", "savez_compressed")):
+        sink, kind = call.args[0], d
+    if sink is not None and param_in(sink):
+        summary["raw_writes"].append(
+            [kind, call.lineno, suppressed("durability")])
+
+
+# ---------------------------------------------------------------------------
+# the linked index
+# ---------------------------------------------------------------------------
+class ProjectIndex:
+    """Linked phase-1 output.  Build with :func:`build_index` (from
+    FileCtx objects) or :meth:`from_json` (the serialized cache), then
+    query from the passes."""
+
+    def __init__(self, files=None):
+        self.files = files or {}   # rel -> summarize_file() dict
+        self._linked = False
+
+    # -- construction -------------------------------------------------------
+    def add_file(self, rel, summary):
+        self.files[rel] = summary
+        self._linked = False
+
+    def remove_file(self, rel):
+        """Drop a file that left the tree (deleted/renamed) so its stale
+        summary cannot keep discharging proofs or feeding reachability."""
+        self.files.pop(rel, None)
+        self._linked = False
+
+    def link(self):
+        if self._linked:
+            return self
+        self.module_map = {}       # dotted module -> rel
+        for rel, info in self.files.items():
+            self.module_map[info["module"]] = rel
+        # unique-method table (last-resort receiver-less resolution)
+        counts = {}
+        for rel, info in self.files.items():
+            for cq, cinfo in info["classes"].items():
+                for m in cinfo["methods"]:
+                    counts.setdefault(m, []).append((rel, f"{cq}.{m}"))
+        self.unique_methods = {m: v[0] for m, v in counts.items()
+                               if len(v) == 1 and m not in COMMON_METHODS}
+        # resolve every call site -> edges + callers map
+        self.edges = {}            # (rel, qual) -> [(rel2, qual2, lineno)]
+        self.callers = {}          # (rel2, qual2) -> [((rel, qual), locked)]
+        for rel, info in self.files.items():
+            for qual, fs in info["functions"].items():
+                fid = (rel, qual)
+                out = []
+                for text, lineno, locked in fs["calls"]:
+                    tgt = self.resolve_call(rel, qual, text)
+                    if tgt is None or tgt == fid:
+                        continue
+                    out.append((tgt[0], tgt[1], lineno))
+                    self.callers.setdefault(tgt, []).append((fid, locked))
+                self.edges[fid] = out
+        self._locked_memo = {}
+        self._hot = None
+        self._emit_memo = {}
+        self._linked = True
+        return self
+
+    # -- symbol resolution --------------------------------------------------
+    def _function(self, rel, qual):
+        info = self.files.get(rel)
+        return info["functions"].get(qual) if info else None
+
+    def _resolve_symbol(self, rel, name, depth=0):
+        """`name` looked up in module `rel`: a function, a class (→ its
+        __init__ / the class qual), a submodule, or a re-export."""
+        if depth > 6 or rel not in self.files:
+            return None
+        info = self.files[rel]
+        if name in info["functions"]:
+            return ("func", rel, name)
+        if name in info["classes"]:
+            return ("class", rel, name)
+        # submodule file?
+        sub = f"{info['module']}.{name}" if info["module"] else name
+        if sub in getattr(self, "module_map", {}):
+            return ("module", self.module_map[sub], sub)
+        # re-export: `from .x import name` at module level
+        fi = info["from_imports"].get(name)
+        if fi is not None:
+            mod_rel = self._resolve_module(rel, fi[0])
+            if mod_rel is not None:
+                got = self._resolve_symbol(mod_rel, fi[1], depth + 1)
+                if got is not None:
+                    return got
+                # the imported NAME may itself be a submodule of fi[0]
+                minfo = self.files.get(mod_rel)
+                if minfo is not None:
+                    sub = f"{minfo['module']}.{fi[1]}"
+                    if sub in self.module_map:
+                        return ("module", self.module_map[sub], sub)
+        mod = info["mod_alias"].get(name)
+        if mod is not None and mod in self.module_map:
+            return ("module", self.module_map[mod], mod)
+        return None
+
+    def _resolve_module(self, rel, dotted_mod):
+        """A (possibly relative) module string from file `rel` -> rel of
+        the module file, or None when it's not part of the scan set."""
+        info = self.files.get(rel)
+        if info is None:
+            return None
+        level = len(dotted_mod) - len(dotted_mod.lstrip("."))
+        tail = dotted_mod.lstrip(".")
+        if level:
+            parts = info["module"].split(".") if info["module"] else []
+            keep = len(parts) - level + (1 if info["is_pkg"] else 0)
+            if keep < 0:
+                return None
+            base = parts[:keep]
+            full = ".".join(base + ([tail] if tail else []))
+        else:
+            full = tail
+        return self.module_map.get(full)
+
+    def resolve_call(self, rel, caller_qual, text):
+        """Call-site text -> (rel, qualname) of the target function, or
+        None (external / unresolvable — contributes no edge)."""
+        info = self.files.get(rel)
+        if info is None or not text:
+            return None
+        parts = text.split(".")
+
+        def as_func(kind_tuple):
+            if kind_tuple is None:
+                return None
+            kind, r2, n2 = kind_tuple
+            if kind == "func":
+                return (r2, n2)
+            if kind == "class":
+                init = f"{n2}.__init__"
+                if init in self.files[r2]["functions"]:
+                    return (r2, init)
+            return None
+
+        # self.m() — same-class method
+        if parts[0] == "self" and len(parts) == 2:
+            fs = info["functions"].get(caller_qual)
+            cls = fs.get("cls") if fs else None
+            if cls and parts[1] in info["classes"].get(
+                    cls, {}).get("methods", ()):
+                return (rel, f"{cls}.{parts[1]}")
+            return self.unique_methods.get(parts[1])
+        # self.attr.m() — via constructor-typed attributes
+        if parts[0] == "self" and len(parts) == 3:
+            fs = info["functions"].get(caller_qual)
+            cls = fs.get("cls") if fs else None
+            ctor = info["classes"].get(cls, {}).get(
+                "attr_types", {}).get(parts[1]) if cls else None
+            if ctor is not None:
+                got = self._resolve_path(rel, ctor.split("."))
+                if got is not None and got[0] == "class":
+                    r2, cq = got[1], got[2]
+                    if parts[2] in self.files[r2]["classes"].get(
+                            cq, {}).get("methods", ()):
+                        return (r2, f"{cq}.{parts[2]}")
+            return self.unique_methods.get(parts[2])
+        if len(parts) == 1:
+            name = parts[0]
+            # lexically nested helper (closures): nearest enclosing scope
+            prefix = caller_qual
+            while prefix:
+                cand = f"{prefix}.{name}"
+                if cand in info["functions"]:
+                    return (rel, cand)
+                prefix = prefix.rpartition(".")[0]
+            return as_func(self._resolve_symbol(rel, name))
+        # dotted: resolve the head to a module/class, descend
+        got = self._resolve_path(rel, parts)
+        if got is not None:
+            if got[0] in ("func", "class"):
+                return as_func(got)
+            if got[0] == "method":
+                return (got[1], got[2])
+        if parts[0] != "self" and not info["mod_alias"].get(parts[0]):
+            return self.unique_methods.get(parts[-1])
+        return None
+
+    def _resolve_path(self, rel, parts):
+        """Resolve a dotted name path: descend through modules, stopping
+        at a function, class, or class method.  Returns a ('func'|'class'
+        |'module', rel, name) tuple, ('method', rel, qual), or None."""
+        got = self._resolve_symbol(rel, parts[0])
+        i = 1
+        while got is not None and i < len(parts):
+            kind, r2, n2 = got
+            if kind == "module":
+                got = self._resolve_symbol(r2, parts[i])
+                i += 1
+            elif kind == "class" and i == len(parts) - 1:
+                if parts[i] in self.files[r2]["classes"].get(
+                        n2, {}).get("methods", ()):
+                    return ("method", r2, f"{n2}.{parts[i]}")
+                return None
+            else:
+                return None
+        return got
+
+    # -- lock-context propagation -------------------------------------------
+    def always_locked(self, rel, qual):
+        """True when EVERY project call chain reaching (rel, qual) holds a
+        lock at the boundary — the caller-holds-lock proof."""
+        self.link()
+        return self._always_locked((rel, qual), set())[0]
+
+    def _always_locked(self, fid, stack):
+        """(verdict, provisional).  `provisional` marks a verdict that
+        leaned on the optimistic in-cycle assumption for a node still on
+        the evaluation stack — correct for the OUTERMOST query (greatest
+        fixpoint: a cycle whose only external entries are locked is
+        locked) but NOT memoizable: the assumed node may yet resolve
+        unlocked, and a cached optimistic True would silently discharge
+        a real lock-free mutation.  False is never provisional — the
+        optimism only pushes verdicts toward True."""
+        if fid in self._locked_memo:
+            return self._locked_memo[fid], False
+        if fid in stack:
+            return True, True  # optimistic on cycles: outer entries decide
+        sites = self.callers.get(fid)
+        if not sites:
+            self._locked_memo[fid] = False
+            return False, False
+        stack.add(fid)
+        ok, provisional = True, False
+        for caller, locked in sites:
+            if locked:
+                continue
+            v, p = self._always_locked(caller, stack)
+            if not v:
+                ok, provisional = False, False
+                break
+            provisional = provisional or p
+        stack.discard(fid)
+        if not provisional:
+            self._locked_memo[fid] = ok
+        return ok, provisional
+
+    def unlocked_entry_chain(self, rel, qual):
+        """One call chain entry→…→(rel, qual) holding no lock, for the
+        finding message; [] when none is known (no callers at all)."""
+        self.link()
+        seen = set()
+
+        def walk(fid, chain):
+            if fid in seen:
+                return None
+            seen.add(fid)
+            sites = self.callers.get(fid)
+            if not sites:
+                return chain  # an entry point with no (known) callers
+            for caller, locked in sites:
+                if locked:
+                    continue
+                got = walk(caller, [caller[1]] + chain)
+                if got is not None:
+                    return got
+            return None
+
+        got = walk((rel, qual), [])
+        return got or []
+
+    # -- hot-path reachability ----------------------------------------------
+    def _hot_map(self):
+        self.link()
+        if self._hot is not None:
+            return self._hot
+        hot = {}
+        queue = []
+        for rel, info in self.files.items():
+            for root_rel, root_qual in HOT_ROOTS:
+                if rel.endswith(root_rel) and root_qual in info["functions"]:
+                    fid = (rel, root_qual)
+                    hot[fid] = [f"{rel}::{root_qual}"]
+                    queue.append(fid)
+        while queue:
+            fid = queue.pop(0)
+            for r2, q2, _ in self.edges.get(fid, ()):
+                tgt = (r2, q2)
+                if tgt not in hot:
+                    hot[tgt] = hot[fid] + [q2]
+                    queue.append(tgt)
+        self._hot = hot
+        return hot
+
+    def hot_chain(self, rel, qual):
+        """The call chain from a hot-path root to (rel, qual), or None
+        when the function is not reachable from any root."""
+        return self._hot_map().get((rel, qual))
+
+    # -- one-hop helper summaries -------------------------------------------
+    def callee_summary(self, rel, caller_qual, text):
+        """Resolve a call-site text and return (rel2, qual2, summary) of
+        the target, or None."""
+        self.link()
+        tgt = self.resolve_call(rel, caller_qual, text)
+        if tgt is None:
+            return None
+        fs = self._function(*tgt)
+        return (tgt[0], tgt[1], fs) if fs is not None else None
+
+    # -- emitter alias closure ----------------------------------------------
+    def emitter_aliases(self, rel, home_rel, emitters):
+        """(module-alias names, function-alias names) in `rel` that
+        resolve — transitively through project re-exports — to the
+        catalog's home module (`home_rel`, e.g. tpu_mx/telemetry.py) or
+        its emitter functions."""
+        self.link()
+        key = (rel, home_rel)
+        if key in self._emit_memo:
+            return self._emit_memo[key]
+        mods, funcs = set(), set()
+        info = self.files.get(rel)
+        if info is None:
+            self._emit_memo[key] = (mods, funcs)
+            return mods, funcs
+        names = set(info["mod_alias"]) | set(info["from_imports"])
+        for name in names:
+            got = self._resolve_symbol(rel, name)
+            if got is None:
+                # absolute alias to a module outside the scan set roots
+                mod = info["mod_alias"].get(name)
+                if mod is not None and self.module_map.get(mod) == home_rel:
+                    mods.add(name)
+                continue
+            kind, r2, n2 = got
+            if kind == "module" and r2 == home_rel:
+                mods.add(name)
+            elif kind == "func" and r2 == home_rel and n2 in emitters:
+                funcs.add(name)
+        self._emit_memo[key] = (mods, funcs)
+        return mods, funcs
+
+    # -- serialization + dirty-region computation ---------------------------
+    def to_json(self):
+        return {"format": INDEX_FORMAT, "files": self.files}
+
+    @classmethod
+    def from_json(cls, payload):
+        if not isinstance(payload, dict) \
+                or payload.get("format") != INDEX_FORMAT:
+            return None  # a stale/foreign cache rebuilds, never crashes
+        files = payload.get("files")
+        if not isinstance(files, dict):
+            return None
+        return cls(dict(files))
+
+    def file_edges(self):
+        """File-level call-graph edges {rel -> set(rel2)}."""
+        self.link()
+        out = {rel: set() for rel in self.files}
+        for (rel, _), tgts in self.edges.items():
+            for r2, _, _ in tgts:
+                if r2 != rel:
+                    out[rel].add(r2)
+        return out
+
+    def dirty_region(self, changed):
+        """Files whose analysis verdicts may change when `changed` files
+        change: the changed files, their file-level strongly-connected
+        components, and direct callers/callees (lock proofs and
+        reachability look one resolution step across a file boundary;
+        deeper effects are what the full CI run covers)."""
+        self.link()
+        fwd = self.file_edges()
+        rev = {rel: set() for rel in self.files}
+        for rel, tgts in fwd.items():
+            for t in tgts:
+                rev.setdefault(t, set()).add(rel)
+        region = {c for c in changed if c in self.files}
+        # SCC membership via forward∩backward reachability from each seed
+        for seed in list(region):
+            down = self._bfs(seed, fwd)
+            up = self._bfs(seed, rev)
+            region |= (down & up)
+        for seed in list(region):
+            region |= fwd.get(seed, set())
+            region |= rev.get(seed, set())
+        return region
+
+    @staticmethod
+    def _bfs(seed, graph):
+        seen, queue = {seed}, [seed]
+        while queue:
+            cur = queue.pop(0)
+            for nxt in graph.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    queue.append(nxt)
+        return seen
+
+
+def build_index(ctxs):
+    """Phase 1 over parsed files: {relpath: FileCtx} -> linked index."""
+    idx = ProjectIndex()
+    for rel, ctx in ctxs.items():
+        idx.add_file(rel, summarize_file(ctx))
+    return idx.link()
+
+
+def read_index(path):
+    """Load the serialized index cache; None when absent/stale-format."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return ProjectIndex.from_json(payload)
+
+
+def write_index(path, index):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(index.to_json(), f, sort_keys=True)
+        f.write("\n")

@@ -4,34 +4,37 @@
 PRs 2-5 established hard runtime contracts that, until now, were enforced
 only dynamically — by whichever chaos/soak/obs CI schedule happened to
 execute the offending branch.  This tool makes them checkable at review
-time on EVERY line, including cold error paths no fault schedule reaches:
+time on EVERY line, including cold error paths no fault schedule reaches.
+
+Since ISSUE 10 the linter is a **two-phase analyzer**: phase 1 builds a
+project-wide index — symbol table, call graph, per-function summaries
+(locks held at call sites, implicit syncs, raw parameter writes, jit
+boundaries) — and phase 2 runs the rule passes against it:
 
 - **durability** — every state write must go through
-  ``checkpoint.atomic_write`` (a raw ``open(path, "w"/"wb")``,
-  ``pickle.dump`` or ``np.save`` of a state-shaped path can be torn by a
-  preemption and then loaded as garbage; docs/robustness.md).
+  ``checkpoint.atomic_write``; with the index, a wrapper around
+  ``open(path, "w")`` is caught one helper hop away.
 - **determinism** — library RNG must flow through ``tpu_mx/random.py``'s
-  process-global state (a stray ``np.random.*`` draw or fresh
-  ``jax.random.PRNGKey`` stream silently escapes the PR-5 resume
-  capsules, so a "bit-exact" resume isn't).
-- **sync-point** — no implicit device→host syncs (``asnumpy``,
-  ``.item()``, ``float()`` on an array) inside the hot paths: fusion
-  segment construction, the compiled train step, optimizer updates.
-  Hidden syncs are exactly what breaks fusion segments and pipelining
-  ("Operator Fusion in XLA", PAPERS.md).
-- **concurrency** — ``threading.Thread`` must be explicit about
-  lifetime (``daemon=`` or a join), and an attribute guarded by a lock
-  at some sites must not be mutated lock-free at others (the class of
-  bug behind PR 4's zombie-step fix).
-- **telemetry-catalog** — metric-name literals at
-  counter/gauge/histogram/span call sites must be in
-  ``telemetry.KNOWN_METRICS`` (catches names in branches the runtime
-  obs tier never executes; an unknown name is invisible to every
-  dashboard).
+  process-global state.
+- **sync-point** — no implicit device→host syncs inside the hot paths;
+  with the index, a helper hiding the ``.item()`` is flagged at the
+  call site.
+- **concurrency** — thread lifetime + lock discipline; with the index,
+  lock context propagates through the call graph, so caller-holds-lock
+  helpers are PROVEN safe (no suppression needed) or flagged with a
+  lock-free witness chain.
+- **telemetry-catalog** — metric/event name literals at emission sites
+  must be in ``telemetry.KNOWN_METRICS`` / ``tracing.KNOWN_EVENTS``,
+  including sites reached via re-exported aliases across modules.
+- **hot-path-purity** — no eager host↔device traffic (``jnp.asarray``
+  outside a jit, ``np.asarray`` of device values, ``.item()``,
+  per-call ``jax.jit`` construction) reachable from the decode/train/
+  fusion hot-path roots through ANY helper chain — the PR-9 decode
+  cliff (~73 µs per eager operand) is a lint error now.
 
 Zero third-party dependencies: pure ``ast`` + stdlib, and the metric
 catalog is extracted *statically* from ``tpu_mx/telemetry.py`` (the tool
-never imports the package, so it runs in <1s with no jax in sight).
+never imports the package, so it runs with no jax in sight).
 
 Suppressions: ``# tpumx-lint: disable=<rule>[,<rule>...] [-- reason]``
 on the finding's line, or on a comment-only line directly above it.
@@ -47,1099 +50,34 @@ Usage::
 
     python tools/tpumx_lint.py                  # lint the default tree
     python tools/tpumx_lint.py --format json    # machine-readable (CI)
+    python tools/tpumx_lint.py --changed-only   # git-dirty region only
     python tools/tpumx_lint.py --write-baseline # accept current findings
     python tools/tpumx_lint.py path.py ...      # explicit file set
 
 Exit status: 0 when every finding is suppressed or baselined, 1
 otherwise, 2 on usage/internal error.  See docs/static_analysis.md for
 the rule catalog and how to add a pass.
+
+The implementation lives in the ``tools/lint/`` package (core / index /
+passes / cli); this module is the stable entry point and import surface.
 """
 from __future__ import annotations
 
-import argparse
-import ast
-import fnmatch
-import hashlib
-import json
 import os
-import re
 import sys
 
-LINT_FORMAT = "tpumx-lint-baseline-v1"
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-# the default scan set (ISSUE 6): the library, the tools, the bench driver
-DEFAULT_TARGETS = ("tpu_mx", "tools", "bench.py")
-
-_SUPPRESS_RE = re.compile(
-    r"#\s*tpumx-lint:\s*disable="
-    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
-
-
-# ---------------------------------------------------------------------------
-# findings
-# ---------------------------------------------------------------------------
-class Finding:
-    """One rule violation at a source location."""
-
-    __slots__ = ("rule", "path", "line", "col", "message", "context",
-                 "line_text")
-
-    def __init__(self, rule, path, line, col, message, context="",
-                 line_text=""):
-        self.rule = rule
-        self.path = path            # repo-relative, forward slashes
-        self.line = line            # 1-based
-        self.col = col              # 0-based
-        self.message = message
-        self.context = context      # enclosing Class.def qualname ("" = module)
-        self.line_text = line_text
-
-    def fingerprint(self):
-        """Stable identity for baselining: hashes the rule, file, enclosing
-        scope and the normalized source line — NOT the line number, so
-        unrelated edits above a baselined finding don't resurrect it."""
-        norm = " ".join(self.line_text.split())
-        raw = "|".join((self.rule, self.path, self.context, norm))
-        return hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
-
-    def as_dict(self):
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "col": self.col, "message": self.message,
-                "context": self.context, "fingerprint": self.fingerprint()}
-
-    def render(self):
-        return (f"{self.path}:{self.line}:{self.col + 1}: "
-                f"[{self.rule}] {self.message}")
-
-
-# ---------------------------------------------------------------------------
-# per-file context shared by every pass
-# ---------------------------------------------------------------------------
-class FileCtx:
-    """Parsed file + the lookups the passes share: source lines, a
-    node→enclosing-scope map, and the module's import aliases."""
-
-    def __init__(self, path, source):
-        self.path = path.replace(os.sep, "/")
-        self.source = source
-        self.lines = source.splitlines()
-        self.tree = ast.parse(source, filename=path)
-        self.scope = {}        # id(node) -> "Class.method" qualname
-        self.func_of = {}      # id(node) -> nearest FunctionDef node (or None)
-        self.class_of = {}     # id(node) -> nearest ClassDef node (or None)
-        self._index_scopes()
-        # import aliases: local name -> dotted module it refers to
-        self.mod_alias = {}    # e.g. {"np": "numpy", "_telemetry": "...telemetry"}
-        self.from_imports = {} # local name -> (module, original name)
-        self._index_imports()
-
-    def _index_scopes(self):
-        def walk(node, qual, func, klass):
-            for child in ast.iter_child_nodes(node):
-                q, f, k = qual, func, klass
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    q = f"{qual}.{child.name}" if qual else child.name
-                    f = child
-                elif isinstance(child, ast.ClassDef):
-                    q = f"{qual}.{child.name}" if qual else child.name
-                    k = child
-                self.scope[id(child)] = qual
-                self.func_of[id(child)] = func
-                self.class_of[id(child)] = klass
-                walk(child, q, f, k)
-        walk(self.tree, "", None, None)
-
-    def _index_imports(self):
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    self.mod_alias[a.asname or a.name.split(".")[0]] = a.name
-            elif isinstance(node, ast.ImportFrom):
-                mod = ("." * node.level) + (node.module or "")
-                for a in node.names:
-                    self.from_imports[a.asname or a.name] = (mod, a.name)
-
-    def qualname(self, node):
-        return self.scope.get(id(node), "")
-
-    def line_text(self, lineno):
-        if 1 <= lineno <= len(self.lines):
-            return self.lines[lineno - 1]
-        return ""
-
-    def finding(self, rule, node, message):
-        return Finding(rule, self.path, node.lineno, node.col_offset,
-                       message, context=self.qualname(node),
-                       line_text=self.line_text(node.lineno))
-
-
-# ---------------------------------------------------------------------------
-# small AST helpers
-# ---------------------------------------------------------------------------
-def dotted(node):
-    """'a.b.c' for a Name/Attribute chain, else None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def call_name(call):
-    return dotted(call.func)
-
-
-def const_str(node):
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
-
-
-def strings_in(node):
-    """Every string constant anywhere inside `node` (e.g. both arms of a
-    conditional mode expression)."""
-    return [n.value for n in ast.walk(node)
-            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
-
-
-def expr_text(node):
-    try:
-        return ast.unparse(node)
-    except Exception:  # pragma: no cover — unparse handles all real exprs
-        return ""
-
-
-def _numpy_names(ctx):
-    """Local aliases that refer to the host numpy module."""
-    return {alias for alias, mod in ctx.mod_alias.items()
-            if mod in ("numpy", "numpy.random")} | {"np", "onp", "_np"}
-
-
-# ---------------------------------------------------------------------------
-# rule passes
-# ---------------------------------------------------------------------------
-class Pass:
-    """One rule pass.  Subclasses set `name` and implement `run(ctx)`
-    yielding Findings.  Adding a pass = subclass + append to PASSES
-    (docs/static_analysis.md walks through an example)."""
-
-    name = None
-
-    def run(self, ctx):  # pragma: no cover — interface
-        raise NotImplementedError
-
-
-class DurabilityPass(Pass):
-    """Raw state writes that bypass checkpoint.atomic_write.
-
-    Flags, in library code (``tpu_mx/``): any ``open(path, "w"/"wb")``,
-    any ``pickle.dump(obj, file)``, and ``np.save/np.savez`` to anything
-    not provably an in-memory buffer.  In ``tools/``/``bench.py`` only
-    *state-shaped* paths are flagged (ones whose expression mentions
-    checkpoints/params/states/manifests) — report files there are not
-    recovery state.  ``atomic_write``'s own internal ``open`` is the one
-    structural allowlist: it IS the durability layer.
-    """
-
-    name = "durability"
-
-    STATE_HINTS = ("params", "states", "checkpoint", "ckpt", "manifest",
-                   "capsule", "lastgood")
-
-    def _is_library(self, ctx):
-        return ctx.path.startswith("tpu_mx/")
-
-    def _state_shaped(self, arg):
-        text = expr_text(arg).lower()
-        return any(h in text for h in self.STATE_HINTS)
-
-    def _in_scope(self, ctx, path_arg):
-        return self._is_library(ctx) or self._state_shaped(path_arg)
-
-    def _bytesio_fed(self, ctx, call, arg):
-        """True when `arg` is (or is assigned from) an io.BytesIO — an
-        in-memory sink, no durability contract applies."""
-        if any("BytesIO" in (dotted(n) or "")
-               for n in ast.walk(arg) if isinstance(n, (ast.Name, ast.Attribute))):
-            return True
-        if isinstance(arg, ast.Name):
-            func = ctx.func_of.get(id(call))
-            search = func if func is not None else ctx.tree
-            for node in ast.walk(search):
-                if isinstance(node, ast.Assign) and any(
-                        isinstance(t, ast.Name) and t.id == arg.id
-                        for t in node.targets):
-                    if "BytesIO" in expr_text(node.value):
-                        return True
-        return False
-
-    def run(self, ctx):
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = call_name(node)
-            # --- open(path, "w"/"wb") --------------------------------
-            if fn == "open" and node.args:
-                func = ctx.func_of.get(id(node))
-                if func is not None and func.name == "atomic_write":
-                    continue  # the durability layer's own tmp-file open
-                mode = None
-                if len(node.args) >= 2:
-                    mode = node.args[1]
-                for kw in node.keywords:
-                    if kw.arg == "mode":
-                        mode = kw.value
-                if mode is None:
-                    continue  # default "r"
-                modes = strings_in(mode)
-                if not any(m.startswith("w") for m in modes):
-                    continue
-                if not self._in_scope(ctx, node.args[0]):
-                    continue
-                yield ctx.finding(
-                    self.name, node,
-                    f"raw open({expr_text(node.args[0])}, "
-                    f"{'/'.join(sorted(set(modes)))}) write bypasses "
-                    "checkpoint.atomic_write — a crash mid-write leaves a "
-                    "truncated destination (docs/robustness.md)")
-            # --- pickle.dump(obj, file) ------------------------------
-            elif fn is not None and fn.endswith("pickle.dump"):
-                if not self._is_library(ctx) and not (
-                        len(node.args) >= 2
-                        and self._state_shaped(node.args[1])):
-                    continue
-                yield ctx.finding(
-                    self.name, node,
-                    "pickle.dump to a raw file handle bypasses "
-                    "checkpoint.atomic_write — use pickle.dumps + "
-                    "atomic_write so the commit is all-or-nothing")
-            # --- np.save / np.savez(path, ...) -----------------------
-            elif fn is not None and node.args and any(
-                    fn == f"{alias}.{save}"
-                    for alias in _numpy_names(ctx)
-                    for save in ("save", "savez", "savez_compressed")):
-                sink = node.args[0]
-                if self._bytesio_fed(ctx, node, sink):
-                    continue  # in-memory serialize-then-atomic_write idiom
-                if not self._in_scope(ctx, sink):
-                    continue
-                yield ctx.finding(
-                    self.name, node,
-                    f"{fn}({expr_text(sink)}, ...) writes state in place — "
-                    "serialize to BytesIO and commit via "
-                    "checkpoint.atomic_write")
-
-
-class DeterminismPass(Pass):
-    """Library RNG outside the tpu_mx.random process-global state.
-
-    Flags, in ``tpu_mx/`` (the framework's own ``random.py`` excepted):
-    draws/seeds on numpy's global stream (``np.random.rand`` etc. —
-    route through ``tpu_mx.random.host_rng()`` so the dependence on the
-    capsule-covered stream is explicit), fresh ``jax.random.PRNGKey``
-    streams (escape the capsule entirely), entropy-seeded
-    ``RandomState()``/``default_rng()`` (irreproducible by
-    construction), and time-seeded RNG anywhere.  A *seeded* private
-    ``RandomState(seed)`` is NOT flagged — that is the blessed pattern
-    for iterators that snapshot their own stream via ``state_dict()``.
-    """
-
-    name = "determinism"
-
-    GLOBAL_DRAWS = frozenset({
-        "seed", "rand", "randn", "randint", "random", "random_sample",
-        "ranf", "sample", "uniform", "normal", "standard_normal",
-        "shuffle", "permutation", "choice", "beta", "gamma", "binomial",
-        "multinomial", "poisson", "exponential", "laplace", "bytes",
-    })
-    SEEDED_CTORS = ("RandomState", "default_rng")
-
-    def _library(self, ctx):
-        return (ctx.path.startswith("tpu_mx/")
-                and ctx.path != "tpu_mx/random.py")
-
-    @staticmethod
-    def _has_seed_arg(call):
-        """True when the RNG constructor receives a non-None seed, either
-        positionally or as a keyword (RandomState(seed=7))."""
-        if call.args and not (isinstance(call.args[0], ast.Constant)
-                              and call.args[0].value is None):
-            return True
-        return any(not (isinstance(kw.value, ast.Constant)
-                        and kw.value.value is None)
-                   for kw in call.keywords if kw.arg is not None)
-
-    def _time_seeded(self, node):
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Call):
-                d = call_name(sub) or ""
-                if d in ("time.time", "time.time_ns", "time.monotonic",
-                         "time.perf_counter"):
-                    return True
-        return False
-
-    def run(self, ctx):
-        lib = self._library(ctx)
-        np_names = _numpy_names(ctx)
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = call_name(node)
-            if fn is None:
-                continue
-            parts = fn.split(".")
-            # time-seeded RNG is wrong EVERYWHERE (tools included): the
-            # run is irreproducible and the seed is unrecorded.  Both
-            # positional and keyword (seed=time.time()) spellings count.
-            seedish = list(node.args) + [kw.value for kw in node.keywords]
-            if (parts[-1] in ("seed", "PRNGKey", "key", "Random")
-                    + self.SEEDED_CTORS
-                    and any(self._time_seeded(a) for a in seedish)):
-                yield ctx.finding(
-                    self.name, node,
-                    f"{fn} seeded from wall-clock time — the stream is "
-                    "unrecorded and can never be replayed by a resume "
-                    "capsule; derive the seed from tpu_mx.random or config")
-                continue
-            if not lib:
-                continue
-            # np.random.<draw> on the GLOBAL numpy stream
-            if (len(parts) >= 3 and parts[-2] == "random"
-                    and parts[-3] in np_names
-                    and parts[-1] in self.GLOBAL_DRAWS):
-                yield ctx.finding(
-                    self.name, node,
-                    f"direct {fn} draws from numpy's global stream — "
-                    "route through tpu_mx.random.host_rng() (the "
-                    "capsule-covered stream) or a private seeded "
-                    "RandomState with state_dict coverage")
-            # fresh jax PRNGKey/typed-key stream outside tpu_mx/random.py
-            # (jax.random.key is the current recommended constructor —
-            # same capsule-escape as the legacy PRNGKey)
-            elif parts[-1] == "PRNGKey" or (
-                    len(parts) >= 2 and parts[-2] == "random"
-                    and parts[-1] == "key"):
-                yield ctx.finding(
-                    self.name, node,
-                    f"fresh {parts[-1]} stream escapes the "
-                    "process-global tpu_mx.random state — resume capsules "
-                    "cannot replay it; use tpu_mx.random.take_key()")
-            # entropy-seeded private streams (a seed passed positionally
-            # OR as seed=/... keyword makes the stream reproducible)
-            elif parts[-1] in self.SEEDED_CTORS and (
-                    len(parts) < 3 or parts[-2] == "random") and (
-                    not self._has_seed_arg(node)):
-                yield ctx.finding(
-                    self.name, node,
-                    f"{fn} with no seed draws OS entropy — the stream is "
-                    "irreproducible; seed it from config or "
-                    "tpu_mx.random")
-
-
-class SyncPointPass(Pass):
-    """Implicit device→host syncs inside the hot paths.
-
-    Hot scopes: ``tpu_mx/fusion.py`` and ``tpu_mx/parallel/train_step.py``
-    (whole files — segment construction and the step dispatch path), and
-    optimizer ``update*``/``create_state*`` bodies.  Flags ``.asnumpy()``
-    / ``.item()`` / ``.tolist()`` / ``jax.device_get`` /
-    host-``np.asarray(...)`` calls, and ``float()/bool()/int()`` applied
-    to a call or subscript result (an array reduction like
-    ``float(loss.mean())`` blocks dispatch; ``float(self.lr)`` on plain
-    attributes stays silent).  Explicit syncs (``wait_to_read``,
-    ``block_until_ready``) are allowed — the contract is that a sync must
-    be *visible*, not that it never happens.
-    """
-
-    name = "sync-point"
-
-    HOT_FILES = ("tpu_mx/fusion.py", "tpu_mx/parallel/train_step.py")
-    HOT_FUNC_FILES = ("tpu_mx/optimizer/", )
-    HOT_FUNC_PREFIXES = ("update", "_update", "create_state", "step")
-    IMPLICIT = ("asnumpy", "item", "tolist", "asscalar")
-    # method-style array reductions: float(loss.mean()) blocks on device.
-    # Module-level host calls (np.prod(shape)) and dict methods (.get)
-    # are host work — the nearest legitimate look-alikes, left silent.
-    REDUCTIONS = frozenset({"mean", "sum", "max", "min", "norm", "prod",
-                            "all", "any", "dot"})
-
-    def _hot(self, ctx, node):
-        if ctx.path in self.HOT_FILES:
-            return True
-        if any(ctx.path.startswith(p) for p in self.HOT_FUNC_FILES):
-            func = ctx.func_of.get(id(node))
-            while func is not None:
-                if any(func.name.startswith(p)
-                       for p in self.HOT_FUNC_PREFIXES):
-                    return True
-                func = ctx.func_of.get(id(func))
-        return False
-
-    def run(self, ctx):
-        hot_possible = (ctx.path in self.HOT_FILES
-                        or any(ctx.path.startswith(p)
-                               for p in self.HOT_FUNC_FILES))
-        if not hot_possible:
-            return
-        np_names = _numpy_names(ctx)
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call) or not self._hot(ctx, node):
-                continue
-            fn = call_name(node)
-            if (isinstance(node.func, ast.Attribute)
-                    and node.func.attr in self.IMPLICIT
-                    and not node.args and not node.keywords):
-                yield ctx.finding(
-                    self.name, node,
-                    f".{node.func.attr}() forces a device→host sync on the "
-                    "hot path — it stalls dispatch and flushes/splits any "
-                    "fusion segment; hoist it out or make the sync "
-                    "explicit at the loop level")
-            elif fn == "jax.device_get" or (
-                    fn is not None and "." in fn
-                    and fn.split(".")[0] in np_names
-                    and fn.split(".")[-1] in ("asarray", "array")
-                    and ctx.path in self.HOT_FILES):
-                yield ctx.finding(
-                    self.name, node,
-                    f"{fn}(...) copies device memory to host on the hot "
-                    "path — an implicit sync; keep data on device "
-                    "(jnp.asarray) or sync explicitly outside the step")
-            elif (isinstance(node.func, ast.Name)
-                  and node.func.id in ("float", "bool", "int")
-                  and node.args
-                  and isinstance(node.args[0], ast.Call)
-                  and isinstance(node.args[0].func, ast.Attribute)
-                  and node.args[0].func.attr in self.REDUCTIONS
-                  and not (isinstance(node.args[0].func.value, ast.Name)
-                           and node.args[0].func.value.id in np_names)):
-                yield ctx.finding(
-                    self.name, node,
-                    f"{node.func.id}({expr_text(node.args[0])}) on the hot "
-                    "path blocks until the device value materializes — an "
-                    "implicit sync point; read it back outside the step "
-                    "or keep the value on device")
-
-
-class ConcurrencyPass(Pass):
-    """Thread-lifetime and lock-discipline contracts.
-
-    (a) ``threading.Thread(...)`` must pass an explicit ``daemon=``; a
-    non-daemon thread must additionally be ``.join()``-ed somewhere in
-    the file (otherwise interpreter shutdown can hang on it — the
-    watchdog/generation discipline from PR 4).
-    (b) Per class: a ``self.X`` attribute that is assigned under a
-    ``with self.<lock>:`` block at ANY site must not be assigned
-    lock-free at another site (``__init__`` excepted — before the object
-    escapes, no thread can see it).  Mixed discipline is exactly the
-    zombie-step class of race.
-    (c) Per MODULE: a module-level global that is assigned/mutated under
-    a ``with <module_lock>:`` block at ANY site must not be mutated
-    lock-free in another function (module top level — import time,
-    single-threaded — excepted).  The ``checkpoint._intended`` /
-    ``_intended_lock`` shape, and the serving KV-cache free list's:
-    the PR-6 linter only saw class-scoped pairs (ROADMAP limitation,
-    closed in ISSUE 8).  Covered mutations: ``global X; X = ...``,
-    ``X[...] = ...`` and ``X.attr = ...`` where X is a module-level
-    name (plus their aug/annotated forms); method CALLS
-    (``X.append(...)``) are not assignments and stay out of scope —
-    lexical analysis, same bar as the class rule.
-    """
-
-    name = "concurrency"
-
-    def run(self, ctx):
-        yield from self._threads(ctx)
-        yield from self._lock_discipline(ctx)
-        yield from self._module_lock_discipline(ctx)
-
-    @staticmethod
-    def _thread_joins(ctx):
-        """Receiver texts of `<expr>.join(...)` calls that can plausibly
-        be thread joins — string `", ".join` and `os.path.join` (any
-        path-module join) are excluded, so they cannot satisfy the
-        non-daemon rule vacuously."""
-        joins = set()
-        for node in ast.walk(ctx.tree):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "join"):
-                recv = node.func.value
-                if isinstance(recv, ast.Constant):
-                    continue  # ", ".join(...)
-                text = expr_text(recv)
-                if text.endswith("path") or ".path" in text:
-                    continue  # os.path.join / posixpath.join
-                joins.add(text)
-        return joins
-
-    def _threads(self, ctx):
-        joins = self._thread_joins(ctx)
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = call_name(node)
-            if fn is None:
-                continue
-            if fn.endswith("threading.Thread"):
-                pass
-            elif isinstance(node.func, ast.Name):
-                # `from threading import Thread [as T]` — resolve the
-                # alias; a class merely NAMED Thread from elsewhere is
-                # not ours
-                mod, orig = ctx.from_imports.get(node.func.id, ("", ""))
-                if orig != "Thread" or mod.split(".")[-1] != "threading":
-                    continue
-            else:
-                continue
-            daemon = None
-            for kw in node.keywords:
-                if kw.arg == "daemon":
-                    daemon = kw.value
-            if daemon is None:
-                yield ctx.finding(
-                    self.name, node,
-                    "threading.Thread without an explicit daemon= — "
-                    "decide the lifetime: daemon=True (watchdog-style, "
-                    "may die mid-write) or daemon=False with a join")
-            elif (isinstance(daemon, ast.Constant)
-                  and daemon.value is False and not joins):
-                yield ctx.finding(
-                    self.name, node,
-                    "non-daemon Thread with no .join() anywhere in this "
-                    "file — interpreter shutdown will hang on it")
-
-    def _is_lock_with(self, item):
-        d = dotted(item.context_expr) or ""
-        return d.startswith("self.") and "lock" in d.lower()
-
-    @staticmethod
-    def _flat_targets(node):
-        # Assign has .targets; AugAssign and AnnAssign have one .target
-        targets = node.targets if isinstance(node, ast.Assign) \
-            else [node.target]
-        flat = []
-        for t in targets:
-            if isinstance(t, (ast.Tuple, ast.List)):
-                flat.extend(t.elts)
-            else:
-                flat.append(t)
-        return flat
-
-    def _lock_discipline(self, ctx):
-        for klass in ast.walk(ctx.tree):
-            if not isinstance(klass, ast.ClassDef):
-                continue
-            guarded = {}    # attr -> first guarded-assign node
-            unguarded = {}  # attr -> [unguarded-assign nodes]
-
-            def visit(node, locked, in_init):
-                for child in ast.iter_child_nodes(node):
-                    if isinstance(child, ast.ClassDef):
-                        continue  # nested class: analyzed on its own
-                    if isinstance(child, (ast.FunctionDef,
-                                          ast.AsyncFunctionDef)):
-                        # a direct method's nearest enclosing function is
-                        # the class's own (None at module level); anything
-                        # deeper is a closure inside a method
-                        direct = (ctx.class_of.get(id(child)) is klass
-                                  and ctx.func_of.get(id(child))
-                                  is ctx.func_of.get(id(klass)))
-                        # a function DEFINED under a lock does not RUN
-                        # under it; a closure inside __init__ still runs
-                        # during construction (keeps in_init)
-                        visit(child, False,
-                              child.name == "__init__" if direct
-                              else in_init)
-                        continue
-                    child_locked = locked
-                    if isinstance(child, ast.With) and any(
-                            self._is_lock_with(i) for i in child.items):
-                        child_locked = True
-                    if isinstance(child, (ast.Assign, ast.AugAssign,
-                                          ast.AnnAssign)) and not (
-                            isinstance(child, ast.AnnAssign)
-                            and child.value is None):  # bare annotation
-                        for t in self._flat_targets(child):
-                            d = dotted(t) or ""
-                            if not d.startswith("self.") or d.count(".") != 1:
-                                continue
-                            attr = d.split(".", 1)[1]
-                            if locked:
-                                guarded.setdefault(attr, child)
-                            elif not in_init:
-                                unguarded.setdefault(attr, []).append(child)
-                    visit(child, child_locked, in_init)
-
-            visit(klass, False, False)
-            for attr, sites in unguarded.items():
-                if attr not in guarded:
-                    continue
-                g = guarded[attr]
-                for site in sites:
-                    yield ctx.finding(
-                        self.name, site,
-                        f"self.{attr} is assigned under a lock at "
-                        f"{ctx.path}:{g.lineno} but lock-free here — "
-                        "mixed discipline races exactly like the PR-4 "
-                        "zombie-step bug; take the lock (or document why "
-                        "this site is single-threaded)")
-
-
-    # -- (c) module-level lock/global discipline -----------------------------
-    def _is_module_lock_with(self, item):
-        d = dotted(item.context_expr) or ""
-        return d and not d.startswith("self.") and "lock" in d.lower()
-
-    @staticmethod
-    def _locals_of(fn):
-        """(local names, declared globals) of a function: parameters plus
-        bare-Name assignment/loop targets anywhere inside (nested scopes
-        included — over-approximating locals under-approximates findings,
-        the safe direction for a lexical rule)."""
-        if fn is None:
-            return frozenset(), frozenset()
-        args = fn.args
-        params = {a.arg for a in (args.args + args.kwonlyargs
-                                  + getattr(args, "posonlyargs", []))}
-        if args.vararg:
-            params.add(args.vararg.arg)
-        if args.kwarg:
-            params.add(args.kwarg.arg)
-        declared_global, assigned = set(), set()
-        for n in ast.walk(fn):
-            if isinstance(n, ast.Global):
-                declared_global.update(n.names)
-            elif isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-                for t in ConcurrencyPass._flat_targets(n):
-                    if isinstance(t, ast.Name):
-                        assigned.add(t.id)
-            elif isinstance(n, (ast.For, ast.AsyncFor)):
-                for t in ast.walk(n.target):
-                    if isinstance(t, ast.Name):
-                        assigned.add(t.id)
-            elif isinstance(n, ast.comprehension):
-                for t in ast.walk(n.target):
-                    if isinstance(t, ast.Name):
-                        assigned.add(t.id)
-            elif isinstance(n, (ast.With, ast.AsyncWith)):
-                for item in n.items:
-                    if item.optional_vars is not None:
-                        for t in ast.walk(item.optional_vars):
-                            if isinstance(t, ast.Name):
-                                assigned.add(t.id)
-        return params | (assigned - declared_global), declared_global
-
-    def _module_lock_discipline(self, ctx):
-        mod_globals = set()
-        for node in ctx.tree.body:
-            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-                for t in self._flat_targets(node):
-                    if isinstance(t, ast.Name):
-                        mod_globals.add(t.id)
-        # names declared `global` anywhere also count (first assignment
-        # may happen inside a function)
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Global):
-                mod_globals.update(node.names)
-        if not mod_globals:
-            return
-        guarded = {}    # global name -> first guarded-mutation node
-        unguarded = {}  # global name -> [unguarded-mutation nodes]
-        locals_cache = {}
-
-        def target_global(t, fn):
-            """The module-global name this target mutates, or None."""
-            if id(fn) not in locals_cache:
-                locals_cache[id(fn)] = self._locals_of(fn)
-            local_names, declared_global = locals_cache[id(fn)]
-            if isinstance(t, ast.Name):
-                # a bare-name rebind targets the module global only
-                # under an explicit `global` declaration
-                return t.id if (t.id in declared_global
-                                and t.id in mod_globals) else None
-            node = t
-            while isinstance(node, (ast.Subscript, ast.Attribute)):
-                node = node.value
-            if isinstance(node, ast.Name) and node.id in mod_globals \
-                    and node.id not in local_names:
-                return node.id
-            return None
-
-        def visit(node, locked, exempt, fn):
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)):
-                    # function bodies run post-import (not exempt); a
-                    # function DEFINED under a lock does not RUN under it
-                    visit(child, False, False, child)
-                    continue
-                if isinstance(child, ast.ClassDef):
-                    # a class BODY executes at import time (exempt like
-                    # module level); its methods hit the branch above
-                    visit(child, False, exempt, fn)
-                    continue
-                child_locked = locked
-                if isinstance(child, (ast.With, ast.AsyncWith)) and any(
-                        self._is_module_lock_with(i) for i in child.items):
-                    child_locked = True
-                if isinstance(child, (ast.Assign, ast.AugAssign,
-                                      ast.AnnAssign)) and not (
-                        isinstance(child, ast.AnnAssign)
-                        and child.value is None):  # bare annotation
-                    for t in self._flat_targets(child):
-                        name = target_global(t, fn)
-                        if name is None:
-                            continue
-                        if locked:
-                            guarded.setdefault(name, child)
-                        elif not exempt:
-                            unguarded.setdefault(name, []).append(child)
-                visit(child, child_locked, exempt, fn)
-
-        visit(ctx.tree, False, True, None)
-        for name, sites in unguarded.items():
-            if name not in guarded:
-                continue
-            g = guarded[name]
-            for site in sites:
-                yield ctx.finding(
-                    self.name, site,
-                    f"module global {name!r} is mutated under a lock at "
-                    f"{ctx.path}:{g.lineno} but lock-free here — mixed "
-                    "discipline on module-level shared state (the "
-                    "checkpoint._intended shape); take the lock (or "
-                    "document why this site is single-threaded)")
-
-
-class TelemetryCatalogPass(Pass):
-    """Names at emission sites must be in their static catalog.
-
-    Two catalogs, one discipline (stable names are an API,
-    docs/observability.md): metric names at
-    ``<telemetry>.counter/gauge/histogram/span(...)`` call sites are
-    checked against ``telemetry.KNOWN_METRICS``, and flight-recorder
-    event names at ``<tracing>.emit(...)`` call sites against
-    ``tracing.KNOWN_EVENTS`` (any alias whose import resolves to the
-    respective module, or functions imported from it).  A literal name
-    outside the catalog — even in a branch the obs CI tier never
-    executes — fails; a non-literal name is flagged as unverifiable.
-    Each catalog's home module is exempt (it manipulates records
-    generically).
-    """
-
-    name = "telemetry-catalog"
-
-    EMITTERS = frozenset({"counter", "gauge", "histogram", "span"})
-    TRACE_EMITTERS = frozenset({"emit"})
-
-    def __init__(self, known_metrics, known_events=None):
-        self.known = known_metrics
-        self.known_events = known_events
-
-    @staticmethod
-    def _aliases(ctx, module, emitters):
-        mods = {alias for alias, mod in ctx.mod_alias.items()
-                if mod.split(".")[-1] == module}
-        # `from tpu_mx import telemetry [as _telemetry]` — the module is
-        # the imported NAME here, not the from-module path
-        mods |= {alias for alias, (_, name) in ctx.from_imports.items()
-                 if name == module}
-        funcs = {alias for alias, (mod, name) in ctx.from_imports.items()
-                 if name in emitters and mod.split(".")[-1] == module}
-        return mods, funcs
-
-    def _check(self, ctx, module, emitters, known, catalog_name):
-        if ctx.path == f"tpu_mx/{module}.py" or known is None:
-            return
-        mods, funcs = self._aliases(ctx, module, emitters)
-        if not mods and not funcs:
-            return
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            is_emit = False
-            if (isinstance(node.func, ast.Attribute)
-                    and node.func.attr in emitters
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id in mods):
-                is_emit = True
-            elif isinstance(node.func, ast.Name) and node.func.id in funcs:
-                is_emit = True
-            if not is_emit or not node.args:
-                continue
-            name = const_str(node.args[0])
-            if name is None:
-                yield ctx.finding(
-                    self.name, node,
-                    f"name {expr_text(node.args[0])!r} is not a string "
-                    f"literal — {catalog_name} cannot verify it "
-                    "statically; emit a literal name (labels/payload "
-                    "fields carry the dynamic part)")
-            elif name not in known:
-                yield ctx.finding(
-                    self.name, node,
-                    f'name "{name}" is not in {catalog_name} — '
-                    "dashboards and the black-box schema will never see "
-                    "it; add it to the catalog (and "
-                    "docs/observability.md) or fix the typo")
-
-    def run(self, ctx):
-        yield from self._check(ctx, "telemetry", self.EMITTERS,
-                               self.known, "telemetry.KNOWN_METRICS")
-        yield from self._check(ctx, "tracing", self.TRACE_EMITTERS,
-                               self.known_events, "tracing.KNOWN_EVENTS")
-
-
-# ---------------------------------------------------------------------------
-# catalog extraction (static — never imports tpu_mx)
-# ---------------------------------------------------------------------------
-def _load_catalog(repo, module, var):
-    """Extract a literal catalog assignment from tpu_mx/<module>.py by
-    parsing it — no package import, so the linter needs no jax and runs
-    anywhere.  Dict literals yield their key set."""
-    path = os.path.join(repo, "tpu_mx", f"{module}.py")
-    try:
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-    except (OSError, SyntaxError):
-        return None
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == var
-                for t in node.targets):
-            value = node.value
-            if (isinstance(value, ast.Call)
-                    and (dotted(value.func) == "frozenset")
-                    and value.args):
-                value = value.args[0]
-            try:
-                return frozenset(ast.literal_eval(value))
-            except ValueError:
-                return None
-    return None
-
-
-def load_known_metrics(repo=REPO):
-    """KNOWN_METRICS from tpu_mx/telemetry.py (statically parsed)."""
-    return _load_catalog(repo, "telemetry", "KNOWN_METRICS")
-
-
-def load_known_events(repo=REPO):
-    """KNOWN_EVENTS names from tpu_mx/tracing.py (statically parsed;
-    the catalog is a dict of name -> typed payload fields — the event
-    NAMES are what emit() call sites are checked against)."""
-    return _load_catalog(repo, "tracing", "KNOWN_EVENTS")
-
-
-# ---------------------------------------------------------------------------
-# suppression + baseline
-# ---------------------------------------------------------------------------
-def suppressed_rules(ctx, lineno):
-    """Rules disabled for `lineno` via an inline comment on the line, or
-    anywhere in the contiguous comment-only block directly above it (so a
-    multi-line justification can lead with the directive)."""
-    rules = set()
-
-    def collect(text):
-        m = _SUPPRESS_RE.search(text)
-        if m:
-            rules.update(r.strip() for r in m.group(1).split(",")
-                         if r.strip())
-
-    collect(ctx.line_text(lineno))
-    ln = lineno - 1
-    while ln >= 1 and ctx.line_text(ln).lstrip().startswith("#"):
-        collect(ctx.line_text(ln))
-        ln -= 1
-    return rules
-
-
-def read_baseline(path):
-    try:
-        with open(path, encoding="utf-8") as f:
-            data = json.load(f)
-    except OSError:
-        return set()
-    except ValueError as e:
-        raise SystemExit(f"tpumx-lint: baseline {path} unreadable: {e}")
-    if data.get("format") != LINT_FORMAT:
-        raise SystemExit(f"tpumx-lint: baseline {path}: unknown format "
-                         f"{data.get('format')!r}")
-    return {e["fingerprint"] for e in data.get("findings", [])}
-
-
-def write_baseline(path, findings):
-    entries = [{"fingerprint": f.fingerprint(), "rule": f.rule,
-                "path": f.path, "context": f.context,
-                "line": f.line, "message": f.message}
-               for f in findings]
-    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
-    payload = {"format": LINT_FORMAT,
-               "note": "Accepted pre-existing findings; regenerate with "
-                       "tools/tpumx_lint.py --write-baseline.  Keep this "
-                       "EMPTY: prefer a fix, or an inline justified "
-                       "'# tpumx-lint: disable=<rule> -- why'.",
-               "findings": entries}
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(payload, f, indent=1, sort_keys=True)
-        f.write("\n")
-
-
-# ---------------------------------------------------------------------------
-# driver
-# ---------------------------------------------------------------------------
-def build_passes(known_metrics, known_events=None):
-    return [DurabilityPass(), DeterminismPass(), SyncPointPass(),
-            ConcurrencyPass(),
-            TelemetryCatalogPass(known_metrics, known_events)]
-
-
-def lint_source(source, relpath, known_metrics=None, rules=None,
-                known_events=None):
-    """Lint one in-memory file; returns (findings, suppressed) lists.
-    `relpath` decides scoping (library vs tools vs hot path), so tests
-    can exercise any scope with fixture paths."""
-    ctx = FileCtx(relpath, source)
-    findings, suppressed = [], []
-    for p in build_passes(known_metrics, known_events):
-        if rules and p.name not in rules:
-            continue
-        for f in p.run(ctx):
-            sup = suppressed_rules(ctx, f.line)
-            if p.name in sup or "all" in sup:
-                suppressed.append(f)
-            else:
-                findings.append(f)
-    return findings, suppressed
-
-
-def iter_files(targets, repo=REPO, missing=None):
-    for t in targets:
-        full = t if os.path.isabs(t) else os.path.join(repo, t)
-        if not os.path.isfile(full) and not os.path.isdir(full) \
-                and os.path.exists(t):
-            full = os.path.abspath(t)  # relative to CWD, not the repo
-        if os.path.isfile(full):
-            yield full
-        elif not os.path.isdir(full):
-            # a typo'd target must NOT read as a clean lint
-            if missing is not None:
-                missing.append(t)
-        elif os.path.isdir(full):
-            for dirpath, dirnames, filenames in os.walk(full):
-                dirnames[:] = [d for d in dirnames
-                               if d not in ("__pycache__", ".git")]
-                for fname in sorted(filenames):
-                    if fname.endswith(".py"):
-                        yield os.path.join(dirpath, fname)
-
-
-def lint_paths(targets, repo=REPO, known_metrics=None, rules=None,
-               known_events=None):
-    all_findings, all_suppressed, errors = [], [], []
-    missing = []
-    for path in iter_files(targets, repo, missing=missing):
-        rel = os.path.relpath(os.path.abspath(path), repo)
-        try:
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
-            found, sup = lint_source(source, rel, known_metrics, rules,
-                                     known_events=known_events)
-        except SyntaxError as e:
-            errors.append(f"{rel}: syntax error: {e}")
-            continue
-        all_findings.extend(found)
-        all_suppressed.extend(sup)
-    errors.extend(f"target not found: {t}" for t in missing)
-    return all_findings, all_suppressed, errors
-
-
-def main(argv=None):
-    ap = argparse.ArgumentParser(
-        prog="tpumx_lint",
-        description="framework-aware static analysis for tpu-mx contracts")
-    ap.add_argument("targets", nargs="*", default=list(DEFAULT_TARGETS),
-                    help="files/dirs to lint (default: tpu_mx tools "
-                         "bench.py)")
-    ap.add_argument("--format", choices=("human", "json"), default="human")
-    ap.add_argument("--rules", default=None,
-                    help="comma-separated subset of rules to run")
-    ap.add_argument("--baseline",
-                    default=os.path.join(REPO, "tools",
-                                         "tpumx_lint_baseline.json"))
-    ap.add_argument("--no-baseline", action="store_true",
-                    help="report baselined findings too")
-    ap.add_argument("--write-baseline", action="store_true",
-                    help="accept all current findings into the baseline")
-    opts = ap.parse_args(argv)
-
-    rules = None
-    if opts.rules:
-        rules = {r.strip() for r in opts.rules.split(",") if r.strip()}
-        valid = {p.name for p in build_passes(frozenset())}
-        unknown = rules - valid
-        if unknown:
-            ap.error(f"unknown rules: {sorted(unknown)} "
-                     f"(valid: {sorted(valid)})")
-
-    known = load_known_metrics()
-    known_events = load_known_events()
-    if (known is None or known_events is None) \
-            and (rules is None or "telemetry-catalog" in rules):
-        # failing OPEN here would silently disable the whole catalog
-        # pass (e.g. after a refactor that makes KNOWN_METRICS /
-        # KNOWN_EVENTS a computed expression the static extractor can't
-        # evaluate)
-        missing = "KNOWN_METRICS from tpu_mx/telemetry.py" \
-            if known is None else "KNOWN_EVENTS from tpu_mx/tracing.py"
-        print(f"tpumx-lint: could not extract {missing} — the "
-              "telemetry-catalog pass cannot run; keep the catalog a "
-              "literal frozenset({...}) / dict and update "
-              "load_known_metrics()/load_known_events()", file=sys.stderr)
-        return 2
-
-    findings, suppressed, errors = lint_paths(
-        opts.targets, known_metrics=known, rules=rules,
-        known_events=known_events)
-
-    if opts.write_baseline:
-        write_baseline(opts.baseline, findings)
-        print(f"tpumx-lint: baselined {len(findings)} finding(s) -> "
-              f"{opts.baseline}")
-        return 0
-
-    baseline = set() if opts.no_baseline else read_baseline(opts.baseline)
-    fresh = [f for f in findings if f.fingerprint() not in baseline]
-    baselined = len(findings) - len(fresh)
-
-    if opts.format == "json":
-        print(json.dumps({
-            "findings": [f.as_dict() for f in fresh],
-            "baselined": baselined,
-            "suppressed": len(suppressed),
-            "errors": errors,
-            "known_metrics_loaded": known is not None,
-            "known_events_loaded": known_events is not None,
-        }, indent=1, sort_keys=True))
-    else:
-        for f in fresh:
-            print(f.render())
-        for e in errors:
-            print(f"error: {e}")
-        print(f"tpumx-lint: {len(fresh)} finding(s), "
-              f"{baselined} baselined, {len(suppressed)} suppressed"
-              + ("" if known is not None else
-                 " [WARNING: KNOWN_METRICS catalog not loaded]"))
-    if errors:
-        return 2
-    return 1 if fresh else 0
-
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lint import *          # noqa: F401,F403,E402 — the public surface
+from lint import cli, core, index, passes  # noqa: F401,E402 — submodules
+from lint import (          # noqa: F401,E402 — explicit names for callers
+    DEFAULT_INDEX, DEFAULT_TARGETS, HOT_ROOTS, INDEX_FORMAT, LINT_FORMAT,
+    REPO, ConcurrencyPass, DeterminismPass, DurabilityPass, FileCtx,
+    Finding, HotPathPurityPass, Pass, ProjectIndex, SyncPointPass,
+    TelemetryCatalogPass, build_index, build_passes, git_changed_files,
+    iter_files, lint_paths, lint_source, lint_sources, load_known_events,
+    load_known_metrics, main, read_baseline, read_index, summarize_file,
+    suppressed_rules, write_baseline, write_index)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -614,9 +614,12 @@ class CompiledTrainStep:
             expect_gen = self._generation
         t_start = time.perf_counter()
         # None batch args pass through (optional model inputs like
-        # valid_length); they contribute no leaves to the jitted signature
-        raw = tuple(b._data if isinstance(b, NDArray)
-                    else (None if b is None else jnp.asarray(b))
+        # valid_length); they contribute no leaves to the jitted
+        # signature.  Non-NDArray operands stay RAW (numpy/python): the
+        # jit boundary commits them on the C++ fast path — an eager
+        # jnp.asarray here costs a dispatch per operand per step (the
+        # PR-9 decode cliff; hot-path-purity flags it now)
+        raw = tuple(b._data if isinstance(b, NDArray) else b
                     for b in batch)
         # flight-recorder phase events (docs/observability.md): the step
         # histogram split into its host-side stations — the device-side
@@ -652,10 +655,12 @@ class CompiledTrainStep:
             lr = sched(t_next) if sched else self.optimizer.lr
         gacc = self._gacc if self._accum > 1 else {}
         t_disp = time.perf_counter()
+        # np scalars, not jnp.asarray: the jit boundary places them —
+        # two fewer eager device commits per step
         (new_vals, new_masters, new_states, new_efs, gacc,
          loss) = self._jitted(
             self.values, self.masters, self.opt_states, self._efs, gacc,
-            jnp.asarray(t_next, jnp.float32), jnp.asarray(lr, jnp.float32),
+            np.float32(t_next), np.float32(lr),
             key, *raw)
         t_done = time.perf_counter()
         _tracing.emit("train_step.phase", t0=t_disp, t1=t_done,
@@ -803,13 +808,13 @@ class CompiledTrainStep:
         """Discard in-flight microbatch state: restored weights invalidate
         partial gradients accumulated against the previous weights (the
         silent-corruption alternative is worse than dropping ≤K-1
-        microbatches).  Caller MUST hold _state_lock (both call sites —
-        sync_from_net, load_state_dict — do)."""
-        # tpumx-lint: disable=concurrency -- caller holds _state_lock (see
-        # docstring contract); the linter only sees lexical lock scopes
+        microbatches).  Caller MUST hold _state_lock — every call site
+        does, and tpumx-lint's interprocedural concurrency pass PROVES it
+        (lock context propagates through the call graph since ISSUE 10;
+        the suppressions that used to sit here are gone because a new
+        lock-free caller would be a lint error, not a silent race)."""
         self._micro = 0
         if self._gacc is not None:
-            # tpumx-lint: disable=concurrency -- same caller-holds-lock
             self._gacc = jax.tree_util.tree_map(
                 lambda a: jnp.zeros_like(a), self._gacc)
 

@@ -208,6 +208,11 @@ def _paged_decode(q, cache, seq_ids, layer, kind, batch=None):
         jax.default_backend() == "tpu"
         and _pk.supported(q.shape[-1], q_in.dtype, cache.block_size))
     fn = _pk.paged_attention if use_kernel else _pk.paged_attention_reference
+    # tpumx-lint: disable=hot-path-purity -- the ONE deliberate host
+    # readback per layer: the TinyLM reference model is host-resident
+    # numpy, so the kernel's output must come home for layer_combine
+    # (docs/DIVERGENCES.md #27 — a fully device-resident forward is the
+    # ROADMAP serving-v3 item; when that lands, this line goes with it)
     out = np.asarray(fn(q_in, kp, vp, tables, lengths))
     return out[:b]
 

@@ -500,6 +500,11 @@ class PagedKVCache:
             # fetch the (B, Lpad, H, D) result once — the parity tests'
             # honest dense baseline against the same resident pool
             import jax.numpy as jnp
+            # tpumx-lint: disable=hot-path-purity -- dense REFERENCE arm
+            # reading a device-resident pool: one index-array commit per
+            # gather is the documented O(context) fallback cost, not the
+            # production paged path (that one walks raw tables in-kernel;
+            # docs/DIVERGENCES.md #27, docs/serving.md "decode arms")
             idx = jnp.asarray(ids.ravel(), jnp.int32)
             k = np.asarray(kp[idx]).reshape(shape)
             v = np.asarray(vp[idx]).reshape(shape)
