@@ -1133,7 +1133,26 @@ def bench_serve(smoke):
     def pct(vals, q):
         return float(np.percentile(np.asarray(vals, np.float64), q))
 
-    def run_arm(sched_cls):
+    # the live-vs-exact bar (ISSUE 11): the SLO engine's windowed
+    # bucket-merge estimates must track the exact offline percentiles —
+    # the standing proof the "p99 right now" numbers a dashboard reads
+    # can be trusted.  Smoke's 16-request percentiles are rank-noisy
+    # (p99 of 16 samples rides the top order statistic), so the bar
+    # loosens there; the full leg holds 10%.  The bar is ASSERTED on
+    # the continuous arm (the production policy whose TTFT/ITL are the
+    # leg's SLO receipts); the static strawman's deltas are recorded
+    # but not gated — its batch-drain TTFT clusters are a point-mass
+    # distribution where within-bucket interpolation can drift past
+    # 10% at p50, a shape the intentionally-bad baseline manufactures.
+    slo_rel_tol = 0.15 if smoke else 0.10
+
+    def run_arm(sched_cls, assert_live=True):
+        from tpu_mx import telemetry as _tel
+        # reset each SLO histogram's window ring with a horizon covering
+        # the whole arm, so the live estimate aggregates exactly this
+        # arm's samples (cumulative state is untouched)
+        _tel.histogram("serve.ttft_seconds").configure_window(600.0, 12)
+        _tel.histogram("serve.itl_seconds").configure_window(600.0, 12)
         srv = serving.Server(
             model, scheduler=sched_cls(max_pending=n_req + 1,
                                        max_batch=max_batch,
@@ -1150,16 +1169,61 @@ def bench_serve(smoke):
         wall = time.perf_counter() - t0
         total = sum(len(r.tokens) for r in reqs)
         assert total == sum(outs), "lost tokens"
+        # the live-vs-exact comparison below is only apples-to-apples
+        # when no request was requeued: reset_generation clears the
+        # token_times the exact list is built from, but the discarded
+        # attempt's observations stay in the window ring.  The fixed
+        # trace never preempts today — make that a loud precondition
+        # rather than a confusing estimator-drift failure if the trace
+        # or pool sizing is ever retuned.
+        assert not any(r.requeues for r in reqs), (
+            "bench arm saw requeues; live-vs-exact gate precondition "
+            "broken — retune the trace or pool sizing")
         ttft = [r.ttft * 1e3 for r in reqs]
         itl = [dt * 1e3
                for r in reqs
                for dt in np.diff(r.token_times)] or [0.0]
-        return {"tokens_per_sec": round(total / wall, 1),
-                "steps": step, "wall_s": round(wall, 3),
-                "ttft_ms_p50": round(pct(ttft, 50), 2),
-                "ttft_ms_p99": round(pct(ttft, 99), 2),
-                "itl_ms_p50": round(pct(itl, 50), 3),
-                "itl_ms_p99": round(pct(itl, 99), 3)}
+        exact = {"ttft_ms_p50": round(pct(ttft, 50), 2),
+                 "ttft_ms_p99": round(pct(ttft, 99), 2),
+                 "itl_ms_p50": round(pct(itl, 50), 3),
+                 "itl_ms_p99": round(pct(itl, 99), 3)}
+        # The runtime SLO engine's windowed estimates next to the exact
+        # offline percentiles.  GATED against the order-statistic
+        # BRACKET [percentile(method=lower), percentile(method=higher)]:
+        # a p99 of 64 requests rides the gap between the top two order
+        # statistics, where the "exact" value is itself a convention
+        # (linear/lower/higher disagree by the whole gap) — the bucket
+        # estimate is guaranteed within one ~5% bucket of that bracket,
+        # so the 10% bar is meaningful rather than rank-lottery.  The
+        # linear-convention delta is reported alongside for the receipt.
+        live, rel_errs, bracket_errs = {}, {}, {}
+        for name, key, samples in (
+                ("serve.ttft_seconds", "ttft_ms", ttft),
+                ("serve.itl_seconds", "itl_ms", itl)):
+            h = _tel.get(name)
+            arr = np.asarray(samples, np.float64)
+            for q, qtag in ((0.50, "p50"), (0.99, "p99")):
+                est = h.window_quantile(q)
+                assert est is not None, (name, "empty SLO window")
+                est_ms = est * 1e3
+                live[f"{key}_{qtag}"] = round(est_ms, 3)
+                ex = exact[f"{key}_{qtag}"]
+                rel_errs[f"{key}_{qtag}"] = round(
+                    abs(est_ms - ex) / max(ex, 1e-9), 4)
+                lo = float(np.percentile(arr, q * 100, method="lower"))
+                hi = float(np.percentile(arr, q * 100, method="higher"))
+                gap = max(lo - est_ms, est_ms - hi, 0.0)
+                bracket_errs[f"{key}_{qtag}"] = round(
+                    gap / max(ex, 1e-9), 4)
+        worst = max(bracket_errs.values())
+        assert not assert_live or worst <= slo_rel_tol, (
+            f"live SLO estimate drifted {worst:.1%} outside the exact "
+            f"order-statistic bracket (bar {slo_rel_tol:.0%}): "
+            f"live={live} exact={exact}")
+        return dict(exact, tokens_per_sec=round(total / wall, 1),
+                    steps=step, wall_s=round(wall, 3),
+                    slo_live=live, slo_live_rel_err=rel_errs,
+                    slo_live_bracket_err=bracket_errs)
 
     # warm both code paths before timing either arm: the first prefill/
     # decode at each shape pays one-time numpy/dispatch setup (measured
@@ -1177,8 +1241,13 @@ def bench_serve(smoke):
     log(f"  continuous: {cont['tokens_per_sec']} tok/s in "
         f"{cont['steps']} steps; ttft p50/p99 "
         f"{cont['ttft_ms_p50']}/{cont['ttft_ms_p99']} ms")
+    log(f"  live SLO estimates: {cont['slo_live']} (vs exact-linear "
+        f"worst {max(cont['slo_live_rel_err'].values()):.1%}; vs "
+        f"order-statistic bracket worst "
+        f"{max(cont['slo_live_bracket_err'].values()):.1%}, gated at "
+        f"{slo_rel_tol:.0%})")
     log("serve: static arm...")
-    stat = run_arm(serving.StaticBatchingScheduler)
+    stat = run_arm(serving.StaticBatchingScheduler, assert_live=False)
     log(f"  static:     {stat['tokens_per_sec']} tok/s in "
         f"{stat['steps']} steps")
     speedup = cont["tokens_per_sec"] / max(stat["tokens_per_sec"], 1e-9)
@@ -1232,6 +1301,17 @@ def bench_serve(smoke):
         "speedup_vs_static": round(speedup, 2),
         "continuous": cont,
         "static": stat,
+        # live-vs-exact proof (ISSUE 11): the SLO engine's windowed
+        # p50/p99 next to the offline-exact percentiles, per arm (the
+        # per-metric deltas ride each arm's slo_live_rel_err /
+        # slo_live_bracket_err; the assert in run_arm gates the
+        # continuous arm's bracket distance — the static strawman's
+        # deltas are recorded unasserted, see the comment above run_arm)
+        "slo_live_max_rel_err": round(
+            max(cont["slo_live_rel_err"].values()), 4),
+        "slo_live_max_bracket_err": round(
+            max(cont["slo_live_bracket_err"].values()), 4),
+        "slo_live_rel_tol": slo_rel_tol,
         # O(1)-append receipt.  A cache-less (recompute-the-prefix)
         # decode's per-token cost scales ~linearly with context —
         # "linear_would_be" is the late/early CONTEXT ratio such a decode

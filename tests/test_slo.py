@@ -1,0 +1,525 @@
+"""Serving SLO engine (ISSUE 11): per-request latency attribution
+(tpu_mx/serving/timeline.py), the live SLO monitor
+(tpu_mx/serving/slo.py — windowed attainment, multi-window burn rate,
+breach events, the scheduler signal hook), and the jax-less ops surface
+(tools/slo_report.py).
+
+The attribution invariant under test everywhere: the typed phases
+(queue_wait / prefill / decode_gap / restart_penalty / defer_stall)
+sum to every request's independently stamped wall clock within 5%, and
+the first-token snapshot sums to the measured TTFT — including across
+engine restarts (restart_penalty) and cache-backpressure deferrals."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_mx import serving, telemetry, tracing
+from tpu_mx.contrib import chaos
+from tpu_mx.serving import SLO, SLOMonitor, Server, TinyLM
+from tpu_mx.serving.timeline import PHASES, RequestTimeline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Telemetry/tracing are process-global — isolate every test."""
+    telemetry.reset()
+    tracing.reset()
+    yield
+    telemetry.reset()
+    tracing.reset()
+
+
+def tiny(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("embed_dim", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("seed", 0)
+    return TinyLM(**kw)
+
+
+def assert_attributed(req, tol=0.05):
+    """The CI serve tier's invariant, as a test helper."""
+    tl = req.timeline
+    lat = req.finished_at - req.submitted_at
+    assert tl.ended_at is not None and tl.outcome is not None
+    assert abs(tl.total - lat) <= max(tol * lat, 1e-3), (
+        req.id, tl.total, lat, tl.phases)
+    if req.tokens:
+        ttft_sum = sum(tl.ttft_breakdown.values())
+        assert abs(ttft_sum - req.ttft) <= max(tol * req.ttft, 1e-3), (
+            req.id, ttft_sum, req.ttft, tl.ttft_breakdown)
+    assert set(tl.phases) <= set(PHASES)
+
+
+# ---------------------------------------------------------------------------
+# per-request attribution
+# ---------------------------------------------------------------------------
+def test_attribution_sums_to_wall_clock_happy_path():
+    srv = Server(tiny(), num_blocks=96, block_size=8, max_batch=4)
+    reqs = [srv.submit([1, 2, 3], max_new_tokens=5) for _ in range(6)]
+    srv.run_until_idle()
+    for r in reqs:
+        assert r.state == "done"
+        assert_attributed(r)
+        # a healthy run attributes to the three live phases only
+        assert r.timeline.phases.get("prefill", 0) > 0
+        assert r.timeline.phases.get("decode_gap", 0) > 0
+        assert r.timeline.requeues == 0
+        assert "restart_penalty" not in r.timeline.phases
+    # one serve.request_timeline event per request, schema-valid, and
+    # its phase fields reproduce the in-process ledger
+    evs = [e for e in tracing.snapshot()
+           if e["event"] == "serve.request_timeline"]
+    assert len(evs) == len(reqs)
+    for e in evs:
+        tracing.validate_event(e)
+        assert e["data"]["outcome"] == "done"
+        total = sum(e["data"][p] for p in PHASES)
+        assert abs(total - e["data"]["latency"]) <= 1e-6
+    # per-phase histograms landed (windowed like every histogram)
+    h = telemetry.get("serve.phase_seconds", phase="decode_gap")
+    assert h is not None and h.count == len(reqs)
+    assert h.window_stats()["count"] == len(reqs)
+
+
+def test_attribution_restart_penalty_on_engine_restart():
+    srv = Server(tiny(), num_blocks=96, block_size=8, max_batch=4,
+                 backoff=0.0)
+    with chaos.enable(seed=0, nan_after=4):
+        reqs = [srv.submit([1, 2, 3], max_new_tokens=6) for _ in range(4)]
+        srv.run_until_idle()
+    assert srv.restarts == 1
+    bounced = [r for r in reqs if r.timeline.requeues]
+    assert bounced, "the restart must have requeued in-flight requests"
+    for r in reqs:
+        assert r.state == "done"
+        assert_attributed(r)
+    for r in bounced:
+        # the discarded attempt + rebuild + re-queue wait is attributed,
+        # not smeared into queue_wait
+        assert r.timeline.phases.get("restart_penalty", 0) > 0
+        # the TTFT breakdown restarted with the generation: it reflects
+        # the FINAL attempt's path to the first token
+        assert r.timeline.ttft_breakdown.get("restart_penalty", 0) > 0
+
+
+def test_attribution_defer_stall_on_cache_backpressure():
+    # 3 prompts of 3 blocks each against an 8-block pool: the third
+    # prefill admission bounces on CacheExhausted and is deferred until
+    # decode evictions free blocks
+    srv = Server(tiny(), num_blocks=8, block_size=4, max_batch=4,
+                 max_tokens=10 ** 6)
+    reqs = [srv.submit([1] * 10, max_new_tokens=4) for _ in range(3)]
+    srv.run_until_idle()
+    deferred = [r for r in reqs if r.timeline.defers]
+    assert deferred, "the pool was sized to force a deferral"
+    for r in reqs:
+        assert r.state == "done"
+        assert_attributed(r)
+    for r in deferred:
+        assert r.timeline.phases.get("defer_stall", 0) > 0
+
+
+def test_attribution_rejected_request_closes_as_reject():
+    srv = Server(tiny(), num_blocks=96, block_size=8)
+    with chaos.enable(seed=0, reject_storm=1):
+        with pytest.raises(serving.AdmissionReject):
+            srv.submit([1, 2], max_new_tokens=2)
+    evs = [e for e in tracing.snapshot()
+           if e["event"] == "serve.request_timeline"]
+    assert len(evs) == 1
+    d = evs[0]["data"]
+    assert d["outcome"] == "rejected"
+    assert d["tokens"] == 0
+    assert abs(sum(d[p] for p in PHASES) - d["latency"]) <= 1e-6
+
+
+def test_timeline_mid_decode_fail_residual_is_decode_gap(monkeypatch):
+    """A request failed while in flight (degraded drain of RUNNING
+    requests) attributes its final interval to decode_gap — the time was
+    spent decoding, not queued — while a fail during a genuine wait
+    keeps the wait's label."""
+    import tpu_mx.serving.timeline as _tlmod
+    clock = [100.0]
+    monkeypatch.setattr(_tlmod.time, "perf_counter", lambda: clock[0])
+    tl = RequestTimeline()
+    clock[0] = 100.1
+    tl.mark_prefill_start()   # 0.1 queue_wait
+    clock[0] = 100.2
+    tl.mark_prefill_end()     # 0.1 prefill
+    tl.mark_token(now=100.5)  # 0.3 decode_gap
+    tl.finalize("req-m", "failed", now=101.5)   # 1.0 in-flight residual
+    assert tl.phases["decode_gap"] == pytest.approx(1.3)
+    assert tl.phases["queue_wait"] == pytest.approx(0.1)
+    # a requeued-then-failed-waiting request stays on the wait label
+    clock[0] = 100.0
+    tl2 = RequestTimeline()
+    tl2.mark_prefill_start()
+    tl2.mark_prefill_end()
+    tl2.mark_token(now=100.5)
+    clock[0] = 101.0
+    tl2.mark_requeue()        # 0.5 restart_penalty so far
+    tl2.finalize("req-w", "failed", now=103.0)  # +2.0 still the penalty
+    assert tl2.phases["restart_penalty"] == pytest.approx(2.5)
+    assert tl2.phases["decode_gap"] == pytest.approx(0.5)
+
+
+def test_timeline_is_idempotent_and_standalone():
+    tl = RequestTimeline(t0=100.0)
+    # un-marked timelines finalize cleanly (Request used outside a
+    # Server, e.g. scheduler unit tests)
+    tl.finalize("req-x", "done")
+    ended = tl.ended_at
+    tl.finalize("req-x", "failed")   # second finalize is a no-op
+    assert tl.ended_at == ended and tl.outcome == "done"
+
+
+# ---------------------------------------------------------------------------
+# the SLO monitor
+# ---------------------------------------------------------------------------
+def test_slo_parse_and_validation():
+    s = SLO.parse("itl_p99 < 50ms")
+    assert s.metric == "serve.itl_seconds"
+    assert s.threshold_seconds == pytest.approx(0.05)
+    assert s.objective == pytest.approx(0.99)
+    with pytest.raises(ValueError):
+        SLO("m", quantile=1.5, threshold_seconds=0.1)
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOMonitor(("itl_p99 < 50ms", "itl_p99 < 60ms"))
+    with pytest.raises(ValueError, match="window"):
+        SLOMonitor(windows=())
+
+
+def test_slo_monitor_burn_rate_and_breach_transition_event():
+    h = telemetry.histogram("serve.itl_seconds")
+    # 3% of samples over the 50 ms threshold against a 1% budget: burn 3x
+    for _ in range(970):
+        h.observe(0.005)
+    for _ in range(30):
+        h.observe(0.2)
+    mon = SLOMonitor(("itl_p99 < 50ms",), windows=(5.0, 30.0))
+    sig = mon.refresh(force=True)
+    st = sig["slos"]["itl_p99"]
+    assert st["breaching"] and sig["breaching"]
+    assert sig["max_burn_rate"] == pytest.approx(3.0, rel=0.15)
+    for w in (5.0, 30.0):
+        assert st["windows"][w]["attainment"] == pytest.approx(0.97,
+                                                               abs=0.005)
+    # gauges published, catalog-valid
+    assert telemetry.get("serve.slo_breaching", slo="itl_p99").value == 1.0
+    assert telemetry.get("serve.slo_burn_rate", slo="itl_p99",
+                         window="30s").value == pytest.approx(3.0, rel=0.15)
+    est = telemetry.get("serve.slo_estimate_seconds", slo="itl_p99").value
+    assert est > 0.05   # the p99 estimate is over the threshold
+    for rec in telemetry.snapshot():
+        telemetry.validate_record(rec)
+        assert rec["name"] in telemetry.KNOWN_METRICS
+    # exactly one breach-transition event; a second refresh in the same
+    # state emits nothing new
+    evs = [e for e in tracing.snapshot() if e["event"] == "serve.slo"]
+    assert len(evs) == 1 and evs[0]["data"]["breaching"] is True
+    tracing.validate_event(evs[0])
+    mon.refresh(force=True)
+    assert len([e for e in tracing.snapshot()
+                if e["event"] == "serve.slo"]) == 1
+
+
+def test_slo_monitor_recovers_when_window_expires(monkeypatch):
+    clock = [2000.0]
+    monkeypatch.setattr(telemetry, "_monotonic", lambda: clock[0])
+    h = telemetry.histogram("serve.itl_seconds")
+    for _ in range(10):
+        h.observe(0.5)   # every sample breaches
+    mon = SLOMonitor(("itl_p99 < 50ms",), windows=(10.0, 60.0))
+    assert mon.refresh(force=True)["breaching"]
+    clock[0] += 120.0    # the bad minute scrolls out of the ring
+    sig = mon.refresh(force=True)
+    # empty windows are healthy-by-absence, and the flip emitted the
+    # breach-cleared transition event
+    assert not sig["breaching"]
+    evs = [e for e in tracing.snapshot() if e["event"] == "serve.slo"]
+    assert [e["data"]["breaching"] for e in evs] == [True, False]
+
+
+def test_slo_monitor_requires_breach_in_all_windows(monkeypatch):
+    clock = [3000.0]
+    monkeypatch.setattr(telemetry, "_monotonic", lambda: clock[0])
+    h = telemetry.histogram("serve.itl_seconds")
+    for _ in range(100):
+        h.observe(0.5)   # an old burst of pure badness
+    clock[0] += 50.0     # ... 50 s ago
+    for _ in range(100):
+        h.observe(0.001)  # the recent window is clean
+    mon = SLOMonitor(("itl_p99 < 50ms",), windows=(10.0, 60.0))
+    sig = mon.refresh(force=True)
+    st = sig["slos"]["itl_p99"]
+    # slow window still burning, fast window clean -> no breach (the
+    # multi-window AND kills flapping)
+    assert st["windows"][60.0]["burn_rate"] >= 1.0
+    assert st["windows"][10.0]["burn_rate"] == 0.0
+    assert not st["breaching"]
+
+
+def test_server_slo_hook_publishes_signal_to_scheduler():
+    srv = Server(tiny(), num_blocks=96, block_size=8, max_batch=4,
+                 slo=("itl_p99 < 30s", "ttft_p99 < 30s"))
+    assert isinstance(srv.slo, SLOMonitor)
+    reqs = [srv.submit([1, 2, 3], max_new_tokens=4) for _ in range(3)]
+    srv.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+    sig = srv.slo_signal
+    assert sig is not None and not sig["breaching"]
+    assert srv.scheduler.slo_signal is sig
+    assert telemetry.get("serve.slo_estimate_seconds",
+                         slo="itl_p99") is not None
+    # a server without a monitor reports None and sets nothing
+    srv2 = Server(tiny(), num_blocks=32)
+    assert srv2.slo_signal is None
+
+
+def test_ttft_observed_once_per_request_across_restarts():
+    """serve.ttft_seconds gets ONE sample per request, stamped from the
+    final attempt: a per-attempt observe would let a restart's discarded
+    attempt contribute an extra, optimistic (no restart penalty) sample
+    to exactly the histogram the SLO monitor alerts on mid-incident."""
+    srv = Server(tiny(), num_blocks=96, block_size=8, max_batch=4,
+                 backoff=0.0)
+    with chaos.enable(seed=0, nan_after=4):
+        reqs = [srv.submit([1, 2, 3], max_new_tokens=6) for _ in range(4)]
+        srv.run_until_idle()
+    assert srv.restarts == 1 and all(r.state == "done" for r in reqs)
+    assert any(r.requeues for r in reqs)   # a restart actually happened
+    h = telemetry.get("serve.ttft_seconds")
+    assert h.count == len(reqs), (h.count, len(reqs))
+    # every sample carries final-attempt semantics: the histogram's max
+    # is at least the slowest request's measured (restart-inclusive) TTFT
+    assert h.max == pytest.approx(max(r.ttft for r in reqs), rel=1e-6)
+
+
+def test_slo_gauges_publish_no_data_when_window_empties(monkeypatch):
+    """A gauge frozen at its last non-empty value would read as live
+    after traffic stops — an empty window publishes the NO_DATA
+    sentinel (-1; NaN would break the strict-JSON black-box
+    contract)."""
+    from tpu_mx.serving.slo import NO_DATA
+    clock = [1000.0]
+    monkeypatch.setattr(telemetry, "_monotonic", lambda: clock[0])
+    h = telemetry.histogram("serve.itl_seconds")
+    h.observe(0.002)
+    mon = SLOMonitor(("itl_p99 < 50ms",), windows=(5.0,),
+                     min_refresh_seconds=0.0)
+    mon.refresh(force=True)
+    g = telemetry.get("serve.slo_estimate_seconds", slo="itl_p99")
+    assert g.value == pytest.approx(0.002, rel=0.1)
+    clock[0] += 1e4   # the whole ring expires
+    mon.refresh(force=True)
+    assert g.value == NO_DATA
+    assert telemetry.get("serve.slo_attainment", slo="itl_p99",
+                         window="5s").value == NO_DATA
+    # burn/breaching stay honest zeros (no evidence = no breach)
+    assert telemetry.get("serve.slo_breaching", slo="itl_p99").value == 0.0
+    # every record (and hence every black box) stays strict-JSON clean
+    for rec in telemetry.snapshot():
+        json.loads(json.dumps(rec, allow_nan=False))
+
+
+def test_server_slo_false_means_unarmed():
+    srv = Server(tiny(), num_blocks=32, slo=False)
+    assert srv.slo is None and srv.slo_signal is None
+    r = srv.submit([1, 2], max_new_tokens=2)
+    srv.run_until_idle()
+    assert r.state == "done"
+
+
+def test_server_slo_accepts_single_spec_string_and_rejects_junk():
+    srv = Server(tiny(), num_blocks=32, slo="itl_p99 < 30s")
+    assert isinstance(srv.slo, SLOMonitor)
+    assert [s.name for s in srv.slo.slos] == ["itl_p99"]
+    r = srv.submit([1, 2], max_new_tokens=2)
+    srv.run_until_idle()
+    assert r.state == "done" and srv.slo_signal is not None
+    with pytest.raises(TypeError, match="slo="):
+        Server(tiny(), num_blocks=32, slo=object())
+
+
+def test_prefill_fault_requeues_popped_admissions():
+    """A non-CacheExhausted engine fault mid-prefill must not lose the
+    admissions take_prefills() already popped: the restart path only
+    requeues RUNNING requests, so the server has to put the popped ones
+    back itself — a lost request's wait() would hang forever."""
+    from tpu_mx.supervisor import NumericDivergence
+    srv = Server(tiny(), num_blocks=96, block_size=8, max_batch=4,
+                 backoff=0.0)
+    real_prefill = srv.engine.prefill
+    fired = []
+
+    def poisoned(req):
+        if not fired:
+            fired.append(req.id)
+            raise NumericDivergence("injected prefill fault")
+        return real_prefill(req)
+
+    srv.engine.prefill = poisoned
+    reqs = [srv.submit([1, 2, 3], max_new_tokens=4) for _ in range(3)]
+    srv.run_until_idle()
+    assert fired and srv.restarts == 1
+    assert all(r.state == "done" for r in reqs), [r.state for r in reqs]
+    faulted = [r for r in reqs if r.id == fired[0]][0]
+    assert faulted.requeues == 1
+    assert faulted.timeline.phases.get("restart_penalty", 0) > 0
+    for r in reqs:
+        assert_attributed(r)
+
+
+def test_nan_sample_dropped_visibly_not_misfiled():
+    """A non-finite observation has no honest bucket (bisect would call
+    NaN the fastest sample; the overflow would force false breaches for
+    legitimate >30s samples; nan+x poisons the sum forever) — it is
+    dropped and surfaced via the record's dropped_nonfinite field."""
+    h = telemetry.histogram("serve.itl_seconds")
+    for _ in range(99):
+        h.observe(0.01)
+    h.observe(float("nan"))
+    assert h.count == 99 and h.dropped_nonfinite == 1
+    assert h.window_fraction_le(0.05) == pytest.approx(1.0)
+    # the record stays strict-JSON clean and carries the drop count
+    rec = h._record(1.0)
+    json.loads(json.dumps(rec, allow_nan=False))
+    assert rec["sum"] == pytest.approx(0.99)
+    assert rec["dropped_nonfinite"] == 1
+    # a legitimately slow finite sample above the ladder top still
+    # attains a threshold above it (no false breach)
+    h.observe(40.0)
+    assert h.window_fraction_le(60.0) == pytest.approx(1.0)
+
+
+def test_histogram_nonfinite_never_reaches_buckets():
+    """Neither NaN nor ±Inf may perturb the bucket counts, quantiles,
+    or min/max — they are dropped (visibly; see the sibling test)."""
+    h = telemetry.histogram("serve.itl_seconds")
+    h.observe(0.001)
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    h.observe(float("-inf"))
+    cum = dict(h.cumulative())
+    assert cum["+Inf"] == 1 and h.count == 1
+    assert h.dropped_nonfinite == 3
+    assert h.window_quantile(0.99) == pytest.approx(0.001)
+    assert (h.min, h.max) == (0.001, 0.001)
+
+
+def test_restart_black_box_captures_slo_window_state(tmp_path):
+    prefix = str(tmp_path / "sv")
+    srv = Server(tiny(), num_blocks=96, block_size=8, max_batch=4,
+                 backoff=0.0, blackbox=prefix, slo=True)
+    with chaos.enable(seed=0, nan_after=4):
+        reqs = [srv.submit([1, 2, 3], max_new_tokens=6) for _ in range(4)]
+        srv.run_until_idle()
+    assert srv.restarts == 1 and all(r.state == "done" for r in reqs)
+    box = json.load(open(tracing.blackbox_path(prefix)))
+    tracing.validate_blackbox(box)
+    names = {(r["name"], json.dumps(r.get("labels", {}), sort_keys=True))
+             for r in box["telemetry"]}
+    assert ("serve.slo_estimate_seconds",
+            '{"slo": "itl_p99"}') in names, sorted(names)[:20]
+    # the box's tracing.events_dropped gauge rode along
+    assert any(r["name"] == "tracing.events_dropped"
+               for r in box["telemetry"])
+
+
+# ---------------------------------------------------------------------------
+# tools/slo_report.py (jax-less, rc 0/1/2)
+# ---------------------------------------------------------------------------
+def _make_artifacts(tmp_path):
+    """A real storm's telemetry JSONL + end-of-run audit box."""
+    jsonl = str(tmp_path / "m.jsonl")
+    prefix = str(tmp_path / "audit")
+    srv = Server(tiny(), num_blocks=96, block_size=8, max_batch=4,
+                 backoff=0.0, slo=True)
+    with chaos.enable(seed=0, nan_after=4):
+        reqs = [srv.submit([1, 2, 3], max_new_tokens=5) for _ in range(4)]
+        srv.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+    tracing.dump_blackbox(prefix, reason="slo audit")
+    telemetry.flush(path=jsonl, final=True)
+    return jsonl, tracing.blackbox_path(prefix)
+
+
+def _run_slo_report(*args, poison=True):
+    """Run the tool in a subprocess with jax/tpu_mx poisoned — it must
+    never import either."""
+    tool = os.path.join(REPO, "tools", "slo_report.py")
+    preamble = ("import sys, runpy; "
+                + ("sys.modules['jax'] = None; "
+                   "sys.modules['tpu_mx'] = None; " if poison else "")
+                + f"sys.argv = ['slo_report.py'] + {list(args)!r}; "
+                + f"runpy.run_path({tool!r}, run_name='__main__')")
+    return subprocess.run([sys.executable, "-c", preamble],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_slo_report_renders_and_validates_without_jax(tmp_path):
+    jsonl, box = _make_artifacts(tmp_path)
+    run = _run_slo_report(jsonl, "--box", box, "--validate")
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out
+    assert "Windowed latency state" in out
+    assert "SLO targets" in out
+    assert "serve.itl_seconds" in out
+    assert "Live monitor gauges" in out
+    assert "Worst requests by latency" in out
+    assert "restart_penalty" in out      # the faulted requests' phases
+    assert "schema OK" in out
+    assert "top 5 of 0" not in out       # timelines actually rendered
+
+
+def test_slo_report_breach_rendering(tmp_path):
+    # a file whose window clearly breaches a tight target
+    h = telemetry.histogram("serve.itl_seconds")
+    for _ in range(100):
+        h.observe(0.2)
+    jsonl = str(tmp_path / "m.jsonl")
+    telemetry.flush(path=jsonl)
+    run = _run_slo_report(jsonl, "--slo", "itl_p99 < 50ms")
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "BREACH" in run.stdout
+
+
+def test_slo_report_rc1_on_schema_violations(tmp_path):
+    jsonl, box = _make_artifacts(tmp_path)
+    with open(jsonl, "a", encoding="utf-8") as f:
+        f.write(json.dumps({"name": "not.in.catalog", "type": "counter",
+                            "value": 1, "ts": 1.0}) + "\n")
+    run = _run_slo_report(jsonl, "--validate")
+    assert run.returncode == 1
+    assert "not.in.catalog" in run.stderr
+    # without --validate it renders anyway (ops view of a dirty file)
+    assert _run_slo_report(jsonl).returncode == 0
+
+
+def test_slo_report_rc1_on_attribution_invariant_break(tmp_path):
+    jsonl, box_path = _make_artifacts(tmp_path)
+    box = json.load(open(box_path))
+    for e in box["events"]:
+        if e["event"] == "serve.request_timeline":
+            e["data"]["latency"] = e["data"]["latency"] + 10.0
+    tampered = str(tmp_path / "tampered.json")
+    with open(tampered, "w", encoding="utf-8") as f:
+        json.dump(box, f)
+    run = _run_slo_report(jsonl, "--box", tampered, "--validate")
+    assert run.returncode == 1
+    assert "phases sum to" in run.stderr
+
+
+def test_slo_report_rc2_on_unreadable_input(tmp_path):
+    run = _run_slo_report(str(tmp_path / "missing.jsonl"))
+    assert run.returncode == 2
+    jsonl, _ = _make_artifacts(tmp_path)
+    run = _run_slo_report(jsonl, "--box", str(tmp_path / "nope.json"))
+    assert run.returncode == 2

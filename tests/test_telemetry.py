@@ -115,12 +115,20 @@ def test_jsonl_flush_appends_and_final_is_complete(tmp_path):
     assert telemetry.flush(path=path) is not None
     telemetry.counter("fusion.flushes").inc()
     telemetry.flush(path=path)
+
+    def per_name(lines):
+        names = [json.loads(ln)["name"] for ln in lines]
+        return {n: names.count(n) for n in set(names)}
+
     lines = [ln for ln in open(path).read().splitlines() if ln]
-    assert len(lines) == 4  # two snapshots x two metrics, appended
+    # two snapshots, appended (flush also refreshes bridge gauges like
+    # tracing.events_dropped, so count per-series, not raw lines)
+    assert per_name(lines)["fusion.flushes"] == 2
+    assert per_name(lines)["checkpoint.save_seconds"] == 2
     # final snapshot: the whole history is rewritten atomically
     telemetry.flush(path=path, final=True)
     lines = [ln for ln in open(path).read().splitlines() if ln]
-    assert len(lines) == 6
+    assert per_name(lines)["fusion.flushes"] == 3
     for ln in lines:
         telemetry.validate_record(json.loads(ln))
     # the two counter snapshots carry the cumulative values 1 then 2
@@ -504,3 +512,255 @@ def test_report_diff_honors_require_against_after_snapshot(tmp_path):
     run = _run_report("--diff", a, b, "--require", "supervisor.restarts")
     assert run.returncode == 1
     assert "supervisor.restarts" in run.stderr
+
+
+# ---------------------------------------------------------------------------
+# sliding windows (ISSUE 11): ring-of-subwindow aggregation — quantile
+# accuracy vs exact numpy percentiles on adversarial distributions,
+# expiry across subwindow rollover, and a concurrent observe+read hammer
+# ---------------------------------------------------------------------------
+def _assert_within_one_bucket(est, exact, buckets):
+    """The accuracy contract: the bucket-merge estimate lands within one
+    histogram bucket of the exact percentile, either side."""
+    from bisect import bisect_left
+    i = bisect_left(buckets, exact)
+    lo = buckets[i - 2] if i >= 2 else 0.0
+    hi = buckets[i + 1] if i + 1 < len(buckets) else float("inf")
+    assert lo <= est <= hi, (est, exact, lo, hi)
+
+
+@pytest.mark.parametrize("dist", ["bimodal", "heavy_tail", "one_bucket"])
+def test_windowed_quantile_accuracy_vs_numpy(dist):
+    rng = np.random.RandomState(7)
+    if dist == "bimodal":
+        vals = np.abs(np.concatenate([rng.normal(2e-3, 2e-4, 1500),
+                                      rng.normal(8e-2, 8e-3, 500)]))
+    elif dist == "heavy_tail":
+        vals = rng.lognormal(np.log(1e-3), 1.2, 2000)
+    else:   # every sample identical -> one bucket; estimate is EXACT
+        vals = np.full(500, 0.01234)
+    h = telemetry.histogram("serve.itl_seconds")   # the dense SLO ladder
+    for v in vals:
+        h.observe(float(v))
+    for q in (0.5, 0.9, 0.99):
+        est = h.window_quantile(q)
+        exact = float(np.percentile(vals, q * 100))
+        _assert_within_one_bucket(est, exact, h.buckets)
+        # the lifetime estimator shares the math (same samples here)
+        _assert_within_one_bucket(h.quantile(q), exact, h.buckets)
+    if dist == "one_bucket":
+        # min == max clamping makes the degenerate case exact
+        assert h.window_quantile(0.99) == pytest.approx(0.01234)
+    # attainment interpolation agrees with the empirical CDF
+    thr = float(np.percentile(vals, 75))
+    frac = h.window_fraction_le(thr)
+    assert abs(frac - float((vals <= thr).mean())) < 0.05
+
+
+def test_window_expiry_across_subwindow_rollover(monkeypatch):
+    clock = [1000.0]
+    monkeypatch.setattr(telemetry, "_monotonic", lambda: clock[0])
+    h = telemetry.histogram("train_step.seconds")
+    h.configure_window(10.0, 5)           # 2 s subwindows
+    for _ in range(100):
+        h.observe(0.001)
+    clock[0] += 4.0                       # two subwindows later
+    for _ in range(50):
+        h.observe(0.1)
+    st = h.window_stats()
+    assert st["count"] == 150 and st["min"] == 0.001 and st["max"] == 0.1
+    # a narrower read sees only the newest subwindows
+    assert h.window_stats(window=2.0)["count"] == 50
+    clock[0] += 7.0                       # first batch now > 10 s old
+    st = h.window_stats()
+    assert st["count"] == 50
+    assert st["sum"] == pytest.approx(5.0)
+    assert h.window_quantile(0.5) == pytest.approx(0.1, rel=0.2)
+    clock[0] += 100.0                     # everything expired
+    assert h.window_stats()["count"] == 0
+    assert h.window_quantile(0.99) is None
+    assert h.window_fraction_le(1.0) is None
+    # cumulative state never expires
+    assert h.count == 150
+    # the record's window sub-object reflects the empty window but the
+    # cumulative fields do not
+    rec = telemetry.snapshot()[0]
+    telemetry.validate_record(rec)
+    assert rec["value"] == 150 and rec["window"]["count"] == 0
+
+
+def test_windowed_counter_delta_and_rate(monkeypatch):
+    clock = [500.0]
+    monkeypatch.setattr(telemetry, "_monotonic", lambda: clock[0])
+    c = telemetry.counter("serve.generated_tokens")
+    c.configure_window(10.0, 5)
+    c.inc(30)
+    clock[0] += 6.0
+    c.inc(10)
+    assert c.window_delta() == 40
+    # covered time is age-clamped: the ring is only 6 s old, so the
+    # rate is 40/6, not 40/10 — a young ring must not claim the full
+    # horizon and under-report warm-up throughput
+    assert c.window_rate() == pytest.approx(40 / 6.0)
+    assert c.window_delta(window=2.0) == 10
+    clock[0] += 6.0                       # the 30-burst expired
+    assert c.window_delta() == 10
+    assert c.value == 40                  # cumulative untouched
+    rec = c._record(1.0)
+    telemetry.validate_record(rec)
+    assert rec["window"]["value"] == 10
+
+
+def test_windowed_read_hammer_under_concurrent_observes():
+    """Thread-safety of the window ring under the registry lock: reads
+    interleaved with observes never tear (monotone window buckets,
+    schema-valid records, no exceptions)."""
+    h = telemetry.histogram("serve.ttft_seconds")
+    stop = threading.Event()
+    errs = []
+
+    def worker(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            while not stop.is_set():
+                h.observe(float(rng.lognormal(np.log(1e-3), 1.0)))
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            q = h.window_quantile(0.99)
+            assert q is None or q > 0
+            h.window_fraction_le(0.05)
+            cum = h.window_cumulative()
+            counts = [c for _, c in cum]
+            assert counts == sorted(counts)
+            assert cum[-1][0] == "+Inf"
+            for rec in telemetry.snapshot():
+                telemetry.validate_record(rec)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+    assert not errs, errs
+
+
+def test_validate_record_rejects_malformed_windows():
+    base = {"name": "h", "type": "histogram", "value": 1, "ts": 1.0,
+            "sum": 0.5, "buckets": [[0.1, 1], ["+Inf", 1]]}
+    telemetry.validate_record(dict(base))           # no window: valid
+    good_win = {"seconds": 60.0, "count": 1, "sum": 0.5,
+                "buckets": [[0.1, 1], ["+Inf", 1]]}
+    telemetry.validate_record(dict(base, window=good_win))
+    with pytest.raises(ValueError, match="window missing numeric"):
+        telemetry.validate_record(
+            dict(base, window={"count": 1, "sum": 0.5,
+                               "buckets": [["+Inf", 1]]}))
+    with pytest.raises(ValueError, match="not monotone"):
+        telemetry.validate_record(dict(base, window=dict(
+            good_win, buckets=[[0.1, 2], [0.3, 1], ["+Inf", 2]])))
+    with pytest.raises(ValueError, match=r"\+Inf"):
+        telemetry.validate_record(dict(base, window=dict(
+            good_win, buckets=[[0.1, 1], [0.3, 1]])))
+    with pytest.raises(ValueError, match="!= *count|window"):
+        telemetry.validate_record(dict(base, window=dict(
+            good_win, count=7)))
+    cbase = {"name": "c", "type": "counter", "value": 3, "ts": 1.0}
+    telemetry.validate_record(
+        dict(cbase, window={"seconds": 60.0, "value": 2}))
+    with pytest.raises(ValueError, match="counter window"):
+        telemetry.validate_record(dict(cbase, window={"seconds": 60.0}))
+
+
+def test_parse_slo_spec_grammar():
+    d = telemetry.parse_slo_spec("itl_p99 < 50ms")
+    assert d["metric"] == "serve.itl_seconds"
+    assert d["quantile"] == pytest.approx(0.99)
+    assert d["threshold_seconds"] == pytest.approx(0.05)
+    d = telemetry.parse_slo_spec("ttft_p50<2s")
+    assert d["metric"] == "serve.ttft_seconds"
+    assert d["threshold_seconds"] == pytest.approx(2.0)
+    d = telemetry.parse_slo_spec("train_step.seconds_p90 < 300us")
+    assert d["metric"] == "train_step.seconds"
+    assert d["threshold_seconds"] == pytest.approx(3e-4)
+    for bad in ("itl < 50ms", "itl_p99 > 50ms", "itl_p99 < 50", ""):
+        with pytest.raises(ValueError):
+            telemetry.parse_slo_spec(bad)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition escaping (ISSUE 11 satellite): label values with
+# backslash/quote/newline must round-trip per the text-format spec, and
+# histogram `le` bounds must render sorted with +Inf last
+# ---------------------------------------------------------------------------
+def _prom_unescape(s):
+    """Inverse of the text-format label-value escaping."""
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def test_exposition_escapes_adversarial_label_values_roundtrip():
+    evil = 'a\\b"c\nd'
+    telemetry.counter("chaos.injections", kind=evil).inc(3)
+    text = telemetry.exposition()
+    assert "\n\n" not in text.strip(), "raw newline leaked into a sample"
+    [line] = [ln for ln in text.splitlines()
+              if ln.startswith("tpumx_chaos_injections_total{")]
+    # every sample line must stay one physical line
+    body = line[line.index("{") + 1:line.rindex("}")]
+    assert body.startswith('kind="') and body.endswith('"')
+    assert _prom_unescape(body[len('kind="'):-1]) == evil
+    assert line.rsplit(" ", 1)[1] == "3"
+
+
+def test_exposition_histogram_le_bounds_sorted_with_inf_last():
+    # buckets deliberately passed unsorted + duplicated: the registry
+    # must canonicalize so `le` renders ascending with +Inf last
+    h = telemetry.histogram("serve.phase_seconds",
+                            buckets=(0.3, 0.1, 0.3, 0.001), phase="prefill")
+    assert h.buckets == (0.001, 0.1, 0.3)
+    for v in (0.0005, 0.2, 5.0):
+        h.observe(v)
+    text = telemetry.exposition()
+    les = []
+    for ln in text.splitlines():
+        if ln.startswith("tpumx_serve_phase_seconds_bucket"):
+            body = ln[ln.index("{") + 1:ln.rindex("}")]
+            le = [kv.split("=")[1].strip('"') for kv in body.split(",")
+                  if kv.startswith("le=")][0]
+            les.append(le)
+    assert les[-1] == "+Inf"
+    finite = [float(v) for v in les[:-1]]
+    assert finite == sorted(finite) == [0.001, 0.1, 0.3]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+              if ln.startswith("tpumx_serve_phase_seconds_bucket")]
+    assert counts == sorted(counts) and counts[-1] == 3
+
+
+def test_flush_refreshes_tracing_dropped_gauge(tmp_path):
+    from tpu_mx import tracing
+    tracing.reset()
+    prior = tracing.configure()
+    try:
+        tracing.configure(capacity=4)
+        for i in range(9):
+            tracing.emit("chaos.inject", kind="hang")
+        assert tracing.stats()["dropped"] == 5
+        telemetry.flush(path=str(tmp_path / "m.jsonl"))
+        g = telemetry.get("tracing.events_dropped")
+        assert g is not None and g.value == 5.0
+    finally:
+        tracing.configure(capacity=prior[1])
+        tracing.reset()

@@ -582,6 +582,7 @@ import random
 from tpu_mx import serving, telemetry, tracing
 from tpu_mx.contrib import chaos
 from tpu_mx.serving import AdmissionReject
+from tpu_mx.telemetry import ATTRIBUTION_TOLERANCE as ATOL
 
 D = os.environ["TPUMX_SERVE_DIR"]
 SEED = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
@@ -595,7 +596,11 @@ def storm(tag, fault, n_req=12, **srv_kw):
     prefix = os.path.join(D, tag)
     srv = serving.Server(model, num_blocks=96, block_size=8, max_batch=4,
                          max_pending=64, max_tokens=100000, backoff=0.0,
-                         blackbox=prefix, **srv_kw)
+                         blackbox=prefix,
+                         slo=serving.SLOMonitor(("itl_p99 < 30s",
+                                                 "ttft_p99 < 30s"),
+                                                windows=(5.0, 30.0)),
+                         **srv_kw)
     todo = [([1 + rng.randrange(40) for _ in range(rng.randint(2, 10))],
              rng.randint(2, 8)) for _ in range(n_req)]
     reqs = []
@@ -612,6 +617,40 @@ def storm(tag, fault, n_req=12, **srv_kw):
     for (prompt, mnt), r in zip(todo, reqs):   # ZERO lost requests
         assert r.state == "done", (tag, r)
         assert len(r.tokens) == mnt, (tag, r, mnt)
+        # the SLO engine's attribution invariant (ISSUE 11): the typed
+        # phases must sum to the independently stamped wall clock within
+        # telemetry.ATTRIBUTION_TOLERANCE (1 ms absolute floor for
+        # sub-ms requests), restart-penalty phases included — a seam
+        # that stops closing its interval, or double-counts one, breaks
+        # this for every faulted request
+        tl = r.timeline
+        lat = r.finished_at - r.submitted_at
+        assert abs(tl.total - lat) <= max(ATOL * lat, 1e-3), (
+            tag, r.id, tl.total, lat, tl.phases)
+        ttft_sum = sum(tl.ttft_breakdown.values())
+        assert abs(ttft_sum - r.ttft) <= max(ATOL * r.ttft, 1e-3), (
+            tag, r.id, ttft_sum, r.ttft, tl.ttft_breakdown)
+    if srv.restarts:
+        # every in-flight request the restart requeued must carry a
+        # nonzero restart_penalty phase (the re-run is attributed, not
+        # smeared into queue_wait)
+        bounced = [r for r in reqs if r.timeline.requeues]
+        assert bounced, tag
+        assert all(r.timeline.phases.get("restart_penalty", 0) > 0
+                   for r in bounced), (tag, bounced)
+    # the live monitor published its gauges and signal hook
+    sig = srv.slo_signal
+    assert sig is not None and not sig["breaching"], (tag, sig)
+    assert srv.scheduler.slo_signal is sig, tag
+    for name in ("itl_p99", "ttft_p99"):
+        assert telemetry.get("serve.slo_estimate_seconds",
+                             slo=name) is not None, (tag, name)
+    # an end-of-run audit box: unlike the restart-time box it contains
+    # the finished requests' serve.request_timeline events — what
+    # tools/slo_report.py's worst-request section (and its offline
+    # re-check of the attribution invariant) reads
+    tracing.dump_blackbox(prefix + "-audit",
+                          reason=f"serve {tag} slo audit")
     path = tracing.blackbox_path(prefix)
     if not os.path.exists(path):   # faults with no restart (reject
         tracing.dump_blackbox(prefix, reason=f"serve {tag} audit")
@@ -790,6 +829,44 @@ def _serve_storm_leg(mode):
                       f"is missing timeline markers {missing}:"
                       f"\n{out[-3000:]}")
                 return 1
+        # the SLO ops surface, under the same poisoned-jax discipline:
+        # schema-gate the storm's telemetry (window sub-objects
+        # included) plus the end-of-run audit box, whose request
+        # timelines slo_report re-checks against the 5% attribution
+        # invariant offline — and whose worst-request section must
+        # actually render recorded timelines
+        slo_tool = os.path.join(repo, "tools", "slo_report.py")
+        audit = os.path.join(d, "sv-nan-audit-blackbox.json")
+        code = ("import sys, runpy; "
+                "sys.modules['jax'] = None; "
+                "sys.modules['tpu_mx'] = None; "
+                f"sys.argv = ['slo_report.py', {jsonl!r}, "
+                f"'--box', {audit!r}, '--validate']; "
+                f"runpy.run_path({slo_tool!r}, run_name='__main__')")
+        try:
+            slo = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  serve[{tag_mode}]: slo_report timed out: {e}")
+            return 1
+        out = (slo.stdout or "") + (slo.stderr or "")
+        if slo.returncode != 0:
+            print(f"  serve[{tag_mode}]: slo_report failed "
+                  f"(rc={slo.returncode}):\n{out[-3000:]}")
+            return 1
+        # "serving.SLOMonitor state" appears only in the ARMED gauge
+        # rendering — the none-armed fallback line also says "Live
+        # monitor gauges", which would let missing serve.slo_* series
+        # slip through a looser marker
+        missing = [m for m in ("SLO targets", "Worst requests by latency",
+                               "serving.SLOMonitor state")
+                   if m not in out]
+        if missing or "top 5 of 0 recorded" in out:
+            print(f"  serve[{tag_mode}]: slo_report output is missing "
+                  f"sections {missing or ['request timelines']}:"
+                  f"\n{out[-3000:]}")
+            return 1
     return 0
 
 
@@ -900,6 +977,24 @@ def obs_tier():
             print(f"  obs: telemetry validation failed "
                   f"(rc={val.returncode}):\n{out[-3000:]}")
             return val.returncode or 1
+        # the SLO ops surface must schema-gate the same snapshot (rc
+        # 0/1/2 contract like blackbox_report): window sub-objects are
+        # part of the record schema, and a training-only file must
+        # render cleanly (no serving data is "no data", not an error)
+        try:
+            slo = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "slo_report.py"),
+                 jsonl, "--validate"],
+                capture_output=True, text=True, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  obs: slo_report validation timed out: {e}")
+            return 1
+        if slo.returncode != 0:
+            print(f"  obs: slo_report validation failed "
+                  f"(rc={slo.returncode}):\n"
+                  f"{((slo.stdout or '') + (slo.stderr or ''))[-3000:]}")
+            return slo.returncode or 1
         rc = _blackbox_leg(repo, env)
         if rc != 0:
             return rc

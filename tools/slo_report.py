@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Render live-window SLO state, burn rate, and worst-request breakdowns.
+
+The serving runtime's telemetry snapshots (TPUMX_TELEMETRY JSONL) carry,
+on every counter/histogram record, a ``window`` sub-object — the
+trailing-window state the SLO engine reads live (tpu_mx/telemetry.py).
+This tool is the jax-less ops view over that data:
+
+- **Windowed latency state**: per histogram series, the window's sample
+  count and p50/p90/p99 bucket-merge estimates (the same math the live
+  monitor uses — ``telemetry.quantile_from_cumulative``);
+- **SLO targets**: each ``--slo`` spec (default: the serving pair
+  ``ttft_p99 < 500ms`` / ``itl_p99 < 50ms``; grammar:
+  ``telemetry.parse_slo_spec``) evaluated against the window —
+  estimate vs threshold, attainment, error-budget burn rate, OK/BREACH;
+- **Live monitor gauges**: the ``serve.slo_*`` series a running
+  ``serving.SLOMonitor`` published, when armed;
+- **Worst requests** (``--box <prefix>-blackbox.json``): the
+  ``serve.request_timeline`` events from a flight-recorder black box,
+  sorted by latency, each decomposed into its typed phases
+  (queue_wait/prefill/decode_gap/restart_penalty/defer_stall) with
+  percentages — "which phase of this slow request ate the budget".
+
+``--validate`` schema-gates every telemetry record (including the
+window sub-objects) against the catalog, every box event against
+``tracing.KNOWN_EVENTS``, and every request timeline against the
+attribution invariant (phases sum to the recorded latency within 5%).
+Exit status: 0 ok, 1 validation failure, 2 unreadable input — the same
+contract as tools/blackbox_report.py, enforced by the ``obs``/``serve``
+CI tiers.
+
+The tpu_mx modules are loaded standalone from their files — this tool
+NEVER imports the ``tpu_mx`` package (which would boot jax); it must
+work on a machine with no accelerator stack at all.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# share blackbox_report's standalone loader (load tpu_mx/<name>.py by
+# file path, NEVER import the package — which would boot jax) instead of
+# keeping a third copy of the mechanism in sync
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from blackbox_report import load_module  # noqa: E402
+
+
+def read_series(path, telemetry, validate=False):
+    """{(name, labels_json): last_record} from a cumulative-snapshot
+    JSONL file, plus the validation error list."""
+    series, errors = {}, []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errors.append(f"line {lineno}: not JSON: {e}")
+                continue
+            if validate:
+                try:
+                    telemetry.validate_record(rec)
+                except ValueError as e:
+                    errors.append(f"line {lineno}: {e}")
+                    continue
+                if rec["name"] not in telemetry.KNOWN_METRICS:
+                    errors.append(
+                        f"line {lineno}: unknown metric name "
+                        f"{rec['name']!r} — not in telemetry.KNOWN_METRICS")
+                    continue
+            key = (rec.get("name"),
+                   json.dumps(rec.get("labels", {}), sort_keys=True))
+            series[key] = rec
+    return series, errors
+
+
+def _label(name, labels_json):
+    labels = json.loads(labels_json)
+    if not labels:
+        return name
+    return name + "{%s}" % ",".join(f"{k}={v}"
+                                    for k, v in sorted(labels.items()))
+
+
+def _ms(v):
+    return "-" if v is None else f"{v * 1e3:.3f}"
+
+
+def render_windows(series, telemetry):
+    """The windowed-histogram table: per series with window samples,
+    count and p50/p90/p99 estimates in ms."""
+    lines = ["Windowed latency state (trailing-window bucket-merge "
+             "estimates, ms):",
+             "  %-44s %8s %7s %10s %10s %10s" %
+             ("Series", "win(s)", "count", "p50", "p90", "p99")]
+    shown = 0
+    for (name, lj), rec in sorted(series.items()):
+        if rec.get("type") != "histogram":
+            continue
+        win = rec.get("window")
+        if not win or not win.get("count"):
+            continue
+        shown += 1
+        q = {}
+        for p in (0.50, 0.90, 0.99):
+            q[p] = telemetry.quantile_from_cumulative(
+                win["buckets"], p, vmin=win.get("min"),
+                vmax=win.get("max"))
+        lines.append("  %-44s %8g %7d %10s %10s %10s" % (
+            _label(name, lj), win.get("seconds", 0), win["count"],
+            _ms(q[0.50]), _ms(q[0.90]), _ms(q[0.99])))
+    if not shown:
+        lines.append("  (no histogram series with window samples — "
+                     "pre-window snapshot, or the run was idle)")
+    return lines
+
+
+def render_slos(series, telemetry, specs):
+    """Evaluate each --slo spec against its histogram's window."""
+    lines = ["SLO targets (evaluated over each series' trailing "
+             "window):",
+             "  %-28s %12s %12s %11s %9s %8s" %
+             ("Target", "estimate", "threshold", "attainment", "burn",
+              "status")]
+    for spec in specs:
+        try:
+            d = telemetry.parse_slo_spec(spec)
+        except ValueError as e:
+            lines.append(f"  {spec!r}: {e}")
+            continue
+        rec = series.get((d["metric"], "{}"))
+        win = (rec or {}).get("window")
+        if not win or not win.get("count"):
+            lines.append("  %-28s %12s %12s %11s %9s %8s" % (
+                d["name"], "-", _ms(d["threshold_seconds"]), "-", "-",
+                "no data"))
+            continue
+        est = telemetry.quantile_from_cumulative(
+            win["buckets"], d["quantile"], vmin=win.get("min"),
+            vmax=win.get("max"))
+        att = telemetry.fraction_le_from_cumulative(
+            win["buckets"], d["threshold_seconds"], vmin=win.get("min"),
+            vmax=win.get("max"))
+        burn = (1.0 - att) / (1.0 - d["objective"])
+        lines.append("  %-28s %9s ms %9s ms %11.4f %9.2f %8s" % (
+            d["name"], _ms(est), _ms(d["threshold_seconds"]), att, burn,
+            "BREACH" if burn >= 1.0 else "OK"))
+    return lines
+
+
+def render_monitor_gauges(series):
+    """The serve.slo_* gauges a live SLOMonitor published."""
+    rows = [(k, r) for k, r in sorted(series.items())
+            if k[0].startswith("serve.slo_")]
+    if not rows:
+        return ["Live monitor gauges: (none — no SLOMonitor was armed)"]
+    lines = ["Live monitor gauges (serving.SLOMonitor state at last "
+             "snapshot):"]
+    for (name, lj), rec in rows:
+        lines.append("  %-56s %g" % (_label(name, lj), rec.get("value")))
+    return lines
+
+
+def timeline_phases(tracing):
+    """The attribution phases, in render order, derived from the
+    ``serve.request_timeline`` event schema — NOT hand-copied from
+    tpu_mx/serving/timeline.py, so a new phase can never make this
+    tool's invariant re-check under-count and fail correct data."""
+    schema = tracing.KNOWN_EVENTS["serve.request_timeline"]
+    return tuple(k for k, t in schema.items()
+                 if t == "float" and k not in ("latency", "ttft"))
+
+
+def request_timelines(box):
+    """The serve.request_timeline events from a black-box document."""
+    return [e for e in box.get("events", [])
+            if e.get("event") == "serve.request_timeline"
+            and isinstance(e.get("data"), dict)]
+
+
+def render_worst_requests(box, top, phases):
+    """Top-N requests by latency, each with its phase breakdown."""
+    tls = sorted(request_timelines(box),
+                 key=lambda e: -float(e["data"].get("latency", 0.0)))
+    lines = [f"Worst requests by latency (top {top} of {len(tls)} "
+             "recorded timelines):"]
+    if not tls:
+        lines.append("  (no serve.request_timeline events in the box)")
+        return lines
+    for e in tls[:top]:
+        d = e["data"]
+        lat = float(d.get("latency", 0.0))
+        parts = []
+        for p in phases:
+            v = float(d.get(p, 0.0))
+            if v > 0:
+                pct = 100.0 * v / lat if lat > 0 else 0.0
+                parts.append(f"{p} {v * 1e3:.2f}ms ({pct:.0f}%)")
+        lines.append(
+            "  %-12s %8.2fms  %-8s tok=%-3s requeues=%s defers=%s"
+            % (d.get("request", "?"), lat * 1e3, d.get("outcome", "?"),
+               d.get("tokens", "?"), d.get("requeues", "?"),
+               d.get("defers", "?")))
+        lines.append("    " + (" + ".join(parts) if parts else "(empty)"))
+    return lines
+
+
+def validate_timelines(box, phases, tolerance):
+    """The attribution invariant, re-checked offline: each recorded
+    timeline's phases must sum to its latency within tolerance
+    (``telemetry.ATTRIBUTION_TOLERANCE`` — the serve CI tier's bar)."""
+    errors = []
+    for e in request_timelines(box):
+        d = e["data"]
+        lat = float(d.get("latency", 0.0))
+        total = sum(float(d.get(p, 0.0)) for p in phases)
+        tol = max(tolerance * lat, 1e-3)
+        if abs(total - lat) > tol:
+            errors.append(
+                f"request {d.get('request', '?')}: phases sum to "
+                f"{total * 1e3:.3f}ms but latency is {lat * 1e3:.3f}ms "
+                f"(tolerance {tol * 1e3:.3f}ms)")
+    return errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("file", help="TPUMX_TELEMETRY JSONL snapshot file")
+    ap.add_argument("--box", default=None,
+                    help="a <prefix>-blackbox.json dump: adds the "
+                         "worst-request phase-breakdown section")
+    ap.add_argument("--slo", action="append", default=[],
+                    help="SLO spec to evaluate, e.g. 'itl_p99 < 50ms' "
+                         "(repeatable; default: the serving pair)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="worst requests to show (default 5)")
+    ap.add_argument("--validate", action="store_true",
+                    help="fail on schema violations or attribution "
+                         "invariant breaks")
+    opts = ap.parse_args(argv)
+    telemetry = load_module("telemetry")
+    try:
+        series, errors = read_series(opts.file, telemetry,
+                                     validate=opts.validate)
+    except OSError as e:
+        print(f"slo_report: cannot read {opts.file}: {e}",
+              file=sys.stderr)
+        return 2
+    box = tracing = None
+    if opts.box:
+        try:
+            with open(opts.box, encoding="utf-8") as f:
+                box = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"slo_report: cannot read {opts.box}: {e}",
+                  file=sys.stderr)
+            return 2
+        tracing = load_module("tracing")
+
+    out = [f"SLO report: {opts.file}", ""]
+    out.extend(render_windows(series, telemetry))
+    out.append("")
+    out.extend(render_slos(series, telemetry,
+                           opts.slo or list(telemetry.DEFAULT_SLOS)))
+    out.append("")
+    out.extend(render_monitor_gauges(series))
+    if box is not None:
+        out.append("")
+        out.extend(render_worst_requests(box, opts.top,
+                                         timeline_phases(tracing)))
+    print("\n".join(out))
+
+    if opts.validate:
+        if box is not None:
+            try:
+                tracing.validate_blackbox(box)
+            except ValueError as e:
+                errors.append(f"box: {e}")
+            errors.extend(f"box: {e}" for e in validate_timelines(
+                box, timeline_phases(tracing),
+                telemetry.ATTRIBUTION_TOLERANCE))
+        if not series:
+            errors.append("file contains no telemetry records")
+        if errors:
+            print("VALIDATION FAILED:", file=sys.stderr)
+            for e in errors:
+                print(f"  {e}", file=sys.stderr)
+            return 1
+        print(f"schema OK: {len(series)} series"
+              + (f", {len(request_timelines(box))} request timeline(s)"
+                 if box is not None else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

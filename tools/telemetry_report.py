@@ -56,10 +56,16 @@ REQUIRE_PRESETS = {
     # "serve" gates the serve tier: the SLO histograms must have samples,
     # throughput must be nonzero, and the chaos schedule must have
     # actually driven an engine restart (queue_depth/cache_utilization
-    # are deliberately absent — both are rightly 0 once a run drains)
+    # are deliberately absent — both are rightly 0 once a run drains).
+    # The SLO-engine additions (ISSUE 11): per-request phase attribution
+    # must have landed, and the live monitor must have published its
+    # windowed estimate and attainment gauges (burn_rate/breaching are
+    # deliberately absent — both are rightly 0 on a healthy run).
     "serve": ("serve.requests", "serve.ttft_seconds", "serve.itl_seconds",
               "serve.generated_tokens", "serve.decode_steps",
-              "serve.tokens_per_sec", "serve.engine_restarts"),
+              "serve.tokens_per_sec", "serve.engine_restarts",
+              "serve.phase_seconds", "serve.slo_estimate_seconds",
+              "serve.slo_attainment"),
 }
 
 

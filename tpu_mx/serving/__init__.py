@@ -21,6 +21,13 @@ names.  Layer map, bottom up:
 - :mod:`.server` — ``submit``/``stream``/``step``; watchdog +
   classified engine restart reusing ``tpu_mx.supervisor``'s patterns —
   queued requests survive a restart and re-run.
+- :mod:`.timeline` — per-request latency attribution: every request's
+  wall clock decomposed into typed phases (queue_wait/prefill/
+  decode_gap/restart_penalty/defer_stall) that sum to the measured
+  TTFT/latency.
+- :mod:`.slo` — the live SLO monitor: declarative targets over the
+  telemetry layer's sliding windows, multi-window error-budget burn
+  rate, the ``serve.slo_*`` gauges and the scheduler signal hook.
 
 Telemetry (``serve.*`` in ``telemetry.KNOWN_METRICS``) and the request
 lifecycle events (``serve.admit/prefill/decode/evict/reject/restart`` in
@@ -33,6 +40,8 @@ from .attention import (dense_attention, dense_decode_attention,
                         decode_attention, decode_path, prefill_attention,
                         resolve_decode_path)
 from .model import TinyLM
+from .timeline import RequestTimeline
+from .slo import SLO, SLOMonitor
 from .scheduler import (AdmissionReject, ContinuousBatchingScheduler,
                         Request, StaticBatchingScheduler)
 from .engine import EngineCore
@@ -42,4 +51,5 @@ __all__ = ["BlockAllocator", "CacheExhausted", "PagedKVCache",
            "dense_attention", "dense_decode_attention", "decode_attention",
            "decode_path", "resolve_decode_path", "prefill_attention",
            "TinyLM", "AdmissionReject", "ContinuousBatchingScheduler",
-           "Request", "StaticBatchingScheduler", "EngineCore", "Server"]
+           "Request", "StaticBatchingScheduler", "EngineCore", "Server",
+           "RequestTimeline", "SLO", "SLOMonitor"]

@@ -42,6 +42,7 @@ from ..base import MXNetError
 from .. import telemetry as _telemetry
 from .. import tracing as _tracing
 from ..contrib import chaos as _chaos
+from .timeline import RequestTimeline
 
 __all__ = ["Request", "AdmissionReject", "ContinuousBatchingScheduler",
            "StaticBatchingScheduler"]
@@ -87,7 +88,12 @@ class Request:
         self.requeues = 0
         self.submitted_at = time.perf_counter()
         self.first_token_at = None
+        self.finished_at = None
         self.token_times = []
+        # the attribution ledger shares the submit timestamp so phases
+        # and the TTFT/latency bookkeeping run on one clock
+        # (tpu_mx/serving/timeline.py; docs/observability.md)
+        self.timeline = RequestTimeline(self.submitted_at)
         self._done = threading.Event()
 
     @property
@@ -110,12 +116,12 @@ class Request:
         now = time.perf_counter()
         if self.first_token_at is None:
             self.first_token_at = now
-            _telemetry.histogram("serve.ttft_seconds").observe(self.ttft)
         else:
             _telemetry.histogram("serve.itl_seconds").observe(
                 now - self.token_times[-1])
         self.token_times.append(now)
         self.tokens.append(int(token))
+        self.timeline.mark_token(now)
 
     def reset_generation(self):
         """Discard generated state for a re-run (restart/preemption)."""
@@ -124,15 +130,39 @@ class Request:
         self.first_token_at = None
         self.requeues += 1
         self.state = "queued"
+        self.timeline.mark_requeue()
+
+    def _observe_ttft(self):
+        # one serve.ttft_seconds sample per REQUEST, stamped at terminal
+        # time from the final attempt's first token: a per-attempt
+        # observe would let a restart's discarded attempt contribute an
+        # extra, optimistic sample (no restart penalty) to exactly the
+        # histogram the SLO monitor alerts on during an incident.
+        # Deliberate tradeoff: the sample lands when the request ENDS,
+        # so TTFT breach detection lags by the decode duration and
+        # still-decoding requests are invisible to the window — fine at
+        # this runtime's generation lengths; long-generation serving
+        # would want an in-flight-aware read (docs/observability.md).
+        if self.first_token_at is not None:
+            _telemetry.histogram("serve.ttft_seconds").observe(self.ttft)
 
     def finish(self, reason="length"):
         self.state = "done"
         self.finish_reason = reason
+        self.finished_at = time.perf_counter()
+        self._observe_ttft()
+        self.timeline.finalize(self.id, "done", ttft=self.ttft)
         self._done.set()
 
     def fail(self, reason):
         self.state = "failed"
         self.finish_reason = reason
+        self.finished_at = time.perf_counter()
+        self._observe_ttft()
+        self.timeline.finalize(
+            self.id,
+            "rejected" if str(reason).startswith("rejected") else "failed",
+            ttft=self.ttft)
         self._done.set()
 
     def wait(self, timeout=None):
@@ -157,6 +187,11 @@ class ContinuousBatchingScheduler:
         self._lock = threading.RLock()
         self._pending = []
         self._running = []
+        # the server publishes its SLO monitor's latest signal here each
+        # step (tpu_mx/serving/slo.py) — the hook a fairness-aware
+        # admission policy consults; this base policy records it without
+        # acting on it (the ROADMAP fleet-scale item is the consumer)
+        self.slo_signal = None
 
     # -- admission (any thread) ----------------------------------------------
     def submit(self, req):
@@ -170,12 +205,17 @@ class ContinuousBatchingScheduler:
                 f"prompt+max_new = {req.budget_tokens} tokens > "
                 f"max_tokens = {self.max_tokens}")
         with self._lock:
-            if len(self._pending) >= self.max_pending:
-                self.reject(
-                    req, "queue_full",
-                    f"{len(self._pending)} pending >= max_pending = "
-                    f"{self.max_pending}")
-            self._pending.append(req)
+            # the reject itself (handle fail + timeline finalize +
+            # telemetry + event) runs OUTSIDE the lock: a client-thread
+            # reject burst must not block the step thread's queue ops
+            depth = len(self._pending)
+            full = depth >= self.max_pending
+            if not full:
+                self._pending.append(req)
+        if full:
+            self.reject(
+                req, "queue_full",
+                f"{depth} pending >= max_pending = {self.max_pending}")
         _telemetry.counter("serve.requests", state="admitted").inc()
         _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
         _tracing.emit("serve.admit", request=req.id,
@@ -231,9 +271,13 @@ class ContinuousBatchingScheduler:
     def finish(self, req, reason="length"):
         """Mark ``req`` finished; returns the requests whose cache should
         be evicted NOW (continuous: immediately — the block pool is the
-        scarce resource and a finished sequence holds it for no one)."""
+        scarce resource and a finished sequence holds it for no one).
+        ``req.finish`` (terminal telemetry: TTFT observe, per-phase
+        histograms, the timeline event) runs OUTSIDE the lock — only the
+        step thread calls this, and holding the lock through it would
+        serialize submitting threads against per-request telemetry."""
+        req.finish(reason)
         with self._lock:
-            req.finish(reason)
             if req in self._running:
                 self._running.remove(req)
         return [req]
@@ -337,8 +381,8 @@ class StaticBatchingScheduler(ContinuousBatchingScheduler):
             return list(self._running) + list(self._finished)
 
     def finish(self, req, reason="length"):
+        req.finish(reason)   # terminal telemetry outside the lock
         with self._lock:
-            req.finish(reason)
             if req in self._running:
                 self._running.remove(req)
                 self._finished.append(req)

@@ -51,6 +51,7 @@ from ..supervisor import classify, run_with_deadline
 from .engine import EngineCore
 from .kv_cache import CacheExhausted
 from .scheduler import ContinuousBatchingScheduler, Request
+from .slo import SLOMonitor
 
 __all__ = ["Server"]
 
@@ -71,9 +72,27 @@ class Server:
     def __init__(self, model, *, scheduler=None, max_pending=64,
                  max_batch=8, max_tokens=8192, block_size=16,
                  num_blocks=256, deadline=None, max_restarts=3,
-                 backoff=0.05, blackbox=None, eos_id=None,
+                 backoff=0.05, blackbox=None, eos_id=None, slo=None,
                  dtype=np.float32):
         self.model = model
+        # the live SLO monitor (tpu_mx/serving/slo.py): True arms the
+        # default targets, a list/tuple of spec strings builds a monitor
+        # from them, or pass a configured SLOMonitor.  Refreshed every
+        # step; its signal is published to scheduler.slo_signal (the
+        # fairness hook) and force-refreshed before every black-box dump
+        # so a restart's box carries the fault-time SLO window state.
+        if slo is True:
+            slo = SLOMonitor()
+        elif not slo:
+            slo = None   # False/()/[] all mean unarmed, same as None
+        elif isinstance(slo, str):
+            slo = SLOMonitor((slo,))
+        elif isinstance(slo, (list, tuple)):
+            slo = SLOMonitor(slo)
+        elif not isinstance(slo, SLOMonitor):
+            raise TypeError(f"slo= takes True, spec string(s), or an "
+                            f"SLOMonitor — got {type(slo).__name__}")
+        self.slo = slo
         self.scheduler = scheduler if scheduler is not None else \
             ContinuousBatchingScheduler(max_pending=max_pending,
                                         max_batch=max_batch,
@@ -145,6 +164,7 @@ class Server:
             if self._t_first_work is None:
                 self._t_first_work = time.perf_counter()
             _tracing.set_context(request=req.id)
+            req.timeline.mark_prefill_start()
             try:
                 first = run_with_deadline(
                     lambda r=req: self.engine.prefill(r),
@@ -156,12 +176,32 @@ class Server:
                 # is reset or counted — and the step FALLS THROUGH to
                 # decode, whose progress (and evictions) is what will
                 # free the blocks; an early return here would starve
-                # decode and livelock
+                # decode and livelock.  Attribution: the bounced attempt
+                # (and the wait until its retry) is a defer_stall; the
+                # admissions behind it never started — their wait keeps
+                # its label until the stall begins
+                req.timeline.mark_prefill_failed()
+                for later in admits[i + 1:]:
+                    later.timeline.mark_defer()
                 self.scheduler.defer(admits[i:])
                 _tracing.set_context(request=None)
                 break
+            except BaseException:
+                # engine fault mid-prefill (numeric divergence, wedged
+                # deadline): take_prefills() already popped this step's
+                # admissions and the restart path only requeues RUNNING
+                # requests — put them back before the classified
+                # restart or they are silently lost (state "queued" in
+                # neither queue; wait() hangs forever).  The faulting
+                # request pays a requeue (its destroyed attempt is
+                # restart_penalty); the ones behind it never started
+                # and keep accruing queue wait.
+                self.scheduler.defer(admits[i + 1:])
+                self.scheduler.requeue(req, front=True)
+                raise
             finally:
                 _tracing.set_context(request=None)
+            req.timeline.mark_prefill_end()
             self.scheduler.mark_running(req)
             self._commit_token(req, first)
             worked = True
@@ -241,6 +281,19 @@ class Server:
             if dt > 0:
                 _telemetry.gauge("serve.tokens_per_sec").set(
                     self._tokens_generated / dt)
+        if self.slo is not None:
+            # rate-limited inside the monitor; the signal lands on the
+            # scheduler for admission policies that weigh it
+            self.scheduler.slo_signal = self.slo.refresh()
+
+    @property
+    def slo_signal(self):
+        """The SLO monitor's latest signal dict, or None when no
+        monitor is armed (the hook the fleet-scale fairness item
+        consumes — see tpu_mx/serving/slo.py).  A property, matching
+        ``scheduler.slo_signal``'s attribute access — one name, one
+        access style on both surfaces."""
+        return self.slo.signal() if self.slo is not None else None
 
     # -- self-healing --------------------------------------------------------
     def _restart(self, err):
@@ -299,6 +352,16 @@ class Server:
     def _dump_blackbox(self, reason):
         if not self.blackbox:
             return None
+        if self.slo is not None:
+            # capture the fault-time SLO window state in the box's
+            # telemetry snapshot (bypassing the refresh rate limit);
+            # box-less servers skip it — the per-step refresh keeps the
+            # gauges fresh within the rate limit anyway
+            try:
+                self.scheduler.slo_signal = self.slo.refresh(force=True)
+            except Exception as slo_err:  # noqa: BLE001 — best effort
+                log.warning("serving: SLO refresh at black-box time "
+                            "failed: %s", slo_err)
         try:
             return _tracing.dump_blackbox(self.blackbox, reason=reason)
         except Exception as dump_err:  # noqa: BLE001 — best effort

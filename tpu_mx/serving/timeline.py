@@ -1,0 +1,188 @@
+"""Per-request latency attribution: every request's wall clock, typed.
+
+The SLO pair (TTFT/ITL histograms) says *how slow*; this module says
+*where the time went*.  Each :class:`~tpu_mx.serving.scheduler.Request`
+owns a :class:`RequestTimeline` that decomposes its whole lifetime —
+submit to finish/fail — into typed phases stamped at the existing
+scheduler/engine/server seams:
+
+- ``queue_wait``   — pending-queue time before a prefill attempt starts
+- ``prefill``      — the prompt's engine prefill (watchdog wait included:
+  this is the *request's* wall clock, not the device's)
+- ``decode_gap``   — per-token: from the previous committed token (or
+  prefill end) to this commit — scheduler share and decode compute both
+- ``restart_penalty`` — everything an engine restart / cache preemption
+  cost this request: the in-flight interval at fault time, plus the
+  rebuild/backoff/queue wait until its re-run's prefill starts
+- ``defer_stall``  — cache-backpressure deferrals: the wait after a
+  prefill admission bounced on ``CacheExhausted``
+- ``reject``       — the (tiny) interval a rejected admission consumed
+
+The accounting is **interval-complete by construction**: a single
+``_mark`` cursor advances monotonically from ``submitted_at``, and every
+seam closes ``[mark, now]`` into exactly one phase — so the phases sum
+to the measured wall clock and any clock-mixing or double-count bug
+breaks the 5% invariant the serve CI tier asserts.  At first-token time
+the cumulative sums are snapshotted as ``ttft_breakdown`` (which
+therefore sums to the measured TTFT, restarts included — the snapshot
+resets when a requeue discards the generation).
+
+One ``serve.request_timeline`` event per request is emitted at
+finish/fail (never per transition — 512 ring slots are for *whole*
+lifecycles) carrying the request id in its payload (``data.request``;
+the process-global trace context is NOT written here — finalize can run
+on the submitting thread), and each phase total lands in
+the ``serve.phase_seconds{phase=...}`` histogram, windowed like every
+histogram, so "which phase is eating the fleet's budget *right now*" is
+an O(buckets) read (docs/observability.md "SLO engine").
+
+Thread-safety: a timeline is mutated only by the thread driving its
+request — the server's step thread after admission, the submitting
+thread for a synchronous reject — matching the Request handle's own
+discipline (docs/serving.md).
+"""
+from __future__ import annotations
+
+import time
+
+from .. import telemetry as _telemetry
+from .. import tracing as _tracing
+
+__all__ = ["PHASES", "RequestTimeline"]
+
+# the closed set of phase names (docs/observability.md documents each);
+# serve.phase_seconds{phase=...} and the serve.request_timeline payload
+# carry exactly these
+PHASES = ("queue_wait", "prefill", "decode_gap", "restart_penalty",
+          "defer_stall", "reject")
+
+
+class RequestTimeline:
+    """See module docstring.  ``t0`` is the request's ``submitted_at``
+    (the same ``perf_counter`` reading, so the attribution and the SLO
+    bookkeeping share one clock)."""
+
+    __slots__ = ("t0", "_mark", "_wait_kind", "_in_flight", "phases",
+                 "defers", "requeues", "tokens", "ttft_breakdown",
+                 "_first_token_pending", "ended_at", "outcome")
+
+    def __init__(self, t0=None):
+        self.t0 = time.perf_counter() if t0 is None else float(t0)
+        self._mark = self.t0
+        self._wait_kind = "queue_wait"   # what the NEXT wait interval is
+        self._in_flight = False          # prefill done, decoding
+        self.phases = {}
+        self.defers = 0
+        self.requeues = 0
+        self.tokens = 0                  # delivered by the final attempt
+        self.ttft_breakdown = None
+        self._first_token_pending = True
+        self.ended_at = None
+        self.outcome = None
+
+    # -- the one accounting primitive ----------------------------------------
+    def _close(self, phase, now=None):
+        """Close ``[mark, now]`` into ``phase`` and advance the mark."""
+        now = time.perf_counter() if now is None else now
+        if now > self._mark:
+            self.phases[phase] = (self.phases.get(phase, 0.0)
+                                  + (now - self._mark))
+            self._mark = now
+        return self._mark
+
+    # -- seams (server/scheduler call these) ---------------------------------
+    def mark_prefill_start(self):
+        """The server picked this request's prefill: the wait so far was
+        queue_wait (or restart_penalty/defer_stall after a requeue or
+        deferral)."""
+        self._close(self._wait_kind)
+        self._wait_kind = "queue_wait"
+
+    def mark_prefill_end(self):
+        self._close("prefill")
+        self._in_flight = True
+
+    def mark_prefill_failed(self):
+        """The prefill attempt bounced on cache backpressure: the
+        attempt itself, and the wait until the retry starts, are a
+        defer stall."""
+        self._close("defer_stall")
+        self._wait_kind = "defer_stall"
+        self._in_flight = False
+        self.defers += 1
+
+    def mark_defer(self):
+        """Deferred before starting (an earlier admission in the same
+        step exhausted the cache): the wait so far keeps its label, the
+        wait from here to the retried prefill is a defer stall."""
+        self._close(self._wait_kind)
+        self._wait_kind = "defer_stall"
+        self.defers += 1
+
+    def mark_token(self, now=None):
+        """A token committed: the gap since the previous commit (or the
+        prefill end) is decode_gap.  The first token of an attempt
+        snapshots the cumulative phase sums — the TTFT breakdown."""
+        self._close("decode_gap", now)
+        self.tokens += 1
+        if self._first_token_pending:
+            self._first_token_pending = False
+            self.ttft_breakdown = dict(self.phases)
+
+    def mark_requeue(self):
+        """An engine restart / cache preemption discarded this request's
+        generation: the in-flight interval, and everything until the
+        re-run's prefill starts, is restart penalty.  The first-token
+        snapshot resets with the generation (TTFT is measured to the
+        final attempt's first token)."""
+        self._close("restart_penalty")
+        self._wait_kind = "restart_penalty"
+        self._in_flight = False
+        self.requeues += 1
+        self.tokens = 0
+        self._first_token_pending = True
+        self.ttft_breakdown = None
+
+    # -- terminal ------------------------------------------------------------
+    def finalize(self, request_id, outcome, ttft=None, now=None):
+        """Close the books (idempotent) and emit the one-per-request
+        ``serve.request_timeline`` event + phase histograms.  ``outcome``
+        is ``done``/``failed``/``rejected``; ``ttft`` the request's
+        measured submit→first-token seconds when a token was produced."""
+        if self.ended_at is not None:
+            return
+        if outcome == "rejected":
+            self._close("reject", now)
+        elif outcome != "done":
+            # failed mid-decode (degraded drain of RUNNING requests):
+            # the residual interval was in-flight, not queued — it is
+            # the decode gap that never committed.  A request failed
+            # while genuinely waiting keeps the wait label it was
+            # accruing under.
+            self._close("decode_gap" if self._in_flight
+                        else self._wait_kind, now)
+        # a "done" request's mark already sits at its last token commit
+        self.ended_at = self._mark
+        self.outcome = outcome
+        for phase, seconds in self.phases.items():
+            _telemetry.histogram("serve.phase_seconds",
+                                 phase=phase).observe(seconds)
+        payload = {p: self.phases.get(p, 0.0) for p in PHASES}
+        if ttft is not None:
+            payload["ttft"] = float(ttft)
+        # the request id travels in the PAYLOAD, not the trace context:
+        # finalize can run on the submitting thread (synchronous
+        # reject), and the context is process-global — writing it here
+        # would race the step thread's request scope.  Join timeline
+        # events on data.request.
+        _tracing.emit("serve.request_timeline", request=request_id,
+                      outcome=outcome, latency=self.ended_at - self.t0,
+                      tokens=self.tokens, requeues=self.requeues,
+                      defers=self.defers, **payload)
+
+    @property
+    def total(self):
+        """Sum of all attributed phases (== ended_at - t0 once
+        finalized; the CI invariant compares this against the request's
+        independently stamped wall clock)."""
+        return sum(self.phases.values())

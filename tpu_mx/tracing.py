@@ -120,6 +120,26 @@ KNOWN_EVENTS = {
     # paged-kernel) and where its KV pool lives (host / device) — a
     # restarted engine's black box records which data plane it was on
     "serve.decode_path": {"path": "str", "storage": "str"},
+    # per-request latency attribution (tpu_mx/serving/timeline.py,
+    # ISSUE 11): emitted ONCE per request at finish/fail/reject — not
+    # per phase transition, which would flood the ring — with the
+    # request's wall clock decomposed into the typed phases.  The
+    # invariant the serve CI tier gates: the phase fields sum to the
+    # measured request latency within 5% (and the breakdown snapshot at
+    # first-token time sums to the measured ttft).
+    "serve.request_timeline": {
+        "request": "str", "outcome": "str", "latency": "float",
+        "ttft": "float", "queue_wait": "float", "prefill": "float",
+        "decode_gap": "float", "restart_penalty": "float",
+        "defer_stall": "float", "reject": "float",
+        "tokens": "int", "requeues": "int", "defers": "int"},
+    # SLO monitor breach transitions (tpu_mx/serving/slo.py): emitted
+    # when a declared target starts or stops breaching its multi-window
+    # error-budget burn bar — the timeline record of WHEN the SLO state
+    # flipped (the continuous state lives in the serve.slo_* gauges)
+    "serve.slo": {"slo": "str", "breaching": "bool", "burn_rate": "float",
+                  "estimate_seconds": "float", "attainment": "float",
+                  "threshold_seconds": "float"},
 }
 
 # the documented values of train_step.phase's `phase` field (the whole
@@ -395,6 +415,10 @@ def blackbox_doc(reason="", last=None):
     stats, a full telemetry snapshot and the environment fingerprint."""
     try:
         from . import telemetry
+        # surface ring overflow (and any future bridge gauge) in the
+        # box's own telemetry — one shared helper so the flush and
+        # black-box export paths can never drift apart
+        telemetry._refresh_bridge_gauges()
         tel = telemetry.snapshot()
     except ImportError:
         tel = []  # standalone module load: no telemetry registry
