@@ -1,0 +1,642 @@
+"""Multi-tenant serving: shared-prefix KV reuse + SLO-weighted fairness
+(tpu_mx/serving/prefix_cache.py, tenancy.py, the refcounted allocator —
+ISSUE 12).
+
+Covers: allocator refcount invariants under a concurrent
+share/cow/free hammer (double-free stays loud), copy-on-write semantics
+(fork + divergent append never mutates a sharer's bits, in both storage
+modes), the prefix trie (match with the final-token cap, insertion,
+LRU-leaf eviction under pool pressure, exhaustion backpressure
+unchanged), greedy-stream BIT-equality with sharing on vs off in both
+decode arms, tenant quotas (``tenant_quota`` rejects) and
+weighted-fairness admission ordering (including the SLO burn-rate
+boost), preemption never corrupting a shared prefix, and the
+cached-prefill attribution surface (serve.prefill ``cached``,
+timeline ``cached_tokens``, tenant label on the timeline event).
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_mx import telemetry, tracing
+from tpu_mx.base import MXNetError
+from tpu_mx import serving
+from tpu_mx.serving import (AdmissionReject, BlockAllocator, CacheExhausted,
+                            ContinuousBatchingScheduler, EngineCore,
+                            PagedKVCache, Request, Server, TenantConfig,
+                            TenantTable, TinyLM)
+from tpu_mx.serving import tenancy
+from tpu_mx.serving.slo import SLOMonitor
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Tracing/telemetry/tenant-label state is process-global —
+    isolate every test (the label cap is first-come-first-named)."""
+    tracing.reset()
+    tenancy.reset_label_registry()
+    yield
+    tracing.reset()
+    tenancy.reset_label_registry()
+
+
+def tiny(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("embed_dim", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("seed", 0)
+    return TinyLM(**kw)
+
+
+def shared_cache(**kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 32)
+    return PagedKVCache(2, 2, 4, share_prefix=True, **kw)
+
+
+def kv(rng, n, layers=2, heads=2, dim=4):
+    k = rng.rand(layers, n, heads, dim).astype(np.float32)
+    return k, (k * 0.5).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# allocator refcounts
+# ---------------------------------------------------------------------------
+def test_refcount_share_free_roundtrip():
+    a = BlockAllocator(4)
+    ids = a.alloc(2)
+    a.incref(ids)                      # a sharer appears
+    assert all(a.refcount(b) == 2 for b in ids)
+    a.free(ids)                        # first holder leaves
+    assert a.used == 2                 # blocks survive at refcount 1
+    assert a.available == 2
+    a.free(ids)                        # last holder leaves
+    assert a.used == 0 and a.available == 4
+    assert a.refcounts() == {}
+
+
+def test_refcount_double_free_and_foreign_incref_are_loud():
+    a = BlockAllocator(2)
+    ids = a.alloc(1)
+    a.free(ids)
+    with pytest.raises(MXNetError):
+        a.free(ids)                    # double free
+    with pytest.raises(MXNetError):
+        a.incref(ids)                  # resurrecting a freed block
+    with pytest.raises(MXNetError):
+        a.incref([99])                 # foreign id
+
+
+def test_refcount_invariants_under_4_thread_hammer():
+    """share/cow/free interleavings from 4 threads: counts stay exact,
+    nothing leaks, nothing is freed twice silently."""
+    a = BlockAllocator(64)
+    # each thread's ledger: list of block ids it holds ONE reference to
+    # (a block may appear in several threads' ledgers = sharing)
+    owned = [[] for _ in range(4)]
+    errs = []
+
+    def worker(i, iters=400):
+        rng = np.random.RandomState(100 + i)
+        try:
+            for _ in range(iters):
+                r = rng.rand()
+                if owned[i] and r < 0.35:
+                    a.free([owned[i].pop()])
+                elif owned[i] and r < 0.55:
+                    # "share": take another reference on a block this
+                    # thread already holds (fork/index shape)
+                    bid = owned[i][int(rng.randint(len(owned[i])))]
+                    a.incref([bid])
+                    owned[i].append(bid)
+                else:
+                    try:
+                        owned[i].extend(a.alloc(int(rng.randint(1, 4))))
+                    except CacheExhausted:
+                        if owned[i]:
+                            a.free([owned[i].pop()])
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs
+    # exact accounting: per-block reference totals match the ledgers
+    held = {}
+    for lst in owned:
+        for b in lst:
+            held[b] = held.get(b, 0) + 1
+    assert a.refcounts() == held
+    assert a.used == len(held)
+    for lst in owned:
+        a.free(lst)
+    assert a.used == 0 and a.refcounts() == {}
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("storage", ["host", "device"])
+def test_fork_then_divergent_append_copies_on_write(storage):
+    cache = PagedKVCache(2, 2, 4, block_size=4, num_blocks=32,
+                         storage=storage, share_prefix=True)
+    rng = np.random.RandomState(0)
+    k, v = kv(rng, 6)                  # 6 tokens: 1 full + 1 partial block
+    cache.prefill("p", k, v)
+    cache.fork("p", "c")
+    assert cache.block_table("p") == cache.block_table("c")
+    before_k, before_v = cache.gather("p", 1)
+    # child appends: its shared partial tail must be COW'd
+    pos = cache.reserve("c")
+    assert pos == 6
+    assert cache.block_table("c")[-1] != cache.block_table("p")[-1]
+    for layer in range(2):
+        cache.write("c", layer, np.full((2, 4), 9.0, np.float32),
+                    np.full((2, 4), 9.0, np.float32))
+    after_k, after_v = cache.gather("p", 1)
+    assert np.array_equal(before_k, after_k)       # parent bits untouched
+    assert np.array_equal(before_v, after_v)
+    ck, _ = cache.gather("c", 1)
+    assert np.all(ck[6] == 9.0)                    # child sees its write
+    assert np.array_equal(ck[:6], before_k)        # and the shared prefix
+    assert cache.prefix_stats()["cow_copies"] == 1
+    cache.free_sequence("p")
+    cache.free_sequence("c")
+    assert cache.allocator.used == 0
+
+
+def test_parent_append_after_fork_also_cows():
+    cache = shared_cache()
+    rng = np.random.RandomState(1)
+    k, v = kv(rng, 5)
+    cache.prefill("p", k, v)
+    cache.fork("p", "c")
+    cache.reserve("p")                 # parent diverges first
+    assert cache.block_table("p")[-1] != cache.block_table("c")[-1]
+    # child's tail is now refcount 1 — its append writes in place
+    tail = cache.block_table("c")[-1]
+    cache.reserve("c")
+    assert cache.block_table("c")[-1] == tail
+    assert cache.prefix_stats()["cow_copies"] == 1
+
+
+# ---------------------------------------------------------------------------
+# prefix trie
+# ---------------------------------------------------------------------------
+def test_match_caps_at_final_token_and_shares_physical_blocks():
+    cache = shared_cache()
+    rng = np.random.RandomState(2)
+    toks = list(range(8))              # exactly 2 full blocks
+    k, v = kv(rng, 8)
+    cache.prefill("a", k, v, tokens=toks)
+    # identical prompt: only block 0 may match (block 1's end == len,
+    # but the FINAL token must be computed for its logits — cap len-1)
+    plan = cache.match_prefix(toks)
+    assert plan is not None and plan.tokens_matched == 4
+    assert plan.blocks == cache.block_table("a")[:1]
+    cache.commit_prefill("b", plan, k[:, 4:], v[:, 4:], toks)
+    assert cache.block_table("b")[0] == cache.block_table("a")[0]
+    # longer prompt extending the template: both full blocks match
+    ext = toks + [9, 9]
+    plan = cache.match_prefix(ext)
+    assert plan.tokens_matched == 8
+    cache.abandon_plan(plan)
+    # a diverging prompt matches only the common prefix
+    plan = cache.match_prefix([0, 1, 2, 3, 7, 7, 7, 7, 7])
+    assert plan.tokens_matched == 4
+    cache.abandon_plan(plan)
+    assert cache.match_prefix([5, 5, 5, 5, 5]) is None      # miss
+
+
+def test_pressure_evicts_lru_index_blocks_but_backpressure_stands():
+    cache = PagedKVCache(2, 2, 4, block_size=4, num_blocks=4,
+                         share_prefix=True)
+    rng = np.random.RandomState(3)
+    k, v = kv(rng, 8)
+    cache.prefill("a", k, v, tokens=list(range(8)))   # 2 blocks, indexed
+    cache.free_sequence("a")           # index keeps both blocks alive
+    assert cache.allocator.used == 2
+    # a new 3-block prefill only fits by evicting the cached prefix
+    k3, v3 = kv(rng, 12)
+    cache.prefill("b", k3, v3, tokens=list(range(20, 32)))
+    assert cache.has_sequence("b")
+    assert cache.prefix_stats()["evictions"] >= 1
+    # pool now genuinely full of LIVE data + its index refs: the next
+    # allocation must still raise (the index never masks real pressure)
+    with pytest.raises(CacheExhausted):
+        cache.prefill("c", *kv(rng, 8))
+    assert not cache.has_sequence("c")
+
+
+def test_index_survives_sequence_free_for_future_hits():
+    cache = shared_cache()
+    rng = np.random.RandomState(4)
+    toks = list(range(9))
+    k, v = kv(rng, 9)
+    cache.prefill("a", k, v, tokens=toks)
+    expect_k, expect_v = cache.gather("a", 0)
+    cache.free_sequence("a")
+    plan = cache.match_prefix(toks)    # the template outlives its author
+    assert plan is not None and plan.tokens_matched == 8
+    kp, vp = cache.gather_plan(plan)
+    assert np.array_equal(kp[0], expect_k[:8])
+    assert np.array_equal(vp[0], expect_v[:8])
+    cache.abandon_plan(plan)
+    cache.drop_prefix_cache()
+    assert cache.allocator.refcounts() == {}
+
+
+# ---------------------------------------------------------------------------
+# greedy-stream bit-equality, both decode arms
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["0", "1"])
+def test_greedy_streams_bit_identical_sharing_on_vs_off(mode, monkeypatch):
+    monkeypatch.setenv("TPUMX_PAGED_DECODE", mode)
+    model = tiny(embed_dim=32, num_heads=2, num_layers=2, seed=5)
+    tpl = list(np.random.RandomState(6).randint(1, 60, size=20))
+    prompts = [tpl + [i + 1, i + 2] for i in range(6)] + [tpl[:11]]
+
+    def run(share):
+        srv = Server(model, num_blocks=256, block_size=8, max_batch=4,
+                     prefix_sharing=share)
+        reqs = [srv.submit(p, max_new_tokens=8) for p in prompts]
+        srv.run_until_idle()
+        stats = srv.engine.cache.prefix_stats()
+        srv.engine.cache.drop_prefix_cache()
+        assert srv.engine.cache.allocator.refcounts() == {}
+        return [r.tokens for r in reqs], stats
+
+    on, stats = run(True)
+    off, _ = run(False)
+    assert on == off
+    assert stats["hits"] >= 6          # the template actually shared
+    assert stats["prefill_bytes_saved"] > 0
+
+
+# ---------------------------------------------------------------------------
+# tenancy: quotas, fairness, boost
+# ---------------------------------------------------------------------------
+def test_tenant_quota_rejects_with_reason():
+    sched = ContinuousBatchingScheduler(
+        tenants={"capped": {"max_inflight": 2, "token_quota": 100}})
+    sched.submit(Request([1], 2, tenant="capped"))
+    sched.submit(Request([1], 2, tenant="capped"))
+    with pytest.raises(AdmissionReject) as e:
+        sched.submit(Request([1], 2, tenant="capped"))
+    assert e.value.reason == "tenant_quota"
+    # other tenants are unaffected by one tenant's quota
+    sched.submit(Request([1], 2, tenant="other"))
+    # token quota: a single oversized admission for the capped tenant
+    with pytest.raises(AdmissionReject) as e:
+        sched.submit(Request([1] * 50, 60, tenant="capped"))
+    assert e.value.reason == "tenant_quota"
+    # the rejected handle is failed + counted with its tenant label
+    assert telemetry.get("serve.requests", state="rejected",
+                         tenant="capped").value == 2
+
+
+def test_weighted_fair_admission_tracks_weight_ratio():
+    sched = ContinuousBatchingScheduler(
+        max_pending=100, max_batch=2, max_tokens=40,
+        tenants={"hi": {"weight": 2.0}, "lo": {"weight": 1.0}})
+    for i in range(15):
+        sched.submit(Request([1] * 5, 5, tenant="hi", request_id=f"h{i}"))
+        sched.submit(Request([1] * 5, 5, tenant="lo", request_id=f"l{i}"))
+    admitted = []
+    for _ in range(6):
+        admitted.extend(r.tenant for r in sched.take_prefills())
+    hi, lo = admitted.count("hi"), admitted.count("lo")
+    assert hi == 2 * lo, admitted       # 2:1 token bandwidth, exactly
+    # FIFO within a tenant
+    hid = [r for r in admitted]  # order sanity via ids requires handles
+    assert admitted[0] == "hi"          # ties break by queue order
+
+
+def test_single_tenant_admission_is_plain_fifo():
+    """One tenant present → the pre-tenancy policy exactly, including
+    stop-at-the-head on budget."""
+    sched = ContinuousBatchingScheduler(max_pending=10, max_batch=8,
+                                        max_tokens=13)
+    small = Request([1], 1, request_id="small")      # budget 2
+    big = Request([1] * 6, 6, request_id="big")      # budget 12
+    tail = Request([1], 1, request_id="tail")        # would fit, but FIFO
+    sched.submit(small)
+    sched.submit(big)
+    sched.submit(tail)
+    got = sched.take_prefills()
+    for r in got:
+        sched.mark_running(r)
+    assert [r.id for r in got] == ["small"]
+    # head "big" no longer fits (2 + 12 > 13): admission stops AT the
+    # head — "tail" is not pulled around it within one tenant
+    assert sched.take_prefills() == []
+
+
+def test_slo_breaching_tenant_gets_boosted():
+    """A tenant whose per-tenant burn is breaching is admitted at
+    boosted weight until the breach clears.  Tenant names are unique to
+    this test: telemetry series are process-global and cumulative, so
+    reusing another test's labels would couple the assertion to test
+    order."""
+    h = telemetry.histogram("serve.itl_seconds", tenant="boost-bad")
+    for _ in range(50):
+        h.observe(0.5)                 # way over the 50ms target
+    g = telemetry.histogram("serve.itl_seconds", tenant="boost-good")
+    for _ in range(50):
+        g.observe(0.001)
+    mon = SLOMonitor(("itl_p99 < 50ms",), windows=(5.0, 30.0))
+    sig = mon.refresh(force=True)
+    assert "boost-bad" in sig["breaching_tenants"]
+    assert "boost-good" not in sig["breaching_tenants"]
+    assert telemetry.get("serve.slo_tenant_burn_rate", slo="itl_p99",
+                         tenant="boost-bad").value >= 1.0
+    sched = ContinuousBatchingScheduler(
+        max_pending=100, max_batch=2, max_tokens=40, slo_boost=2.0,
+        tenants={"boost-bad": {"weight": 1.0},
+                 "boost-good": {"weight": 1.0}})
+    sched.slo_signal = sig
+    for i in range(15):
+        sched.submit(Request([1] * 5, 5, tenant="boost-bad",
+                             request_id=f"b{i}"))
+        sched.submit(Request([1] * 5, 5, tenant="boost-good",
+                             request_id=f"g{i}"))
+    admitted = []
+    for _ in range(6):
+        admitted.extend(r.tenant for r in sched.take_prefills())
+    bad = admitted.count("boost-bad")
+    good = admitted.count("boost-good")
+    assert bad == 2 * good, admitted    # equal weights, boosted 2x
+
+
+def test_returning_tenant_enters_at_the_floor_not_zero():
+    """A tenant that was idle (or new) while others accrued virtual
+    time must enter at the system floor — not at a stale-low clock
+    that would let it monopolize admission until it 'catches up'."""
+    sched = ContinuousBatchingScheduler(
+        max_pending=100, max_batch=1, max_tokens=40,
+        tenants={"a": {}, "b": {}})
+    for i in range(5):
+        sched.submit(Request([1] * 5, 5, tenant="a", request_id=f"a{i}"))
+    for _ in range(3):                 # "a" serves alone for a while
+        assert sched.take_prefills()
+    for i in range(5):                 # now "b" bursts in
+        sched.submit(Request([1] * 5, 5, tenant="b", request_id=f"b{i}"))
+    order = []
+    for _ in range(4):
+        order.extend(r.tenant for r in sched.take_prefills())
+    # equal weights: b gets its floor-entry pick, then they alternate —
+    # never three b's in a row burning down a phantom deficit
+    assert order.count("b") == 2, order
+
+
+def test_past_cap_tenant_receives_aggregated_overflow_boost():
+    """Burn is measured under the cardinality-capped label, so a tenant
+    past the cap breaches as `_other` — the boost must follow the label
+    or capped tenants could never be boosted."""
+    for i in range(tenancy.TENANT_LABEL_CAP):
+        tenancy.label_for(f"pad{i}")   # fill the cap
+    sched = ContinuousBatchingScheduler(slo_boost=3.0)
+    sched.slo_signal = {"slos": {"itl_p99": {"tenants": {
+        tenancy.OVERFLOW_LABEL: {"breaching": True}}}}}
+    boosted = sched._breaching_tenants()
+    assert sched._effective_weight("past-cap-newcomer", boosted) == 3.0
+    # capped tenants keep their own-label boost path
+    assert sched._effective_weight("pad0", boosted) == 1.0
+
+
+def test_tenant_label_cardinality_cap_overflows():
+    for i in range(tenancy.TENANT_LABEL_CAP):
+        assert tenancy.label_for(f"t{i}") == f"t{i}"
+    assert tenancy.label_for("straggler") == tenancy.OVERFLOW_LABEL
+    assert tenancy.label_for("t0") == "t0"          # stable
+    assert tenancy.label_for("straggler") == tenancy.OVERFLOW_LABEL
+
+
+def test_tenant_table_coercion_and_defaults():
+    t = TenantTable.coerce({"a": {"weight": 3.0}, "b": None})
+    assert t.get("a").weight == 3.0
+    assert t.get("b").weight == 1.0
+    assert t.get("unknown").max_inflight is None    # permissive default
+    assert TenantTable.coerce(t) is t
+    assert len(TenantTable.coerce(None)) == 0
+    with pytest.raises(ValueError):
+        TenantConfig("x", weight=0)
+    with pytest.raises(ValueError):
+        TenantTable([TenantConfig("x"), TenantConfig("x")])
+
+
+# ---------------------------------------------------------------------------
+# preemption + sharing
+# ---------------------------------------------------------------------------
+def test_preemption_never_corrupts_shared_prefix():
+    """Preempt a sequence whose blocks are shared with a live sibling:
+    the sibling's reads stay bit-identical and both requests complete
+    with the exact streams an uncontended pool produces."""
+    model = tiny(embed_dim=32, seed=7)
+    tpl = list(np.random.RandomState(8).randint(1, 60, size=17))
+    prompts = [tpl + [1], tpl + [2], tpl + [3]]
+
+    def run(num_blocks):
+        srv = Server(model, num_blocks=num_blocks, block_size=8,
+                     max_batch=3, prefix_sharing=True)
+        reqs = [srv.submit(p, max_new_tokens=12) for p in prompts]
+        srv.run_until_idle()
+        assert all(r.state == "done" for r in reqs)
+        requeues = sum(r.requeues for r in reqs)
+        cache = srv.engine.cache
+        cache.drop_prefix_cache()
+        assert cache.allocator.refcounts() == {}
+        return [r.tokens for r in reqs], requeues
+
+    roomy, r0 = run(256)
+    assert r0 == 0
+    # 7 blocks: the three 18-token prompts share their 2 template
+    # blocks (3+1+1 at prefill) and decode growth past 24 tokens needs
+    # 3 more — one reservation must preempt a sibling that SHARES the
+    # template blocks
+    tight, r1 = run(7)
+    assert r1 > 0, "pool was not tight enough to force preemption"
+    assert tight == roomy
+
+
+def test_victim_selection_prefers_low_weight_tenant():
+    """Three one-block sequences exactly fill the pool; the first
+    decode reservation must evict one of the OTHER two — and it picks
+    by tenant weight, not age."""
+    def run(w_b, w_c):
+        eng = EngineCore(tiny(seed=9), block_size=4, num_blocks=3,
+                         share_prefix=False)
+        reqs = []
+        for name, w in (("a", 1.0), ("b", w_b), ("c", w_c)):
+            r = Request([1, 2, 3, 4], 8, request_id=name, tenant=name)
+            r.tenant_weight = w
+            first, _ = eng.prefill(r)
+            reqs.append((r, first))
+        _, pre = eng.decode(reqs)
+        return [r.id for r in pre]
+
+    # a's reservation evicts the lowest-weight candidate among b/c
+    assert run(0.5, 2.0)[0] == "b"
+    assert run(2.0, 0.5)[0] == "c"
+
+
+# ---------------------------------------------------------------------------
+# attribution + observability surfaces
+# ---------------------------------------------------------------------------
+def test_cached_prefill_attribution_and_tenant_on_timeline():
+    model = tiny(embed_dim=32, seed=10)
+    tpl = list(np.random.RandomState(11).randint(1, 60, size=18))
+    srv = Server(model, num_blocks=128, block_size=8, max_batch=2,
+                 prefix_sharing=True)
+    a = srv.submit(tpl + [1], max_new_tokens=3, tenant="acme")
+    srv.run_until_idle()
+    b = srv.submit(tpl + [2], max_new_tokens=3, tenant="acme")
+    srv.run_until_idle()
+    assert a.timeline.cached_tokens == 0
+    assert b.timeline.cached_tokens == 16           # 2 full 8-blocks
+    evs = [e for e in tracing.snapshot()
+           if e["event"] == "serve.request_timeline"]
+    by_req = {e["data"]["request"]: e["data"] for e in evs}
+    assert by_req[a.id]["cached_tokens"] == 0
+    assert by_req[b.id]["cached_tokens"] == 16
+    assert by_req[b.id]["tenant"] == "acme"
+    prefills = [e for e in tracing.snapshot()
+                if e["event"] == "serve.prefill"]
+    assert [e["data"]["cached"] for e in prefills] == [0, 16]
+    # per-tenant terminal count + SLO pair twins exist
+    assert telemetry.get("serve.requests", state="completed",
+                         tenant="acme").value == 2
+    assert telemetry.get("serve.ttft_seconds", tenant="acme").count == 2
+    assert telemetry.get("serve.prefix_hit_ratio").value > 0
+
+
+def test_commit_prefill_failure_releases_pins_and_fresh_blocks():
+    """All-or-nothing: a fault INSIDE commit_prefill (bad suffix shape,
+    fill error) must release the plan's pins AND any freshly allocated
+    blocks — a leak here shrinks the pool forever and fails the CI
+    post-storm refcount audit."""
+    cache = shared_cache(num_blocks=16)
+    toks = list(range(9))
+    k, v = kv(np.random.RandomState(20), 9)
+    cache.prefill("a", k, v, tokens=toks)
+    plan = cache.match_prefix(toks)
+    assert plan is not None
+    with pytest.raises(ValueError):
+        cache.commit_prefill("b", plan, k[:, :2, :1], v[:, :2, :1], toks)
+    assert not cache.has_sequence("b")
+    cache.free_sequence("a")
+    cache.drop_prefix_cache()
+    assert cache.allocator.refcounts() == {}
+
+
+def test_commit_prefill_already_cached_keeps_live_sequence_intact():
+    """The already-cached guard must only release THIS call's pins —
+    popping the pre-existing live sequence's registration would leak
+    its blocks and orphan its handle."""
+    cache = shared_cache(num_blocks=16)
+    toks = list(range(9))
+    k, v = kv(np.random.RandomState(21), 9)
+    cache.prefill("a", k, v, tokens=toks)
+    want_k, want_v = cache.gather("a", 0)
+    plan = cache.match_prefix(toks)
+    with pytest.raises(MXNetError):
+        cache.commit_prefill("a", plan, k[:, 8:], v[:, 8:], toks)
+    assert cache.has_sequence("a")                 # still registered
+    got_k, got_v = cache.gather("a", 0)            # still readable
+    assert np.array_equal(got_k, want_k)
+    assert np.array_equal(got_v, want_v)
+    cache.free_sequence("a")
+    cache.drop_prefix_cache()
+    assert cache.allocator.refcounts() == {}
+
+
+def test_plan_double_consumption_is_loud():
+    """A plan's pins are released exactly once: double abandon, or
+    abandon after commit, must raise — not silently steal another
+    holder's reference (the refcount analog of double-free)."""
+    cache = shared_cache(num_blocks=16)
+    toks = list(range(9))
+    k, v = kv(np.random.RandomState(22), 9)
+    cache.prefill("a", k, v, tokens=toks)
+    plan = cache.match_prefix(toks)
+    cache.abandon_plan(plan)
+    with pytest.raises(MXNetError):
+        cache.abandon_plan(plan)               # double abandon
+    plan2 = cache.match_prefix(toks)
+    cache.commit_prefill("b", plan2, k[:, 8:], v[:, 8:], toks)
+    with pytest.raises(MXNetError):
+        cache.abandon_plan(plan2)              # abandon after commit
+    # no reference was stolen: both sequences still audit clean
+    cache.free_sequence("a")
+    cache.free_sequence("b")
+    cache.drop_prefix_cache()
+    assert cache.allocator.refcounts() == {}
+
+
+def test_sharing_refuses_lossy_pool_dtype():
+    """A quantized pool would feed the suffix prefill pool-rounded
+    prefix K/V where the sharing-off arm recomputes at model precision
+    — sharing must refuse loudly rather than break bit-equality."""
+    with pytest.raises(ValueError):
+        PagedKVCache(2, 2, 4, dtype=np.float16, share_prefix=True)
+    # sharing off: lossy pools stay allowed (the decode arms quantize
+    # consistently for every token)
+    PagedKVCache(2, 2, 4, dtype=np.float16, share_prefix=False)
+
+
+def test_tenant_quota_covers_mid_prefill_window():
+    """A request popped by take_prefills but not yet running is still
+    in flight: a concurrent submit in that window must count it, or
+    max_inflight is exceeded exactly when the step thread is busy."""
+    sched = ContinuousBatchingScheduler(
+        max_batch=4, tenants={"t": {"max_inflight": 1}})
+    sched.submit(Request([1], 2, tenant="t"))
+    popped = sched.take_prefills()
+    assert len(popped) == 1
+    with pytest.raises(AdmissionReject) as e:
+        sched.submit(Request([1], 2, tenant="t"))
+    assert e.value.reason == "tenant_quota"
+    sched.mark_running(popped[0])
+
+
+def test_defer_refunds_vtime_charge():
+    """A deferred admission (cache backpressure — never started) gets
+    its pick-time vtime charge back: a tenant bouncing on memory
+    pressure must not fall behind the weight ratio while receiving
+    zero service.  A requeue (real service consumed) keeps the
+    charge."""
+    sched = ContinuousBatchingScheduler(
+        max_batch=1, tenants={"a": {}, "b": {}})
+    sched.submit(Request([1] * 4, 4, tenant="a"))
+    sched.submit(Request([1] * 4, 4, tenant="b"))
+    got = sched.take_prefills()
+    assert len(got) == 1
+    charged = dict(sched._vtime)[got[0].tenant]
+    sched.defer(got)
+    assert sched._vtime[got[0].tenant] < charged
+
+
+def test_restart_with_sharing_loses_nothing():
+    """A NaN-poisoned decode restarts the engine mid-storm with sharing
+    on: zero lost requests, and the rebuilt engine's fresh cache audits
+    clean."""
+    from tpu_mx.contrib import chaos
+    model = tiny(embed_dim=32, seed=12)
+    tpl = list(np.random.RandomState(13).randint(1, 60, size=14))
+    srv = Server(model, num_blocks=128, block_size=8, max_batch=4,
+                 backoff=0.0, prefix_sharing=True)
+    with chaos.enable(seed=0, nan_after=3):
+        reqs = [srv.submit(tpl + [i], max_new_tokens=4) for i in range(4)]
+        srv.run_until_idle()
+    assert srv.restarts == 1
+    assert all(r.state == "done" and len(r.tokens) == 4 for r in reqs)
+    cache = srv.engine.cache
+    cache.drop_prefix_cache()
+    assert cache.allocator.refcounts() == {}

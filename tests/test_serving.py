@@ -250,7 +250,7 @@ def test_paged_decode_logits_bit_identical_to_dense_cache():
     req = Request(prompt, max_new_tokens=steps + 1, request_id="main")
     other = Request([9, 9, 9], max_new_tokens=steps + 1,
                     request_id="other")
-    first = eng.prefill(req)
+    first, _ = eng.prefill(req)
     eng.prefill(other)
     assert first == ref_tokens[0]
     got = [first]

@@ -571,10 +571,16 @@ SOAK_REQUIRED = ("supervisor", "resume", "chaos.injections",
 # turn — reject_storm (admission backpressure + client resubmit), a
 # hung decode (slow_decode_step -> watchdog -> classified engine
 # restart) and NaN logits (nan_after -> NumericDivergence -> restart).
+# Storm prompts share a per-storm template prefix so the shared-prefix
+# index (ISSUE 12 — the tier runs with TPUMX_PREFIX_SHARING=1) is
+# actually exercised under every fault, not just present.
 # Hard assertions: ZERO lost requests (every submission eventually
 # completes with its full token budget), a schema-valid black box per
 # injected fault whose timeline correlates injection -> decision by
-# shared (step, generation), and catalog-valid serving metrics.
+# shared (step, generation), catalog-valid serving metrics, and the
+# post-storm allocator audit — with the prefix index dropped, every
+# block refcount is back at zero (no reference leaks under restarts,
+# preemption, or requeues).
 SERVE_SCRIPT = """
 import json
 import os
@@ -586,6 +592,7 @@ from tpu_mx.telemetry import ATTRIBUTION_TOLERANCE as ATOL
 
 D = os.environ["TPUMX_SERVE_DIR"]
 SEED = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
+SHARING = os.environ.get("TPUMX_PREFIX_SHARING", "0") not in ("", "0")
 rng = random.Random(SEED)
 model = serving.TinyLM(vocab_size=64, embed_dim=32, num_heads=2,
                        num_layers=2, seed=SEED % 997)
@@ -601,7 +608,11 @@ def storm(tag, fault, n_req=12, **srv_kw):
                                                  "ttft_p99 < 30s"),
                                                 windows=(5.0, 30.0)),
                          **srv_kw)
-    todo = [([1 + rng.randrange(40) for _ in range(rng.randint(2, 10))],
+    # a 12-token storm template: every prompt shares its first full
+    # 8-block, so prefix sharing (when armed) is hit by request #2 on
+    template = [1 + rng.randrange(40) for _ in range(12)]
+    todo = [(template + [1 + rng.randrange(40)
+                         for _ in range(rng.randint(1, 5))],
              rng.randint(2, 8)) for _ in range(n_req)]
     reqs = []
     with chaos.enable(seed=SEED, **fault):
@@ -645,6 +656,20 @@ def storm(tag, fault, n_req=12, **srv_kw):
     for name in ("itl_p99", "ttft_p99"):
         assert telemetry.get("serve.slo_estimate_seconds",
                              slo=name) is not None, (tag, name)
+    # post-storm allocator audit (ISSUE 12): every sequence is done and
+    # evicted; with the prefix index dropped, every block refcount must
+    # be back at zero — restarts, preemptions and requeues may not leak
+    # references.  When sharing is armed, the template prompts must have
+    # actually HIT the index (the storm exercises sharing, not just
+    # carries the knob).
+    cache = srv.engine.cache
+    if SHARING:
+        st = cache.prefix_stats()
+        assert st["hits"] > 0, (tag, st)
+    cache.drop_prefix_cache()
+    leftover = cache.allocator.refcounts()
+    assert not leftover, (tag, leftover)
+    assert cache.allocator.used == 0, (tag, cache.stats())
     # an end-of-run audit box: unlike the restart-time box it contains
     # the finished requests' serve.request_timeline events — what
     # tools/slo_report.py's worst-request section (and its offline
@@ -762,15 +787,18 @@ SERVE_BOX_EXPECT = {
 def _serve_storm_leg(mode):
     """One full chaos-storm pass (the three faults) with the decode arm
     pinned to `mode` ("0" = dense-gather reference, "1" = paged:
-    device-resident pool + block-table program), then telemetry
-    validation and jax-less black-box rendering."""
+    device-resident pool + block-table program) and shared-prefix KV
+    reuse ENABLED (ISSUE 12: the self-healing contract must hold with
+    sharing on — the storm script's post-storm allocator audit asserts
+    every refcount returns to zero), then telemetry validation and
+    jax-less black-box rendering."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     tag_mode = "dense" if mode in ("", "0") else "paged"
     with tempfile.TemporaryDirectory() as d:
         jsonl = os.path.join(d, "telemetry.jsonl")
         env = dict(os.environ, TPUMX_TELEMETRY=jsonl, JAX_PLATFORMS="cpu",
                    TPUMX_CHAOS_SEED="20260804", TPUMX_SERVE_DIR=d,
-                   TPUMX_PAGED_DECODE=mode)
+                   TPUMX_PAGED_DECODE=mode, TPUMX_PREFIX_SHARING="1")
         env.pop("TPUMX_CHAOS", None)    # the script arms its own faults
         env.pop("TPUMX_TRACING", None)  # the black boxes need the recorder
         try:
@@ -860,7 +888,8 @@ def _serve_storm_leg(mode):
         # monitor gauges", which would let missing serve.slo_* series
         # slip through a looser marker
         missing = [m for m in ("SLO targets", "Worst requests by latency",
-                               "serving.SLOMonitor state")
+                               "serving.SLOMonitor state",
+                               "Per-tenant SLO state")
                    if m not in out]
         if missing or "top 5 of 0 recorded" in out:
             print(f"  serve[{tag_mode}]: slo_report output is missing "

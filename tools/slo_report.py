@@ -19,7 +19,12 @@ This tool is the jax-less ops view over that data:
   ``serve.request_timeline`` events from a flight-recorder black box,
   sorted by latency, each decomposed into its typed phases
   (queue_wait/prefill/decode_gap/restart_penalty/defer_stall) with
-  percentages — "which phase of this slow request ate the budget".
+  percentages — "which phase of this slow request ate the budget";
+- **Per-tenant state** (ISSUE 12): for every tenant-labeled series of a
+  target's histogram (``serve.itl_seconds{tenant=...}`` — bounded
+  labels, tpu_mx/serving/tenancy.py), the window quantile, attainment
+  and burn rate, plus each tenant's worst request by latency with its
+  phase breakdown — "WHICH tenant's budget is burning, and on what".
 
 ``--validate`` schema-gates every telemetry record (including the
 window sub-objects) against the catalog, every box event against
@@ -168,6 +173,77 @@ def render_monitor_gauges(series):
     return lines
 
 
+def render_tenants(series, telemetry, specs, box, phases):
+    """The per-tenant section: each target evaluated against every
+    tenant-labeled series' window (quantile estimate, attainment, burn,
+    status), then each tenant's worst recorded request with its phase
+    breakdown.  Tenant labels are already cardinality-bounded at the
+    source (tenancy.label_for: the overflow label aggregates the long
+    tail)."""
+    targets = []
+    for spec in specs:
+        try:
+            targets.append(telemetry.parse_slo_spec(spec))
+        except ValueError:
+            continue
+    tenants = set()
+    for (name, lj), rec in series.items():
+        labels = json.loads(lj)
+        if rec.get("type") == "histogram" and "tenant" in labels:
+            tenants.add(labels["tenant"])
+    by_tenant = {}
+    if box is not None:
+        for e in request_timelines(box):
+            t = e["data"].get("tenant")
+            if t is not None:
+                tenants.add(t)
+                by_tenant.setdefault(t, []).append(e)
+    if not tenants:
+        return ["Per-tenant SLO state: (no tenant-labeled series — "
+                "single-tenant run, or pre-tenancy snapshot)"]
+    lines = ["Per-tenant SLO state (window estimates per tenant label):",
+             "  %-10s %-24s %7s %12s %11s %9s %8s" %
+             ("Tenant", "Target", "count", "estimate", "attainment",
+              "burn", "status")]
+    for tenant in sorted(tenants):
+        for d in targets:
+            key = (d["metric"],
+                   json.dumps({"tenant": tenant}, sort_keys=True))
+            win = (series.get(key) or {}).get("window")
+            if not win or not win.get("count"):
+                lines.append("  %-10s %-24s %7s %12s %11s %9s %8s" % (
+                    tenant, d["name"], 0, "-", "-", "-", "no data"))
+                continue
+            est = telemetry.quantile_from_cumulative(
+                win["buckets"], d["quantile"], vmin=win.get("min"),
+                vmax=win.get("max"))
+            att = telemetry.fraction_le_from_cumulative(
+                win["buckets"], d["threshold_seconds"],
+                vmin=win.get("min"), vmax=win.get("max"))
+            burn = (1.0 - att) / (1.0 - d["objective"])
+            lines.append("  %-10s %-24s %7d %9s ms %11.4f %9.2f %8s" % (
+                tenant, d["name"], win["count"], _ms(est), att, burn,
+                "BREACH" if burn >= 1.0 else "OK"))
+        worst = sorted(by_tenant.get(tenant, ()),
+                       key=lambda e: -float(e["data"].get("latency", 0.0)))
+        if worst:
+            d = worst[0]["data"]
+            lat = float(d.get("latency", 0.0))
+            parts = []
+            for p in phases:
+                v = float(d.get(p, 0.0))
+                if v > 0:
+                    pct = 100.0 * v / lat if lat > 0 else 0.0
+                    parts.append(f"{p} {v * 1e3:.2f}ms ({pct:.0f}%)")
+            lines.append(
+                "    worst request: %-12s %8.2fms %-8s cached=%s"
+                % (d.get("request", "?"), lat * 1e3,
+                   d.get("outcome", "?"), d.get("cached_tokens", 0)))
+            lines.append("      " + (" + ".join(parts) if parts
+                                     else "(empty)"))
+    return lines
+
+
 def timeline_phases(tracing):
     """The attribution phases, in render order, derived from the
     ``serve.request_timeline`` event schema — NOT hand-copied from
@@ -253,7 +329,8 @@ def main(argv=None):
         print(f"slo_report: cannot read {opts.file}: {e}",
               file=sys.stderr)
         return 2
-    box = tracing = None
+    box = None
+    tracing = load_module("tracing")
     if opts.box:
         try:
             with open(opts.box, encoding="utf-8") as f:
@@ -262,15 +339,17 @@ def main(argv=None):
             print(f"slo_report: cannot read {opts.box}: {e}",
                   file=sys.stderr)
             return 2
-        tracing = load_module("tracing")
 
+    specs = opts.slo or list(telemetry.DEFAULT_SLOS)
     out = [f"SLO report: {opts.file}", ""]
     out.extend(render_windows(series, telemetry))
     out.append("")
-    out.extend(render_slos(series, telemetry,
-                           opts.slo or list(telemetry.DEFAULT_SLOS)))
+    out.extend(render_slos(series, telemetry, specs))
     out.append("")
     out.extend(render_monitor_gauges(series))
+    out.append("")
+    out.extend(render_tenants(series, telemetry, specs, box,
+                              timeline_phases(tracing)))
     if box is not None:
         out.append("")
         out.extend(render_worst_requests(box, opts.top,

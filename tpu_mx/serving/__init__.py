@@ -27,7 +27,15 @@ names.  Layer map, bottom up:
   TTFT/latency.
 - :mod:`.slo` — the live SLO monitor: declarative targets over the
   telemetry layer's sliding windows, multi-window error-budget burn
-  rate, the ``serve.slo_*`` gauges and the scheduler signal hook.
+  rate, the ``serve.slo_*`` gauges and the scheduler signal hook —
+  per-tenant burn included when tenant-labeled series exist.
+- :mod:`.prefix_cache` — the shared-prefix index (ISSUE 12): a trie
+  keyed on full block contents so N requests carrying a common template
+  map their leading block-table entries onto the SAME physical blocks —
+  one prefill, refcounted sharing, copy-on-write on divergence
+  (``TPUMX_PREFIX_SHARING``).
+- :mod:`.tenancy` — per-tenant weights/quotas and the bounded telemetry
+  label: SLO-weighted fair admission, ``tenant_quota`` backpressure.
 
 Telemetry (``serve.*`` in ``telemetry.KNOWN_METRICS``) and the request
 lifecycle events (``serve.admit/prefill/decode/evict/reject/restart`` in
@@ -35,7 +43,10 @@ lifecycle events (``serve.admit/prefill/decode/evict/reject/restart`` in
 make every claim here observable; ``tools/ci.py``'s ``serve`` tier
 storms a chaos-faulted server and asserts zero lost requests.
 """
-from .kv_cache import BlockAllocator, CacheExhausted, PagedKVCache
+from .kv_cache import (BlockAllocator, CacheExhausted, PagedKVCache,
+                       PrefillPlan, prefix_sharing_enabled)
+from .prefix_cache import PrefixIndex
+from .tenancy import TenantConfig, TenantTable
 from .attention import (dense_attention, dense_decode_attention,
                         decode_attention, decode_path, prefill_attention,
                         resolve_decode_path)
@@ -48,6 +59,8 @@ from .engine import EngineCore
 from .server import Server
 
 __all__ = ["BlockAllocator", "CacheExhausted", "PagedKVCache",
+           "PrefillPlan", "PrefixIndex", "prefix_sharing_enabled",
+           "TenantConfig", "TenantTable",
            "dense_attention", "dense_decode_attention", "decode_attention",
            "decode_path", "resolve_decode_path", "prefill_attention",
            "TinyLM", "AdmissionReject", "ContinuousBatchingScheduler",

@@ -106,21 +106,31 @@ def _tpu_flash_ok(length, head_dim, dtype):
 
 
 def prefill_attention(q, k, v):
-    """Causal self-attention over one prompt: ``q``/``k``/``v`` are
-    (L, H, D); returns (L, H, D).
+    """Causal self-attention over one prompt: ``q`` is (S, H, D) and
+    ``k``/``v`` are (L, H, D) with L >= S; returns (S, H, D).
 
-    TPU + supported shape → the Pallas flash kernel ((H, L, D) folded
-    layout, O(L) memory); otherwise the dense numpy reference (the CPU
-    fallback tier-1 tests, docs/DIVERGENCES.md #27)."""
+    L == S is the classic whole-prompt prefill; L > S is the
+    shared-prefix SUFFIX prefill (ISSUE 12): the queries are the last S
+    prompt positions and the leading L-S keys/values came from the
+    prefix cache — causal alignment puts query i at absolute position
+    L - S + i, which is exactly ``dense_attention``'s convention, so
+    the suffix rows see the same score rows (same reduction order) a
+    full prefill would compute.
+
+    TPU + supported whole-prompt shape → the Pallas flash kernel
+    ((H, L, D) folded layout, O(L) memory); suffix prefills and
+    everything off-TPU run the dense numpy reference (the CPU fallback
+    tier-1 tests, docs/DIVERGENCES.md #27)."""
     q = np.asarray(q)
+    k = np.asarray(k)
     length, heads, dim = q.shape
-    if _tpu_flash_ok(length, dim, q.dtype):
+    if k.shape[0] == length and _tpu_flash_ok(length, dim, q.dtype):
         import jax.numpy as jnp
         from ..kernels.flash_attention import flash_attention as _flash
         fold = lambda x: jnp.asarray(x).transpose(1, 0, 2)  # (H, L, D)
         out = _flash(fold(q), fold(k), fold(v), causal=True)
         return np.asarray(out).transpose(1, 0, 2)
-    return dense_attention(q[None], np.asarray(k)[None],
+    return dense_attention(q[None], k[None],
                            np.asarray(v)[None], causal=True)[0]
 
 

@@ -47,7 +47,7 @@ from .. import tracing as _tracing
 from ..contrib import chaos as _chaos
 from ..supervisor import NumericDivergence
 from .attention import decode_attention, resolve_decode_path
-from .kv_cache import CacheExhausted, PagedKVCache
+from .kv_cache import CacheExhausted, PagedKVCache, prefix_sharing_enabled
 
 __all__ = ["EngineCore"]
 
@@ -57,39 +57,70 @@ class EngineCore:
     (tpu_mx/serving/model.py); cache geometry comes from it."""
 
     def __init__(self, model, block_size=16, num_blocks=256,
-                 dtype=np.float32):
+                 dtype=np.float32, share_prefix=None):
         self.model = model
         # the decode arm is resolved ONCE per engine generation: a knob
         # flip mid-flight cannot leave half a batch on each path, and
         # the serve.decode_path event below is the black box's record of
-        # which arm a (possibly restarted) engine was on
+        # which arm a (possibly restarted) engine was on.  The sharing
+        # knob resolves the same way (TPUMX_PREFIX_SHARING unless pinned
+        # by the caller) and rides the same event for the same reason.
         self.decode_kind = resolve_decode_path()
+        if share_prefix is None:
+            share_prefix = prefix_sharing_enabled()
+        self.share_prefix = bool(share_prefix)
         storage = "device" if self.decode_kind != "dense" else "host"
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
             block_size=block_size, num_blocks=num_blocks, dtype=dtype,
-            storage=storage)
+            storage=storage, share_prefix=self.share_prefix)
         _tracing.emit("serve.decode_path", path=self.decode_kind,
-                      storage=storage)
+                      storage=storage, sharing=self.share_prefix)
 
     # -- prefill -------------------------------------------------------------
     def prefill(self, req):
-        """Run ``req``'s prompt, bulk-fill its cache blocks, return the
-        first generated token.  :class:`CacheExhausted` propagates with
-        the cache unchanged (the scheduler's backpressure path); NaN/Inf
-        logits raise :class:`NumericDivergence`."""
+        """Run ``req``'s prompt, fill its cache blocks, return ``(first
+        generated token, cached_tokens)``.
+
+        With sharing on, the longest indexed full-block prefix of the
+        prompt is served from the cache (``cached_tokens`` of them):
+        only the suffix's K/V is computed (``model.prefill_suffix``
+        attending over the cached prefix) and written — bit-identical
+        logits to a full prefill, one prefill's compute shared by every
+        request carrying the template.  :class:`CacheExhausted`
+        propagates with the cache unchanged and no pinned references
+        left behind (the scheduler's backpressure path); NaN/Inf logits
+        raise :class:`NumericDivergence`."""
         t0 = time.perf_counter()
-        k, v, logits = self.model.prefill(req.prompt)
-        self.cache.prefill(req.id, k, v)
+        tokens = req.prompt
+        plan = self.cache.match_prefix(tokens)
+        if plan is not None:
+            cached = plan.tokens_matched
+            try:
+                kp, vp = self.cache.gather_plan(plan)
+                k, v, logits = self.model.prefill_suffix(
+                    tokens[cached:], cached, kp, vp)
+            except BaseException:
+                # model/gather fault between match and commit: the pins
+                # must not outlive the attempt (the audit counts them)
+                self.cache.abandon_plan(plan)
+                raise
+            self.cache.commit_prefill(req.id, plan, k, v, tokens)
+        else:
+            cached = 0
+            k, v, logits = self.model.prefill(tokens)
+            self.cache.prefill(req.id, k, v,
+                               tokens=tokens if self.share_prefix
+                               else None)
         health = float(np.max(np.abs(logits)))
         if not math.isfinite(health):
             raise NumericDivergence(
                 f"serving: non-finite logits in prefill of {req.id} "
                 f"(health={health}) — restarting the engine")
         _tracing.emit("serve.prefill", request=req.id,
-                      tokens=len(req.prompt), t0=t0,
+                      tokens=len(req.prompt), cached=cached, t0=t0,
                       t1=time.perf_counter())
-        return int(np.argmax(logits))
+        return int(np.argmax(logits)), cached
 
     # -- decode --------------------------------------------------------------
     def decode(self, items):
@@ -104,8 +135,15 @@ class EngineCore:
 
         Preemption picks FINISHED batch members first (static-batching
         padding slots — their cache is pure waste and their handles are
-        already done), then YOUNGEST-first among the unfinished
-        not-yet-reserved members; the reservation is retried after each
+        already done), then the unfinished not-yet-reserved member
+        scoring worst on (tenant weight ascending, exclusively-held
+        blocks descending, youngest): a low-weight tenant's sequence is
+        sacrificed before a high-weight one's, and between peers the
+        victim whose eviction actually RETURNS the most blocks goes
+        first — freeing a sequence whose blocks are shared releases
+        references, not memory (refcounts: the survivors keep reading
+        the same bits, so preemption can never evict a block another
+        live sequence shares).  The reservation is retried after each
         eviction, so the oldest live sequence always makes progress and
         an over-admitted batch drains instead of livelocking on mutual
         preemption (``items`` arrive in admission order from the
@@ -129,8 +167,11 @@ class EngineCore:
                         if remaining[j][0].done:
                             victim = remaining.pop(j)[0]
                             break
+                    if victim is None and remaining:
+                        victim = remaining.pop(
+                            self._pick_victim(remaining))[0]
                     if victim is None:
-                        victim = remaining.pop()[0] if remaining else req
+                        victim = req
                     self.cache.free_sequence(victim.id)
                     preempted.append(victim)
                     if victim is req:
@@ -162,6 +203,24 @@ class EngineCore:
         out = np.argmax(logits, axis=-1)
         return ({req.id: int(out[b]) for b, (req, _) in enumerate(live)},
                 preempted)
+
+    def _pick_victim(self, remaining):
+        """Index into ``remaining`` of the preemption victim: lowest
+        tenant weight first (SLO-weighted fairness extends to who gets
+        sacrificed under memory pressure), then the sequence whose
+        eviction returns the MOST exclusively-held blocks (evicting a
+        fully shared prefix frees nothing), youngest breaking ties
+        (matching the pre-tenancy youngest-first drain guarantee).
+        Requests without a tenant weight (bare tests) count as 1.0."""
+        best_j, best_key = len(remaining) - 1, None
+        for j in range(len(remaining) - 1, -1, -1):
+            req = remaining[j][0]
+            excl = (self.cache.exclusive_blocks(req.id)
+                    if self.cache.has_sequence(req.id) else 0)
+            key = (-float(getattr(req, "tenant_weight", 1.0)), excl, j)
+            if best_key is None or key > best_key:
+                best_j, best_key = j, key
+        return best_j
 
     def evict(self, req):
         """Free a sequence's blocks (idempotent)."""

@@ -16,6 +16,14 @@ layout) fixes both:
   from a LIFO free list under one lock.  Exhaustion raises
   :class:`CacheExhausted` — the scheduler's backpressure signal (requeue /
   reject), NEVER an allocation attempt that OOMs the process.
+- **Refcounts** (ISSUE 12): every held block carries a reference count.
+  ``alloc`` hands out blocks at one reference; ``incref`` adds sharers
+  (the shared-prefix index, a :meth:`PagedKVCache.fork` sibling);
+  ``free`` DECREMENTS and only returns a block to the free list at
+  zero.  Freeing a sequence whose blocks another live sequence shares
+  therefore releases references, never data — the invariant behind
+  "preemption never evicts a block another live sequence shares".
+  Double-free (freeing an unheld block) stays loud.
 - **O(1) append**: generating one token costs at most one free-list pop
   (amortized ``1/block_size`` pops) and one slot write — independent of
   how long the sequence already is.
@@ -54,8 +62,12 @@ import threading
 import numpy as np
 
 from ..base import MXNetError
+from .. import telemetry as _telemetry
+from .. import tracing as _tracing
+from .prefix_cache import PrefixIndex, prefix_sharing_enabled
 
-__all__ = ["CacheExhausted", "BlockAllocator", "PagedKVCache"]
+__all__ = ["CacheExhausted", "BlockAllocator", "PagedKVCache",
+           "PrefillPlan", "prefix_sharing_enabled"]
 
 
 def _next_pow2(n):
@@ -91,7 +103,13 @@ def _dev_ops():
         def write_blocks(pool, bids, chunk):
             return pool.at[bids].set(chunk.astype(pool.dtype))
 
-        _DEV_OPS = (write_slot, write_rows, write_blocks)
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def copy_block(pool, dst, src):
+            # the copy-on-write primitive: one block's slots duplicated
+            # on-device (the pool never round-trips through the host)
+            return pool.at[dst].set(pool[src])
+
+        _DEV_OPS = (write_slot, write_rows, write_blocks, copy_block)
     return _DEV_OPS
 
 
@@ -120,10 +138,11 @@ class BlockAllocator:
         # the warmest — copy-free reuse on sequence completion)
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._held = set()
+        self._refs = {}   # block id -> reference count (held blocks only)
 
     def alloc(self, n=1):
-        """``n`` block ids, or raise :class:`CacheExhausted` (free list
-        untouched — all-or-nothing)."""
+        """``n`` block ids at one reference each, or raise
+        :class:`CacheExhausted` (free list untouched — all-or-nothing)."""
         n = int(n)
         with self._lock:
             if n > len(self._free):
@@ -133,11 +152,32 @@ class BlockAllocator:
                     "backpressure, not OOM: requeue or reject")
             ids = [self._free.pop() for _ in range(n)]
             self._held.update(ids)
+            for bid in ids:
+                self._refs[bid] = 1
         return ids
 
+    def incref(self, block_ids):
+        """Add one reference to each (held) block — a sharer: the
+        shared-prefix index, or a :meth:`PagedKVCache.fork` sibling.
+        Increfing a block the allocator did not hand out is as loud as
+        double-freeing one (a stale id would resurrect a freed block)."""
+        with self._lock:
+            for bid in block_ids:
+                if bid not in self._held:
+                    raise MXNetError(
+                        f"BlockAllocator.incref: block {bid} is not held "
+                        "(stale or foreign id) — sharing it would "
+                        "resurrect freed storage")
+            for bid in block_ids:
+                self._refs[bid] += 1
+
     def free(self, block_ids):
-        """Return blocks to the free list (copy-free: contents are left
-        in place for the next owner to overwrite)."""
+        """Drop one reference per block; a block reaching ZERO
+        references returns to the free list (copy-free: contents are
+        left in place for the next owner to overwrite).  A block another
+        holder still references survives — which is why freeing a
+        preempted sequence can never corrupt a sequence sharing its
+        prefix.  Freeing an unheld block (double free) stays loud."""
         with self._lock:
             for bid in block_ids:
                 if bid not in self._held:
@@ -145,8 +185,23 @@ class BlockAllocator:
                         f"BlockAllocator.free: block {bid} is not held "
                         "(double free or foreign id) — the pool would be "
                         "silently corrupted")
-                self._held.discard(bid)
-                self._free.append(bid)
+                self._refs[bid] -= 1
+                if self._refs[bid] == 0:
+                    del self._refs[bid]
+                    self._held.discard(bid)
+                    self._free.append(bid)
+
+    def refcount(self, block_id):
+        """The block's live reference count (0 when not held)."""
+        with self._lock:
+            return self._refs.get(block_id, 0)
+
+    def refcounts(self):
+        """``{block_id: refcount}`` for every held block — the audit
+        surface: after every sequence is freed and the prefix index
+        dropped, this must be empty (CI's post-storm allocator audit)."""
+        with self._lock:
+            return dict(self._refs)
 
     @property
     def available(self):
@@ -171,6 +226,45 @@ class _Sequence:
     def __init__(self):
         self.blocks = []
         self.length = 0
+
+
+class PrefillPlan:
+    """A pinned prefix match (:meth:`PagedKVCache.match_prefix`):
+    ``blocks`` are increfed physical ids covering the leading
+    ``tokens_matched`` prompt tokens.  A plan MUST flow into exactly one
+    of :meth:`PagedKVCache.commit_prefill` (which takes ownership of the
+    pins) or :meth:`PagedKVCache.abandon_plan` (which releases them) —
+    dropping it on the floor leaks references until the audit catches
+    it."""
+
+    __slots__ = ("blocks", "tokens_matched", "_consumed")
+
+    def __init__(self, blocks, tokens_matched):
+        self.blocks = list(blocks)
+        self.tokens_matched = int(tokens_matched)
+        # a plan's pins are released exactly once (by commit_prefill or
+        # abandon_plan).  Without this flag a double abandon — or an
+        # abandon after commit — would free() blocks the plan no longer
+        # owns, silently stealing ANOTHER holder's reference (the index
+        # or a live sequence) and eventually serving a recycled block's
+        # K/V as someone's cached prefix.  The allocator cannot catch
+        # that (the block is legitimately held); the plan must.
+        self._consumed = False
+
+    def consume(self):
+        """Mark the pins as spent; raises on a second consumption —
+        the refcount analog of 'double-free stays loud'."""
+        if self._consumed:
+            raise MXNetError(
+                "PrefillPlan already consumed (committed or abandoned) — "
+                "releasing its pins again would steal another holder's "
+                "reference and corrupt served K/V")
+        self._consumed = True
+
+    def __repr__(self):
+        return (f"PrefillPlan({len(self.blocks)} shared blocks, "
+                f"{self.tokens_matched} tokens"
+                + (", consumed)" if self._consumed else ")"))
 
 
 class PagedKVCache:
@@ -200,7 +294,8 @@ class PagedKVCache:
     """
 
     def __init__(self, num_layers, num_heads, head_dim, block_size=16,
-                 num_blocks=256, dtype=np.float32, storage="host"):
+                 num_blocks=256, dtype=np.float32, storage="host",
+                 share_prefix=None):
         if storage not in ("host", "device"):
             raise ValueError(f"storage must be 'host' or 'device', "
                              f"got {storage!r}")
@@ -236,6 +331,34 @@ class PagedKVCache:
             self.v_blocks = np.zeros(shape, dtype)
         self._lock = threading.RLock()
         self._seqs = {}
+        # shared-prefix index (ISSUE 12): None = every prefill is
+        # private (the pre-sharing behavior, bit-for-bit).  The knob
+        # defaults to the TPUMX_PREFIX_SHARING env resolution so an
+        # engine, the bench arms, and a bare test cache all agree.
+        if share_prefix is None:
+            share_prefix = prefix_sharing_enabled()
+        if share_prefix and np.dtype(dtype) != np.float32:
+            # the suffix prefill attends over PREFIX K/V read back from
+            # the pool; a quantized pool (f16/bf16) would feed it
+            # pool-rounded values where the sharing-off arm recomputes
+            # the prefix at model precision — silently different logits
+            # is the one failure mode sharing must never have, so a
+            # lossy pool refuses loudly instead (docs/DIVERGENCES.md
+            # #28; widen by writing the index's compute-precision copy
+            # if a quantized shared pool is ever needed)
+            raise ValueError(
+                f"share_prefix requires a float32 pool (got "
+                f"{np.dtype(dtype).name}): a lossy pool dtype would "
+                "break the sharing-on/off bit-equality guarantee")
+        self.prefix = PrefixIndex(self.block_size) if share_prefix else None
+        # per-token K/V footprint across all layers, both pools — the
+        # unit of the prefill-bytes accounting (what a prefill COMPUTES;
+        # the bench receipt's ">= 2x reduction" numerator/denominator)
+        self._token_bytes = (self.num_layers * self.num_heads
+                             * self.head_dim * 2 * np.dtype(dtype).itemsize)
+        self._prompt_tokens = 0     # tokens requested across prefills
+        self._cached_tokens = 0     # of those, served from the index
+        self._cow_copies = 0
 
     @property
     def device_resident(self):
@@ -278,13 +401,85 @@ class PagedKVCache:
         return -(-int(num_tokens) // self.block_size)
 
     # -- writes --------------------------------------------------------------
-    def prefill(self, seq_id, k, v):
+    def _alloc(self, n):
+        """``allocator.alloc`` with prefix-cache pressure relief: on
+        exhaustion, least-recently-matched index-only prefixes are
+        released and the allocation retried ONCE.  When the pool is
+        genuinely full of live sequence data, :class:`CacheExhausted`
+        propagates — the backpressure contract is unchanged, the index
+        merely never stands between a live request and free memory.
+        Called under the cache lock."""
+        try:
+            return self.allocator.alloc(n)
+        except CacheExhausted:
+            if self.prefix is None:
+                raise
+            released = self.prefix.release(self.allocator, n)
+            if released:
+                _telemetry.counter("serve.prefix_evictions").inc(released)
+                _tracing.emit("serve.prefix_evict", released=released,
+                              need=int(n))
+            return self.allocator.alloc(n)
+
+    def _fill(self, blocks, k, v, offset=0):
+        """Write ``k``/``v`` (``(num_layers, T, H, D)``) into ``blocks``
+        starting at slot ``offset`` of the first block (``offset`` is
+        the in-block remainder of a block-aligned prefix — 0 everywhere
+        today because only full blocks are shared).  Called under the
+        cache lock, blocks privately owned by the caller."""
+        length = k.shape[1]
+        bs = self.block_size
+        if self.storage == "device":
+            _, _, write_blocks, _ = _dev_ops()
+            nb = len(blocks)
+            pad = nb * bs - length - offset
+            bids = np.asarray(blocks, np.int32)
+            for layer in range(self.num_layers):
+                # one scatter per pool per layer: the prompt's K/V
+                # crosses to the device once, zero-padded to whole
+                # blocks (the tail slots are this sequence's own
+                # future append slots)
+                ck = np.pad(k[layer], ((offset, pad), (0, 0), (0, 0)))
+                cv = np.pad(v[layer], ((offset, pad), (0, 0), (0, 0)))
+                self._k_dev[layer] = write_blocks(
+                    self._k_dev[layer], bids,
+                    ck.reshape(nb, bs, *ck.shape[1:]))
+                self._v_dev[layer] = write_blocks(
+                    self._v_dev[layer], bids,
+                    cv.reshape(nb, bs, *cv.shape[1:]))
+        else:
+            for i, bid in enumerate(blocks):
+                lo = max(i * bs - offset, 0)
+                hi = min((i + 1) * bs - offset, length)
+                s0 = offset if i == 0 else 0
+                self.k_blocks[:, bid, s0:s0 + hi - lo] = k[:, lo:hi]
+                self.v_blocks[:, bid, s0:s0 + hi - lo] = v[:, lo:hi]
+
+    def _account_prefill(self, computed_tokens, cached_tokens):
+        """Prefill byte accounting + the hit-ratio gauge (under the
+        cache lock; telemetry's registry lock is a leaf)."""
+        self._prompt_tokens += computed_tokens + cached_tokens
+        self._cached_tokens += cached_tokens
+        _telemetry.counter("serve.prefill_bytes").inc(
+            computed_tokens * self._token_bytes)
+        if cached_tokens:
+            _telemetry.counter("serve.prefix_hits").inc()
+            _telemetry.counter("serve.prefill_bytes_saved").inc(
+                cached_tokens * self._token_bytes)
+        if self._prompt_tokens:
+            _telemetry.gauge("serve.prefix_hit_ratio").set(
+                self._cached_tokens / self._prompt_tokens)
+
+    def prefill(self, seq_id, k, v, tokens=None):
         """Bulk-fill a new sequence's blocks in one call.
 
         ``k``/``v``: ``(num_layers, L, num_heads, head_dim)``.  Allocates
         exactly ``ceil(L / block_size)`` blocks all-or-nothing — on
         :class:`CacheExhausted` nothing is registered, so the scheduler
-        can requeue the request and retry after an eviction."""
+        can requeue the request and retry after an eviction.  ``tokens``
+        (the prompt's token ids, optional) lets the shared-prefix index
+        learn this sequence's full blocks for future reuse — omitted,
+        the prefill stays private (the pre-sharing behavior)."""
         k = np.asarray(k)
         v = np.asarray(v)
         want = (self.num_layers, k.shape[1], self.num_heads, self.head_dim)
@@ -296,54 +491,190 @@ class PagedKVCache:
         length = k.shape[1]
         if length < 1:
             raise ValueError("prefill: empty prompt")
+        if tokens is not None and len(tokens) != length:
+            raise ValueError(f"prefill: {len(tokens)} tokens for {length} "
+                             "K/V positions")
         with self._lock:
             if seq_id in self._seqs:
                 raise MXNetError(f"prefill: sequence {seq_id!r} already "
                                  "cached (free it first)")
-            blocks = self.allocator.alloc(self.blocks_for(length))
+            blocks = self._alloc(self.blocks_for(length))
             # fill BEFORE publishing in _seqs: a concurrent gather must
             # never see a registered-but-empty sequence (all-zero K/V
             # would be silently wrong logits, not an error)
-            bs = self.block_size
-            if self.storage == "device":
-                _, _, write_blocks = _dev_ops()
-                nb = len(blocks)
-                pad = nb * bs - length
-                bids = np.asarray(blocks, np.int32)
-                for layer in range(self.num_layers):
-                    # one scatter per pool per layer: the prompt's K/V
-                    # crosses to the device once, zero-padded to whole
-                    # blocks (the tail slots are this sequence's own
-                    # future append slots)
-                    ck = np.pad(k[layer], ((0, pad), (0, 0), (0, 0)))
-                    cv = np.pad(v[layer], ((0, pad), (0, 0), (0, 0)))
-                    self._k_dev[layer] = write_blocks(
-                        self._k_dev[layer], bids,
-                        ck.reshape(nb, bs, *ck.shape[1:]))
-                    self._v_dev[layer] = write_blocks(
-                        self._v_dev[layer], bids,
-                        cv.reshape(nb, bs, *cv.shape[1:]))
-            else:
-                for i, bid in enumerate(blocks):
-                    lo = i * bs
-                    hi = min(lo + bs, length)
-                    self.k_blocks[:, bid, :hi - lo] = k[:, lo:hi]
-                    self.v_blocks[:, bid, :hi - lo] = v[:, lo:hi]
+            self._fill(blocks, k, v)
             entry = _Sequence()
             entry.blocks = blocks
             entry.length = length
             self._seqs[seq_id] = entry
+            if self.prefix is not None and tokens is not None:
+                self.prefix.insert(tokens, blocks, self.allocator)
+            self._account_prefill(length, 0)
+
+    # -- shared-prefix prefill (ISSUE 12) ------------------------------------
+    def match_prefix(self, tokens):
+        """The longest indexed full-block prefix of ``tokens``, PINNED:
+        the matched blocks are increfed under the lock so pressure
+        eviction can never reuse them between the match and the commit.
+        Returns a :class:`PrefillPlan` or None (sharing off, or no
+        match).  Every plan must reach :meth:`commit_prefill` or
+        :meth:`abandon_plan`."""
+        if self.prefix is None:
+            return None
+        with self._lock:
+            blocks, m = self.prefix.match(tokens)
+            if not m:
+                return None
+            self.allocator.incref(blocks)
+            return PrefillPlan(blocks, m)
+
+    def gather_plan(self, plan):
+        """The pinned prefix's K/V as host ``(num_layers, m, H, D)``
+        arrays — the suffix prefill's attention operands.  A device pool
+        pays one fetch here; acceptable because prefill is host-resident
+        anyway (docs/DIVERGENCES.md #27) and the fetch replaces the
+        whole prefix's projection matmuls."""
+        m = plan.tokens_matched
+        ks = np.empty((self.num_layers, m, self.num_heads, self.head_dim),
+                      np.float32)
+        vs = np.empty_like(ks)
+        for layer in range(self.num_layers):
+            kp, vp = self.pool(layer)
+            if self.storage == "device":
+                import jax.numpy as jnp
+                # tpumx-lint: disable=hot-path-purity -- prefill-path
+                # fetch of the shared prefix (one gather per layer per
+                # SHARED prefill, replacing the prefix's full projection
+                # compute); decode never takes this path
+                idx = jnp.asarray(plan.blocks, jnp.int32)
+                kp, vp = np.asarray(kp[idx]), np.asarray(vp[idx])
+            else:
+                kp, vp = kp[plan.blocks], vp[plan.blocks]
+            ks[layer] = kp.reshape(-1, self.num_heads, self.head_dim)[:m]
+            vs[layer] = vp.reshape(-1, self.num_heads, self.head_dim)[:m]
+        return ks, vs
+
+    def commit_prefill(self, seq_id, plan, k, v, tokens):
+        """Register ``seq_id`` as the pinned prefix plus the computed
+        suffix: ``k``/``v`` are ``(num_layers, S, H, D)`` projections
+        for ``tokens[plan.tokens_matched:]``.  All-or-nothing like
+        :meth:`prefill`: on ANY failure (suffix allocation hitting
+        genuine exhaustion included) the plan's pins are released and
+        nothing is registered — the scheduler defers and the retry
+        re-plans from scratch."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        m = plan.tokens_matched
+        length = m + k.shape[1]
+        with self._lock:
+            plan.consume()   # pins spent here, succeed or fail
+            fresh = []
+            published = False
+            try:
+                if seq_id in self._seqs:
+                    raise MXNetError(f"commit_prefill: sequence {seq_id!r} "
+                                     "already cached (free it first)")
+                if length != len(tokens):
+                    raise ValueError(
+                        f"commit_prefill: {len(tokens)} tokens vs "
+                        f"{m} matched + {k.shape[1]} suffix positions")
+                if m % self.block_size != 0 or k.shape[1] < 1:
+                    raise ValueError(
+                        f"commit_prefill: matched prefix ({m}) must be "
+                        f"block-aligned with a non-empty suffix")
+                want = (self.num_layers, k.shape[1], self.num_heads,
+                        self.head_dim)
+                if k.shape != want or v.shape != want:
+                    raise ValueError(
+                        f"commit_prefill: suffix k/v must be {want}, got "
+                        f"{k.shape} / {v.shape}")
+                fresh = self._alloc(self.blocks_for(length)
+                                    - len(plan.blocks))
+                self._fill(fresh, k, v)
+                entry = _Sequence()
+                entry.blocks = plan.blocks + fresh
+                entry.length = length
+                self._seqs[seq_id] = entry
+                published = True
+                self.prefix.insert(tokens, entry.blocks, self.allocator)
+            except BaseException:
+                # ALL-or-nothing: unregister (only what THIS call
+                # published — the already-cached guard's failure must
+                # not destroy the pre-existing live sequence), release
+                # the plan's pins AND any fresh blocks allocated above —
+                # a fill/insert fault must not leak held refcounts (the
+                # post-storm audit would catch it, after the pool had
+                # already shrunk) or publish a half-built sequence
+                if published:
+                    self._seqs.pop(seq_id, None)
+                if fresh:
+                    self.allocator.free(fresh)
+                self.allocator.free(plan.blocks)
+                raise
+            self._account_prefill(k.shape[1], m)
+
+    def abandon_plan(self, plan):
+        """Release a plan's pins without committing (the model faulted
+        between match and commit).  Like :meth:`commit_prefill` this
+        consumes the plan — a second release raises instead of stealing
+        another holder's reference."""
+        with self._lock:
+            plan.consume()
+            self.allocator.free(plan.blocks)
+
+    def fork(self, parent_id, child_id):
+        """Register ``child_id`` sharing ALL of ``parent_id``'s blocks
+        (one incref per block) — the parallel-sampling shape: N
+        generations from one prompt pay one prefill and one copy of the
+        prompt's KV.  Both siblings copy-on-write their shared tail
+        block on their next divergent append (:meth:`reserve`)."""
+        with self._lock:
+            if child_id in self._seqs:
+                raise MXNetError(f"fork: sequence {child_id!r} already "
+                                 "cached (free it first)")
+            parent = self._entry(parent_id)
+            self.allocator.incref(parent.blocks)
+            entry = _Sequence()
+            entry.blocks = list(parent.blocks)
+            entry.length = parent.length
+            self._seqs[child_id] = entry
+
+    def _cow_tail(self, entry):
+        """Copy-on-write the entry's (shared) tail block: allocate a
+        private block, duplicate the tail's slots into it, drop one
+        reference on the original.  The sharers keep reading the
+        original bits; this sequence appends into its own copy — the
+        write is invisible to them by construction."""
+        old = entry.blocks[-1]
+        new = self._alloc(1)[0]
+        if self.storage == "device":
+            _, _, _, copy_block = _dev_ops()
+            for layer in range(self.num_layers):
+                self._k_dev[layer] = copy_block(self._k_dev[layer], new, old)
+                self._v_dev[layer] = copy_block(self._v_dev[layer], new, old)
+        else:
+            self.k_blocks[:, new] = self.k_blocks[:, old]
+            self.v_blocks[:, new] = self.v_blocks[:, old]
+        entry.blocks[-1] = new
+        self.allocator.free([old])
+        self._cow_copies += 1
+        _telemetry.counter("serve.cow_copies").inc()
 
     def reserve(self, seq_id):
         """Reserve the next token's slot: the O(1) append.  At most one
         free-list pop (when the tail block is full); returns the position
-        index the per-layer :meth:`write` calls will fill.  On
+        index the per-layer :meth:`write` calls will fill.  A partially
+        filled tail block that is SHARED (refcount > 1 — a fork sibling
+        or the prefix index holds it) is copy-on-written first: appends
+        must never mutate bits another reader sees.  On
         :class:`CacheExhausted` the sequence is unchanged — the caller
         preempts it (free + requeue), never crashes."""
         with self._lock:
             entry = self._entry(seq_id)
             if entry.length % self.block_size == 0:
-                entry.blocks.extend(self.allocator.alloc(1))
+                entry.blocks.extend(self._alloc(1))
+            elif self.allocator.refcount(entry.blocks[-1]) > 1:
+                self._cow_tail(entry)
             pos = entry.length
             entry.length = pos + 1
             return pos
@@ -360,7 +691,7 @@ class PagedKVCache:
                 # numpy operands cross the jit boundary on the C++ fast
                 # path; an eager jnp.asarray per operand costs ~73us of
                 # dispatch each and dominated the per-token write cost
-                write_slot, _, _ = _dev_ops()
+                write_slot, _, _, _ = _dev_ops()
                 self._k_dev[layer] = write_slot(
                     self._k_dev[layer], bid, off, np.asarray(k))
                 self._v_dev[layer] = write_slot(
@@ -383,7 +714,7 @@ class PagedKVCache:
                 slots.append((entry.blocks[pos // self.block_size],
                               pos % self.block_size))
             if self.storage == "device":
-                _, write_rows, _ = _dev_ops()
+                _, write_rows, _, _ = _dev_ops()
                 bids = np.asarray([b for b, _ in slots], np.int32)
                 offs = np.asarray([o for _, o in slots], np.int32)
                 self._k_dev[layer] = write_rows(
@@ -396,15 +727,63 @@ class PagedKVCache:
                     self.v_blocks[layer, bid, off] = v[i]
 
     def free_sequence(self, seq_id):
-        """Evict: push the sequence's blocks back on the free list
-        (copy-free — contents stay until reuse).  Returns the number of
-        blocks released."""
+        """Evict: drop one reference per block (copy-free — contents
+        stay until reuse).  A block only this sequence held returns to
+        the free list; one the prefix index or a fork sibling shares
+        SURVIVES at its remaining count — freeing a preempted sequence
+        can never evict a block another live sequence reads.  Returns
+        the number of block references released."""
         with self._lock:
             entry = self._seqs.pop(seq_id, None)
             if entry is None:
                 return 0
             self.allocator.free(entry.blocks)
             return len(entry.blocks)
+
+    def exclusive_blocks(self, seq_id):
+        """How many of the sequence's blocks only IT holds (refcount
+        1) — what freeing it would actually return to the pool.  The
+        engine's preemption victim selection reads this: evicting a
+        sequence whose blocks are all shared frees nothing."""
+        with self._lock:
+            entry = self._seqs.get(seq_id)
+            if entry is None:
+                return 0
+            return sum(1 for b in entry.blocks
+                       if self.allocator.refcount(b) == 1)
+
+    def drop_prefix_cache(self):
+        """Release EVERY prefix-index reference (teardown, tests, and
+        the CI post-storm audit: after this plus freeing every sequence,
+        ``allocator.refcounts()`` must be empty).  Returns the number of
+        index entries dropped; 0 when sharing is off."""
+        with self._lock:
+            if self.prefix is None:
+                return 0
+            return self.prefix.drop_all(self.allocator)
+
+    def prefix_stats(self):
+        """Sharing observability: ``{sharing, prompt_tokens,
+        cached_tokens, hit_ratio, prefill_bytes, prefill_bytes_saved,
+        cow_copies}`` plus the index's own ``{nodes, lookups, hits,
+        tokens_matched, evictions}`` when sharing is on."""
+        with self._lock:
+            out = {
+                "sharing": self.prefix is not None,
+                "prompt_tokens": self._prompt_tokens,
+                "cached_tokens": self._cached_tokens,
+                "hit_ratio": (self._cached_tokens / self._prompt_tokens
+                              if self._prompt_tokens else 0.0),
+                "prefill_bytes": ((self._prompt_tokens
+                                   - self._cached_tokens)
+                                  * self._token_bytes),
+                "prefill_bytes_saved": (self._cached_tokens
+                                        * self._token_bytes),
+                "cow_copies": self._cow_copies,
+            }
+            if self.prefix is not None:
+                out.update(self.prefix.stats())
+            return out
 
     # -- reads: the paged-kernel operands ------------------------------------
     def pool(self, layer):

@@ -129,3 +129,49 @@ class TinyLM:
             attn = _attn.prefill_attention(q, k, v)
             h = self.layer_combine(i, h, attn)
         return ks, vs, self.logits(h[-1:])[0]
+
+    def prefill_suffix(self, tokens, pos_offset, k_prefix, v_prefix):
+        """The tail of a prompt whose leading ``pos_offset`` positions'
+        K/V are already cached (shared-prefix reuse, ISSUE 12).
+
+        ``tokens``: the SUFFIX token ids, absolute positions
+        ``pos_offset .. pos_offset+S-1``; ``k_prefix``/``v_prefix``:
+        ``(num_layers, pos_offset, H, D)`` — the cached prefix K/V
+        (``PagedKVCache.gather_plan``).  Returns ``(k, v, logits_last)``
+        with ``k``/``v`` shaped ``(num_layers, S, H, D)`` — only the
+        suffix positions, the cache-write payload — and the last
+        position's ``(V,)`` logits.
+
+        Soundness: position p's K/V is a pure function of tokens 0..p,
+        so the cached prefix is bit-identical to what a full prefill
+        would recompute; each suffix query attends causally over
+        [prefix K/V ++ suffix K/V] — the same score rows, reduced in the
+        same order, as the full prefill's last S rows
+        (tests/test_multitenant.py pins the greedy streams)."""
+        tokens = np.asarray(tokens, np.int64)
+        s = tokens.shape[0]
+        if s < 1:
+            raise ValueError("prefill_suffix: empty suffix — at least the "
+                             "final prompt position must be computed for "
+                             "its logits")
+        m = int(pos_offset)
+        want = (self.num_layers, m, self.num_heads, self.head_dim)
+        k_prefix = np.asarray(k_prefix, np.float32)
+        v_prefix = np.asarray(v_prefix, np.float32)
+        if k_prefix.shape != want or v_prefix.shape != want:
+            raise ValueError(
+                f"prefill_suffix: prefix K/V must be {want}, got "
+                f"{k_prefix.shape} / {v_prefix.shape}")
+        h = self.embed(tokens, m + np.arange(s))           # (S, E)
+        ks = np.empty((self.num_layers, s, self.num_heads,
+                       self.head_dim), np.float32)
+        vs = np.empty_like(ks)
+        for i in range(self.num_layers):
+            q, k, v = self.layer_qkv(i, h)                 # (S, H, D)
+            ks[i] = k
+            vs[i] = v
+            attn = _attn.prefill_attention(
+                q, np.concatenate([k_prefix[i], k], axis=0),
+                np.concatenate([v_prefix[i], v], axis=0))
+            h = self.layer_combine(i, h, attn)
+        return ks, vs, self.logits(h[-1:])[0]

@@ -1,0 +1,234 @@
+"""Block-granular shared-prefix index: the trie behind KV reuse.
+
+"Millions of users" traffic is dominated by shared system prompts and
+templates (ROADMAP fleet-scale item): N requests carrying the same
+leading tokens each used to pay a full prefill and a private copy of
+identical KV blocks.  This module is the index that lets
+:class:`~tpu_mx.serving.kv_cache.PagedKVCache` map those requests'
+leading block-table entries onto the SAME physical blocks:
+
+- **Trie keyed on full block contents**: each node is one FULL block of
+  tokens (the ``block_size``-tuple is the key — a token hash via the
+  dict), chained parent→child in prompt order, holding the physical
+  block id whose K/V encodes exactly that token prefix.  Sharing is
+  sound at this granularity because a position's K/V is a pure function
+  of the tokens at and before it: same prefix tokens → bit-identical
+  K/V, whichever request computed them first.
+- **Only full blocks are indexed.**  A partial tail block is still being
+  appended to — its contents are not final, so it is never shared
+  through the index (a matched sequence writes its own tail; the
+  copy-on-write path in ``PagedKVCache.reserve`` guards the residual
+  case where a tail block IS shared, e.g. after ``fork``).
+- **Refcounts, not ownership**: the index holds one reference on every
+  block it indexes (``BlockAllocator`` refcounts — kv_cache.py), so a
+  prefix outlives the sequence that prefilled it and the next request
+  with the same template reuses it.  ``free_sequence`` decrements;
+  physical reuse happens only at refcount zero.
+- **Eviction under pressure**: when an allocation cannot be satisfied,
+  the cache asks the index to release least-recently-matched LEAF nodes
+  whose blocks no live sequence shares (refcount 1 — index-only) until
+  the allocation fits.  Leaf-first keeps the trie reachable (evicting an
+  interior node would orphan its descendants: matching walks from the
+  root, so an unreachable child could never be handed out again but
+  would hold its block forever).  The exhaustion contract is unchanged:
+  if releasing every evictable prefix still cannot satisfy the
+  allocation, :class:`~tpu_mx.serving.kv_cache.CacheExhausted`
+  propagates — backpressure, never OOM.
+
+Determinism: recency is a monotone integer clock (``itertools.count``),
+not wall time — eviction order is a pure function of the request
+sequence, which is what keeps the sharing-on vs sharing-off greedy
+streams comparable under a fixed trace (tests/test_multitenant.py, the
+bench prefix trace).
+
+Thread-safety: the index has no lock of its own — every call happens
+under the owning ``PagedKVCache``'s lock (the cache's documented
+bookkeeping discipline), and allocator refcount mutations go through
+the allocator's own lock beneath it.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+
+__all__ = ["PrefixIndex", "prefix_sharing_enabled"]
+
+_SHARING_ENV = "TPUMX_PREFIX_SHARING"
+
+
+def prefix_sharing_enabled():
+    """The ``TPUMX_PREFIX_SHARING`` knob: ``1``/``on`` enables the
+    shared-prefix index, unset/``0``/``off`` disables it (the default —
+    sharing changes pool-residency behavior, so it is opt-in like
+    ``TPUMX_PAGED_DECODE``).  Unknown values raise: a typo'd knob
+    silently running the other arm would let a "sharing" receipt pass
+    without ever exercising the trie."""
+    v = os.environ.get(_SHARING_ENV, "0").strip().lower()
+    if v in ("", "0", "off", "false"):
+        return False
+    if v in ("1", "on", "true", "share"):
+        return True
+    raise ValueError(
+        f"{_SHARING_ENV}={v!r} is not a recognized setting — use 0 "
+        "(private prefills, the default) or 1 (block-granular shared-"
+        "prefix KV reuse)")
+
+
+class _Node:
+    """One indexed FULL block: ``key`` is its token tuple, ``block_id``
+    the physical block whose K/V encodes the prefix ending here."""
+
+    __slots__ = ("key", "block_id", "parent", "children", "last_used")
+
+    def __init__(self, key, block_id, parent):
+        self.key = key
+        self.block_id = block_id
+        self.parent = parent
+        self.children = {}
+        self.last_used = 0
+
+
+class PrefixIndex:
+    """See module docstring.  All methods are called under the owning
+    cache's lock; ``allocator`` is the cache's refcounted
+    :class:`~tpu_mx.serving.kv_cache.BlockAllocator`."""
+
+    def __init__(self, block_size):
+        self.block_size = int(block_size)
+        self._root = _Node(None, None, None)
+        self._clock = itertools.count(1)
+        self._nodes = 0
+        # observability counters (the cache publishes them as the
+        # serve.prefix_* metrics — docs/observability.md)
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_matched = 0
+        self.evictions = 0
+
+    # -- matching ------------------------------------------------------------
+    def match(self, tokens):
+        """The longest indexed chain of full blocks that is a prefix of
+        ``tokens`` AND leaves at least the final token uncovered —
+        returns ``(block_ids, tokens_covered)`` (both empty/0 on a
+        miss).
+
+        The final-token cap is the engine's logits contract: the first
+        generated token is the argmax at the LAST prompt position, so at
+        least that position must be computed (suffix prefill) rather
+        than served from cache.  Touches the whole matched chain's
+        recency — a template's interior blocks must not age out while
+        its tail is hot.  The caller pins the returned blocks (incref)
+        before releasing the cache lock."""
+        bs = self.block_size
+        self.lookups += 1
+        node, blocks = self._root, []
+        limit = len(tokens) - 1
+        while (len(blocks) + 1) * bs <= limit:
+            lo = len(blocks) * bs
+            child = node.children.get(tuple(tokens[lo:lo + bs]))
+            if child is None:
+                break
+            blocks.append(child.block_id)
+            node = child
+        if blocks:
+            stamp = next(self._clock)
+            n = node
+            while n is not self._root:
+                n.last_used = stamp
+                n = n.parent
+            self.hits += 1
+            self.tokens_matched += len(blocks) * bs
+        return blocks, len(blocks) * bs
+
+    # -- insertion -----------------------------------------------------------
+    def insert(self, tokens, block_ids, allocator):
+        """Index every FULL block of ``tokens`` (physical ids
+        ``block_ids``, in table order).  New nodes take one index
+        reference on their block (``allocator.incref``); chains that
+        already exist are left pointing at their original block — the
+        first writer wins, so concurrent identical prefills converge on
+        one physical copy for all FUTURE requests even though each kept
+        its own."""
+        bs = self.block_size
+        node = self._root
+        stamp = next(self._clock)
+        for i in range(len(tokens) // bs):
+            if i >= len(block_ids):
+                break
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, block_ids[i], node)
+                allocator.incref([block_ids[i]])
+                node.children[key] = child
+                self._nodes += 1
+            child.last_used = stamp
+            node = child
+
+    # -- eviction ------------------------------------------------------------
+    def release(self, allocator, need):
+        """Release least-recently-matched evictable leaves until the
+        free list holds at least ``need`` blocks (or nothing evictable
+        remains).  Evictable = a leaf whose block only the index holds
+        (refcount 1): releasing a block a live sequence shares would
+        free no memory.  Returns the number of blocks released.
+
+        One DFS collects every candidate leaf into a heap keyed on
+        recency; parents that BECOME evictable leaves as their children
+        go are pushed as they appear — amortized O(nodes + k log n) per
+        relief pass, instead of a full-trie walk per victim (this runs
+        under the owning cache's lock on the allocation path)."""
+        if allocator.available >= need:
+            return 0
+        heap = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if not n.children and allocator.refcount(n.block_id) == 1:
+                heapq.heappush(heap, (n.last_used, id(n), n))
+            stack.extend(n.children.values())
+        released = 0
+        while heap and allocator.available < need:
+            _, _, victim = heapq.heappop(heap)
+            if victim.key not in victim.parent.children or \
+                    victim.children:
+                continue   # stale entry (shouldn't happen; be safe)
+            del victim.parent.children[victim.key]
+            allocator.free([victim.block_id])
+            self._nodes -= 1
+            self.evictions += 1
+            released += 1
+            parent = victim.parent
+            if parent is not self._root and not parent.children \
+                    and allocator.refcount(parent.block_id) == 1:
+                heapq.heappush(heap, (parent.last_used, id(parent),
+                                      parent))
+        return released
+
+    def drop_all(self, allocator):
+        """Release EVERY index reference (teardown / the post-storm
+        refcount audit: with the index dropped and all sequences freed,
+        every allocator refcount must be back at zero).  Returns the
+        number of nodes released."""
+        dropped = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            allocator.free([n.block_id])
+            dropped += 1
+        self._root.children = {}
+        self._nodes = 0
+        return dropped
+
+    # -- observables ---------------------------------------------------------
+    @property
+    def nodes(self):
+        return self._nodes
+
+    def stats(self):
+        """``{nodes, lookups, hits, tokens_matched, evictions}``."""
+        return {"nodes": self._nodes, "lookups": self.lookups,
+                "hits": self.hits, "tokens_matched": self.tokens_matched,
+                "evictions": self.evictions}

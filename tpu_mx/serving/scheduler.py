@@ -42,6 +42,7 @@ from ..base import MXNetError
 from .. import telemetry as _telemetry
 from .. import tracing as _tracing
 from ..contrib import chaos as _chaos
+from .tenancy import DEFAULT_TENANT, TenantTable, label_for
 from .timeline import RequestTimeline
 
 __all__ = ["Request", "AdmissionReject", "ContinuousBatchingScheduler",
@@ -59,6 +60,10 @@ class AdmissionReject(MXNetError):
         super().__init__(f"request rejected: {reason}"
                          + (f" ({detail})" if detail else ""))
         self.reason = reason
+        # reasons: queue_full / request_too_large / reject_storm /
+        # degraded / tenant_quota (ISSUE 12 — the submitting tenant is
+        # over its max_inflight or token_quota; resubmit after its own
+        # in-flight work drains, other tenants are unaffected)
 
 
 class Request:
@@ -73,7 +78,8 @@ class Request:
     ``first_token_at``, ``token_times``) feeds the TTFT/ITL telemetry
     and the bench percentiles."""
 
-    def __init__(self, prompt, max_new_tokens, request_id=None):
+    def __init__(self, prompt, max_new_tokens, request_id=None,
+                 tenant=None):
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("Request: empty prompt")
@@ -82,6 +88,13 @@ class Request:
         self.id = request_id or f"req-{next(_req_counter):06d}"
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
+        # the submitting tenant (ISSUE 12): the fairness/quota identity
+        # and the bounded telemetry label.  tenant_weight is resolved by
+        # the server from its TenantTable at submit (1.0 bare) — the
+        # engine's preemption victim selection reads it without needing
+        # the table.
+        self.tenant = DEFAULT_TENANT if tenant is None else str(tenant)
+        self.tenant_weight = 1.0
         self.state = "queued"
         self.tokens = []
         self.finish_reason = None
@@ -117,8 +130,15 @@ class Request:
         if self.first_token_at is None:
             self.first_token_at = now
         else:
-            _telemetry.histogram("serve.itl_seconds").observe(
-                now - self.token_times[-1])
+            gap = now - self.token_times[-1]
+            _telemetry.histogram("serve.itl_seconds").observe(gap)
+            # the per-tenant twin (bounded label — tenancy.label_for):
+            # the unlabeled series stays the fleet aggregate every
+            # existing dashboard and the global SLO monitor read; the
+            # labeled one is what the per-tenant burn/boost loop and
+            # slo_report's tenant section consume
+            _telemetry.histogram("serve.itl_seconds",
+                                 tenant=label_for(self.tenant)).observe(gap)
         self.token_times.append(now)
         self.tokens.append(int(token))
         self.timeline.mark_token(now)
@@ -145,13 +165,21 @@ class Request:
         # would want an in-flight-aware read (docs/observability.md).
         if self.first_token_at is not None:
             _telemetry.histogram("serve.ttft_seconds").observe(self.ttft)
+            _telemetry.histogram(
+                "serve.ttft_seconds",
+                tenant=label_for(self.tenant)).observe(self.ttft)
 
     def finish(self, reason="length"):
         self.state = "done"
         self.finish_reason = reason
         self.finished_at = time.perf_counter()
         self._observe_ttft()
-        self.timeline.finalize(self.id, "done", ttft=self.ttft)
+        # per-tenant terminal count (the unlabeled completed/rejected
+        # totals live at the scheduler/server seams, unchanged)
+        _telemetry.counter("serve.requests", state="completed",
+                           tenant=label_for(self.tenant)).inc()
+        self.timeline.finalize(self.id, "done", ttft=self.ttft,
+                               tenant=self.tenant)
         self._done.set()
 
     def fail(self, reason):
@@ -159,10 +187,12 @@ class Request:
         self.finish_reason = reason
         self.finished_at = time.perf_counter()
         self._observe_ttft()
-        self.timeline.finalize(
-            self.id,
-            "rejected" if str(reason).startswith("rejected") else "failed",
-            ttft=self.ttft)
+        outcome = ("rejected" if str(reason).startswith("rejected")
+                   else "failed")
+        _telemetry.counter("serve.requests", state=outcome,
+                           tenant=label_for(self.tenant)).inc()
+        self.timeline.finalize(self.id, outcome, ttft=self.ttft,
+                               tenant=self.tenant)
         self._done.set()
 
     def wait(self, timeout=None):
@@ -178,22 +208,76 @@ class Request:
 
 class ContinuousBatchingScheduler:
     """Split prefill/decode queues with per-step continuous admission
-    (policy details in the module docstring)."""
+    (policy details in the module docstring).
 
-    def __init__(self, max_pending=64, max_batch=8, max_tokens=8192):
+    **Multi-tenant fairness** (ISSUE 12): ``tenants`` (anything
+    :meth:`~tpu_mx.serving.tenancy.TenantTable.coerce` accepts) arms
+    per-tenant policy.  Admission enforces each tenant's
+    ``max_inflight``/``token_quota`` (reject reason ``tenant_quota``),
+    and :meth:`take_prefills` becomes **SLO-weighted fair**: candidates
+    are the per-tenant QUEUE HEADS (FIFO within a tenant — one tenant's
+    oversized head no longer blocks every other tenant's admissible
+    work), picked by weighted virtual time — each admission advances its
+    tenant's clock by ``budget_tokens / effective_weight``, so admitted
+    token bandwidth converges to the weight ratio, deficit-style.  A
+    tenant whose per-tenant SLO burn is breaching (``slo_signal``, the
+    PR-11 hook — tpu_mx/serving/slo.py publishes per-tenant burn when
+    tenant-labeled series exist) gets its weight multiplied by
+    ``slo_boost`` until the breach clears.  With a single tenant every
+    rule degenerates to exactly the pre-tenancy FIFO behavior."""
+
+    def __init__(self, max_pending=64, max_batch=8, max_tokens=8192,
+                 tenants=None, slo_boost=2.0):
         self.max_pending = int(max_pending)
         self.max_batch = int(max_batch)
         self.max_tokens = int(max_tokens)
+        self.tenants = TenantTable.coerce(tenants)
+        self.slo_boost = float(slo_boost)
         self._lock = threading.RLock()
         self._pending = []
         self._running = []
+        # weighted-fairness state: tenant -> virtual time (service
+        # received / effective weight), plus the monotone SYSTEM floor:
+        # the highest virtual time any pick has been served at.  A new
+        # or long-idle tenant enters at max(own, floor) — it competes
+        # from "now", not from a stale-low clock that would let it
+        # monopolize admission for an unbounded catch-up period.  (For
+        # continuously backlogged tenants the floor is provably inert:
+        # a candidate with a lower clock would have been picked first.)
+        self._vtime = {}
+        self._vfloor = 0.0
+        # requests popped by take_prefills but not yet running (the
+        # mid-prefill window): in neither queue, but still in flight —
+        # the tenant quota count must see them or a concurrent submit
+        # in that window slips past max_inflight/token_quota.  Removed
+        # at mark_running / defer / requeue.
+        self._admitting = set()
+        # the vtime charge each pending admission paid at pick time, so
+        # a DEFERRED admission (cache backpressure, never started) can
+        # be refunded — without the refund a tenant under memory
+        # pressure is charged once per bounce while receiving zero
+        # service, skewing the weight ratio against it
+        self._vtime_charges = {}
         # the server publishes its SLO monitor's latest signal here each
-        # step (tpu_mx/serving/slo.py) — the hook a fairness-aware
-        # admission policy consults; this base policy records it without
-        # acting on it (the ROADMAP fleet-scale item is the consumer)
+        # step (tpu_mx/serving/slo.py) — take_prefills consults it for
+        # the per-tenant burn-rate boost
         self.slo_signal = None
 
     # -- admission (any thread) ----------------------------------------------
+    def _tenant_inflight(self, tenant):
+        """(requests, budget tokens) admitted and unfinished for
+        ``tenant`` — pending + running + the mid-prefill window
+        (popped by ``take_prefills``, not yet ``mark_running``).
+        Called under the lock; O(n) over bounded queues beats a
+        drift-prone incremental counter."""
+        n = toks = 0
+        for bucket in (self._pending, self._running, self._admitting):
+            for r in bucket:
+                if r.tenant == tenant:
+                    n += 1
+                    toks += r.budget_tokens
+        return n, toks
+
     def submit(self, req):
         """Enqueue ``req`` or raise :class:`AdmissionReject`."""
         if _chaos.forced_reject():
@@ -204,14 +288,28 @@ class ContinuousBatchingScheduler:
                 req, "request_too_large",
                 f"prompt+max_new = {req.budget_tokens} tokens > "
                 f"max_tokens = {self.max_tokens}")
+        cfg = self.tenants.get(req.tenant)
         with self._lock:
             # the reject itself (handle fail + timeline finalize +
             # telemetry + event) runs OUTSIDE the lock: a client-thread
             # reject burst must not block the step thread's queue ops
+            quota = None
+            if cfg.max_inflight is not None or cfg.token_quota is not None:
+                n, toks = self._tenant_inflight(req.tenant)
+                if cfg.max_inflight is not None and n >= cfg.max_inflight:
+                    quota = (f"tenant {req.tenant!r} has {n} in-flight "
+                             f">= max_inflight = {cfg.max_inflight}")
+                elif cfg.token_quota is not None \
+                        and toks + req.budget_tokens > cfg.token_quota:
+                    quota = (f"tenant {req.tenant!r} in-flight worst case "
+                             f"{toks} + {req.budget_tokens} tokens > "
+                             f"token_quota = {cfg.token_quota}")
             depth = len(self._pending)
-            full = depth >= self.max_pending
-            if not full:
+            full = quota is None and depth >= self.max_pending
+            if quota is None and not full:
                 self._pending.append(req)
+        if quota is not None:
+            self.reject(req, "tenant_quota", quota)
         if full:
             self.reject(
                 req, "queue_full",
@@ -220,7 +318,8 @@ class ContinuousBatchingScheduler:
         _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
         _tracing.emit("serve.admit", request=req.id,
                       prompt_tokens=len(req.prompt),
-                      max_new_tokens=req.max_new_tokens)
+                      max_new_tokens=req.max_new_tokens,
+                      tenant=req.tenant)
         return req
 
     def reject(self, req, reason, detail=""):
@@ -238,19 +337,106 @@ class ContinuousBatchingScheduler:
         with self._lock:
             return sum(r.budget_tokens for r in self._running)
 
+    def _breaching_tenants(self):
+        """Tenant LABELS whose per-tenant SLO burn is breaching, read
+        off the last published ``slo_signal`` (tpu_mx/serving/slo.py
+        adds a ``tenants`` sub-map per target when tenant-labeled
+        series exist).  These are telemetry labels, not raw tenant ids:
+        measurement happens under the cardinality-capped label, so a
+        past-cap tenant breaches — and boosts — as the aggregated
+        ``_other`` group.  Called under the lock; empty set when no
+        monitor is armed."""
+        sig = self.slo_signal
+        if not sig:
+            return frozenset()
+        out = set()
+        for st in sig.get("slos", {}).values():
+            for tenant, ts in st.get("tenants", {}).items():
+                if ts.get("breaching"):
+                    out.add(tenant)
+        return out
+
+    def _effective_weight(self, tenant, boosted):
+        """``boosted`` holds breaching LABELS — compare through
+        ``label_for`` so a tenant measured under the overflow label
+        still receives the boost its (aggregated) burn earned."""
+        w = self.tenants.get(tenant).weight
+        return w * self.slo_boost if label_for(tenant) in boosted else w
+
+    def _pick_next(self, used):
+        """The weighted-fair admission pick (under the lock): among the
+        per-tenant queue heads that fit the remaining token budget, the
+        tenant with the LOWEST virtual time goes next (ties: queue
+        order — ``heads`` preserves first-seen order, so keeping the
+        earliest on equal vtime is FIFO).  Returns the request, or None
+        when nothing admissible."""
+        heads = {}
+        for r in self._pending:
+            if r.tenant not in heads:
+                heads[r.tenant] = r
+        if not heads:
+            return None
+        boosted = self._breaching_tenants()
+        if len(heads) == 1:
+            # single tenant: the pre-tenancy ORDER bit-for-bit,
+            # including stop-at-the-head (no in-tenant reordering) —
+            # but the clock still runs, so a tenant that served alone
+            # does not look idle-cheap the moment a second one appears
+            r = self._pending[0]
+            if used + r.budget_tokens > self.max_tokens:
+                return None
+            self._charge(r, boosted)
+            return r
+        best, best_vt = None, None
+        for r in heads.values():
+            if used + r.budget_tokens > self.max_tokens:
+                continue
+            vt = max(self._vtime.get(r.tenant, 0.0), self._vfloor)
+            if best is None or vt < best_vt:
+                best, best_vt = r, vt
+        if best is not None:
+            self._charge(best, boosted)
+            # bound the vtime map: tenant ids are client-controlled
+            # strings, so an adversarial id-per-request stream would
+            # otherwise grow it forever.  Pruning idle tenants is
+            # harmless — the re-entry floor already handles a returning
+            # tenant fairly.
+            if len(self._vtime) > 4 * max(len(heads), 16):
+                live = ({r.tenant for r in self._pending}
+                        | {r.tenant for r in self._running}
+                        | {r.tenant for r in self._admitting})
+                self._vtime = {t: v for t, v in self._vtime.items()
+                               if t in live}
+        return best
+
+    def _charge(self, req, boosted):
+        """Advance the picked tenant's virtual clock and the system
+        floor; remember the charge so a deferred (never-started)
+        admission can be refunded on its way back to the queue."""
+        vt = max(self._vtime.get(req.tenant, 0.0), self._vfloor)
+        cost = req.budget_tokens / self._effective_weight(req.tenant,
+                                                          boosted)
+        self._vtime[req.tenant] = vt + cost
+        self._vfloor = max(self._vfloor, vt)
+        self._vtime_charges[req] = cost
+
     def take_prefills(self):
         """Pop the pending requests admissible THIS step: batch slots
-        free and the worst-case token budget respected.  Continuous: runs
-        every step, so a finishing sequence's slot is refilled on the
-        very next iteration."""
+        free and the worst-case token budget respected, ordered by the
+        SLO-weighted fair policy across tenants (class docstring) —
+        plain FIFO when one tenant is present.  Continuous: runs every
+        step, so a finishing sequence's slot is refilled on the very
+        next iteration."""
         out = []
         with self._lock:
             used = sum(r.budget_tokens for r in self._running)
             while (self._pending
-                   and len(self._running) + len(out) < self.max_batch
-                   and used + self._pending[0].budget_tokens
-                   <= self.max_tokens):
-                req = self._pending.pop(0)
+                   and len(self._running) + len(out) < self.max_batch):
+                req = self._pick_next(used)
+                if req is None:
+                    break
+                self._pending.remove(req)
+                self._admitting.add(req)
                 used += req.budget_tokens
                 out.append(req)
         if out:
@@ -260,6 +446,8 @@ class ContinuousBatchingScheduler:
     def mark_running(self, req):
         with self._lock:
             req.state = "running"
+            self._admitting.discard(req)
+            self._vtime_charges.pop(req, None)   # service delivered
             self._running.append(req)
 
     def decode_batch(self):
@@ -285,10 +473,14 @@ class ContinuousBatchingScheduler:
     def requeue(self, req, front=True):
         """Bounce a running request back to pending for a re-run
         (engine restart, cache preemption).  Its generated tokens are
-        discarded; ``front=True`` preserves arrival order fairness."""
+        discarded; ``front=True`` preserves arrival order fairness.
+        The vtime charge is NOT refunded: a requeued request consumed
+        real service (its destroyed attempt) — unlike a deferral."""
         with self._lock:
             if req in self._running:
                 self._running.remove(req)
+            self._admitting.discard(req)
+            self._vtime_charges.pop(req, None)
             req.reset_generation()
             if front:
                 self._pending.insert(0, req)
@@ -301,8 +493,17 @@ class ContinuousBatchingScheduler:
         """Push admissions that never STARTED back to the queue front
         (prefill hit cache backpressure).  Unlike :meth:`requeue` this
         neither resets generation nor counts a requeue — a deferred
-        request was not re-run, merely not admitted yet."""
+        request was not re-run, merely not admitted yet — and its
+        pick-time vtime charge is REFUNDED: a tenant bouncing on memory
+        pressure received no service, so charging it per bounce would
+        skew the weighted ratio against exactly the tenant being
+        starved."""
         with self._lock:
+            for req in reqs:
+                self._admitting.discard(req)
+                charge = self._vtime_charges.pop(req, None)
+                if charge is not None and req.tenant in self._vtime:
+                    self._vtime[req.tenant] -= charge
             self._pending[0:0] = list(reqs)
         _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
 
@@ -363,9 +564,11 @@ class StaticBatchingScheduler(ContinuousBatchingScheduler):
     discarded by the server) and their cache is only freed when the
     whole batch completes."""
 
-    def __init__(self, max_pending=64, max_batch=8, max_tokens=8192):
+    def __init__(self, max_pending=64, max_batch=8, max_tokens=8192,
+                 tenants=None, slo_boost=2.0):
         super().__init__(max_pending=max_pending, max_batch=max_batch,
-                         max_tokens=max_tokens)
+                         max_tokens=max_tokens, tenants=tenants,
+                         slo_boost=slo_boost)
         self._finished = []
 
     def take_prefills(self):

@@ -73,7 +73,7 @@ class Server:
                  max_batch=8, max_tokens=8192, block_size=16,
                  num_blocks=256, deadline=None, max_restarts=3,
                  backoff=0.05, blackbox=None, eos_id=None, slo=None,
-                 dtype=np.float32):
+                 tenants=None, prefix_sharing=None, dtype=np.float32):
         self.model = model
         # the live SLO monitor (tpu_mx/serving/slo.py): True arms the
         # default targets, a list/tuple of spec strings builds a monitor
@@ -93,20 +93,28 @@ class Server:
             raise TypeError(f"slo= takes True, spec string(s), or an "
                             f"SLOMonitor — got {type(slo).__name__}")
         self.slo = slo
+        # multi-tenant policy (ISSUE 12): `tenants` is anything
+        # TenantTable.coerce accepts; `prefix_sharing` pins the shared-
+        # prefix KV reuse knob (None = the TPUMX_PREFIX_SHARING env
+        # resolution).  Both thread through engine restarts — a rebuilt
+        # engine keeps the data-plane contract it degraded under.
         self.scheduler = scheduler if scheduler is not None else \
             ContinuousBatchingScheduler(max_pending=max_pending,
                                         max_batch=max_batch,
-                                        max_tokens=max_tokens)
+                                        max_tokens=max_tokens,
+                                        tenants=tenants)
         self._block_size = int(block_size)
         self._num_blocks = int(num_blocks)
         self._dtype = dtype
+        self._prefix_sharing = prefix_sharing
         self.deadline = deadline
         self.max_restarts = int(max_restarts)
         self.backoff = float(backoff)
         self.blackbox = blackbox
         self.eos_id = eos_id
         self.engine = EngineCore(model, block_size=block_size,
-                                 num_blocks=num_blocks, dtype=dtype)
+                                 num_blocks=num_blocks, dtype=dtype,
+                                 share_prefix=prefix_sharing)
         self.generation = 0        # engine generation (restart count)
         self.restarts = 0
         self.degraded = False
@@ -115,11 +123,17 @@ class Server:
         self._t_first_work = None
 
     # -- admission (any thread) ----------------------------------------------
-    def submit(self, prompt, max_new_tokens=16, request_id=None):
+    def submit(self, prompt, max_new_tokens=16, request_id=None,
+               tenant=None):
         """Admit one request; returns its handle or raises
         :class:`AdmissionReject` (reason on the exception — resubmit
-        later).  A degraded server rejects everything."""
-        req = Request(prompt, max_new_tokens, request_id=request_id)
+        later; ``tenant_quota`` means THIS tenant is over its caps).
+        ``tenant`` names the submitting tenant (fairness/quota identity
+        + bounded telemetry label; None = the default tenant).  A
+        degraded server rejects everything."""
+        req = Request(prompt, max_new_tokens, request_id=request_id,
+                      tenant=tenant)
+        req.tenant_weight = self.scheduler.tenants.get(req.tenant).weight
         # both server-side gates route through the scheduler's ONE
         # reject implementation, so a degraded-window or oversized
         # submit is counted and lands on the timeline like any other
@@ -166,7 +180,7 @@ class Server:
             _tracing.set_context(request=req.id)
             req.timeline.mark_prefill_start()
             try:
-                first = run_with_deadline(
+                first, cached = run_with_deadline(
                     lambda r=req: self.engine.prefill(r),
                     self.deadline, name=f"serve-prefill-{req.id}")
             except CacheExhausted:
@@ -201,7 +215,7 @@ class Server:
                 raise
             finally:
                 _tracing.set_context(request=None)
-            req.timeline.mark_prefill_end()
+            req.timeline.mark_prefill_end(cached_tokens=cached)
             self.scheduler.mark_running(req)
             self._commit_token(req, first)
             worked = True
@@ -324,7 +338,8 @@ class Server:
         # mutate nothing the new generation reads
         self.engine = EngineCore(self.model, block_size=self._block_size,
                                  num_blocks=self._num_blocks,
-                                 dtype=self._dtype)
+                                 dtype=self._dtype,
+                                 share_prefix=self._prefix_sharing)
         self._dump_blackbox(f"serving engine restart "
                             f"{self.restarts}/{self.max_restarts}: "
                             f"{reason}")

@@ -129,13 +129,42 @@ class SLOMonitor:
                         "slos": {}}
 
     # -- evaluation (pure read; no gauges, no events) -------------------------
+    def _eval_windows(self, h, slo, allowed):
+        """One histogram's multi-window read for one target:
+        ``(attainments, burns, sampled)`` aligned with
+        ``self.windows`` (attainment None / burn 0 for an empty
+        window).  The ONE place the burn rule lives — the global and
+        per-tenant evaluations must never drift apart."""
+        atts, burns, sampled = [], [], False
+        for w in self.windows:
+            frac = (h.window_fraction_le(slo.threshold_seconds,
+                                         window=w) if h else None)
+            if frac is None:
+                atts.append(None)
+                burns.append(0.0)
+            else:
+                sampled = True
+                atts.append(frac)
+                burns.append((1.0 - frac) / allowed)
+        return atts, burns, sampled
+
     def evaluate(self):
         """The full state dict, computed from the live telemetry
-        windows: ``{breaching, max_burn_rate, slos: {name: {...}}}``.
-        An SLO with no samples in a window is healthy-by-absence there
-        (attainment None, burn 0) — breach requires evidence in every
-        window, never its lack."""
-        out = {"breaching": False, "max_burn_rate": 0.0, "slos": {}}
+        windows: ``{breaching, max_burn_rate, breaching_tenants,
+        slos: {name: {...}}}``.  An SLO with no samples in a window is
+        healthy-by-absence there (attainment None, burn 0) — breach
+        requires evidence in every window, never its lack.
+
+        **Per-tenant** (ISSUE 12): when tenant-labeled series of a
+        target's histogram exist (``serve.itl_seconds{tenant=...}`` —
+        the bounded labels tenancy.label_for mints), each is evaluated
+        with the same multi-window rule into ``slos[name]["tenants"]
+        [tenant] = {burn_rate, attainment, breaching}``, and the union
+        of breaching tenants lands in ``breaching_tenants`` — the
+        signal the scheduler's SLO-weighted boost consumes."""
+        out = {"breaching": False, "max_burn_rate": 0.0,
+               "breaching_tenants": [], "slos": {}}
+        breaching_tenants = set()
         for slo in self.slos:
             h = _telemetry.get(slo.metric)
             if getattr(h, "kind", None) != "histogram":
@@ -148,20 +177,29 @@ class SLOMonitor:
             # p99 beside an already-clean attainment
             est = (h.window_quantile(slo.quantile, window=self.windows[-1])
                    if h else None)
-            windows, burns, sampled = {}, [], False
-            for w in self.windows:
-                frac = (h.window_fraction_le(slo.threshold_seconds,
-                                             window=w) if h else None)
-                if frac is None:
-                    att, burn = None, 0.0
-                else:
-                    sampled = True
-                    att = frac
-                    burn = (1.0 - frac) / allowed
-                windows[w] = {"attainment": att, "burn_rate": burn}
-                burns.append(burn)
+            atts, burns, sampled = self._eval_windows(h, slo, allowed)
+            windows = {w: {"attainment": atts[i], "burn_rate": burns[i]}
+                       for i, w in enumerate(self.windows)}
             breaching = sampled and all(b >= self.breach_burn
                                         for b in burns)
+            tenants = {}
+            for labels, th in _telemetry.series(slo.metric):
+                tenant = labels.get("tenant")
+                if tenant is None or getattr(th, "kind", None) \
+                        != "histogram":
+                    continue
+                tatts, tburns, tsampled = self._eval_windows(th, slo,
+                                                             allowed)
+                tbreach = tsampled and all(b >= self.breach_burn
+                                           for b in tburns)
+                seen = [a for a in tatts if a is not None]
+                tenants[tenant] = {
+                    "burn_rate": max(tburns),
+                    "attainment": min(seen) if seen else None,
+                    "breaching": tbreach,
+                }
+                if tbreach:
+                    breaching_tenants.add(tenant)
             out["slos"][slo.name] = {
                 "metric": slo.metric,
                 "quantile": slo.quantile,
@@ -169,9 +207,11 @@ class SLOMonitor:
                 "estimate_seconds": est,
                 "breaching": breaching,
                 "windows": windows,
+                "tenants": tenants,
             }
             out["breaching"] = out["breaching"] or breaching
             out["max_burn_rate"] = max(out["max_burn_rate"], *burns)
+        out["breaching_tenants"] = sorted(breaching_tenants)
         return out
 
     # -- publication ---------------------------------------------------------
@@ -209,6 +249,12 @@ class SLOMonitor:
                 _telemetry.gauge("serve.slo_burn_rate", slo=name,
                                  window=wl).set(pw["burn_rate"])
                 worst_burn = max(worst_burn, pw["burn_rate"])
+            # per-tenant worst-window burn (ISSUE 12): one gauge per
+            # (slo, tenant) — tenant labels are already cardinality-
+            # bounded at the source (tenancy.label_for)
+            for tenant, ts in st.get("tenants", {}).items():
+                _telemetry.gauge("serve.slo_tenant_burn_rate", slo=name,
+                                 tenant=tenant).set(ts["burn_rate"])
             if st["breaching"] != self._breaching.get(name, False):
                 _tracing.emit(
                     "serve.slo", slo=name, breaching=st["breaching"],

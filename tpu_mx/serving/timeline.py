@@ -64,7 +64,8 @@ class RequestTimeline:
 
     __slots__ = ("t0", "_mark", "_wait_kind", "_in_flight", "phases",
                  "defers", "requeues", "tokens", "ttft_breakdown",
-                 "_first_token_pending", "ended_at", "outcome")
+                 "_first_token_pending", "ended_at", "outcome",
+                 "cached_tokens")
 
     def __init__(self, t0=None):
         self.t0 = time.perf_counter() if t0 is None else float(t0)
@@ -75,6 +76,10 @@ class RequestTimeline:
         self.defers = 0
         self.requeues = 0
         self.tokens = 0                  # delivered by the final attempt
+        self.cached_tokens = 0           # prompt tokens served from the
+        #                                  shared-prefix cache (final
+        #                                  attempt — a short `prefill`
+        #                                  phase is attributed honestly)
         self.ttft_breakdown = None
         self._first_token_pending = True
         self.ended_at = None
@@ -98,9 +103,14 @@ class RequestTimeline:
         self._close(self._wait_kind)
         self._wait_kind = "queue_wait"
 
-    def mark_prefill_end(self):
+    def mark_prefill_end(self, cached_tokens=0):
+        """``cached_tokens``: how many leading prompt tokens this
+        attempt served from the shared-prefix cache — recorded so a
+        suspiciously fast ``prefill`` phase reads as a cache hit, not a
+        measurement bug (ISSUE 12)."""
         self._close("prefill")
         self._in_flight = True
+        self.cached_tokens = int(cached_tokens)
 
     def mark_prefill_failed(self):
         """The prefill attempt bounced on cache backpressure: the
@@ -140,15 +150,19 @@ class RequestTimeline:
         self._in_flight = False
         self.requeues += 1
         self.tokens = 0
+        self.cached_tokens = 0   # the re-run re-resolves its own hit
         self._first_token_pending = True
         self.ttft_breakdown = None
 
     # -- terminal ------------------------------------------------------------
-    def finalize(self, request_id, outcome, ttft=None, now=None):
+    def finalize(self, request_id, outcome, ttft=None, now=None,
+                 tenant=None):
         """Close the books (idempotent) and emit the one-per-request
         ``serve.request_timeline`` event + phase histograms.  ``outcome``
         is ``done``/``failed``/``rejected``; ``ttft`` the request's
-        measured submit→first-token seconds when a token was produced."""
+        measured submit→first-token seconds when a token was produced;
+        ``tenant`` the submitting tenant (rides the event payload — the
+        per-tenant grouping key tools/slo_report.py uses)."""
         if self.ended_at is not None:
             return
         if outcome == "rejected":
@@ -170,6 +184,8 @@ class RequestTimeline:
         payload = {p: self.phases.get(p, 0.0) for p in PHASES}
         if ttft is not None:
             payload["ttft"] = float(ttft)
+        if tenant is not None:
+            payload["tenant"] = str(tenant)
         # the request id travels in the PAYLOAD, not the trace context:
         # finalize can run on the submitting thread (synchronous
         # reject), and the context is process-global — writing it here
@@ -178,7 +194,8 @@ class RequestTimeline:
         _tracing.emit("serve.request_timeline", request=request_id,
                       outcome=outcome, latency=self.ended_at - self.t0,
                       tokens=self.tokens, requeues=self.requeues,
-                      defers=self.defers, **payload)
+                      defers=self.defers,
+                      cached_tokens=self.cached_tokens, **payload)
 
     @property
     def total(self):

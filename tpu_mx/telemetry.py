@@ -183,6 +183,21 @@ KNOWN_METRICS = frozenset({
     "serve.phase_seconds",
     "serve.slo_estimate_seconds", "serve.slo_attainment",
     "serve.slo_burn_rate", "serve.slo_breaching",
+    # multi-tenant serving (ISSUE 12; tpu_mx/serving/prefix_cache.py +
+    # tenancy.py).  prefill_bytes counts K/V bytes a prefill COMPUTED,
+    # prefill_bytes_saved the bytes served from the shared-prefix index
+    # instead (the bench receipt's ">= 2x reduction" pair);
+    # prefix_hit_ratio is cached/total prompt tokens over the cache's
+    # lifetime; cow_copies counts copy-on-write tail-block duplications;
+    # prefix_evictions counts index entries released under pool
+    # pressure.  slo_tenant_burn_rate{slo,tenant} is the per-tenant
+    # worst-window burn the fairness boost consumes — tenant labels are
+    # cardinality-capped (tenancy.label_for: first N tenants keep their
+    # name, the rest collapse into the "_other" overflow label).
+    "serve.prefix_hits", "serve.prefix_hit_ratio",
+    "serve.prefill_bytes", "serve.prefill_bytes_saved",
+    "serve.prefix_evictions", "serve.cow_copies",
+    "serve.slo_tenant_burn_rate",
     # module-API training (tpu_mx/callback.py)
     "speedometer.samples_per_sec",
 })
@@ -721,6 +736,16 @@ def get(name, **labels):
     """The already-registered metric, or None (no create side effect)."""
     with _lock:
         return _metrics.get((name, _labels_key(labels)))
+
+
+def series(name):
+    """Every registered series of ``name`` as ``[(labels_dict, metric),
+    ...]`` (no create side effect).  The per-tenant SLO evaluation uses
+    this to find the tenant-labeled variants of a target's histogram
+    without knowing the tenant set in advance."""
+    with _lock:
+        return [(dict(m.labels), m)
+                for (n, _), m in _metrics.items() if n == name]
 
 
 def reset():

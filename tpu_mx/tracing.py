@@ -109,9 +109,13 @@ KNOWN_EVENTS = {
     # reconstructible; decode is batch-scoped and rides the engine-step
     # `step`/`generation` context like a train step.
     "serve.admit": {"request": "str", "prompt_tokens": "int",
-                    "max_new_tokens": "int"},
+                    "max_new_tokens": "int", "tenant": "str"},
     "serve.reject": {"request": "str", "reason": "str"},
-    "serve.prefill": {"request": "str", "tokens": "int", "seconds": "float"},
+    # `cached` (ISSUE 12): how many leading prompt tokens were served
+    # from the shared-prefix index instead of computed — a prefill that
+    # rode the cache attributes its speed honestly
+    "serve.prefill": {"request": "str", "tokens": "int", "seconds": "float",
+                     "cached": "int"},
     "serve.decode": {"batch": "int", "tokens": "int", "seconds": "float"},
     "serve.evict": {"request": "str", "reason": "str", "generated": "int"},
     "serve.restart": {"n": "int", "reason": "str", "requeued": "int"},
@@ -119,7 +123,12 @@ KNOWN_EVENTS = {
     # decode-attention arm this engine resolved (dense / paged /
     # paged-kernel) and where its KV pool lives (host / device) — a
     # restarted engine's black box records which data plane it was on
-    "serve.decode_path": {"path": "str", "storage": "str"},
+    "serve.decode_path": {"path": "str", "storage": "str",
+                          "sharing": "bool"},
+    # shared-prefix index pressure eviction (ISSUE 12): one event per
+    # relief pass — `released` index entries freed to satisfy a
+    # `need`-block allocation (tpu_mx/serving/kv_cache.py::_alloc)
+    "serve.prefix_evict": {"released": "int", "need": "int"},
     # per-request latency attribution (tpu_mx/serving/timeline.py,
     # ISSUE 11): emitted ONCE per request at finish/fail/reject — not
     # per phase transition, which would flood the ring — with the
@@ -127,12 +136,20 @@ KNOWN_EVENTS = {
     # invariant the serve CI tier gates: the phase fields sum to the
     # measured request latency within 5% (and the breakdown snapshot at
     # first-token time sums to the measured ttft).
+    # `tenant`/`cached_tokens` (ISSUE 12): the tenant label the
+    # per-tenant SLO report groups by, and the prompt tokens the final
+    # attempt served from the shared-prefix cache (a cache-served
+    # prefill's short `prefill` phase is attributed honestly, not
+    # mistaken for noise).  NOTE for offline consumers: phase fields are
+    # exactly the float fields other than latency/ttft (slo_report
+    # derives them that way) — any new float here must be a phase.
     "serve.request_timeline": {
         "request": "str", "outcome": "str", "latency": "float",
         "ttft": "float", "queue_wait": "float", "prefill": "float",
         "decode_gap": "float", "restart_penalty": "float",
         "defer_stall": "float", "reject": "float",
-        "tokens": "int", "requeues": "int", "defers": "int"},
+        "tokens": "int", "requeues": "int", "defers": "int",
+        "tenant": "str", "cached_tokens": "int"},
     # SLO monitor breach transitions (tpu_mx/serving/slo.py): emitted
     # when a declared target starts or stops breaching its multi-window
     # error-budget burn bar — the timeline record of WHEN the SLO state
