@@ -1266,6 +1266,12 @@ def bench_serve(smoke):
                                        max_tokens=10 ** 9),
             num_blocks=4096, block_size=16)
         reqs, i, step = [], 0, 0
+        # capacity receipts (ISSUE 14): per-step pool-bytes samples (one
+        # O(1) counter read per step) for the steady-state figure; the
+        # peak comes from the ledger's own high watermark after the arm
+        cache = srv.engine.cache
+        block_bytes = cache.allocator.ledger.block_bytes
+        pool_samples = []
         t0 = time.perf_counter()
         while i < n_req or not srv.scheduler.idle():
             while i < n_req and arrival_step[i] <= step:
@@ -1273,7 +1279,14 @@ def bench_serve(smoke):
                 i += 1
             srv.step()
             step += 1
+            pool_samples.append(cache.allocator.used * block_bytes)
         wall = time.perf_counter() - t0
+        cap = cache.capacity_stats()
+        busy = [s for s in pool_samples if s > 0] or [0]
+        pool = {"pool_peak_bytes": int(cap["high_watermark_bytes"]),
+                "pool_steady_bytes": int(np.median(busy)),
+                "pool_end_fragmentation": round(cap["fragmentation"], 4),
+                "pool_block_bytes": int(block_bytes)}
         total = sum(len(r.tokens) for r in reqs)
         assert total == sum(outs), "lost tokens"
         # the live-vs-exact comparison below is only apples-to-apples
@@ -1330,7 +1343,7 @@ def bench_serve(smoke):
         return dict(exact, tokens_per_sec=round(total / wall, 1),
                     steps=step, wall_s=round(wall, 3),
                     slo_live=live, slo_live_rel_err=rel_errs,
-                    slo_live_bracket_err=bracket_errs)
+                    slo_live_bracket_err=bracket_errs, **pool)
 
     # warm both code paths before timing either arm: the first prefill/
     # decode at each shape pays one-time numpy/dispatch setup (measured
@@ -1353,6 +1366,9 @@ def bench_serve(smoke):
         f"order-statistic bracket worst "
         f"{max(cont['slo_live_bracket_err'].values()):.1%}, gated at "
         f"{slo_rel_tol:.0%})")
+    log(f"  pool: peak {cont['pool_peak_bytes']} B, steady "
+        f"{cont['pool_steady_bytes']} B, end fragmentation "
+        f"{cont['pool_end_fragmentation']}")
     log("serve: static arm...")
     stat = run_arm(serving.StaticBatchingScheduler, assert_live=False)
     log(f"  static:     {stat['tokens_per_sec']} tok/s in "
@@ -1424,6 +1440,16 @@ def bench_serve(smoke):
         "slo_live_max_bracket_err": round(
             max(cont["slo_live_bracket_err"].values()), 4),
         "slo_live_rel_tol": slo_rel_tol,
+        # capacity receipts (ISSUE 14), flat so the artifact trajectory
+        # diffs them directly: the continuous arm's ledger high
+        # watermark, the median nonzero pool residency, and end-state
+        # free-list fragmentation — a future capacity regression (a
+        # leak, a sharing break, a fragmentation explosion) moves these
+        # before it moves tokens/sec
+        "pool_peak_bytes": cont["pool_peak_bytes"],
+        "pool_steady_bytes": cont["pool_steady_bytes"],
+        "pool_end_fragmentation": cont["pool_end_fragmentation"],
+        "pool_block_bytes": cont["pool_block_bytes"],
         # O(1)-append receipt.  A cache-less (recompute-the-prefix)
         # decode's per-token cost scales ~linearly with context —
         # "linear_would_be" is the late/early CONTEXT ratio such a decode

@@ -1,0 +1,421 @@
+"""Capacity accounting: the device-memory ledger, attribution identity,
+and exhaustion forensics (tpu_mx/serving/accounting.py — ISSUE 14).
+
+Covers: ledger exactness under the 4-thread allocator hammer with
+holder attribution (share/free interleavings; the identity — per block,
+attributed refs == refcount; per tenant, amortized bytes sum EXACTLY to
+pool-used bytes — asserted after every phase), cache-level attribution
+through share/COW/pressure-evict interleavings (plan pins, commit
+handoff, index holder, fork, COW), loud mis-attribution, forensic
+dumps on CacheExhausted in BOTH decode arms (schema-valid, naming 100%
+of live holders), the would-fit ``capacity_signal`` admission gate, the
+per-tenant pool gauges, and the jax-less ``tools/capacity_report.py``
+rc contract.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_mx import telemetry, tracing
+from tpu_mx.base import MXNetError
+from tpu_mx import serving
+from tpu_mx.serving import (BlockAllocator, CacheExhausted,
+                            ContinuousBatchingScheduler, PagedKVCache,
+                            Request, Server, TinyLM,
+                            validate_forensic_doc)
+from tpu_mx.serving import tenancy
+from tpu_mx.serving.accounting import INDEX_TENANT, UNATTRIBUTED
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    tracing.reset()
+    tenancy.reset_label_registry()
+    yield
+    tracing.reset()
+    tenancy.reset_label_registry()
+
+
+def tiny(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("embed_dim", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("seed", 0)
+    return TinyLM(**kw)
+
+
+def kv(rng, n, layers=2, heads=2, dim=4):
+    k = rng.rand(layers, n, heads, dim).astype(np.float32)
+    return k, (k * 0.5).astype(np.float32)
+
+
+def assert_identity(alloc):
+    """The audit must pass AND agree with the raw refcount surface."""
+    report = alloc.audit()
+    assert report["used_blocks"] == alloc.used
+    total = sum(t["bytes_amortized"] for t in report["tenants"].values())
+    assert abs(total - report["used_bytes"]) < 1e-6 * max(
+        report["used_bytes"], 1)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# ledger exactness: allocator level
+# ---------------------------------------------------------------------------
+def test_ledger_identity_under_4_thread_hammer():
+    """The ISSUE-12 hammer, now with holder attribution: 4 threads
+    share/free under their own holders; the accounting identity holds
+    at the join point, after a partial free phase, and drains to zero."""
+    a = BlockAllocator(64, block_bytes=512)
+    owned = [[] for _ in range(4)]
+    errs = []
+
+    def worker(i, iters=400):
+        rng = np.random.RandomState(200 + i)
+        holder = f"seq:hammer-{i}"
+        try:
+            for _ in range(iters):
+                r = rng.rand()
+                if owned[i] and r < 0.35:
+                    a.free([owned[i].pop()], holder=holder)
+                elif owned[i] and r < 0.55:
+                    bid = owned[i][int(rng.randint(len(owned[i])))]
+                    a.incref([bid], holder=holder)
+                    owned[i].append(bid)
+                else:
+                    try:
+                        owned[i].extend(a.alloc(int(rng.randint(1, 4)),
+                                                holder=holder))
+                        # describe-after-hold, the cache's discipline: a
+                        # fully drained holder forgets its meta, so the
+                        # attribution rides each (re)acquisition
+                        a.describe(holder, kind="sequence",
+                                   tenant=f"tenant-{i % 2}")
+                    except CacheExhausted:
+                        if owned[i]:
+                            a.free([owned[i].pop()], holder=holder)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs
+
+    # phase 1: exact attribution at the join point
+    report = assert_identity(a)
+    held = {}
+    for lst in owned:
+        for b in lst:
+            held[b] = held.get(b, 0) + 1
+    assert a.refcounts() == held
+    by_holder = {h["id"]: h["blocks"] for h in report["holders"]}
+    for i, lst in enumerate(owned):
+        if lst:
+            assert by_holder[f"seq:hammer-{i}"] == len(lst)
+    # tenant-{0,1} aggregate threads {0,2} and {1,3}
+    for t in report["tenants"]:
+        assert t.startswith("tenant-")
+
+    # phase 2: half of every ledger drains — identity still exact
+    for i, lst in enumerate(owned):
+        drop, owned[i] = lst[::2], lst[1::2]
+        a.free(drop, holder=f"seq:hammer-{i}")
+    assert_identity(a)
+
+    # phase 3: full drain — zero residual attribution
+    for i, lst in enumerate(owned):
+        a.free(lst, holder=f"seq:hammer-{i}")
+    report = a.audit()
+    assert report["used_blocks"] == 0 and not report["tenants"]
+    assert report["high_watermark_blocks"] > 0   # the peak survived
+
+
+def test_misattributed_free_is_loud_and_mutates_nothing():
+    a = BlockAllocator(8)
+    ids = a.alloc(2, holder="seq:a")
+    with pytest.raises(MXNetError):
+        a.free(ids, holder="seq:b")      # b holds no reference
+    assert a.refcount(ids[0]) == 1       # nothing moved
+    assert_identity(a)
+    a.free(ids, holder="seq:a")
+    assert a.audit()["used_blocks"] == 0
+
+
+def test_unattributed_callers_stay_ledgered():
+    """Bare alloc/incref/free (the pre-ledger API) files under the
+    anonymous holder — the identity never has a blind spot."""
+    a = BlockAllocator(8, block_bytes=64)
+    ids = a.alloc(3)
+    a.incref(ids[:1])
+    report = assert_identity(a)
+    assert set(report["tenants"]) == {UNATTRIBUTED}
+    assert report["tenants"][UNATTRIBUTED]["bytes_amortized"] == 3 * 64
+    a.free(ids[:1])
+    a.free(ids)
+    assert a.audit()["used_blocks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ledger exactness: cache level (share / COW / pressure-evict)
+# ---------------------------------------------------------------------------
+def test_cache_attribution_through_share_cow_and_pressure_evict():
+    rng = np.random.RandomState(3)
+    cache = PagedKVCache(2, 2, 4, block_size=4, num_blocks=12,
+                         share_prefix=True)
+    bb = cache.allocator.ledger.block_bytes
+    tokens = list(range(1, 13))   # 3 full blocks
+    k, v = kv(rng, 12)
+    cache.prefill("s0", k, v, tokens=tokens, tenant="alpha")
+    rep = assert_identity(cache.allocator)
+    # 3 blocks, each refcount 2 (sequence + index): alpha's amortized
+    # share is half of each, the index pseudo-tenant the other half
+    assert rep["tenants"]["alpha"]["bytes_amortized"] == pytest.approx(
+        1.5 * bb)
+    assert rep["tenants"][INDEX_TENANT]["bytes_amortized"] == \
+        pytest.approx(1.5 * bb)
+    assert rep["tenants"]["alpha"]["bytes_exclusive"] == 3 * bb
+
+    # a second tenant rides the shared prefix: match pins under ITS name
+    plan = cache.match_prefix(tokens + [99], tenant="beta")
+    assert plan is not None and plan.tokens_matched == 12
+    rep = assert_identity(cache.allocator)
+    pinned = [h for h in rep["holders"] if h["pinned"]]
+    assert len(pinned) == 1 and pinned[0]["tenant"] == "beta"
+
+    ks, vs = kv(rng, 1)   # suffix: the 13-token prompt's final position
+    cache.commit_prefill("s1", plan, ks, vs, tokens + [99], tenant="beta")
+    rep = assert_identity(cache.allocator)
+    assert not any(h["pinned"] for h in rep["holders"])   # pins handed off
+    assert rep["tenants"]["beta"]["bytes_amortized"] > 0
+
+    # fork inherits the parent's tenant; COW on divergent append
+    cache.fork("s1", "s1-fork")
+    rep = assert_identity(cache.allocator)
+    assert rep["tenants"]["beta"]["holders"] == 2
+    cache.reserve("s1-fork")              # COWs the shared tail
+    assert_identity(cache.allocator)
+
+    # pressure: filling the pool forces index leaf eviction mid-stream
+    k2, v2 = kv(rng, 12)
+    try:
+        cache.prefill("s2", k2, v2, tenant="gamma")
+    except CacheExhausted:
+        pass   # genuinely full of live data is also a valid outcome
+    assert_identity(cache.allocator)
+
+    # drain: free everything, drop the index — zero residual bytes
+    for sid in ("s0", "s1", "s1-fork", "s2"):
+        cache.free_sequence(sid)
+    assert_identity(cache.allocator)
+    cache.drop_prefix_cache()
+    rep = cache.audit()
+    assert rep["used_blocks"] == 0 and not rep["tenants"]
+    assert not cache.allocator.refcounts()
+
+
+# ---------------------------------------------------------------------------
+# forensic dump on exhaustion, both decode arms
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["0", "1"])
+def test_forensic_dump_on_exhaustion_both_decode_arms(mode, monkeypatch,
+                                                      tmp_path):
+    monkeypatch.setenv("TPUMX_PAGED_DECODE", mode)
+    monkeypatch.setenv("TPUMX_PREFIX_SHARING", "1")
+    prefix = str(tmp_path / "cap")
+    srv = Server(tiny(), num_blocks=6, block_size=4, max_batch=4,
+                 max_tokens=10 ** 6, blackbox=prefix,
+                 tenants={"a": {"weight": 1.0}, "b": {"weight": 1.0}})
+    reqs = [srv.submit([1, 2, 3, 4, 5, 6, 7], max_new_tokens=8,
+                       tenant="a" if i % 2 else "b") for i in range(5)]
+    srv.run_until_idle()
+    for r in reqs:
+        assert r.state == "done" and len(r.tokens) == 8, r
+    recs = srv.engine.cache.forensic_records()
+    exh = [r for r in recs if r["kind"] == "exhaustion"]
+    assert exh, "the undersized pool must have exhausted"
+    # the record names 100% of the holders live at fault time: its
+    # attributed refs sum to the pool's total refcount
+    for rec in exh:
+        assert sum(h["blocks"] for h in rec["holders"]) == \
+            rec["pool"]["total_refs"]
+        tenants = {h["tenant"] for h in rec["holders"]
+                   if h["kind"] == "sequence"}
+        assert tenants <= {"a", "b"}
+    # the rolling on-disk dump is schema-valid and (after a forced
+    # flush — disk dumps are rate-limited) matches the ring exactly
+    path = prefix + "-capacity.json"
+    assert os.path.exists(path)
+    assert srv.engine.cache.flush_forensics() == path
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    validate_forensic_doc(doc)
+    assert len(doc["records"]) == len(recs)
+    # the exhaustion landed on the flight-recorder timeline, naming the
+    # forensic file
+    evs = [e for e in tracing.snapshot()
+           if e["event"] == "serve.capacity_exhausted"]
+    assert evs and evs[-1]["data"]["forensic"] == path
+    # the ledger survived the ordeal exactly
+    srv.engine.cache.drop_prefix_cache()
+    rep = srv.engine.cache.audit()
+    assert rep["used_blocks"] == 0 and not rep["tenants"]
+
+
+def test_unarmed_cache_records_forensics_in_memory_only(tmp_path):
+    cache = PagedKVCache(2, 2, 4, block_size=4, num_blocks=2,
+                         share_prefix=False)
+    rng = np.random.RandomState(0)
+    k, v = kv(rng, 8)
+    cache.prefill("s0", k, v)
+    with pytest.raises(CacheExhausted):
+        cache.prefill("s1", *kv(rng, 8))
+    recs = cache.forensic_records()
+    assert recs and recs[-1]["kind"] == "exhaustion"
+    assert not list(tmp_path.iterdir())   # nothing written anywhere
+
+
+# ---------------------------------------------------------------------------
+# the would-fit capacity signal
+# ---------------------------------------------------------------------------
+def test_capacity_signal_gates_admission_until_blocks_free():
+    sched = ContinuousBatchingScheduler(max_batch=4, max_tokens=10 ** 6)
+    sched.submit(Request([1] * 16, 4))
+    # a published signal with no free/reclaimable capacity: the head
+    # stays queued instead of popping just to bounce on CacheExhausted
+    sched.capacity_signal = {"block_size": 4, "free_blocks": 1,
+                             "reclaimable_blocks": 1}
+    assert sched.take_prefills() == []
+    assert sched.queue_depth() == 1
+    # capacity appears (decode evictions freed blocks): admitted
+    sched.capacity_signal = {"block_size": 4, "free_blocks": 3,
+                             "reclaimable_blocks": 1}
+    got = sched.take_prefills()
+    assert len(got) == 1
+    # no signal (bare scheduler, or right after an engine restart):
+    # gating is off — exactly the pre-ledger behavior
+    sched2 = ContinuousBatchingScheduler(max_batch=4, max_tokens=10 ** 6)
+    sched2.submit(Request([1] * 16, 4))
+    assert len(sched2.take_prefills()) == 1
+
+
+def test_server_publishes_capacity_signal_and_pool_gauges():
+    telemetry.reset()
+    try:
+        srv = Server(tiny(), num_blocks=32, block_size=4,
+                     tenants={"acme": {"weight": 1.0}})
+        srv.submit([1, 2, 3, 4, 5], max_new_tokens=3, tenant="acme")
+        srv.run_until_idle()
+        sig = srv.capacity_signal
+        assert sig is not None and sig["num_blocks"] == 32
+        assert sig["free_blocks"] + sig["used_blocks"] == 32
+        assert srv.scheduler.capacity_signal is sig
+        # pool gauges: cataloged, and the per-tenant amortized series
+        # sum to the used-bytes gauge (the identity, live)
+        for rec in telemetry.snapshot():
+            telemetry.validate_record(rec)
+            assert rec["name"] in telemetry.KNOWN_METRICS, rec["name"]
+        assert telemetry.get("serve.pool_used_bytes") is not None
+        used = telemetry.get("serve.pool_used_bytes").value
+        total = 0.0
+        for labels, m in telemetry.series("serve.pool_bytes"):
+            if labels.get("kind") == "amortized":
+                total += m.value
+        assert total == pytest.approx(used)
+        # a drained tenant's gauge reads 0, not a frozen stale value
+        am = telemetry.get("serve.pool_bytes", tenant="acme",
+                           kind="amortized")
+        assert am is not None and am.value == 0.0
+    finally:
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# the jax-less report tool
+# ---------------------------------------------------------------------------
+def _run_capacity_report(args):
+    code = ("import sys, runpy; "
+            "sys.modules['jax'] = None; "
+            "sys.modules['tpu_mx'] = None; "
+            f"sys.argv = ['capacity_report.py'] + {args!r}; "
+            "runpy.run_path("
+            f"{os.path.join(REPO, 'tools', 'capacity_report.py')!r}, "
+            "run_name='__main__')")
+    return subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+
+
+@pytest.mark.slow
+def test_capacity_report_validate_rc_contract(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUMX_PREFIX_SHARING", "1")
+    jsonl = tmp_path / "t.jsonl"
+    prefix = str(tmp_path / "sv")
+    srv = Server(tiny(), num_blocks=6, block_size=4, max_batch=4,
+                 max_tokens=10 ** 6, blackbox=prefix)
+    for i in range(5):
+        srv.submit([1, 2, 3, 4, 5, 6, 7], max_new_tokens=8)
+    srv.run_until_idle()
+    telemetry.flush(path=str(jsonl))
+    srv.engine.cache.flush_forensics()
+    forensics = prefix + "-capacity.json"
+    assert os.path.exists(forensics)
+
+    run = _run_capacity_report([str(jsonl), "--forensics", forensics,
+                               "--validate"])
+    assert run.returncode == 0, run.stderr + run.stdout
+    for marker in ("Ledger timeline", "Per-tenant pool attribution",
+                   "Exhaustion forensics", "schema OK"):
+        assert marker in run.stdout, (marker, run.stdout)
+
+    # rc 1: a forensic record violating the holders-complete gate
+    with open(forensics, encoding="utf-8") as f:
+        doc = json.load(f)
+    doc["records"][0]["holders"] = doc["records"][0]["holders"][:-1]
+    bad = tmp_path / "bad-capacity.json"
+    bad.write_text(json.dumps(doc))
+    run = _run_capacity_report([str(jsonl), "--forensics", str(bad),
+                               "--validate"])
+    assert run.returncode == 1
+    assert "100% of live holders" in run.stderr
+
+    # rc 2: unreadable input
+    run = _run_capacity_report([str(tmp_path / "missing.jsonl"),
+                               "--validate"])
+    assert run.returncode == 2
+
+
+def test_slo_report_renders_no_data_sentinel_as_na(tmp_path):
+    """Satellite (ISSUE 14): the -1 NO_DATA gauges render as n/a, never
+    as a negative estimate/attainment in the monitor-gauge section."""
+    jsonl = tmp_path / "t.jsonl"
+    recs = [
+        {"name": "serve.slo_estimate_seconds", "type": "gauge",
+         "value": -1.0, "ts": 1.0, "labels": {"slo": "itl_p99"}},
+        {"name": "serve.slo_attainment", "type": "gauge", "value": -1.0,
+         "ts": 1.0, "labels": {"slo": "itl_p99", "window": "10s"}},
+        {"name": "serve.slo_burn_rate", "type": "gauge", "value": 0.25,
+         "ts": 1.0, "labels": {"slo": "itl_p99", "window": "10s"}},
+    ]
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    run = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "slo_report.py"),
+         str(jsonl), "--validate"],
+        capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr + run.stdout
+    gauges = [ln for ln in run.stdout.splitlines()
+              if "serve.slo_" in ln]
+    nas = [ln for ln in gauges if "n/a" in ln]
+    assert len(nas) == 2, gauges                 # the two -1 sentinels
+    assert not any(" -1" in ln for ln in gauges), gauges
+    assert any("0.25" in ln for ln in gauges), gauges   # real data kept

@@ -666,10 +666,18 @@ def storm(tag, fault, n_req=12, **srv_kw):
     if SHARING:
         st = cache.prefix_stats()
         assert st["hits"] > 0, (tag, st)
+    # post-storm ledger audit (ISSUE 14), BEFORE the index drop: the
+    # accounting identity — per block, attributed refs == refcount; per
+    # tenant, amortized bytes sum EXACTLY to pool-used bytes — must
+    # hold at the storm's end state (audit raises on any violation)
+    cache.audit()
     cache.drop_prefix_cache()
     leftover = cache.allocator.refcounts()
     assert not leftover, (tag, leftover)
     assert cache.allocator.used == 0, (tag, cache.stats())
+    # ... and AFTER the drop: zero residual attributed bytes
+    rep = cache.audit()
+    assert rep["used_blocks"] == 0 and not rep["tenants"], (tag, rep)
     # an end-of-run audit box: unlike the restart-time box it contains
     # the finished requests' serve.request_timeline events — what
     # tools/slo_report.py's worst-request section (and its offline
@@ -711,6 +719,45 @@ correlated(box, "nan", "serve.restart")
 
 assert telemetry.get("serve.engine_restarts").value == 2
 assert telemetry.get("serve.requests", state="requeued").value >= 1
+
+# capacity pressure leg (ISSUE 14): a deliberately small pool forces
+# genuine CacheExhausted (preemption) and, with sharing armed, prefix
+# pressure evictions.  Every exhaustion must leave a forensic record
+# naming 100% of live holders, the dump on disk must be schema-valid,
+# and the ledger identity must hold through the whole ordeal.
+tracing.reset()
+cappfx = os.path.join(D, "sv-capacity")
+srv = serving.Server(model, num_blocks=10, block_size=4, max_batch=4,
+                     max_pending=64, max_tokens=100000, backoff=0.0,
+                     blackbox=cappfx,
+                     tenants={"t0": {"weight": 2.0}, "t1": {"weight": 1.0}})
+caps = [srv.submit([1, 2, 3, 4, 5, 6, 7], max_new_tokens=8,
+                   tenant=f"t{i % 2}") for i in range(6)]
+srv.run_until_idle()
+for r in caps:
+    assert r.state == "done" and len(r.tokens) == 8, r
+cache = srv.engine.cache
+recs = cache.forensic_records()
+n_exh = sum(1 for r in recs if r["kind"] == "exhaustion")
+assert n_exh > 0, "the pressure leg must genuinely exhaust the pool"
+exh_events = [e for e in tracing.snapshot()
+              if e["event"] == "serve.capacity_exhausted"]
+assert exh_events, "no serve.capacity_exhausted on the timeline"
+if tracing.stats()["dropped"] == 0:   # ring intact: 1:1 with records
+    assert len(exh_events) == n_exh, (len(exh_events), n_exh)
+from tpu_mx.serving import validate_forensic_doc
+cache.flush_forensics()   # disk dumps are rate-limited; audit wants 1:1
+with open(cappfx + "-capacity.json") as f:
+    capdoc = json.load(f)
+validate_forensic_doc(capdoc)   # holders-complete + identity per record
+assert len(capdoc["records"]) == len(recs), (len(capdoc["records"]),
+                                             len(recs))
+cache.audit()
+cache.drop_prefix_cache()
+assert not cache.allocator.refcounts()
+rep = cache.audit()
+assert rep["used_blocks"] == 0 and not rep["tenants"], rep
+print("CAPACITY LEG OK", flush=True)
 
 # the decode-path observables must record the arm this leg actually ran
 # on: every decode_attention call counted under the right kind, and the
@@ -896,6 +943,40 @@ def _serve_storm_leg(mode):
                   f"sections {missing or ['request timelines']}:"
                   f"\n{out[-3000:]}")
             return 1
+        # the capacity ops surface (ISSUE 14), same poisoned-jax
+        # discipline: schema-gate the storm's telemetry (the per-tenant
+        # pool_bytes identity re-checked offline per snapshot) plus the
+        # pressure leg's forensic dump, whose records must name 100% of
+        # the live holders and satisfy the identity record-by-record
+        cap_tool = os.path.join(repo, "tools", "capacity_report.py")
+        capjson = os.path.join(d, "sv-capacity-capacity.json")
+        code = ("import sys, runpy; "
+                "sys.modules['jax'] = None; "
+                "sys.modules['tpu_mx'] = None; "
+                f"sys.argv = ['capacity_report.py', {jsonl!r}, "
+                f"'--forensics', {capjson!r}, '--validate']; "
+                f"runpy.run_path({cap_tool!r}, run_name='__main__')")
+        try:
+            cap = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  serve[{tag_mode}]: capacity_report timed out: {e}")
+            return 1
+        out = (cap.stdout or "") + (cap.stderr or "")
+        if cap.returncode != 0:
+            print(f"  serve[{tag_mode}]: capacity_report failed "
+                  f"(rc={cap.returncode}):\n{out[-3000:]}")
+            return 1
+        missing = [m for m in ("Ledger timeline",
+                               "Per-tenant pool attribution",
+                               "Exhaustion forensics", "schema OK")
+                   if m not in out]
+        if missing or "0 forensic record(s)" in out:
+            print(f"  serve[{tag_mode}]: capacity_report output is "
+                  f"missing sections {missing or ['forensic records']}:"
+                  f"\n{out[-3000:]}")
+            return 1
     return 0
 
 
@@ -1024,6 +1105,24 @@ def obs_tier():
                   f"(rc={slo.returncode}):\n"
                   f"{((slo.stdout or '') + (slo.stderr or ''))[-3000:]}")
             return slo.returncode or 1
+        # capacity_report must hold to the same rc contract on a
+        # training-only snapshot: no serving data renders as "no data",
+        # never as an error, and the training-side twins (per-shape
+        # compiles, checkpoint bytes, host RSS) validate in catalog
+        try:
+            cap = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "capacity_report.py"),
+                 jsonl, "--validate"],
+                capture_output=True, text=True, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  obs: capacity_report validation timed out: {e}")
+            return 1
+        if cap.returncode != 0:
+            print(f"  obs: capacity_report validation failed "
+                  f"(rc={cap.returncode}):\n"
+                  f"{((cap.stdout or '') + (cap.stderr or ''))[-3000:]}")
+            return cap.returncode or 1
         rc = _blackbox_leg(repo, env)
         if rc != 0:
             return rc

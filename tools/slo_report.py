@@ -160,8 +160,19 @@ def render_slos(series, telemetry, specs):
     return lines
 
 
+# the live monitor's empty-window sentinel (serving.slo.NO_DATA): the
+# estimate/attainment gauges publish -1.0 when their window holds no
+# samples — a legitimate state, rendered as "n/a", never as a negative
+# latency or a negative burn rate in the per-tenant gauge rows
+NO_DATA = -1.0
+
+
 def render_monitor_gauges(series):
-    """The serve.slo_* gauges a live SLOMonitor published."""
+    """The serve.slo_* gauges a live SLOMonitor published.  The NO_DATA
+    sentinel (-1, published while an evaluation window is empty so a
+    dashboard never reads a frozen stale value as live) renders as
+    ``n/a``: estimates are positive and attainment lives in [0, 1], so
+    -1 is unambiguously no-data, not a measurement."""
     rows = [(k, r) for k, r in sorted(series.items())
             if k[0].startswith("serve.slo_")]
     if not rows:
@@ -169,7 +180,9 @@ def render_monitor_gauges(series):
     lines = ["Live monitor gauges (serving.SLOMonitor state at last "
              "snapshot):"]
     for (name, lj), rec in rows:
-        lines.append("  %-56s %g" % (_label(name, lj), rec.get("value")))
+        v = rec.get("value")
+        shown = "n/a (empty window)" if v == NO_DATA else "%g" % v
+        lines.append("  %-56s %s" % (_label(name, lj), shown))
     return lines
 
 

@@ -299,7 +299,17 @@ def write_manifest(prefix, epoch, files, extra=None):
         man.update(extra)
     with atomic_write(manifest_path(prefix, epoch), "w") as f:
         f.write(json.dumps(man, indent=1, sort_keys=True))
+    _record_bytes_on_disk(man)
     return man
+
+
+def _record_bytes_on_disk(man):
+    """Publish the manifest's committed payload bytes as the
+    ``checkpoint.bytes_on_disk`` gauge (ISSUE 14 capacity twin): each
+    epoch's save stamps its total, so the telemetry timeline carries
+    bytes-on-disk per epoch without a filesystem walk."""
+    total = sum(int(e.get("size", 0)) for e in man.get("files", {}).values())
+    _telemetry.gauge("checkpoint.bytes_on_disk").set(float(total))
 
 
 def update_manifest(prefix, epoch, add_files, extra=None):
@@ -323,6 +333,7 @@ def update_manifest(prefix, epoch, add_files, extra=None):
     man["written_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
     with atomic_write(mp, "w") as f:
         f.write(json.dumps(man, indent=1, sort_keys=True))
+    _record_bytes_on_disk(man)
     return man
 
 

@@ -37,6 +37,24 @@ __all__ = ["CompiledTrainStep", "fsdp_rules", "sharding_for", "apply_rules"]
 _logger = logging.getLogger(__name__)
 
 
+def _shape_signature(raw):
+    """The batch's shape signature (``"float32[16,4];float32[16]"``) —
+    the label the per-shape compile metrics key on (ISSUE 14): jax
+    retraces/compiles once per distinct operand signature even when the
+    jit wrapper itself survives, so "how many programs did this run
+    compile, for which shapes, costing how long" needs the signature as
+    the series key, not just the build count."""
+    parts = []
+    for b in raw:
+        if b is None:
+            parts.append("none")
+            continue
+        dt = np.dtype(getattr(b, "dtype", np.float32)).name
+        shape = ",".join(str(int(d)) for d in getattr(b, "shape", ()))
+        parts.append(f"{dt}[{shape}]")
+    return ";".join(parts)
+
+
 def apply_rules(name, shape, rules, mesh):
     """First matching (regex → PartitionSpec) rule wins; axes not in the mesh
     are dropped from the spec; default replicated."""
@@ -185,6 +203,11 @@ class CompiledTrainStep:
             self._efs = alloc()
         self._jitted = None
         self._build_count = 0
+        # batch shape-signatures already traced/compiled: the first step
+        # at a NEW signature pays the retrace+XLA-compile inside its jit
+        # call, so that call's wall clock is observed as compile_seconds
+        # under the signature label (ISSUE 14 capacity twins)
+        self._seen_signatures = set()
         # zombie-step guard: a watchdog-abandoned step that later finishes
         # must not apply its (stale) result over restored state.  Restores
         # bump _generation under _state_lock; _step commits its new state
@@ -634,6 +657,17 @@ class CompiledTrainStep:
             self.place()
             _tracing.emit("train_step.phase", t0=t_data,
                           t1=time.perf_counter(), phase="recompile")
+        # per-shape-signature compile accounting (ISSUE 14): the first
+        # step at a new operand signature pays jax's retrace + XLA
+        # compile inside the jit call below — count it under the
+        # signature label and observe that call's wall clock as the
+        # compile cost.  Steady-state steps pay one set lookup.
+        sig = _shape_signature(raw)
+        fresh_sig = sig not in self._seen_signatures
+        if fresh_sig:
+            self._seen_signatures.add(sig)
+            _telemetry.counter("train_step.compiles", signature=sig).inc()
+        t_compile = time.perf_counter()
         key = _random.take_key()
         if self._accum > 1 and self._micro < self._accum - 1:
             # microbatch: accumulate grads, no optimizer application
@@ -642,6 +676,10 @@ class CompiledTrainStep:
                 self.values, self._gacc, key, *raw)
             _tracing.emit("train_step.phase", t0=t_disp,
                           t1=time.perf_counter(), phase="dispatch")
+            if fresh_sig:
+                _telemetry.histogram(
+                    "train_step.compile_seconds", signature=sig).observe(
+                        time.perf_counter() - t_compile)
             with self._state_lock:
                 if self._stale(expect_gen):
                     return NDArray(loss)
@@ -665,6 +703,10 @@ class CompiledTrainStep:
         t_done = time.perf_counter()
         _tracing.emit("train_step.phase", t0=t_disp, t1=t_done,
                       phase="dispatch")
+        if fresh_sig:
+            _telemetry.histogram(
+                "train_step.compile_seconds", signature=sig).observe(
+                    t_done - t_compile)
         with self._state_lock:
             if self._stale(expect_gen):
                 return NDArray(loss)

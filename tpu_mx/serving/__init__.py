@@ -36,6 +36,11 @@ names.  Layer map, bottom up:
   (``TPUMX_PREFIX_SHARING``).
 - :mod:`.tenancy` — per-tenant weights/quotas and the bounded telemetry
   label: SLO-weighted fair admission, ``tenant_quota`` backpressure.
+- :mod:`.accounting` — the capacity ledger (ISSUE 14): every block
+  reference attributed to a holder (sequence/index/pinned plan) and a
+  tenant, amortized + exclusive-if-forked byte views whose per-tenant
+  sum equals pool-used bytes EXACTLY, exhaustion forensics naming every
+  holder, and the scheduler's ``capacity_signal`` would-fit hook.
 
 Telemetry (``serve.*`` in ``telemetry.KNOWN_METRICS``) and the request
 lifecycle events (``serve.admit/prefill/decode/evict/reject/restart`` in
@@ -43,6 +48,8 @@ lifecycle events (``serve.admit/prefill/decode/evict/reject/restart`` in
 make every claim here observable; ``tools/ci.py``'s ``serve`` tier
 storms a chaos-faulted server and asserts zero lost requests.
 """
+from .accounting import (CapacityLedger, FORENSIC_FORMAT,
+                         validate_forensic_doc, validate_forensic_record)
 from .kv_cache import (BlockAllocator, CacheExhausted, PagedKVCache,
                        PrefillPlan, prefix_sharing_enabled)
 from .prefix_cache import PrefixIndex
@@ -65,4 +72,6 @@ __all__ = ["BlockAllocator", "CacheExhausted", "PagedKVCache",
            "decode_path", "resolve_decode_path", "prefill_attention",
            "TinyLM", "AdmissionReject", "ContinuousBatchingScheduler",
            "Request", "StaticBatchingScheduler", "EngineCore", "Server",
-           "RequestTimeline", "SLO", "SLOMonitor"]
+           "RequestTimeline", "SLO", "SLOMonitor",
+           "CapacityLedger", "FORENSIC_FORMAT",
+           "validate_forensic_doc", "validate_forensic_record"]

@@ -57,7 +57,7 @@ class EngineCore:
     (tpu_mx/serving/model.py); cache geometry comes from it."""
 
     def __init__(self, model, block_size=16, num_blocks=256,
-                 dtype=np.float32, share_prefix=None):
+                 dtype=np.float32, share_prefix=None, forensics=None):
         self.model = model
         # the decode arm is resolved ONCE per engine generation: a knob
         # flip mid-flight cannot leave half a batch on each path, and
@@ -73,7 +73,8 @@ class EngineCore:
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
             block_size=block_size, num_blocks=num_blocks, dtype=dtype,
-            storage=storage, share_prefix=self.share_prefix)
+            storage=storage, share_prefix=self.share_prefix,
+            forensics=forensics)
         _tracing.emit("serve.decode_path", path=self.decode_kind,
                       storage=storage, sharing=self.share_prefix)
 
@@ -93,7 +94,10 @@ class EngineCore:
         raise :class:`NumericDivergence`."""
         t0 = time.perf_counter()
         tokens = req.prompt
-        plan = self.cache.match_prefix(tokens)
+        # the capacity ledger's attribution key (ISSUE 14): requests
+        # without a tenant (bare tests) fall to the single-tenant default
+        tenant = getattr(req, "tenant", None)
+        plan = self.cache.match_prefix(tokens, tenant=tenant)
         if plan is not None:
             cached = plan.tokens_matched
             try:
@@ -105,13 +109,14 @@ class EngineCore:
                 # must not outlive the attempt (the audit counts them)
                 self.cache.abandon_plan(plan)
                 raise
-            self.cache.commit_prefill(req.id, plan, k, v, tokens)
+            self.cache.commit_prefill(req.id, plan, k, v, tokens,
+                                      tenant=tenant)
         else:
             cached = 0
             k, v, logits = self.model.prefill(tokens)
             self.cache.prefill(req.id, k, v,
                                tokens=tokens if self.share_prefix
-                               else None)
+                               else None, tenant=tenant)
         health = float(np.max(np.abs(logits)))
         if not math.isfinite(health):
             raise NumericDivergence(

@@ -57,17 +57,27 @@ array — the serving data plane is single-threaded by design
 from __future__ import annotations
 
 import functools
+import itertools
 import threading
+import time
+from collections import deque
 
 import numpy as np
 
 from ..base import MXNetError
 from .. import telemetry as _telemetry
 from .. import tracing as _tracing
+from . import accounting as _accounting
+from .accounting import INDEX_TENANT, CapacityLedger
 from .prefix_cache import PrefixIndex, prefix_sharing_enabled
+from .tenancy import DEFAULT_TENANT
 
 __all__ = ["CacheExhausted", "BlockAllocator", "PagedKVCache",
            "PrefillPlan", "prefix_sharing_enabled"]
+
+# ids for pinned prefill plans' ledger holders — unique per process so a
+# forensic record never conflates two concurrently pinned plans
+_plan_ids = itertools.count()
 
 
 def _next_pow2(n):
@@ -127,9 +137,19 @@ class BlockAllocator:
     or :class:`CacheExhausted` is raised and the free list is untouched —
     a partial grab would leak blocks on the error path.  ``free`` rejects
     ids the allocator did not hand out (double-free corrupts the pool
-    silently; loud is the only acceptable failure mode)."""
+    silently; loud is the only acceptable failure mode).
 
-    def __init__(self, num_blocks):
+    **Capacity ledger** (ISSUE 14): every reference additionally carries
+    an attribution — the ``holder=`` a caller names on
+    ``alloc``/``incref``/``free`` (a sequence, the prefix index, a
+    pinned plan; ``None`` files under the ``_anon`` holder, so bare
+    callers stay ledgered).  The ledger mutates under THIS lock, next to
+    the refcount it mirrors, which is what makes ``audit()``'s identity
+    — per block, attributed refs == refcount; per tenant, amortized
+    bytes sum exactly to pool-used bytes — hold at every instant
+    (tpu_mx/serving/accounting.py)."""
+
+    def __init__(self, num_blocks, block_bytes=1):
         if int(num_blocks) < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         self.num_blocks = int(num_blocks)
@@ -139,10 +159,12 @@ class BlockAllocator:
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._held = set()
         self._refs = {}   # block id -> reference count (held blocks only)
+        self.ledger = CapacityLedger(block_bytes)
 
-    def alloc(self, n=1):
+    def alloc(self, n=1, holder=None):
         """``n`` block ids at one reference each, or raise
-        :class:`CacheExhausted` (free list untouched — all-or-nothing)."""
+        :class:`CacheExhausted` (free list untouched — all-or-nothing).
+        ``holder`` attributes the references in the capacity ledger."""
         n = int(n)
         with self._lock:
             if n > len(self._free):
@@ -154,9 +176,11 @@ class BlockAllocator:
             self._held.update(ids)
             for bid in ids:
                 self._refs[bid] = 1
+            self.ledger.hold(ids, holder)
+            self.ledger.note_used(len(self._held))
         return ids
 
-    def incref(self, block_ids):
+    def incref(self, block_ids, holder=None):
         """Add one reference to each (held) block — a sharer: the
         shared-prefix index, or a :meth:`PagedKVCache.fork` sibling.
         Increfing a block the allocator did not hand out is as loud as
@@ -170,14 +194,17 @@ class BlockAllocator:
                         "resurrect freed storage")
             for bid in block_ids:
                 self._refs[bid] += 1
+            self.ledger.hold(block_ids, holder)
 
-    def free(self, block_ids):
+    def free(self, block_ids, holder=None):
         """Drop one reference per block; a block reaching ZERO
         references returns to the free list (copy-free: contents are
         left in place for the next owner to overwrite).  A block another
         holder still references survives — which is why freeing a
         preempted sequence can never corrupt a sequence sharing its
-        prefix.  Freeing an unheld block (double free) stays loud."""
+        prefix.  Freeing an unheld block (double free) stays loud, and
+        so does naming a ``holder`` that does not hold the reference
+        (the ledger's attribution would silently drift otherwise)."""
         with self._lock:
             for bid in block_ids:
                 if bid not in self._held:
@@ -185,11 +212,86 @@ class BlockAllocator:
                         f"BlockAllocator.free: block {bid} is not held "
                         "(double free or foreign id) — the pool would be "
                         "silently corrupted")
+            # the ledger validates the holder's attribution BEFORE any
+            # refcount moves, so a mis-attributed free changes nothing
+            self.ledger.release(block_ids, holder)
+            for bid in block_ids:
                 self._refs[bid] -= 1
                 if self._refs[bid] == 0:
                     del self._refs[bid]
                     self._held.discard(bid)
                     self._free.append(bid)
+
+    def reassign(self, block_ids, src, dst):
+        """Move the attributed ownership of one reference per block from
+        holder ``src`` to ``dst`` WITHOUT touching refcounts — the
+        commit-prefill handoff (a plan's pins become the registered
+        sequence's references)."""
+        with self._lock:
+            for bid in block_ids:
+                if bid not in self._held:
+                    raise MXNetError(
+                        f"BlockAllocator.reassign: block {bid} is not "
+                        "held — cannot move attribution of a freed block")
+            self.ledger.transfer(block_ids, src, dst)
+
+    def describe(self, holder, kind=None, tenant=None, pinned=None):
+        """Attach attribution metadata to a ledger holder (under the
+        allocator lock, like every ledger mutation)."""
+        with self._lock:
+            self.ledger.describe(holder, kind=kind, tenant=tenant,
+                                 pinned=pinned)
+
+    def _fragmentation_locked(self):
+        """1 - (largest contiguous free-id run / free blocks); 0 when
+        the free list is empty.  Any block satisfies any allocation, so
+        this is a locality signal (how scattered reuse has become), not
+        an allocation-failure predictor."""
+        if not self._free:
+            return 0.0
+        free = sorted(self._free)
+        best = run = 1
+        for a, b in zip(free, free[1:]):
+            run = run + 1 if b == a + 1 else 1
+            if run > best:
+                best = run
+        return 1.0 - best / len(free)
+
+    def fragmentation(self):
+        """Free-list fragmentation in [0, 1] (see the locked helper)."""
+        with self._lock:
+            return self._fragmentation_locked()
+
+    def capacity_snapshot(self):
+        """One consistent read of the pool's capacity state: counts,
+        fragmentation, high watermark, every ledger holder row and the
+        per-tenant attribution — the forensic record's raw material
+        (holders and tenants share one totals pass — ledger.views)."""
+        with self._lock:
+            holders, tenants = self.ledger.views()
+            return {
+                "num_blocks": self.num_blocks,
+                "block_bytes": self.ledger.block_bytes,
+                "used_blocks": len(self._held),
+                "free_blocks": len(self._free),
+                "total_refs": sum(self._refs.values()),
+                "high_watermark_blocks": self.ledger.high_watermark,
+                "fragmentation": self._fragmentation_locked(),
+                "holders": holders,
+                "tenants": tenants,
+            }
+
+    def audit(self):
+        """Verify the accounting identity (ledger vs refcounts, exact
+        per-tenant byte sums — accounting.CapacityLedger.audit) and
+        return the audit report; raises on any violation.  The serve CI
+        tier runs this after every chaos storm."""
+        with self._lock:
+            report = self.ledger.audit(dict(self._refs))
+            report["free_blocks"] = len(self._free)
+            report["num_blocks"] = self.num_blocks
+            report["fragmentation"] = self._fragmentation_locked()
+            return report
 
     def refcount(self, block_id):
         """The block's live reference count (0 when not held)."""
@@ -221,11 +323,13 @@ class BlockAllocator:
 
 
 class _Sequence:
-    __slots__ = ("blocks", "length")
+    __slots__ = ("blocks", "length", "holder", "tenant")
 
-    def __init__(self):
+    def __init__(self, holder=None, tenant=DEFAULT_TENANT):
         self.blocks = []
         self.length = 0
+        self.holder = holder    # the sequence's ledger holder id
+        self.tenant = tenant
 
 
 class PrefillPlan:
@@ -237,11 +341,14 @@ class PrefillPlan:
     dropping it on the floor leaks references until the audit catches
     it."""
 
-    __slots__ = ("blocks", "tokens_matched", "_consumed")
+    __slots__ = ("blocks", "tokens_matched", "holder", "_consumed")
 
-    def __init__(self, blocks, tokens_matched):
+    def __init__(self, blocks, tokens_matched, holder=None):
         self.blocks = list(blocks)
         self.tokens_matched = int(tokens_matched)
+        # the plan's capacity-ledger holder id (pinned attribution):
+        # commit reassigns it to the sequence, abandon releases it
+        self.holder = holder
         # a plan's pins are released exactly once (by commit_prefill or
         # abandon_plan).  Without this flag a double abandon — or an
         # abandon after commit — would free() blocks the plan no longer
@@ -295,7 +402,7 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim, block_size=16,
                  num_blocks=256, dtype=np.float32, storage="host",
-                 share_prefix=None):
+                 share_prefix=None, forensics=None):
         if storage not in ("host", "device"):
             raise ValueError(f"storage must be 'host' or 'device', "
                              f"got {storage!r}")
@@ -305,8 +412,22 @@ class PagedKVCache:
         self.block_size = int(block_size)
         if self.block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
-        self.allocator = BlockAllocator(num_blocks)
+        # per-token K/V footprint across all layers, both pools — the
+        # unit of the prefill-bytes accounting, and (× block_size) the
+        # capacity ledger's block-bytes denomination
+        self._token_bytes = (self.num_layers * self.num_heads
+                             * self.head_dim * 2 * np.dtype(dtype).itemsize)
+        self.allocator = BlockAllocator(
+            num_blocks, block_bytes=self._token_bytes * self.block_size)
         self.storage = storage
+        # exhaustion forensics (ISSUE 14): a bounded ring of capacity
+        # records — one per genuine CacheExhausted and per prefix-index
+        # pressure eviction — persisted (rolling, atomic) as
+        # <forensics>-capacity.json when a path prefix is armed
+        self._forensics = deque(maxlen=256)
+        self._forensics_path = (f"{forensics}-capacity.json"
+                                if forensics else None)
+        self._forensics_dumped = None   # monotonic time of last disk dump
         layer_shape = (self.allocator.num_blocks, self.block_size,
                        self.num_heads, self.head_dim)
         if storage == "device":
@@ -351,11 +472,6 @@ class PagedKVCache:
                 f"{np.dtype(dtype).name}): a lossy pool dtype would "
                 "break the sharing-on/off bit-equality guarantee")
         self.prefix = PrefixIndex(self.block_size) if share_prefix else None
-        # per-token K/V footprint across all layers, both pools — the
-        # unit of the prefill-bytes accounting (what a prefill COMPUTES;
-        # the bench receipt's ">= 2x reduction" numerator/denominator)
-        self._token_bytes = (self.num_layers * self.num_heads
-                             * self.head_dim * 2 * np.dtype(dtype).itemsize)
         self._prompt_tokens = 0     # tokens requested across prefills
         self._cached_tokens = 0     # of those, served from the index
         self._cow_copies = 0
@@ -401,25 +517,92 @@ class PagedKVCache:
         return -(-int(num_tokens) // self.block_size)
 
     # -- writes --------------------------------------------------------------
-    def _alloc(self, n):
+    def _alloc(self, n, holder=None):
         """``allocator.alloc`` with prefix-cache pressure relief: on
         exhaustion, least-recently-matched index-only prefixes are
         released and the allocation retried ONCE.  When the pool is
         genuinely full of live sequence data, :class:`CacheExhausted`
         propagates — the backpressure contract is unchanged, the index
         merely never stands between a live request and free memory.
+        Both the pressure eviction and the genuine exhaustion leave a
+        capacity forensic record naming every live holder (ISSUE 14).
         Called under the cache lock."""
         try:
-            return self.allocator.alloc(n)
+            return self.allocator.alloc(n, holder=holder)
         except CacheExhausted:
             if self.prefix is None:
+                self._record_forensic("exhaustion", need=n)
                 raise
             released = self.prefix.release(self.allocator, n)
             if released:
                 _telemetry.counter("serve.prefix_evictions").inc(released)
                 _tracing.emit("serve.prefix_evict", released=released,
                               need=int(n))
-            return self.allocator.alloc(n)
+                self._record_forensic("pressure_evict", need=n,
+                                      released=released)
+            try:
+                return self.allocator.alloc(n, holder=holder)
+            except CacheExhausted:
+                self._record_forensic("exhaustion", need=n)
+                raise
+
+    def _record_forensic(self, kind, need, released=0):
+        """Snapshot WHO holds the pool at a capacity event — every
+        holder (sequence/index/plan) with its tenant, block counts,
+        pinned/shared state and age — into the bounded forensic ring,
+        and persist the ring (rolling, atomic) when a path is armed.
+        A ``CacheExhausted`` additionally lands on the flight-recorder
+        timeline so a backpressure incident's black box names the
+        forensic file.  Best-effort: forensics must never turn
+        backpressure into a crash.  Called under the cache lock."""
+        snap = self.allocator.capacity_snapshot()
+        rec = {"kind": kind, "ts": time.time(), "need": int(need),
+               "free": snap["free_blocks"], "released": int(released),
+               "pool": {k: snap[k] for k in
+                        ("num_blocks", "block_bytes", "used_blocks",
+                         "total_refs", "high_watermark_blocks",
+                         "fragmentation")},
+               "holders": snap["holders"], "tenants": snap["tenants"]}
+        self._forensics.append(rec)
+        if kind == "exhaustion":
+            _tracing.emit("serve.capacity_exhausted", need=int(need),
+                          free=int(snap["free_blocks"]),
+                          holders=len(snap["holders"]),
+                          forensic=self._forensics_path or "")
+        # disk dumps are rate-limited (>= 1 s apart, first record
+        # always): the RING holds every record regardless, but a
+        # sustained overload storm raises CacheExhausted per bounced
+        # prefill and an O(ring) atomic rewrite under the cache lock
+        # per event would stall the data plane exactly when it is
+        # already exhausted.  flush_forensics() force-syncs at
+        # teardown/audit time.
+        now = time.monotonic()
+        if self._forensics_path and (self._forensics_dumped is None
+                                     or now - self._forensics_dumped
+                                     >= 1.0):
+            self._forensics_dumped = now
+            try:
+                _accounting.dump_forensics(self._forensics_path,
+                                           self._forensics)
+            except Exception:  # noqa: BLE001 — forensics are best-effort
+                pass
+
+    def forensic_records(self):
+        """The in-memory capacity forensic ring (newest last)."""
+        with self._lock:
+            return list(self._forensics)
+
+    def flush_forensics(self):
+        """Force-sync the forensic ring to disk (bypassing the dump
+        rate limit) — teardown and post-storm audit call this so the
+        on-disk record set matches the ring exactly.  Returns the path
+        written, or None (unarmed / empty ring)."""
+        with self._lock:
+            if not self._forensics_path or not self._forensics:
+                return None
+            self._forensics_dumped = time.monotonic()
+            return _accounting.dump_forensics(self._forensics_path,
+                                              self._forensics)
 
     def _fill(self, blocks, k, v, offset=0):
         """Write ``k``/``v`` (``(num_layers, T, H, D)``) into ``blocks``
@@ -470,7 +653,7 @@ class PagedKVCache:
             _telemetry.gauge("serve.prefix_hit_ratio").set(
                 self._cached_tokens / self._prompt_tokens)
 
-    def prefill(self, seq_id, k, v, tokens=None):
+    def prefill(self, seq_id, k, v, tokens=None, tenant=None):
         """Bulk-fill a new sequence's blocks in one call.
 
         ``k``/``v``: ``(num_layers, L, num_heads, head_dim)``.  Allocates
@@ -479,7 +662,9 @@ class PagedKVCache:
         can requeue the request and retry after an eviction.  ``tokens``
         (the prompt's token ids, optional) lets the shared-prefix index
         learn this sequence's full blocks for future reuse — omitted,
-        the prefill stays private (the pre-sharing behavior)."""
+        the prefill stays private (the pre-sharing behavior).
+        ``tenant`` is the capacity ledger's attribution key (defaults
+        to the single-tenant default)."""
         k = np.asarray(k)
         v = np.asarray(v)
         want = (self.num_layers, k.shape[1], self.num_heads, self.head_dim)
@@ -494,16 +679,19 @@ class PagedKVCache:
         if tokens is not None and len(tokens) != length:
             raise ValueError(f"prefill: {len(tokens)} tokens for {length} "
                              "K/V positions")
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
+        holder = f"seq:{seq_id}"
         with self._lock:
             if seq_id in self._seqs:
                 raise MXNetError(f"prefill: sequence {seq_id!r} already "
                                  "cached (free it first)")
-            blocks = self._alloc(self.blocks_for(length))
+            blocks = self._alloc(self.blocks_for(length), holder=holder)
+            self.allocator.describe(holder, kind="sequence", tenant=tenant)
             # fill BEFORE publishing in _seqs: a concurrent gather must
             # never see a registered-but-empty sequence (all-zero K/V
             # would be silently wrong logits, not an error)
             self._fill(blocks, k, v)
-            entry = _Sequence()
+            entry = _Sequence(holder=holder, tenant=tenant)
             entry.blocks = blocks
             entry.length = length
             self._seqs[seq_id] = entry
@@ -512,21 +700,29 @@ class PagedKVCache:
             self._account_prefill(length, 0)
 
     # -- shared-prefix prefill (ISSUE 12) ------------------------------------
-    def match_prefix(self, tokens):
+    def match_prefix(self, tokens, tenant=None):
         """The longest indexed full-block prefix of ``tokens``, PINNED:
         the matched blocks are increfed under the lock so pressure
         eviction can never reuse them between the match and the commit.
         Returns a :class:`PrefillPlan` or None (sharing off, or no
         match).  Every plan must reach :meth:`commit_prefill` or
-        :meth:`abandon_plan`."""
+        :meth:`abandon_plan`.  The pins are ledgered as a ``plan``
+        holder under ``tenant`` — a backpressure forensic taken
+        mid-plan attributes the pinned blocks to the tenant whose
+        prefill pinned them."""
         if self.prefix is None:
             return None
         with self._lock:
             blocks, m = self.prefix.match(tokens)
             if not m:
                 return None
-            self.allocator.incref(blocks)
-            return PrefillPlan(blocks, m)
+            holder = f"plan:{next(_plan_ids)}"
+            self.allocator.incref(blocks, holder=holder)
+            self.allocator.describe(
+                holder, kind="plan",
+                tenant=DEFAULT_TENANT if tenant is None else str(tenant),
+                pinned=True)
+            return PrefillPlan(blocks, m, holder=holder)
 
     def gather_plan(self, plan):
         """The pinned prefix's K/V as host ``(num_layers, m, H, D)``
@@ -554,18 +750,21 @@ class PagedKVCache:
             vs[layer] = vp.reshape(-1, self.num_heads, self.head_dim)[:m]
         return ks, vs
 
-    def commit_prefill(self, seq_id, plan, k, v, tokens):
+    def commit_prefill(self, seq_id, plan, k, v, tokens, tenant=None):
         """Register ``seq_id`` as the pinned prefix plus the computed
         suffix: ``k``/``v`` are ``(num_layers, S, H, D)`` projections
         for ``tokens[plan.tokens_matched:]``.  All-or-nothing like
         :meth:`prefill`: on ANY failure (suffix allocation hitting
         genuine exhaustion included) the plan's pins are released and
         nothing is registered — the scheduler defers and the retry
-        re-plans from scratch."""
+        re-plans from scratch.  On success the plan's pinned ledger
+        attribution is reassigned to the sequence's holder."""
         k = np.asarray(k)
         v = np.asarray(v)
         m = plan.tokens_matched
         length = m + k.shape[1]
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
+        holder = f"seq:{seq_id}"
         with self._lock:
             plan.consume()   # pins spent here, succeed or fail
             fresh = []
@@ -589,14 +788,20 @@ class PagedKVCache:
                         f"commit_prefill: suffix k/v must be {want}, got "
                         f"{k.shape} / {v.shape}")
                 fresh = self._alloc(self.blocks_for(length)
-                                    - len(plan.blocks))
+                                    - len(plan.blocks), holder=holder)
+                self.allocator.describe(holder, kind="sequence",
+                                        tenant=tenant)
                 self._fill(fresh, k, v)
-                entry = _Sequence()
+                entry = _Sequence(holder=holder, tenant=tenant)
                 entry.blocks = plan.blocks + fresh
                 entry.length = length
                 self._seqs[seq_id] = entry
                 published = True
                 self.prefix.insert(tokens, entry.blocks, self.allocator)
+                # LAST, so the except arm below can still release the
+                # pins under the plan's holder: the pinned attribution
+                # becomes the sequence's (refcounts untouched)
+                self.allocator.reassign(plan.blocks, plan.holder, holder)
             except BaseException:
                 # ALL-or-nothing: unregister (only what THIS call
                 # published — the already-cached guard's failure must
@@ -608,8 +813,8 @@ class PagedKVCache:
                 if published:
                     self._seqs.pop(seq_id, None)
                 if fresh:
-                    self.allocator.free(fresh)
-                self.allocator.free(plan.blocks)
+                    self.allocator.free(fresh, holder=holder)
+                self.allocator.free(plan.blocks, holder=plan.holder)
                 raise
             self._account_prefill(k.shape[1], m)
 
@@ -620,21 +825,28 @@ class PagedKVCache:
         another holder's reference."""
         with self._lock:
             plan.consume()
-            self.allocator.free(plan.blocks)
+            self.allocator.free(plan.blocks, holder=plan.holder)
 
-    def fork(self, parent_id, child_id):
+    def fork(self, parent_id, child_id, tenant=None):
         """Register ``child_id`` sharing ALL of ``parent_id``'s blocks
         (one incref per block) — the parallel-sampling shape: N
         generations from one prompt pay one prefill and one copy of the
         prompt's KV.  Both siblings copy-on-write their shared tail
-        block on their next divergent append (:meth:`reserve`)."""
+        block on their next divergent append (:meth:`reserve`).
+        ``tenant`` defaults to the parent's ledger attribution."""
         with self._lock:
             if child_id in self._seqs:
                 raise MXNetError(f"fork: sequence {child_id!r} already "
                                  "cached (free it first)")
             parent = self._entry(parent_id)
-            self.allocator.incref(parent.blocks)
-            entry = _Sequence()
+            holder = f"seq:{child_id}"
+            self.allocator.incref(parent.blocks, holder=holder)
+            self.allocator.describe(
+                holder, kind="sequence",
+                tenant=parent.tenant if tenant is None else str(tenant))
+            entry = _Sequence(holder=holder,
+                              tenant=parent.tenant if tenant is None
+                              else str(tenant))
             entry.blocks = list(parent.blocks)
             entry.length = parent.length
             self._seqs[child_id] = entry
@@ -646,7 +858,7 @@ class PagedKVCache:
         original bits; this sequence appends into its own copy — the
         write is invisible to them by construction."""
         old = entry.blocks[-1]
-        new = self._alloc(1)[0]
+        new = self._alloc(1, holder=entry.holder)[0]
         if self.storage == "device":
             _, _, _, copy_block = _dev_ops()
             for layer in range(self.num_layers):
@@ -656,7 +868,7 @@ class PagedKVCache:
             self.k_blocks[:, new] = self.k_blocks[:, old]
             self.v_blocks[:, new] = self.v_blocks[:, old]
         entry.blocks[-1] = new
-        self.allocator.free([old])
+        self.allocator.free([old], holder=entry.holder)
         self._cow_copies += 1
         _telemetry.counter("serve.cow_copies").inc()
 
@@ -672,7 +884,7 @@ class PagedKVCache:
         with self._lock:
             entry = self._entry(seq_id)
             if entry.length % self.block_size == 0:
-                entry.blocks.extend(self._alloc(1))
+                entry.blocks.extend(self._alloc(1, holder=entry.holder))
             elif self.allocator.refcount(entry.blocks[-1]) > 1:
                 self._cow_tail(entry)
             pos = entry.length
@@ -737,7 +949,7 @@ class PagedKVCache:
             entry = self._seqs.pop(seq_id, None)
             if entry is None:
                 return 0
-            self.allocator.free(entry.blocks)
+            self.allocator.free(entry.blocks, holder=entry.holder)
             return len(entry.blocks)
 
     def exclusive_blocks(self, seq_id):
@@ -901,3 +1113,40 @@ class PagedKVCache:
                 "used_blocks": self.allocator.used,
                 "free_blocks": self.allocator.available,
                 "utilization": self.allocator.utilization()}
+
+    # -- capacity accounting (ISSUE 14) --------------------------------------
+    def audit(self):
+        """Verify the capacity accounting identity — per block,
+        attributed ledger refs == the allocator refcount; per tenant,
+        amortized bytes sum EXACTLY to pool-used bytes — and return the
+        audit report (raises :class:`~tpu_mx.base.MXNetError` on any
+        violation).  The serve CI tier runs this after every chaos
+        storm; with every sequence freed and the prefix index dropped
+        the report must show zero used blocks and no tenants."""
+        with self._lock:
+            report = self.allocator.audit()
+            report["sequences"] = len(self._seqs)
+            return report
+
+    def capacity_stats(self):
+        """The live capacity view the server publishes as gauges and
+        hands the scheduler as ``capacity_signal``: pool geometry,
+        used/free/high-watermark bytes, free-list fragmentation, pinned
+        blocks (plan holders), prefix-index resident bytes (amortized),
+        the optimistic reclaimable-under-pressure bound, and the
+        per-tenant amortized/exclusive byte attribution."""
+        with self._lock:
+            snap = self.allocator.capacity_snapshot()
+            snap["block_size"] = self.block_size
+            snap["used_bytes"] = snap["used_blocks"] * snap["block_bytes"]
+            snap["high_watermark_bytes"] = (snap["high_watermark_blocks"]
+                                            * snap["block_bytes"])
+            snap["pinned_blocks"] = sum(h["blocks"]
+                                        for h in snap["holders"]
+                                        if h["pinned"])
+            idx = snap["tenants"].get(INDEX_TENANT)
+            snap["index_bytes"] = idx["bytes_amortized"] if idx else 0.0
+            snap["reclaimable_blocks"] = (
+                self.prefix.reclaimable(self.allocator)
+                if self.prefix is not None else 0)
+            return snap

@@ -52,6 +52,8 @@ import heapq
 import itertools
 import os
 
+from .accounting import INDEX_HOLDER, INDEX_TENANT
+
 __all__ = ["PrefixIndex", "prefix_sharing_enabled"]
 
 _SHARING_ENV = "TPUMX_PREFIX_SHARING"
@@ -160,7 +162,12 @@ class PrefixIndex:
             child = node.children.get(key)
             if child is None:
                 child = _Node(key, block_ids[i], node)
-                allocator.incref([block_ids[i]])
+                # the index's references are ledgered under its own
+                # holder/pseudo-tenant: index-resident bytes belong to
+                # the fleet, not to whichever tenant prefilled first
+                allocator.incref([block_ids[i]], holder=INDEX_HOLDER)
+                allocator.describe(INDEX_HOLDER, kind="index",
+                                   tenant=INDEX_TENANT)
                 node.children[key] = child
                 self._nodes += 1
             child.last_used = stamp
@@ -195,7 +202,7 @@ class PrefixIndex:
                     victim.children:
                 continue   # stale entry (shouldn't happen; be safe)
             del victim.parent.children[victim.key]
-            allocator.free([victim.block_id])
+            allocator.free([victim.block_id], holder=INDEX_HOLDER)
             self._nodes -= 1
             self.evictions += 1
             released += 1
@@ -216,11 +223,28 @@ class PrefixIndex:
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
-            allocator.free([n.block_id])
+            allocator.free([n.block_id], holder=INDEX_HOLDER)
             dropped += 1
         self._root.children = {}
         self._nodes = 0
         return dropped
+
+    def reclaimable(self, allocator):
+        """How many indexed blocks no live sequence shares (refcount
+        1 — index-only).  An OPTIMISTIC upper bound on what a pressure
+        pass could release: an interior node above a live-shared child
+        can never become an evictable leaf, so the true figure may be
+        lower — callers (the scheduler's would-fit admission gate) must
+        treat a miss as the ordinary defer path, not a promise."""
+        refs = allocator.refcounts()   # ONE lock acquisition, not per node
+        count = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if refs.get(n.block_id) == 1:
+                count += 1
+            stack.extend(n.children.values())
+        return count
 
     # -- observables ---------------------------------------------------------
     @property

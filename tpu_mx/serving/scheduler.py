@@ -262,6 +262,20 @@ class ContinuousBatchingScheduler:
         # step (tpu_mx/serving/slo.py) — take_prefills consults it for
         # the per-tenant burn-rate boost
         self.slo_signal = None
+        # the capacity ledger's would-fit signal (ISSUE 14; the
+        # symmetric twin of slo_signal, published by the server from
+        # cache.capacity_stats each step): admission skips a prefill
+        # whose blocks cannot fit free + pressure-reclaimable capacity
+        # instead of popping it just to bounce on CacheExhausted.  None
+        # (no server driving, or right after an engine restart) means
+        # no gating — exactly the pre-ledger behavior.
+        self.capacity_signal = None
+        # per-request gate-skip counts: the would-fit need is computed
+        # from the FULL prompt, but a shared-prefix hit may need far
+        # fewer fresh blocks — after a bounded number of gated rounds
+        # the head is admitted anyway (the pre-ledger pop-and-maybe-
+        # defer path), so the gate can delay but never starve
+        self._capacity_skips = {}
 
     # -- admission (any thread) ----------------------------------------------
     def _tenant_inflight(self, tenant):
@@ -363,13 +377,47 @@ class ContinuousBatchingScheduler:
         w = self.tenants.get(tenant).weight
         return w * self.slo_boost if label_for(tenant) in boosted else w
 
+    # consecutive gated rounds before a head is admitted regardless: the
+    # gate's need estimate ignores shared-prefix reuse (a fully cached
+    # prompt may need ZERO fresh blocks), so it must be able to delay
+    # but never starve — the escape hands the request to the ordinary
+    # pop-and-maybe-defer path, which resolves the cached case exactly
+    CAPACITY_GATE_MAX_SKIPS = 4
+
+    def _fits_capacity(self, req):
+        """Would-fit admission gate (under the lock): with a published
+        ``capacity_signal``, a prefill whose block need exceeds free +
+        optimistically-reclaimable capacity is left queued this step —
+        popping it could only bounce on ``CacheExhausted`` and stall as
+        a deferral.  The bound is approximate in BOTH directions
+        (reclaimable is optimistic; the need ignores shared-prefix
+        hits), so a gated head escapes after
+        :data:`CAPACITY_GATE_MAX_SKIPS` rounds and an admitted prefill
+        can still bounce into the ordinary defer path — the gate
+        removes the common bounce, it never replaces backpressure."""
+        sig = self.capacity_signal
+        if not sig:
+            return True
+        bs = max(int(sig.get("block_size", 1)), 1)
+        need = -(-len(req.prompt) // bs)
+        if need <= (sig.get("free_blocks", 0)
+                    + sig.get("reclaimable_blocks", 0)):
+            self._capacity_skips.pop(req, None)
+            return True
+        skips = self._capacity_skips.get(req, 0) + 1
+        if skips >= self.CAPACITY_GATE_MAX_SKIPS:
+            self._capacity_skips.pop(req, None)   # anti-starvation escape
+            return True
+        self._capacity_skips[req] = skips
+        return False
+
     def _pick_next(self, used):
         """The weighted-fair admission pick (under the lock): among the
-        per-tenant queue heads that fit the remaining token budget, the
-        tenant with the LOWEST virtual time goes next (ties: queue
-        order — ``heads`` preserves first-seen order, so keeping the
-        earliest on equal vtime is FIFO).  Returns the request, or None
-        when nothing admissible."""
+        per-tenant queue heads that fit the remaining token budget AND
+        the pool's would-fit capacity, the tenant with the LOWEST
+        virtual time goes next (ties: queue order — ``heads`` preserves
+        first-seen order, so keeping the earliest on equal vtime is
+        FIFO).  Returns the request, or None when nothing admissible."""
         heads = {}
         for r in self._pending:
             if r.tenant not in heads:
@@ -385,11 +433,15 @@ class ContinuousBatchingScheduler:
             r = self._pending[0]
             if used + r.budget_tokens > self.max_tokens:
                 return None
+            if not self._fits_capacity(r):
+                return None
             self._charge(r, boosted)
             return r
         best, best_vt = None, None
         for r in heads.values():
             if used + r.budget_tokens > self.max_tokens:
+                continue
+            if not self._fits_capacity(r):
                 continue
             vt = max(self._vtime.get(r.tenant, 0.0), self._vfloor)
             if best is None or vt < best_vt:
@@ -439,6 +491,13 @@ class ContinuousBatchingScheduler:
                 self._admitting.add(req)
                 used += req.budget_tokens
                 out.append(req)
+            if self._capacity_skips:
+                # bound the skip ledger to requests still queued (a
+                # drained/rejected request must not pin its handle)
+                pending = set(self._pending)
+                self._capacity_skips = {
+                    r: n for r, n in self._capacity_skips.items()
+                    if r in pending}
         if out:
             _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
         return out

@@ -52,6 +52,7 @@ from .engine import EngineCore
 from .kv_cache import CacheExhausted
 from .scheduler import ContinuousBatchingScheduler, Request
 from .slo import SLOMonitor
+from .tenancy import label_for
 
 __all__ = ["Server"]
 
@@ -114,13 +115,23 @@ class Server:
         self.eos_id = eos_id
         self.engine = EngineCore(model, block_size=block_size,
                                  num_blocks=num_blocks, dtype=dtype,
-                                 share_prefix=prefix_sharing)
+                                 share_prefix=prefix_sharing,
+                                 forensics=blackbox)
         self.generation = 0        # engine generation (restart count)
         self.restarts = 0
         self.degraded = False
         self._steps = 0
         self._tokens_generated = 0
         self._t_first_work = None
+        # tenant labels the pool-bytes gauges have published: a tenant
+        # whose bytes drop to zero must be zeroed, not left frozen at
+        # its last nonzero attribution (ISSUE 14)
+        self._pool_tenants_seen = set()
+        # capacity-publish throttle: (used_blocks at last publish,
+        # monotonic time of it) — the full ledger walk runs only when
+        # the pool actually moved or the refresh interval elapsed,
+        # mirroring the SLO monitor's own rate limit
+        self._cap_published = None
 
     # -- admission (any thread) ----------------------------------------------
     def submit(self, prompt, max_new_tokens=16, request_id=None,
@@ -295,10 +306,73 @@ class Server:
             if dt > 0:
                 _telemetry.gauge("serve.tokens_per_sec").set(
                     self._tokens_generated / dt)
+        self._publish_capacity()
         if self.slo is not None:
             # rate-limited inside the monitor; the signal lands on the
             # scheduler for admission policies that weigh it
             self.scheduler.slo_signal = self.slo.refresh()
+
+    def _publish_capacity(self):
+        """Publish the capacity ledger live (ISSUE 14): the pool-state
+        gauges, the per-tenant amortized/exclusive byte attribution
+        (bounded labels — tenancy.label_for; two tenants collapsed into
+        the overflow label are SUMMED, preserving the accounting
+        identity), and the scheduler's ``capacity_signal`` hook — the
+        would-fit data admission consults before popping a prefill that
+        can only bounce (the symmetric twin of ``slo_signal``).
+
+        Throttled like the SLO monitor's refresh: the full ledger walk
+        (holders + tenants + trie reclaimable + free-list sort) runs
+        only when the pool's used-block count moved since the last
+        publish or 0.25 s elapsed — a steady decode loop pays one O(1)
+        counter read per step, not an O(pool + trie) scan."""
+        used_now = self.engine.cache.allocator.used
+        now = time.monotonic()
+        if self._cap_published is not None:
+            last_used, last_t = self._cap_published
+            if used_now == last_used and now - last_t < 0.25:
+                return
+        self._cap_published = (used_now, now)
+        cap = self.engine.cache.capacity_stats()
+        _telemetry.gauge("serve.pool_used_bytes").set(
+            float(cap["used_bytes"]))
+        _telemetry.gauge("serve.pool_fragmentation").set(
+            cap["fragmentation"])
+        _telemetry.gauge("serve.pool_high_watermark_bytes").set(
+            float(cap["high_watermark_bytes"]))
+        _telemetry.gauge("serve.prefix_index_bytes").set(
+            float(cap["index_bytes"]))
+        _telemetry.gauge("serve.pool_pinned_blocks").set(
+            float(cap["pinned_blocks"]))
+        by_label = {}
+        for tenant, d in cap["tenants"].items():
+            # ledger pseudo-tenants (_index and friends) are bounded by
+            # construction and keep their names; client-controlled ids
+            # go through the cardinality cap
+            label = tenant if tenant.startswith("_") else label_for(tenant)
+            acc = by_label.setdefault(label, [0.0, 0.0])
+            acc[0] += d["bytes_amortized"]
+            acc[1] += float(d["bytes_exclusive"])
+        for label, (amortized, exclusive) in by_label.items():
+            _telemetry.gauge("serve.pool_bytes", tenant=label,
+                             kind="amortized").set(amortized)
+            _telemetry.gauge("serve.pool_bytes", tenant=label,
+                             kind="exclusive").set(exclusive)
+        for label in self._pool_tenants_seen - set(by_label):
+            _telemetry.gauge("serve.pool_bytes", tenant=label,
+                             kind="amortized").set(0.0)
+            _telemetry.gauge("serve.pool_bytes", tenant=label,
+                             kind="exclusive").set(0.0)
+        self._pool_tenants_seen |= set(by_label)
+        self.scheduler.capacity_signal = {
+            "num_blocks": cap["num_blocks"],
+            "block_size": cap["block_size"],
+            "block_bytes": cap["block_bytes"],
+            "used_blocks": cap["used_blocks"],
+            "free_blocks": cap["free_blocks"],
+            "free_bytes": cap["free_blocks"] * cap["block_bytes"],
+            "reclaimable_blocks": cap["reclaimable_blocks"],
+        }
 
     @property
     def slo_signal(self):
@@ -308,6 +382,13 @@ class Server:
         ``scheduler.slo_signal``'s attribute access — one name, one
         access style on both surfaces."""
         return self.slo.signal() if self.slo is not None else None
+
+    @property
+    def capacity_signal(self):
+        """The latest capacity ledger signal published to the
+        scheduler (``_publish_capacity``), or None before the first
+        step — the symmetric twin of :attr:`slo_signal`."""
+        return self.scheduler.capacity_signal
 
     # -- self-healing --------------------------------------------------------
     def _restart(self, err):
@@ -339,7 +420,13 @@ class Server:
         self.engine = EngineCore(self.model, block_size=self._block_size,
                                  num_blocks=self._num_blocks,
                                  dtype=self._dtype,
-                                 share_prefix=self._prefix_sharing)
+                                 share_prefix=self._prefix_sharing,
+                                 forensics=self.blackbox)
+        # the rebuilt engine's pool starts empty: the stale would-fit
+        # signal (and the stale pool gauges) refresh on the next step,
+        # but the scheduler must not gate admission on the DEAD pool
+        self.scheduler.capacity_signal = None
+        self._cap_published = None
         self._dump_blackbox(f"serving engine restart "
                             f"{self.restarts}/{self.max_restarts}: "
                             f"{reason}")

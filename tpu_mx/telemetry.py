@@ -198,6 +198,25 @@ KNOWN_METRICS = frozenset({
     "serve.prefill_bytes", "serve.prefill_bytes_saved",
     "serve.prefix_evictions", "serve.cow_copies",
     "serve.slo_tenant_burn_rate",
+    # capacity accounting (ISSUE 14; tpu_mx/serving/accounting.py).
+    # pool_bytes{tenant,kind} is the per-tenant block-pool attribution —
+    # kind=amortized (1/refcount share of shared blocks; sums across
+    # tenants to pool_used_bytes EXACTLY, the CI-gated identity) or
+    # kind=exclusive (the full-block exclusive-if-forked cost).
+    # pool_fragmentation is the free-list contiguity signal,
+    # pool_high_watermark_bytes the lifetime peak, prefix_index_bytes
+    # the shared-prefix index's amortized residency, pool_pinned_blocks
+    # the references pinned by in-flight prefill plans.
+    "serve.pool_bytes", "serve.pool_used_bytes",
+    "serve.pool_fragmentation", "serve.pool_high_watermark_bytes",
+    "serve.prefix_index_bytes", "serve.pool_pinned_blocks",
+    # training-side capacity twins (ISSUE 14): jit builds per batch
+    # shape-signature and their wall-clock (first-call XLA compile
+    # included), the newest checkpoint's manifest bytes-on-disk, and
+    # the process's host resident set (refreshed at every flush /
+    # black-box export, like tracing.events_dropped)
+    "train_step.compiles", "train_step.compile_seconds",
+    "checkpoint.bytes_on_disk", "host.rss_bytes",
     # module-API training (tpu_mx/callback.py)
     "speedometer.samples_per_sec",
 })
@@ -852,16 +871,42 @@ def flush(path=None, final=False):
     return recs
 
 
+def _host_rss_bytes():
+    """The process's resident set in bytes (linux /proc fast path;
+    getrusage peak-RSS fallback elsewhere), or None when unreadable —
+    the host-memory capacity twin (ISSUE 14): a serving pool ledger is
+    half the story if the host process itself is the thing growing."""
+    try:
+        with open("/proc/self/statm", encoding="ascii") as f:
+            resident_pages = int(f.read().split()[1])
+        return resident_pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        peak = int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        # peak, not live — and the unit is platform-defined: linux/BSD
+        # report KiB, darwin reports BYTES (a blanket ×1024 would
+        # inflate a mac's gauge three orders of magnitude)
+        return peak if sys.platform == "darwin" else peak * 1024
+    except Exception:
+        return None
+
+
 def _refresh_bridge_gauges():
     """Pull cross-module observables into the registry right before a
     snapshot leaves the process: tracing.stats()["dropped"] becomes the
-    ``tracing.events_dropped`` gauge, so silent ring overflow is visible
-    in every exported snapshot and black box, not only in-process.  Only
-    reads a tracing module that is ALREADY imported (never imports —
-    this module stays standalone-loadable), and tracing's lock is
-    released before the gauge write (no nested lock order)."""
+    ``tracing.events_dropped`` gauge (silent ring overflow visible in
+    every exported snapshot and black box, not only in-process) and the
+    host resident set becomes ``host.rss_bytes``.  Only reads a tracing
+    module that is ALREADY imported (never imports — this module stays
+    standalone-loadable), and tracing's lock is released before the
+    gauge write (no nested lock order)."""
+    rss = _host_rss_bytes()
+    if rss is not None:
+        gauge("host.rss_bytes").set(float(rss))
     if not __package__:
-        return  # standalone module load: no package, no bridges
+        return  # standalone module load: no package, no other bridges
     mod = sys.modules.get(__package__ + ".tracing")
     if mod is None:
         return

@@ -129,6 +129,14 @@ KNOWN_EVENTS = {
     # relief pass — `released` index entries freed to satisfy a
     # `need`-block allocation (tpu_mx/serving/kv_cache.py::_alloc)
     "serve.prefix_evict": {"released": "int", "need": "int"},
+    # capacity exhaustion (ISSUE 14): a genuine CacheExhausted — the
+    # pool could not satisfy `need` blocks even after pressure relief.
+    # `holders` counts the live ledger holders at fault time and
+    # `forensic` names the rolling <prefix>-capacity.json record set
+    # (empty when forensics are unarmed) that attributes every one of
+    # them — rendered by tools/capacity_report.py without jax
+    "serve.capacity_exhausted": {"need": "int", "free": "int",
+                                 "holders": "int", "forensic": "str"},
     # per-request latency attribution (tpu_mx/serving/timeline.py,
     # ISSUE 11): emitted ONCE per request at finish/fail/reject — not
     # per phase transition, which would flood the ring — with the
