@@ -449,9 +449,61 @@ def test_paged_attention_accepts_single_token_axis():
     out4 = np.asarray(paged_attention(q[:, None], kp, vp, tables, lens))
     assert out4.shape == (q.shape[0], 1) + q.shape[1:]
     np.testing.assert_array_equal(out4[:, 0], out3)
-    with pytest.raises(ValueError, match="one token per sequence"):
-        paged_attention(np.zeros((2, 3, 2, 8), np.float32), kp, vp,
-                        tables[:2], lens[:2])
+
+
+def _paged_numpy_window_ref(q, k_pool, v_pool, tables, lengths):
+    """Window truth by reduction: row ``t`` of a ``Tq`` window is the
+    single-token case at length ``lengths - (Tq-1-t)``."""
+    b, tq, h, d = q.shape
+    out = np.zeros((b, tq, h, d), np.float64)
+    for t in range(tq):
+        lens_t = (lengths - (tq - 1 - t)).astype(np.int32)
+        out[:, t] = _paged_numpy_ref(q[:, t], k_pool, v_pool,
+                                     tables, lens_t)
+    return out
+
+
+@pytest.mark.parametrize("arm", ["kernel", "walk", "xla"])
+def test_paged_attention_window_matches_reference(arm):
+    """The widened ``(B, Tq, H, D)`` query axis — the speculative verify
+    call — must match the per-row single-token truth on every arm."""
+    from tpu_mx.kernels import paged_attention as pk
+    q1, kp, vp, tables, lens = _paged_case()
+    rng = np.random.RandomState(7)
+    tq = 3                                  # min length is 3 in the case
+    q = rng.randn(len(lens), tq, q1.shape[-2],
+                  q1.shape[-1]).astype(np.float32)
+    scale = 1.0 / math.sqrt(q1.shape[-1])
+    fn = {"kernel": pk.paged_attention,
+          "walk": lambda *a: pk.window_walk(*a, scale),
+          "xla": pk.paged_attention_reference}[arm]
+    out = np.asarray(fn(q, kp, vp, tables, lens))
+    ref = _paged_numpy_window_ref(q, kp, vp, tables, lens)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_window_rows_are_causally_staggered():
+    """Row ``t`` of the window sits at absolute position
+    ``length - Tq + t``: poisoning the LAST occupied slot may move only
+    the last row — earlier rows must not see their successors' keys."""
+    from tpu_mx.kernels.paged_attention import paged_attention
+    q1, kp, vp, tables, lens = _paged_case()
+    rng = np.random.RandomState(8)
+    tq = 3
+    q = rng.randn(len(lens), tq, q1.shape[-2],
+                  q1.shape[-1]).astype(np.float32)
+    base = np.asarray(paged_attention(q, kp, vp, tables, lens))
+    kp2, vp2 = kp.copy(), vp.copy()
+    bs = kp.shape[1]
+    for i in range(len(lens)):
+        last = int(lens[i]) - 1             # final key slot of row i
+        blk = int(tables[i, last // bs])
+        kp2[blk, last % bs] = 1e6
+        vp2[blk, last % bs] = -1e6
+    again = np.asarray(paged_attention(q, kp2, vp2, tables, lens))
+    np.testing.assert_array_equal(base[:, :-1], again[:, :-1])
+    assert not np.array_equal(base[:, -1], again[:, -1])
 
 
 def test_paged_attention_bf16_pool():

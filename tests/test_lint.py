@@ -1074,6 +1074,33 @@ def test_hot_path_purity_np_asarray_device_readback():
     assert len(found) == 1
 
 
+def test_hot_path_purity_guarded_readback_fallback_exempt():
+    """The guarded-fallback idiom (ISSUE 16): an np.asarray readback
+    tested behind isinstance is the sanctioned device/host-polymorphic
+    normalization — the kernel returns a device array only on the arm
+    that ran it, and the fallback re-binds the SAME value.  The
+    unguarded sibling readback must still fire."""
+    found, _ = run_multi({
+        "tpu_mx/kernels/mykern.py": """
+            def kern(q):
+                return q
+            """,
+        "tpu_mx/serving/attention.py": """
+            import numpy as np
+            from ..kernels.mykern import kern
+
+            def decode_attention(q, cache, seq_ids, layer):
+                out = kern(q)
+                if not isinstance(out, np.ndarray):
+                    out = np.asarray(out)        # guarded: exempt
+                bad = np.asarray(kern(q))        # unguarded: finding
+                return out, bad
+            """,
+    }, rules={"hot-path-purity"})
+    assert len(found) == 1
+    assert found[0].line and "reads a device value back" in found[0].message
+
+
 def test_hot_path_purity_item_and_uncached_jit():
     found, _ = run("""
         import jax

@@ -254,7 +254,8 @@ def test_paged_decode_logits_bit_identical_to_dense_cache():
     eng.prefill(other)
     assert first == ref_tokens[0]
     got = [first]
-    ot = [eng.decode([(other, 9)])[0][other.id]]
+    (first_ot,) = eng.decode([(other, 9)])[0][other.id]
+    ot = [first_ot]
     for step in range(steps):
         if step == 4:
             eng.evict(other)                      # churn: free mid-run
@@ -263,9 +264,9 @@ def test_paged_decode_logits_bit_identical_to_dense_cache():
             items.append((other, ot[-1]))
         res, pre = eng.decode(items)
         assert not pre
-        got.append(res[req.id])
+        got.extend(res[req.id])
         if step < 4:
-            ot.append(res[other.id])
+            ot.extend(res[other.id])
     assert got == ref_tokens
 
 

@@ -1,0 +1,289 @@
+"""Speculative multi-token decode + fused whole-step decode — ISSUE 16.
+
+Covers: the draft/verify/accept protocol (greedy acceptance is LOSSLESS,
+so spec on/off streams are bit-identical on every decode arm, host and
+fused), full-window rejection and disagreement at the first drafted
+slot (cache truncation restores exact lengths, zero block leaks), EOS
+landing inside an accepted draft (tokens past EOS never committed),
+engine restart mid-draft losing zero requests, pool exhaustion under
+window reservations (backpressure, never OOM), and the per-token
+host-crossing receipt (fused: constant 3 per step; host paged:
+4 x num_layers; dense: 0)."""
+import json
+
+import numpy as np
+import pytest
+
+from tpu_mx import telemetry, tracing
+from tpu_mx.contrib import chaos
+from tpu_mx.serving import EngineCore, Request, Server, TinyLM
+from tpu_mx.serving.jax_model import (JaxTinyLM, fused_requested,
+                                      resolve_fused)
+from tpu_mx.serving.speculative import (DEFAULT_WINDOW, SiblingProposer,
+                                        accept_prefix, resolve_spec_window)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    tracing.reset()
+    telemetry.reset()
+    yield
+    tracing.reset()
+    telemetry.reset()
+
+
+def tiny(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("embed_dim", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("seed", 0)
+    return TinyLM(**kw)
+
+
+def set_arms(monkeypatch, mode, fused, spec):
+    monkeypatch.setenv("TPUMX_PAGED_DECODE", mode)
+    monkeypatch.setenv("TPUMX_FUSED_DECODE", fused)
+    monkeypatch.setenv("TPUMX_SPECULATIVE", spec)
+
+
+def run_streams(monkeypatch, mode, fused, spec, prompts, steps=8, **kw):
+    set_arms(monkeypatch, mode, fused, spec)
+    srv = Server(tiny(), num_blocks=64, max_batch=4, **kw)
+    reqs = [srv.submit(p, max_new_tokens=steps) for p in prompts]
+    srv.run_until_idle()
+    for r in reqs:
+        assert r.state == "done", (r.state, r.error)
+    return srv, [r.tokens for r in reqs]
+
+
+# ---------------------------------------------------------------------------
+# knob resolution
+# ---------------------------------------------------------------------------
+def test_resolve_spec_window_env(monkeypatch):
+    for off in ("", "0", "off", "no"):
+        monkeypatch.setenv("TPUMX_SPECULATIVE", off)
+        assert resolve_spec_window() == 1
+    for on in ("1", "on", "yes", "auto"):
+        monkeypatch.setenv("TPUMX_SPECULATIVE", on)
+        assert resolve_spec_window() == DEFAULT_WINDOW
+    monkeypatch.setenv("TPUMX_SPECULATIVE", "6")
+    assert resolve_spec_window() == 6
+    # a typo'd knob must fail LOUDLY, never silently disable speculation
+    for bad in ("fast", "-2"):
+        monkeypatch.setenv("TPUMX_SPECULATIVE", bad)
+        with pytest.raises(ValueError, match="TPUMX_SPECULATIVE"):
+            resolve_spec_window()
+
+
+def test_resolve_fused_env_and_downgrade(monkeypatch):
+    model = tiny()
+    monkeypatch.setenv("TPUMX_FUSED_DECODE", "1")
+    assert fused_requested()
+    assert resolve_fused("paged", model)
+    assert resolve_fused("paged-kernel", model)
+    # dense has no device pool for the program to own: downgrade
+    assert not resolve_fused("dense", model)
+    monkeypatch.setenv("TPUMX_FUSED_DECODE", "0")
+    assert not resolve_fused("paged", model)
+    monkeypatch.setenv("TPUMX_FUSED_DECODE", "sometimes")
+    with pytest.raises(ValueError, match="TPUMX_FUSED_DECODE"):
+        fused_requested()
+
+
+# ---------------------------------------------------------------------------
+# accept protocol
+# ---------------------------------------------------------------------------
+def test_accept_prefix_protocol():
+    draft = np.array([7, 3, 5, 9])           # draft[0] is the input token
+    # verify output: out[j] is greedy-next after consuming draft[:j+1]
+    assert accept_prefix(draft, np.array([3, 5, 9, 2])) == 3   # all agree
+    assert accept_prefix(draft, np.array([3, 5, 1, 2])) == 2   # tail cut
+    assert accept_prefix(draft, np.array([3, 1, 9, 2])) == 1
+    # disagreement at the FIRST drafted slot: nothing speculative lands,
+    # the step still emits out[0] (the true greedy token)
+    assert accept_prefix(draft, np.array([1, 5, 9, 2])) == 0
+    # agreement past a mismatch must NOT resurrect the tail
+    assert accept_prefix(draft, np.array([3, 1, 9, 9])) == 1
+    assert accept_prefix(np.array([7]), np.array([4])) == 0    # K == 1
+
+
+# ---------------------------------------------------------------------------
+# bit-equality across every arm combination
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode,fused", [
+    ("0", "0"), ("0", "1"),                  # dense ("1" downgrades)
+    ("1", "0"), ("1", "1"),                  # paged host / fused
+    ("kernel", "0"), ("kernel", "1"),        # paged-kernel host / fused
+])
+def test_spec_on_off_streams_bit_identical(monkeypatch, mode, fused):
+    """THE acceptance bar: greedy verification makes speculation
+    lossless, so every (decode arm, fused arm, window) combination must
+    produce the same token streams as the plain dense reference."""
+    prompts = [[5, 6, 7], [9, 2], [1] * 7]
+    _, ref = run_streams(monkeypatch, "0", "0", "0", prompts)
+    for spec in ("0", "1", "3"):
+        srv, got = run_streams(monkeypatch, mode, fused, spec, prompts)
+        assert got == ref, (mode, fused, spec)
+        assert srv.engine.fused == (fused == "1" and mode != "0")
+        if spec != "0" and srv.engine.spec_window > 1:
+            ratio = telemetry.get("serve.spec_accept_ratio")
+            assert ratio is not None and 0.0 <= ratio.value <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# rejection edges
+# ---------------------------------------------------------------------------
+def test_full_window_rejection_truncates_exactly(monkeypatch):
+    """A proposer that is ALWAYS wrong at the first drafted slot: every
+    step degenerates to one true token, the cache length never drifts,
+    and no block leaks."""
+    prompts = [[5, 6, 7]]
+    _, ref = run_streams(monkeypatch, "0", "0", "0", prompts, steps=6)
+    bad_token = next(t for t in range(64) if t not in ref[0])
+
+    set_arms(monkeypatch, "1", "0", "4")
+    eng = EngineCore(tiny(), block_size=4, num_blocks=64)
+
+    class AlwaysWrong:
+        def draft(self, last_tokens, positions, n):
+            return np.full((len(last_tokens), n), bad_token, np.int64)
+
+    eng.proposer = AlwaysWrong()
+    req = Request([5, 6, 7], max_new_tokens=6, request_id="r")
+    first, _ = eng.prefill(req)
+    got = [first]
+    base_len = eng.cache.length(req.id)
+    for step in range(5):
+        res, pre = eng.decode([(req, got[-1])])
+        assert not pre
+        assert len(res[req.id]) == 1          # full-window rejection
+        got.extend(res[req.id])
+        # truncation restored the exact post-commit length: base + steps
+        assert eng.cache.length(req.id) == base_len + step + 1
+    assert got == ref[0]
+    assert telemetry.get("serve.spec_drafted").value == 3 * 5
+    assert telemetry.get("serve.spec_accept_ratio").value == 0.0
+    assert telemetry.get("serve.spec_accepted") is None
+    eng.evict(req)
+    assert eng.cache.stats()["used_blocks"] == 0
+
+
+def test_eos_inside_accepted_draft(monkeypatch):
+    """EOS produced inside an accepted window must terminate the stream
+    exactly where the non-speculative run does — accepted tokens past
+    EOS are dropped by the commit loop, never leaked to the client."""
+    prompts = [[5, 6, 7]]
+    _, ref = run_streams(monkeypatch, "0", "0", "0", prompts, steps=8)
+    eos = ref[0][4]                           # mid-stream, mid-window
+    _, ref_eos = run_streams(monkeypatch, "0", "0", "0", prompts,
+                             steps=8, eos_id=eos)
+    assert len(ref_eos[0]) < 8                # EOS actually fired early
+    for mode, fused in (("1", "0"), ("1", "1")):
+        _, got = run_streams(monkeypatch, mode, fused, "4", prompts,
+                             steps=8, eos_id=eos)
+        assert got == ref_eos, (mode, fused)
+
+
+def test_spec_window_exhaustion_is_still_backpressure(monkeypatch):
+    """Window reservations grab up to K slots at once — an
+    over-committed pool must preempt/requeue (all-or-nothing rollback
+    in reserve_window), complete every request, and leak nothing."""
+    prompts = [[1, 2, 3]] * 5
+    _, ref = run_streams(monkeypatch, "0", "0", "0", prompts, steps=6)
+    set_arms(monkeypatch, "1", "0", "4")
+    srv = Server(tiny(), num_blocks=6, block_size=2, max_batch=4,
+                 max_tokens=1000)
+    reqs = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    srv.run_until_idle()
+    for r, t in zip(reqs, ref):
+        assert r.state == "done" and r.tokens == t
+    assert srv.engine.cache.stats()["used_blocks"] == 0
+
+
+def test_restart_mid_draft_loses_zero_requests(monkeypatch, tmp_path):
+    """A NaN storm landing mid-speculative-run restarts the engine; the
+    requeued requests replay from their prompts and finish with the
+    exact clean-run streams."""
+    prompts = [[4, 5], [7, 1]]
+    _, ref = run_streams(monkeypatch, "0", "0", "0", prompts, steps=4)
+    tracing.reset()                           # drop the baseline's events
+    set_arms(monkeypatch, "1", "1", "4")
+    prefix = str(tmp_path / "spec")
+    srv = Server(tiny(), num_blocks=64, max_batch=4, backoff=0.0,
+                 blackbox=prefix)
+    reqs = [srv.submit(p, max_new_tokens=4) for p in prompts]
+    with chaos.enable(nan_after=2):
+        srv.run_until_idle()
+    assert srv.restarts == 1
+    for r, t in zip(reqs, ref):
+        assert r.state == "done" and r.tokens == t
+    assert srv.engine.cache.stats()["used_blocks"] == 0
+    box = json.load(open(tracing.blackbox_path(prefix)))
+    tracing.validate_blackbox(box)
+    paths = [e for e in box["events"]
+             if e["event"] == "serve.decode_path"]
+    assert len(paths) == 2                    # one per engine generation
+    for e in paths:
+        assert e["data"]["fused"] is True
+        assert e["data"]["spec_window"] == 4
+
+
+# ---------------------------------------------------------------------------
+# host-crossing receipt
+# ---------------------------------------------------------------------------
+def test_host_crossings_receipt_o1_vs_olayers(monkeypatch):
+    """The ISSUE 16 perf receipt in telemetry: the fused program crosses
+    the host<->device boundary a CONSTANT 3 times per step; the
+    host-resident paged arm pays 4 per layer; dense crosses zero."""
+    prompts = [[5, 6, 7]]
+    srv, _ = run_streams(monkeypatch, "1", "1", "0", prompts)
+    assert telemetry.get("serve.host_crossings_per_token").value == 3.0
+    assert telemetry.get("serve.fused_steps").value > 0
+    telemetry.reset()
+
+    srv, _ = run_streams(monkeypatch, "1", "0", "0", prompts)
+    layers = srv.engine.model.num_layers
+    assert telemetry.get(
+        "serve.host_crossings_per_token").value == 4.0 * layers
+    assert telemetry.get("serve.fused_steps") is None
+    telemetry.reset()
+
+    run_streams(monkeypatch, "0", "0", "0", prompts)
+    assert telemetry.get("serve.host_crossings_per_token").value == 0.0
+    assert telemetry.get("serve.host_crossings") is None
+
+
+def test_fused_decode_path_event_validates(monkeypatch):
+    set_arms(monkeypatch, "kernel", "1", "1")
+    srv = Server(tiny(), num_blocks=64, max_batch=4)
+    r = srv.submit([3, 1, 4], max_new_tokens=4)
+    srv.run_until_idle()
+    assert r.state == "done"
+    evs = [e for e in tracing.snapshot()
+           if e["event"] == "serve.decode_path"]
+    assert evs
+    for e in evs:
+        tracing.validate_event(e)
+    assert evs[-1]["data"] == {
+        "path": "paged-kernel", "storage": "device",
+        "sharing": evs[-1]["data"]["sharing"],
+        "fused": True, "spec_window": DEFAULT_WINDOW}
+
+
+# ---------------------------------------------------------------------------
+# proposer
+# ---------------------------------------------------------------------------
+def test_sibling_proposer_shapes_and_determinism():
+    model = tiny()
+    prop = SiblingProposer(model)
+    last = np.array([3, 9], np.int64)
+    pos = np.array([5, 2], np.int64)
+    a = prop.draft(last, pos, 3)
+    b = prop.draft(last, pos, 3)
+    assert a.shape == (2, 3) and a.dtype == np.int64
+    assert np.array_equal(a, b)               # drafting is deterministic
+    assert ((0 <= a) & (a < model.vocab_size)).all()
+    # drafts near the position ceiling must clamp, not crash
+    top = np.array([model.max_positions - 1], np.int64)
+    prop.draft(np.array([1], np.int64), top, 3)

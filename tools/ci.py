@@ -760,13 +760,38 @@ assert rep["used_blocks"] == 0 and not rep["tenants"], rep
 print("CAPACITY LEG OK", flush=True)
 
 # the decode-path observables must record the arm this leg actually ran
-# on: every decode_attention call counted under the right kind, and the
-# black boxes carrying serve.decode_path for the restarted generations
+# on: the black boxes carrying serve.decode_path for the restarted
+# generations, with the ISSUE 16 fused/spec_window fields.  The fused
+# arm runs attention INSIDE its one device program — decode_attention
+# is never dispatched, so its counter is asserted only on the host arms
+# and the fused legs assert the whole-step observables instead (the
+# constant-3 host-crossing receipt included).
+from tpu_mx.serving.speculative import resolve_spec_window
 kind = ("paged" if os.environ.get("TPUMX_PAGED_DECODE", "0")
         not in ("", "0") else "dense")
-assert telemetry.get("serve.decode_attention", kind=kind) is not None, kind
+FUSED = (kind != "dense" and
+         os.environ.get("TPUMX_FUSED_DECODE", "0") not in ("", "0"))
+SPECW = resolve_spec_window()
+if FUSED:
+    assert telemetry.get("serve.fused_steps") is not None
+    assert telemetry.get("serve.decode_attention", kind=kind) is None
+    # per-token crossings = 3 / tokens-emitted-that-step: the constant-3
+    # numerator means the gauge can never exceed 3.0 (one sequence, one
+    # token), and any host-resident re-entry (4*layers numerator) would
+    # blow straight past it
+    xing = telemetry.get("serve.host_crossings_per_token")
+    assert xing is not None and 0.0 < xing.value <= 3.0, xing
+else:
+    assert telemetry.get("serve.decode_attention",
+                         kind=kind) is not None, kind
+if SPECW > 1:
+    assert telemetry.get("serve.spec_drafted").value > 0
+    ratio = telemetry.get("serve.spec_accept_ratio")
+    assert ratio is not None and 0.0 <= ratio.value <= 1.0, ratio
 paths = [e for e in box["events"] if e["event"] == "serve.decode_path"]
 assert paths and all(e["data"]["path"] == kind for e in paths), (kind, paths)
+assert all(e["data"]["fused"] is FUSED for e in paths), (FUSED, paths)
+assert all(e["data"]["spec_window"] == SPECW for e in paths), (SPECW, paths)
 telemetry.flush(final=True)
 print("SERVE OK", flush=True)
 """
@@ -788,8 +813,10 @@ model = serving.TinyLM(vocab_size=64, embed_dim=32, num_heads=2,
 prompts = [[5, 6, 7], [9, 2], [1] * 7]
 
 
-def run(mode):
+def run(mode, fused="0", spec="0"):
     os.environ["TPUMX_PAGED_DECODE"] = mode
+    os.environ["TPUMX_FUSED_DECODE"] = fused
+    os.environ["TPUMX_SPECULATIVE"] = spec
     srv = serving.Server(model, num_blocks=64, max_batch=4)
     reqs = [srv.submit(p, max_new_tokens=6) for p in prompts]
     srv.run_until_idle()
@@ -799,6 +826,16 @@ def run(mode):
 dense = run("0")
 kernel = run("kernel")
 assert dense == kernel, (dense, kernel)
+
+# ISSUE 16: the fused whole-step program and speculative decode are pure
+# perf arms — every (decode mode, fused, spec) combination must emit the
+# dense reference's exact greedy streams (greedy verification is
+# lossless; the fused program imports the SAME weights)
+for mode in ("0", "1", "kernel"):
+    for fused in ("0", "1"):
+        for spec in ("0", "1"):
+            got = run(mode, fused, spec)
+            assert got == dense, (mode, fused, spec, got, dense)
 
 # raw-logits tolerance on a shared churned cache (both arms, same pool)
 os.environ["TPUMX_PAGED_DECODE"] = "0"
@@ -831,21 +868,31 @@ SERVE_BOX_EXPECT = {
 }
 
 
-def _serve_storm_leg(mode):
+def _serve_storm_leg(mode, spec="0", fused="0"):
     """One full chaos-storm pass (the three faults) with the decode arm
     pinned to `mode` ("0" = dense-gather reference, "1" = paged:
     device-resident pool + block-table program) and shared-prefix KV
     reuse ENABLED (ISSUE 12: the self-healing contract must hold with
     sharing on — the storm script's post-storm allocator audit asserts
     every refcount returns to zero), then telemetry validation and
-    jax-less black-box rendering."""
+    jax-less black-box rendering.  ISSUE 16 adds `spec`
+    (TPUMX_SPECULATIVE) and `fused` (TPUMX_FUSED_DECODE): the fused
+    whole-step arm and speculative windows must survive the same storms
+    with zero lost requests and a clean post-storm allocator audit
+    (fused silently downgrades to the host arm on mode "0" — the script
+    recomputes the effective arm and asserts the matching observables)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     tag_mode = "dense" if mode in ("", "0") else "paged"
+    if spec not in ("", "0"):
+        tag_mode += "+spec"
+    if fused not in ("", "0"):
+        tag_mode += "+fused"
     with tempfile.TemporaryDirectory() as d:
         jsonl = os.path.join(d, "telemetry.jsonl")
         env = dict(os.environ, TPUMX_TELEMETRY=jsonl, JAX_PLATFORMS="cpu",
                    TPUMX_CHAOS_SEED="20260804", TPUMX_SERVE_DIR=d,
-                   TPUMX_PAGED_DECODE=mode, TPUMX_PREFIX_SHARING="1")
+                   TPUMX_PAGED_DECODE=mode, TPUMX_PREFIX_SHARING="1",
+                   TPUMX_SPECULATIVE=spec, TPUMX_FUSED_DECODE=fused)
         env.pop("TPUMX_CHAOS", None)    # the script arms its own faults
         env.pop("TPUMX_TRACING", None)  # the black boxes need the recorder
         try:
@@ -983,13 +1030,18 @@ def _serve_storm_leg(mode):
 def serve_tier():
     """Run the chaos request storm against the serving runtime in BOTH
     decode modes (dense-gather reference and TPUMX_PAGED_DECODE=1 —
-    ISSUE 9: the self-healing contract is data-plane-independent), then
-    the kernel-parity gate: the forced Pallas kernel (interpret on CPU)
-    must reproduce the dense arm's greedy tokens exactly and its logits
-    within the documented tolerance."""
+    ISSUE 9: the self-healing contract is data-plane-independent), plus
+    the ISSUE 16 legs (fused whole-step arm + TPUMX_SPECULATIVE=1 in
+    both decode modes — on dense the fused knob downgrades to the host
+    arm, which is itself part of the contract), then the kernel-parity
+    gate: the forced Pallas kernel (interpret on CPU) must reproduce
+    the dense arm's greedy tokens exactly — fused on/off and
+    speculative on/off included — and its logits within the documented
+    tolerance."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for mode in ("0", "1"):
-        rc = _serve_storm_leg(mode)
+    for mode, spec, fused in (("0", "0", "0"), ("1", "0", "0"),
+                              ("0", "1", "1"), ("1", "1", "1")):
+        rc = _serve_storm_leg(mode, spec, fused)
         if rc != 0:
             return rc
     env = dict(os.environ, JAX_PLATFORMS="cpu",

@@ -64,6 +64,11 @@ INDEX_FORMAT = "tpumx-lint-index-v1"
 HOT_ROOTS = (
     ("tpu_mx/serving/engine.py", "EngineCore.decode"),
     ("tpu_mx/serving/attention.py", "decode_attention"),
+    # the fused whole-step decode program (ISSUE 16): the step body
+    # itself is jitted, but the dispatch wrapper runs per decode step —
+    # an eager conversion creeping into it would silently reintroduce
+    # the per-step host traffic the fused arm exists to remove
+    ("tpu_mx/serving/jax_model.py", "JaxTinyLM.decode_step"),
     ("tpu_mx/parallel/train_step.py", "CompiledTrainStep.step"),
     ("tpu_mx/parallel/train_step.py", "CompiledTrainStep._step"),
     ("tpu_mx/fusion.py", "flush"),

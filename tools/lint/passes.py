@@ -811,9 +811,10 @@ class HotPathPurityPass(Pass):
 
     Stays silent on: jitted functions and lambdas passed to
     ``jax.jit``/``pallas_call`` (the jit boundary IS the commit point —
-    operands cross on the C++ fast path); conversions inside an
-    ``isinstance``/``hasattr``-tested branch (a guarded fast path
-    exists; only foreign inputs pay) or an ``is None`` branch /
+    operands cross on the C++ fast path); conversions — eager commits
+    AND device readbacks alike — inside an ``isinstance``/``hasattr``-
+    tested branch (the guarded-fallback idiom: a guarded fast path
+    exists, only foreign inputs pay) or an ``is None`` branch /
     ``lru_cache`` function (memoized construction, runs once); and
     everything not reachable from a root.  Findings carry the witness
     call chain from the root.
@@ -961,9 +962,16 @@ class HotPathPurityPass(Pass):
                 "pass the raw operand through the jit boundary instead "
                 f"(C++ fast path){where}")
             return
-        # host readback of a device value: np.asarray(kernel_call(...))
+        # host readback of a device value: np.asarray(kernel_call(...)).
+        # `guarded` exempts the guarded-fallback idiom exactly like the
+        # eager-commit check above: `if not isinstance(out, np.ndarray):
+        # out = np.asarray(out)` is the documented shape for a helper
+        # that serves both host- and device-valued callers — the numpy
+        # fast path pays nothing, only genuinely device-valued results
+        # pay the (deliberate, branch-visible) readback
         if (len(parts) == 2 and parts[0] in np_aliases
-                and parts[1] in ("asarray", "array") and node.args):
+                and parts[1] in ("asarray", "array") and node.args
+                and not guarded):
             arg = node.args[0]
             tainted = False
             if isinstance(arg, ast.Call):

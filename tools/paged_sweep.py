@@ -21,12 +21,22 @@ serve leg persists):
   explicit ``skipped`` disposition so a TPU-less round leaves an honest
   artifact instead of silence.
 
+ISSUE 16 widens the sweep with a **Tq axis**: the speculative verify
+call batches ``Tq`` query positions per sequence into ONE attention
+step, so each (block_size, context, tq) cell now records per-TOKEN
+amortization (``*_us_per_tok``).  Row keys carry the axis
+(``bs{B}_ctx{C}_tq{T}``) and the record is stamped ``record_rev=2``:
+a rev-1 artifact (``bs{B}_ctx{C}`` keys, no tq field) uses a DIFFERENT
+keyspace, so this tool REFUSES to merge into one — rename it or start
+a new TPUMX_ROUND rather than mixing row schemas.
+
 Artifact-protocol semantics (tools/artifact_protocol.py): rows merge on
 rerun, writes are atomic, and a TPU-less run refuses to clobber a
 platform=tpu artifact.
 
     TPUMX_ROUND=r08 python tools/paged_sweep.py \
-        [--block-sizes 8,16,32,64] [--contexts 256,1024] [--batch 4]
+        [--block-sizes 8,16,32,64] [--contexts 256,1024] \
+        [--tq 1,4] [--batch 4]
 """
 from __future__ import annotations
 
@@ -45,6 +55,10 @@ from artifact_protocol import (artifact, load_prior,  # noqa: E402
 
 DEFAULT_BLOCK_SIZES = (8, 16, 32, 64)
 DEFAULT_CONTEXTS = (256, 1024)
+DEFAULT_TQS = (1, 4)
+# rev 2 (ISSUE 16): rows gained the Tq axis — keys are bs{B}_ctx{C}_tq{T}
+# and carry a "tq" field.  Bump on any row-keyspace/schema change.
+RECORD_REV = 2
 
 
 def log(msg):
@@ -58,6 +72,8 @@ def main():
         str(b) for b in DEFAULT_BLOCK_SIZES))
     ap.add_argument("--contexts", default=",".join(
         str(c) for c in DEFAULT_CONTEXTS))
+    ap.add_argument("--tq", default=",".join(str(t) for t in DEFAULT_TQS),
+                    help="query-window widths (speculative verify Tq)")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--dim", type=int, default=16)
@@ -65,6 +81,10 @@ def main():
     args = ap.parse_args()
     block_sizes = [int(b) for b in args.block_sizes.split(",") if b]
     contexts = [int(c) for c in args.contexts.split(",") if c]
+    tqs = [int(t) for t in args.tq.split(",") if t]
+    if any(t < 1 for t in tqs):
+        log(f"--tq must be >= 1, got {tqs}")
+        return 1
 
     import jax
     import bench
@@ -75,8 +95,17 @@ def main():
         log(f"{args.out} holds platform=tpu rows; this {platform} run "
             "refuses to clobber them (artifact protocol)")
         return 1
+    if prior and prior.get("record_rev", 1) != RECORD_REV:
+        # a rev-1 artifact keys rows WITHOUT the tq axis: merging would
+        # mix keyspaces and a later reader could double-count.  Refuse.
+        log(f"{args.out} is record_rev={prior.get('record_rev', 1)} "
+            f"(this tool writes rev {RECORD_REV}, row keys now carry "
+            "the tq axis) — rename the old artifact or start a new "
+            "TPUMX_ROUND instead of mixing row schemas")
+        return 1
 
     record = {
+        "record_rev": RECORD_REV,
         "platform": platform,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "git_head": bench._git_head(),
@@ -98,13 +127,22 @@ def main():
             log(f"block_size={bs}: no usable context (all < 2 blocks), "
                 "skipped")
             continue
-        log(f"block_size={bs}: contexts {usable}")
-        rows = bench.measure_decode_micro(usable, block_size=bs,
-                                          batch=args.batch,
-                                          heads=args.heads, dim=args.dim)
-        for row in rows:
-            record["rows"][f"bs{bs}_ctx{row['context']}"] = row
-            write_atomic(args.out, record)  # row-at-a-time durability
+        for tq in tqs:
+            # every window row needs >= 1 attendable key: ctx > tq
+            win = [c for c in usable if c > tq]
+            if not win:
+                log(f"block_size={bs} tq={tq}: no usable context, "
+                    "skipped")
+                continue
+            log(f"block_size={bs} tq={tq}: contexts {win}")
+            rows = bench.measure_decode_micro(win, block_size=bs,
+                                              batch=args.batch,
+                                              heads=args.heads,
+                                              dim=args.dim, tq=tq)
+            for row in rows:
+                key = f"bs{bs}_ctx{row['context']}_tq{tq}"
+                record["rows"][key] = row
+                write_atomic(args.out, record)  # row-at-a-time durability
 
     # honest disposition for the dense/flash crossover constant
     if platform == "tpu":

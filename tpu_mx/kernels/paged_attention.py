@@ -21,9 +21,13 @@ never round-trips through the host.
 Shape contract (decode-specific, deliberately different from flash's
 `(BH, T, D)` training layout):
 
-- `q`: `(B, H, D)` or `(B, 1, H, D)` — each sequence's single new-token
-  query (the singleton T axis is accepted because that is how a decode
-  batch naturally falls out of a `(B, T, H, D)` model).
+- `q`: `(B, H, D)`, `(B, 1, H, D)` or `(B, Tq, H, D)` — each sequence's
+  new-token queries.  `Tq == 1` is classic one-token decode; a small
+  `Tq > 1` is the speculative **draft window** (ISSUE 16): the queries
+  are the last `Tq` positions of the sequence (query `t` sits at
+  absolute position `lengths[b] - Tq + t`) and the causal mask is
+  applied per row, so one batched `(B, Tq, H, D)` call verifies a whole
+  drafted token window against the same paged pool.
 - `k_pool`/`v_pool`: `(num_blocks, block_size, H, D)` — ONE layer's
   shared block pool.  The last two dims are full-dim blocks, so Mosaic's
   (sublane, lane) tiling sees `(H, D)` exactly.
@@ -67,8 +71,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["paged_attention", "paged_attention_reference", "supported",
-           "DEFAULT_BLOCK_SIZE"]
+__all__ = ["paged_attention", "paged_attention_reference", "window_walk",
+           "supported", "DEFAULT_BLOCK_SIZE"]
 
 NEG_INF = -1e30
 
@@ -86,15 +90,20 @@ def _interpret():
 
 
 def _kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-            acc_scr, *, scale, block_size):
+            acc_scr, *, scale, block_size, tq):
     """One (sequence, kv-block) grid step: flash's online-softmax update
     with the K dimension walking the sequence's block table.
 
-    In-kernel layout is head-major `(H, block_size)` scores so the
+    In-kernel layout is row-major `(Tq*H, block_size)` scores — row
+    `r = t*H + h` is query-window position `t`, head `h` — so the
     running stats mirror flash's `(rows, 128)` scratch pattern with
-    rows = heads.  All score/stat math is f32 regardless of pool dtype;
-    the dots are elementwise-mul + reduce on the VPU — decode attention
-    is memory-bound (1-row queries), the MXU has nothing to chew on."""
+    rows = window × heads (`Tq == 1` reduces to the original head-major
+    layout exactly).  Each row carries its own causal limit: query `t`
+    sits at absolute position `length - Tq + t`, so row `r` admits key
+    positions `< length - (Tq - 1 - t)`.  All score/stat math is f32
+    regardless of pool dtype; the dots are elementwise-mul + reduce on
+    the VPU — decode attention is memory-bound (few-row queries), the
+    MXU has nothing to chew on."""
     b = pl.program_id(0)
     i = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -106,58 +115,64 @@ def _kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
+    # the LAST window row's limit is `length` itself, so the block-skip
+    # guard is unchanged from the Tq=1 kernel
     @pl.when(i * block_size < length)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                   # (H, D)
+        q = q_ref[0].astype(jnp.float32)                   # (Tq, H, D)
         k = k_ref[0].astype(jnp.float32)                   # (BS, H, D)
         v = v_ref[0].astype(jnp.float32)                   # (BS, H, D)
-        # s[h, s'] = q[h, :] . k[s', h, :]  — head-batched 1-row dots
-        s = jnp.sum(q[None, :, :] * k, axis=-1)            # (BS, H)
-        s = s.T * scale                                    # (H, BS)
+        h = q.shape[1]
+        # s[t, h, s'] = q[t, h, :] . k[s', h, :] — head-batched window dots
+        s = jnp.sum(q[:, None, :, :] * k[None, :, :, :], axis=-1)
+        s = s.transpose(0, 2, 1).reshape(tq * h, -1) * scale  # (Tq*H, BS)
         kpos = i * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(kpos < length, s, NEG_INF)
-        m_prev = m_scr[:, 0]                               # (H,)
+        # per-row causal limit: row r = t*H + h_ admits kpos < length -
+        # (Tq - 1 - t); at Tq=1 this is exactly `kpos < length`
+        row_t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // h
+        s = jnp.where(kpos < length - (tq - 1) + row_t, s, NEG_INF)
+        m_prev = m_scr[:, 0]                               # (Tq*H,)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur[:, None])                    # (H, BS)
+        p = jnp.exp(s - m_cur[:, None])                    # (Tq*H, BS)
         l_scr[:] = jnp.broadcast_to(
             (l_scr[:, 0] * alpha + jnp.sum(p, axis=1))[:, None],
             l_scr.shape)
-        # acc[h, d] += sum_s' p[h, s'] * v[s', h, d]
+        # acc[t*H + h_, d] += sum_s' p[t*H + h_, s'] * v[s', h_, d]
+        p3 = p.reshape(tq, h, -1).transpose(2, 0, 1)       # (BS, Tq, H)
         acc_scr[:] = acc_scr[:] * alpha[:, None] + jnp.sum(
-            p.T[:, :, None] * v, axis=0)
+            p3[:, :, :, None] * v[:, None, :, :], axis=0).reshape(
+            tq * h, -1)
         m_scr[:] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
 
     @pl.when(i == nb - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
-        o_ref[0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / l_safe[:, None]).reshape(
+            o_ref.shape[1:]).astype(o_ref.dtype)
 
 
 def _normalize_q(q):
-    """Accept (B, H, D) or (B, 1, H, D); return (B, H, D) + had_t flag.
-    Shape-only: no host->device conversion happens here — operands flow
-    into the jitted/pallas call as-is, so a numpy caller pays one
-    C++-fast-path commit per call instead of an eager convert op per
-    operand (~73us each on this host, measured — it dominated the
-    per-step decode cost at short context)."""
+    """Accept (B, H, D) or (B, Tq, H, D); return (B, Tq, H, D) + had_t
+    flag (was the caller's q 4-d already).  Shape-only: no host->device
+    conversion happens here — operands flow into the jitted/pallas call
+    as-is, so a numpy caller pays one C++-fast-path commit per call
+    instead of an eager convert op per operand (~73us each on this
+    host, measured — it dominated the per-step decode cost at short
+    context).  A 3-d reshape is a view on both numpy and jax arrays."""
     if not hasattr(q, "ndim"):
         q = np.asarray(q)
     if q.ndim == 4:
-        if q.shape[1] != 1:
-            raise ValueError(
-                f"paged_attention: 4-d q must be (B, 1, H, D) — decode is "
-                f"one token per sequence; got {q.shape}")
-        return q[:, 0], True
+        return q, True
     if q.ndim != 3:
         raise ValueError(f"paged_attention: q must be (B, H, D) or "
-                         f"(B, 1, H, D), got shape {q.shape}")
-    return q, False
+                         f"(B, Tq, H, D), got shape {q.shape}")
+    return q.reshape(q.shape[0], 1, *q.shape[1:]), False
 
 
 def _check_operands(q, k_pool, v_pool, block_tables, lengths):
-    b, h, d = q.shape
+    b, tq, h, d = q.shape
     if k_pool.ndim != 4 or k_pool.shape != v_pool.shape:
         raise ValueError(
             f"paged_attention: pools must be matching (num_blocks, "
@@ -177,36 +192,39 @@ def _check_operands(q, k_pool, v_pool, block_tables, lengths):
 
 
 @functools.lru_cache(maxsize=128)
-def _kernel_call(b, nb, block_size, h, d, out_dtype, scale, interpret):
+def _kernel_call(b, nb, block_size, tq, h, d, out_dtype, scale, interpret):
     """Build (once per static geometry) the jitted pallas_call for one
     decode shape.  The decode hot path calls this kernel once per layer
     per token — an uncached eager pallas_call would re-trace (and on a
     TPU backend re-lower through Mosaic) every single call, which would
     dwarf the O(blocks-visited) work the kernel exists to deliver.  The
     jit wrapper carries the compilation cache; the lru key is exactly
-    the set of values baked into the trace."""
+    the set of values baked into the trace (the draft-window width `tq`
+    included — each window width is its own grid geometry)."""
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,           # (block_tables, lengths)
         grid=(b, nb),
         in_specs=[
-            pl.BlockSpec((1, h, d), lambda sb, i, tab, lens: (sb, 0, 0)),
+            pl.BlockSpec((1, tq, h, d),
+                         lambda sb, i, tab, lens: (sb, 0, 0, 0)),
             pl.BlockSpec((1, block_size, h, d),
                          lambda sb, i, tab, lens: (tab[sb, i], 0, 0, 0)),
             pl.BlockSpec((1, block_size, h, d),
                          lambda sb, i, tab, lens: (tab[sb, i], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, h, d),
-                               lambda sb, i, tab, lens: (sb, 0, 0)),
+        out_specs=pl.BlockSpec((1, tq, h, d),
+                               lambda sb, i, tab, lens: (sb, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((h, 128), jnp.float32),   # running max
-            pltpu.VMEM((h, 128), jnp.float32),   # running denom
-            pltpu.VMEM((h, d), jnp.float32),     # output accumulator
+            pltpu.VMEM((tq * h, 128), jnp.float32),   # running max
+            pltpu.VMEM((tq * h, 128), jnp.float32),   # running denom
+            pltpu.VMEM((tq * h, d), jnp.float32),     # output accumulator
         ],
     )
     return jax.jit(pl.pallas_call(
-        functools.partial(_kernel, scale=scale, block_size=block_size),
+        functools.partial(_kernel, scale=scale, block_size=block_size,
+                          tq=tq),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), jnp.dtype(out_dtype)),
+        out_shape=jax.ShapeDtypeStruct((b, tq, h, d), jnp.dtype(out_dtype)),
         interpret=interpret,
     ))
 
@@ -214,7 +232,7 @@ def _kernel_call(b, nb, block_size, h, d, out_dtype, scale, interpret):
 def paged_attention(q, k_pool, v_pool, block_tables, lengths, scale=None):
     """Decode attention over a paged KV pool (see module docstring).
 
-    Returns `(B, H, D)` (or `(B, 1, H, D)` matching a 4-d `q`) in
+    Returns `(B, H, D)` (or `(B, Tq, H, D)` matching a 4-d `q`) in
     `q.dtype`.  `block_tables` entries beyond each row's real blocks
     must be valid pool indices (0-padding per the cache contract);
     `lengths` masks them out exactly."""
@@ -222,14 +240,14 @@ def paged_attention(q, k_pool, v_pool, block_tables, lengths, scale=None):
     block_tables = _as_i32(block_tables)
     lengths = _as_i32(lengths)
     _check_operands(q, k_pool, v_pool, block_tables, lengths)
-    b, h, d = q.shape
+    b, tq, h, d = q.shape
     block_size = k_pool.shape[1]
     nb = block_tables.shape[1]
     scale = 1.0 / math.sqrt(d) if scale is None else scale
-    fn = _kernel_call(b, nb, block_size, h, d, jnp.dtype(q.dtype).name,
+    fn = _kernel_call(b, nb, block_size, tq, h, d, jnp.dtype(q.dtype).name,
                       float(scale), _interpret())
     out = fn(block_tables, lengths, q, k_pool, v_pool)
-    return out[:, None] if had_t else out
+    return out if had_t else out[:, 0]
 
 
 def _as_i32(x):
@@ -241,46 +259,59 @@ def _as_i32(x):
     return x if x.dtype == jnp.int32 else x.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("scale",))
-def _reference_impl(q, k_pool, v_pool, block_tables, lengths, scale):
+def window_walk(q, k_pool, v_pool, block_tables, lengths, scale):
     """The kernel's block walk as lax.scan + per-block dynamic indexing,
-    vmapped over the batch.  NOT a gather-then-softmax: materializing
-    the padded `(B, Lmax, H, D)` batch in-program and re-reading it
-    through the einsum/softmax passes measured ~3x slower at bench
-    contexts on the CPU backend — the online-softmax walk reads each
-    pool byte once, exactly like the Pallas grid does."""
-    b, h, d = q.shape
+    vmapped over the batch — plain traceable jax, so the fused decode
+    step (serving/jax_model.py) can inline it into ITS jitted program
+    against the donated pool without a nested dispatch boundary.
+
+    `q` is the canonical `(B, Tq, H, D)` window; returns the same
+    shape.  NOT a gather-then-softmax: materializing the padded
+    `(B, Lmax, H, D)` batch in-program and re-reading it through the
+    einsum/softmax passes measured ~3x slower at bench contexts on the
+    CPU backend — the online-softmax walk reads each pool byte once,
+    exactly like the Pallas grid does."""
+    b, tq, h, d = q.shape
     bs = k_pool.shape[1]
     qf = q.astype(jnp.float32)
 
     def one_row(tab, length, qr):
+        # query t sits at absolute position length - Tq + t -> admits
+        # key positions strictly below length - (Tq - 1 - t)
+        limit = length - (tq - 1) + jnp.arange(tq, dtype=jnp.int32)
+
         def step(carry, bid):
             m, l, acc, i = carry
             k = jax.lax.dynamic_index_in_dim(k_pool, bid, 0,
                                              keepdims=False)
             v = jax.lax.dynamic_index_in_dim(v_pool, bid, 0,
                                              keepdims=False)
-            s = jnp.einsum("hd,shd->hs", qr,
+            s = jnp.einsum("thd,shd->ths", qr,
                            k.astype(jnp.float32)) * scale
             kpos = i * bs + jnp.arange(bs, dtype=jnp.int32)
-            s = jnp.where(kpos[None, :] < length, s, NEG_INF)
-            m_cur = jnp.maximum(m, jnp.max(s, axis=1))
+            s = jnp.where(kpos[None, None, :] < limit[:, None, None],
+                          s, NEG_INF)
+            m_cur = jnp.maximum(m, jnp.max(s, axis=2))
             alpha = jnp.exp(m - m_cur)
-            p = jnp.exp(s - m_cur[:, None])
-            l = l * alpha + jnp.sum(p, axis=1)
-            acc = acc * alpha[:, None] + jnp.einsum(
-                "hs,shd->hd", p, v.astype(jnp.float32))
+            p = jnp.exp(s - m_cur[:, :, None])
+            l = l * alpha + jnp.sum(p, axis=2)
+            acc = acc * alpha[:, :, None] + jnp.einsum(
+                "ths,shd->thd", p, v.astype(jnp.float32))
             return (m_cur, l, acc, i + 1), None
 
-        init = (jnp.full((h,), NEG_INF, jnp.float32),
-                jnp.zeros((h,), jnp.float32),
-                jnp.zeros((h, d), jnp.float32), jnp.int32(0))
+        init = (jnp.full((tq, h), NEG_INF, jnp.float32),
+                jnp.zeros((tq, h), jnp.float32),
+                jnp.zeros((tq, h, d), jnp.float32), jnp.int32(0))
         (_, l, acc, _), _ = jax.lax.scan(step, init, tab)
-        return acc / jnp.maximum(l, 1e-30)[:, None]
+        return acc / jnp.maximum(l, 1e-30)[:, :, None]
 
     # output cast happens in-trace (free at dispatch time): the decode
     # contract is out.dtype == q.dtype on every arm
     return jax.vmap(one_row)(block_tables, lengths, qf).astype(q.dtype)
+
+
+_reference_impl = functools.partial(jax.jit, static_argnames=("scale",))(
+    window_walk)
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, lengths,
@@ -297,7 +328,7 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, lengths,
     _check_operands(q, k_pool, v_pool, block_tables, lengths)
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else float(scale)
     out = _reference_impl(q, k_pool, v_pool, block_tables, lengths, scale)
-    return out[:, None] if had_t else out
+    return out if had_t else out[:, 0]
 
 
 def supported(head_dim, dtype, block_size=DEFAULT_BLOCK_SIZE):

@@ -218,12 +218,15 @@ def _paged_decode(q, cache, seq_ids, layer, kind, batch=None):
         jax.default_backend() == "tpu"
         and _pk.supported(q.shape[-1], q_in.dtype, cache.block_size))
     fn = _pk.paged_attention if use_kernel else _pk.paged_attention_reference
-    # tpumx-lint: disable=hot-path-purity -- the ONE deliberate host
-    # readback per layer: the TinyLM reference model is host-resident
-    # numpy, so the kernel's output must come home for layer_combine
-    # (docs/DIVERGENCES.md #27 — a fully device-resident forward is the
-    # ROADMAP serving-v3 item; when that lands, this line goes with it)
-    out = np.asarray(fn(q_in, kp, vp, tables, lengths))
+    # the host-resident arm's one readback per layer (the numpy
+    # reference model needs the kernel's output home for layer_combine)
+    # sits behind the guarded-fallback idiom — ISSUE 16 retired the
+    # justified suppression that used to live here, and the FUSED arm
+    # (serving/jax_model.py) removes the readback entirely: the whole
+    # step is one device program and only sampled tokens come home
+    out = fn(q_in, kp, vp, tables, lengths)
+    if not isinstance(out, np.ndarray):
+        out = np.asarray(out)
     return out[:b]
 
 
@@ -232,15 +235,18 @@ def decode_attention(q, cache, seq_ids, layer, kind=None, batch=None):
     paged cache.
 
     ``q``: (B, H, D) — each sequence's single new-token query, the new
-    token's K/V already written at position length-1; ``cache``: the
-    :class:`~tpu_mx.serving.kv_cache.PagedKVCache`; ``seq_ids``: the
-    batch's sequence ids in row order; ``layer``: the layer whose pool
-    to read.  ``kind`` pins the arm (an engine resolves the env knob
-    once per generation so a black box records one truth); defaults to
-    :func:`resolve_decode_path`.  ``batch``: optional precomputed
-    ``cache.batch_tables(seq_ids)`` result for the paged arms — the
-    tables are layer-invariant within a step, so per-layer callers
-    build them once.  Returns (B, H, D).
+    token's K/V already written at position length-1 — or (B, Tq, H, D),
+    a speculative draft WINDOW (ISSUE 16): the last Tq positions'
+    queries, every drafted slot's K/V already written, per-row causal
+    masking (query t sits at absolute position length - Tq + t).
+    ``cache``: the :class:`~tpu_mx.serving.kv_cache.PagedKVCache`;
+    ``seq_ids``: the batch's sequence ids in row order; ``layer``: the
+    layer whose pool to read.  ``kind`` pins the arm (an engine resolves
+    the env knob once per generation so a black box records one truth);
+    defaults to :func:`resolve_decode_path`.  ``batch``: optional
+    precomputed ``cache.batch_tables(seq_ids)`` result for the paged
+    arms — the tables are layer-invariant within a step, so per-layer
+    callers build them once.  Returns q's shape.
 
     Every call counts ``serve.decode_attention{kind=...}`` — the
     observable that says which arm a production decode actually took."""
@@ -248,7 +254,14 @@ def decode_attention(q, cache, seq_ids, layer, kind=None, batch=None):
     q = np.asarray(q)
     if kind == "dense":
         kd, vd, lens = cache.gather_batch(seq_ids, layer)
-        out = dense_decode_attention(q, kd, vd, lens)
+        if q.ndim == 4:
+            # the window arm: dense_attention's causal alignment (last
+            # query at the last valid key) IS the draft window's per-row
+            # mask; the Tq == 1 call below stays byte-for-byte the
+            # pre-speculative path
+            out = dense_attention(q, kd, vd, lengths=lens, causal=True)
+        else:
+            out = dense_decode_attention(q, kd, vd, lens)
     else:
         out = _paged_decode(q, cache, seq_ids, layer, kind, batch=batch)
     _telemetry.counter("serve.decode_attention", kind=kind).inc()

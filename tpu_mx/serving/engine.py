@@ -6,18 +6,32 @@ PagedKVCache` and runs two operations for the server:
 - :meth:`prefill` — one sequence's whole prompt: the model computes every
   layer's K/V (flash kernel on supported TPU shapes), the cache is
   bulk-filled in one call, and the first generated token comes back.
-- :meth:`decode` — ONE token for a whole batch of sequences: reserve the
-  O(1) cache slot per sequence, then interleave the model's layer loop
-  with per-layer batched cache writes and block-table attention
-  (``decode_attention`` — the paged kernel or the dense-gather reference
-  arm, picked ONCE per engine generation from ``TPUMX_PAGED_DECODE`` so
-  a restarted engine's black box records which path it was on via the
-  ``serve.decode_path`` event; docs/DIVERGENCES.md #27).  A paged engine
-  builds its cache with ``storage="device"`` — the pool lives on the
-  accelerator and decode never round-trips it through the host.
-  Sequences whose slot reservation hits :class:`CacheExhausted` are
-  returned as *preempted* — the scheduler requeues them; the rest of
-  the batch proceeds.  Never OOM.
+- :meth:`decode` — ONE decode step for a whole batch of sequences:
+  reserve each sequence's draft-window slots (``reserve_window`` — the
+  O(1) append, window width from ``TPUMX_SPECULATIVE``), then run the
+  window forward through one of two arms picked ONCE per engine
+  generation (recorded on the ``serve.decode_path`` event so a
+  restarted engine's black box says which path it was on):
+
+  * **host-resident** (default): the model's numpy layer loop
+    interleaved with per-layer batched cache writes and block-table
+    attention (``decode_attention`` — the paged kernel or the
+    dense-gather reference arm per ``TPUMX_PAGED_DECODE``;
+    docs/DIVERGENCES.md #27).
+  * **fused** (``TPUMX_FUSED_DECODE=1`` on a paged engine): the ENTIRE
+    step — embed, every layer, paged attention, logits, sampling — is
+    one jitted device program with donated pool buffers
+    (serving/jax_model.py); only sampled token ids cross back.
+
+  With speculation on, the proposer drafts ``K-1`` tokens, the step
+  verifies the whole window in one batched call, and each row's
+  agreeing prefix is accepted (rejected tail slots truncated) — greedy
+  streams bit-identical speculative on/off (serving/speculative.py).
+  A paged engine builds its cache with ``storage="device"`` — the pool
+  lives on the accelerator and decode never round-trips it through the
+  host.  Sequences whose slot reservation hits :class:`CacheExhausted`
+  are returned as *preempted* — the scheduler requeues them; the rest
+  of the batch proceeds.  Never OOM.
 
 Fault surface (what the server's watchdog/sentinel wrap): the chaos
 ``slow_decode_step`` injection fires at the top of :meth:`decode` —
@@ -43,11 +57,15 @@ import time
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from .. import tracing as _tracing
 from ..contrib import chaos as _chaos
 from ..supervisor import NumericDivergence
 from .attention import decode_attention, resolve_decode_path
-from .kv_cache import CacheExhausted, PagedKVCache, prefix_sharing_enabled
+from .jax_model import JaxTinyLM, resolve_fused
+from .kv_cache import (CacheExhausted, PagedKVCache, _next_pow2,
+                       prefix_sharing_enabled)
+from .speculative import SiblingProposer, accept_prefix, resolve_spec_window
 
 __all__ = ["EngineCore"]
 
@@ -57,26 +75,54 @@ class EngineCore:
     (tpu_mx/serving/model.py); cache geometry comes from it."""
 
     def __init__(self, model, block_size=16, num_blocks=256,
-                 dtype=np.float32, share_prefix=None, forensics=None):
+                 dtype=np.float32, share_prefix=None, forensics=None,
+                 warm_batch=None):
         self.model = model
         # the decode arm is resolved ONCE per engine generation: a knob
         # flip mid-flight cannot leave half a batch on each path, and
         # the serve.decode_path event below is the black box's record of
-        # which arm a (possibly restarted) engine was on.  The sharing
-        # knob resolves the same way (TPUMX_PREFIX_SHARING unless pinned
-        # by the caller) and rides the same event for the same reason.
+        # which arm a (possibly restarted) engine was on.  The sharing,
+        # fused-step and speculative knobs resolve the same way
+        # (TPUMX_PREFIX_SHARING / TPUMX_FUSED_DECODE / TPUMX_SPECULATIVE
+        # unless pinned by the caller) and ride the same event for the
+        # same reason.
         self.decode_kind = resolve_decode_path()
         if share_prefix is None:
             share_prefix = prefix_sharing_enabled()
         self.share_prefix = bool(share_prefix)
+        self.spec_window = resolve_spec_window()
+        self.fused = resolve_fused(self.decode_kind, model)
         storage = "device" if self.decode_kind != "dense" else "host"
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
             block_size=block_size, num_blocks=num_blocks, dtype=dtype,
             storage=storage, share_prefix=self.share_prefix,
             forensics=forensics)
+        if self.fused:
+            import jax
+            from ..kernels import paged_attention as _pk
+            use_kernel = self.decode_kind == "paged-kernel" or (
+                jax.default_backend() == "tpu"
+                and _pk.supported(model.head_dim, dtype,
+                                  self.cache.block_size))
+            self.jax_model = JaxTinyLM(model, use_kernel=use_kernel)
+            if warm_batch:
+                # compile the batch buckets NOW, outside the server's
+                # watchdog deadline: a first-bucket compile mid-serving
+                # (~0.6s even for the test model) reads as a wedged
+                # dispatch and can cascade into a spurious restart
+                self.jax_model.warm(self.cache, int(warm_batch),
+                                    self.spec_window)
+        else:
+            self.jax_model = None
+        self.proposer = (SiblingProposer(model) if self.spec_window > 1
+                         else None)
         _tracing.emit("serve.decode_path", path=self.decode_kind,
-                      storage=storage, sharing=self.share_prefix)
+                      storage=storage, sharing=self.share_prefix,
+                      fused=self.fused, spec_window=self.spec_window)
+        # cumulative speculative accounting for the accept-ratio gauge
+        self._spec_drafted = 0
+        self._spec_accepted = 0
 
     # -- prefill -------------------------------------------------------------
     def prefill(self, req):
@@ -129,14 +175,27 @@ class EngineCore:
 
     # -- decode --------------------------------------------------------------
     def decode(self, items):
-        """One token for each ``(req, last_token)`` in ``items``.
+        """One decode STEP for each ``(req, last_token)`` in ``items`` —
+        one to ``spec_window`` tokens per request.
 
         Returns ``(results, preempted)``: ``results`` maps request id →
-        next token for every sequence that decoded; ``preempted`` lists
-        the requests evicted to make room — the scheduler requeues them
-        (re-run), the rest of the batch proceeds.  Raises
-        :class:`NumericDivergence` on non-finite logits (real or
-        chaos-poisoned).
+        the LIST of tokens this step produced, in stream order, for
+        every sequence that decoded (always at least one; up to
+        ``spec_window`` when speculation accepts drafted tokens);
+        ``preempted`` lists the requests evicted to make room — the
+        scheduler requeues them (re-run), the rest of the batch
+        proceeds.  Raises :class:`NumericDivergence` on non-finite
+        logits (real or chaos-poisoned).
+
+        The step reserves each sequence's whole draft window up front
+        (``reserve_window`` — all-or-nothing, so preemption semantics
+        are unchanged), runs ONE batched forward over the ``(B, K)``
+        window through either the fused device program
+        (serving/jax_model.py) or the host-resident layer loop, then
+        accepts each row's agreeing draft prefix and truncates the
+        rejected tail's cache slots.  Greedy verification makes the
+        emitted stream bit-identical to one-token-at-a-time decode
+        (serving/speculative.py).
 
         Preemption picks FINISHED batch members first (static-batching
         padding slots — their cache is pure waste and their handles are
@@ -154,13 +213,14 @@ class EngineCore:
         preemption (``items`` arrive in admission order from the
         scheduler)."""
         _chaos.maybe_slow_decode()
+        k = self.spec_window
         live, preempted = [], []
         remaining = [(req, int(last)) for req, last in items]
         while remaining:
             req, last = remaining.pop(0)
             while True:
                 try:
-                    self.cache.reserve(req.id)
+                    self.cache.reserve_window(req.id, k)
                     live.append((req, last))
                     break
                 except CacheExhausted:
@@ -183,31 +243,138 @@ class EngineCore:
                         break
         if not live:
             return {}, preempted
-        tokens = np.array([t for _, t in live], np.int64)
-        # the reserved slot IS the new token's position (length - 1)
-        positions = np.array(
-            [self.cache.length(r.id) - 1 for r, _ in live], np.int64)
+        b = len(live)
         seq_ids = [r.id for r, _ in live]
-        h = self.model.embed(tokens, positions)
-        # block tables are layer-invariant within a step (the slots were
-        # reserved above): build them once, not once per layer
-        batch = (self.cache.batch_tables(seq_ids)
-                 if self.decode_kind != "dense" else None)
-        for i in range(self.model.num_layers):
-            q, k, v = self.model.layer_qkv(i, h)
-            self.cache.write_batch(seq_ids, i, k, v)
-            attn = decode_attention(q, self.cache, seq_ids, i,
-                                    kind=self.decode_kind, batch=batch)
-            h = self.model.layer_combine(i, h, attn)
-        logits = self.model.logits(h)
-        health = _chaos.poison_loss(float(np.max(np.abs(logits))))
+        # the reserved window's slots ARE positions length-K .. length-1
+        lengths_now = np.array(
+            [self.cache.length(s) for s in seq_ids], np.int64)
+        base_pos = lengths_now - k
+        draft = np.empty((b, k), np.int64)
+        draft[:, 0] = [t for _, t in live]
+        if k > 1:
+            draft[:, 1:] = self.proposer.draft(draft[:, 0], base_pos,
+                                               k - 1)
+        positions = base_pos[:, None] + np.arange(k)
+        if self.fused:
+            out, health, crossings = self._fused_step(seq_ids, draft,
+                                                      positions)
+        else:
+            out, health, crossings = self._host_step(seq_ids, draft,
+                                                     positions)
+        health = _chaos.poison_loss(health)
         if not math.isfinite(health):
             raise NumericDivergence(
                 f"serving: non-finite logits in decode batch of "
                 f"{len(live)} (health={health}) — restarting the engine")
-        out = np.argmax(logits, axis=-1)
-        return ({req.id: int(out[b]) for b, (req, _) in enumerate(live)},
-                preempted)
+        results = {}
+        emitted_total = 0
+        accepted_total = 0
+        for bi, (req, _) in enumerate(live):
+            a = accept_prefix(draft[bi], out[bi])
+            if a + 1 < k:
+                # rejected tail: the bookkeeping must match the
+                # accepted stream NOW (the next window overwrites the
+                # pool slots either way)
+                self.cache.truncate(req.id,
+                                    int(lengths_now[bi]) - (k - 1 - a))
+            results[req.id] = [int(t) for t in out[bi, :a + 1]]
+            accepted_total += a
+            emitted_total += a + 1
+        if k > 1:
+            self._spec_drafted += (k - 1) * b
+            self._spec_accepted += accepted_total
+            _telemetry.counter("serve.spec_drafted").inc((k - 1) * b)
+            if accepted_total:
+                _telemetry.counter("serve.spec_accepted").inc(
+                    accepted_total)
+            _telemetry.gauge("serve.spec_accept_ratio").set(
+                self._spec_accepted / self._spec_drafted)
+        # the O(1)-vs-O(layers) receipt (ISSUE 16): fused decode crosses
+        # the host<->device boundary a CONSTANT 3 times per step
+        # (operand commit, sampled tokens, health scalar); the
+        # host-resident paged arm pays 4 per layer (two pool-write
+        # commits, the query commit, the attention readback); dense is
+        # pure host compute
+        if crossings:
+            _telemetry.counter("serve.host_crossings").inc(crossings)
+        _telemetry.gauge("serve.host_crossings_per_token").set(
+            crossings / emitted_total)
+        return results, preempted
+
+    def _host_step(self, seq_ids, draft, positions):
+        """The host-resident forward: numpy embed/QKV/combine
+        interleaved with per-layer batched cache writes and decode
+        attention.  ``K == 1`` is byte-for-byte the pre-speculative
+        decode step; a wider window runs the same layer loop over the
+        flattened ``(B*K, E)`` hidden batch with window writes and the
+        per-row-causal widened attention.  Returns ``(out tokens
+        (B, K), health, host crossings)``."""
+        b, k = draft.shape
+        model = self.model
+        # block tables are layer-invariant within a step (the slots were
+        # reserved above): build them once, not once per layer
+        batch = (self.cache.batch_tables(seq_ids)
+                 if self.decode_kind != "dense" else None)
+        if k == 1:
+            h = model.embed(draft[:, 0], positions[:, 0])
+            for i in range(model.num_layers):
+                q, kk, vv = model.layer_qkv(i, h)
+                self.cache.write_batch(seq_ids, i, kk, vv)
+                attn = decode_attention(q, self.cache, seq_ids, i,
+                                        kind=self.decode_kind,
+                                        batch=batch)
+                h = model.layer_combine(i, h, attn)
+            logits = model.logits(h)
+            out = np.argmax(logits, axis=-1)[:, None]
+        else:
+            h = model.embed(draft.reshape(-1), positions.reshape(-1))
+            hd = (model.num_heads, model.head_dim)
+            for i in range(model.num_layers):
+                q, kk, vv = model.layer_qkv(i, h)
+                self.cache.write_window(seq_ids, i,
+                                        kk.reshape(b, k, *hd),
+                                        vv.reshape(b, k, *hd))
+                attn = decode_attention(q.reshape(b, k, *hd),
+                                        self.cache, seq_ids, i,
+                                        kind=self.decode_kind,
+                                        batch=batch)
+                h = model.layer_combine(i, h, attn.reshape(b * k, *hd))
+            logits = model.logits(h).reshape(b, k, -1)
+            out = np.argmax(logits, axis=-1)
+        crossings = (0 if self.decode_kind == "dense"
+                     else 4 * model.num_layers)
+        return out, float(np.max(np.abs(logits))), crossings
+
+    def _fused_step(self, seq_ids, draft, positions):
+        """The fused arm: pad the batch to a power of two (dummy rows:
+        zero tables, length 1, scatter coordinates at ``num_blocks`` so
+        ``mode="drop"`` discards their pool writes — the jax_model
+        padding contract) and run the whole window through ONE jitted
+        device program with donated pools.  Returns ``(out tokens
+        (B, K), health, host crossings)`` — crossings is the constant
+        3 however many layers the model has."""
+        b, k = draft.shape
+        tables, lengths = self.cache.batch_tables(seq_ids)
+        bids, offs = self.cache.window_slots(seq_ids, k)
+        bpad = _next_pow2(b)
+        if bpad != b:
+            pad = bpad - b
+            draft = np.concatenate(
+                [draft, np.zeros((pad, k), draft.dtype)])
+            positions = np.concatenate(
+                [positions, np.zeros((pad, k), positions.dtype)])
+            tables = np.concatenate(
+                [tables, np.zeros((pad, tables.shape[1]), tables.dtype)])
+            lengths = np.concatenate(
+                [lengths, np.ones(pad, lengths.dtype)])
+            bids = np.concatenate(
+                [bids, np.full((pad, k), self.cache.allocator.num_blocks,
+                               np.int32)])
+            offs = np.concatenate([offs, np.zeros((pad, k), np.int32)])
+        toks, health = self.jax_model.decode_step(
+            self.cache, draft, positions, tables, lengths, bids, offs)
+        _telemetry.counter("serve.fused_steps").inc()
+        return toks[:b], health, 3
 
     def _pick_victim(self, remaining):
         """Index into ``remaining`` of the preemption victim: lowest

@@ -1,0 +1,271 @@
+"""JaxTinyLM: the whole decode step as ONE jitted device program.
+
+PR 9 made decode *attention* native (the Pallas paged kernel and its
+jitted XLA twin), but the model forward stayed host-resident numpy:
+``embed``/``layer_qkv``/``layer_combine``/``logits`` crossed the
+host<->device boundary per layer per token (docs/DIVERGENCES.md #27) —
+O(layers) eager dispatches fencing in the kernel's win.  This module is
+the serving-v3 answer (ISSUE 16): a transformer whose ENTIRE decode
+step — embed, every layer's QKV projections, the paged-attention walk
+against the device-resident KV pool, residual/combine, logits and
+greedy/top-k sampling — is one ``jax.jit`` program with the cache pools
+passed in as **donated** buffers and written by in-program scatters.
+Only the sampled token ids (and one health scalar) ever cross back to
+the host: O(1) crossings per step, however many layers the model has.
+
+Weights import straight from a host :class:`~tpu_mx.serving.model.
+TinyLM` — same seed, same matrices — so the fused program's greedy
+streams are checkable against the numpy reference bit-for-bit (the CI
+serve tier gates fused-vs-host stream equality; tests/test_serving.py
+pins it per step).
+
+The query axis is a window: ``tokens`` is ``(B, Tq)``, so the same
+program that decodes one token per sequence (``Tq == 1``) verifies a
+speculative draft window (``Tq > 1`` — serving/speculative.py) in one
+batched call, with the widened kernel applying the per-row causal
+mask (kernels/paged_attention.py).
+
+Pool-donation contract: :meth:`JaxTinyLM.decode_step` takes the cache's
+pool handles (``PagedKVCache.pools``), CONSUMES them (donation makes
+the in-program scatter genuinely in-place), and installs the returned
+buffers (``adopt_pools``).  Anything holding a pre-step handle is stale
+by the cache's own step-thread-ownership rule.
+
+Batch-padding contract (the engine's job): dummy rows carry
+``lengths == 1`` and scatter coordinates ``bids == num_blocks`` — out
+of range, so ``mode="drop"`` makes their pool writes no-ops — and the
+health scalar only reduces over rows with ``lengths >= 2`` (every real
+decode row has at least prompt + reserved slot), so a dummy row's
+finite garbage can neither clobber block 0 nor trip the NaN sentinel.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["JaxTinyLM", "fused_requested", "resolve_fused"]
+
+_FUSED_ENV = "TPUMX_FUSED_DECODE"
+
+
+def fused_requested():
+    """The fused-step knob's raw request: ``TPUMX_FUSED_DECODE`` unset/
+    ``0``/``off`` means the host-resident arm, ``1``/``on``/``auto``
+    requests the fused device program.  Unknown values raise — the same
+    loud-config discipline as ``TPUMX_PAGED_DECODE`` (a typo silently
+    falling back would let a "fused parity" run pass without ever
+    executing the fused program)."""
+    v = os.environ.get(_FUSED_ENV, "0").strip().lower()
+    if v in ("", "0", "off", "no", "host"):
+        return False
+    if v in ("1", "on", "auto", "yes", "fused"):
+        return True
+    raise ValueError(
+        f"{_FUSED_ENV}={v!r} is not a recognized decode arm — use 0 "
+        "(host-resident forward) or 1 (whole-step fused device program)")
+
+
+def resolve_fused(decode_kind, model):
+    """Whether THIS engine generation runs the fused arm: requested via
+    the env knob, AND the decode arm is paged (the fused program needs
+    the device-resident pool — a dense engine has host pools), AND the
+    model's weights are importable (:meth:`JaxTinyLM.compatible`).  The
+    downgrades mirror ``resolve_decode_path``'s jax-availability
+    downgrade: resolved once per generation, recorded on the
+    ``serve.decode_path`` event's ``fused`` field."""
+    if not fused_requested():
+        return False
+    if decode_kind == "dense":
+        return False
+    return JaxTinyLM.compatible(model)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_step(num_layers, vocab, num_heads, head_dim, use_kernel,
+                top_k):
+    """Build (once per static geometry) the fused decode-step program.
+
+    Static args are baked into the trace; ``jax.jit`` itself caches one
+    executable per operand-shape set on top (batch bucket, table width,
+    window width), so the decode hot loop never re-traces.  The pools
+    (argnums 1/2) are DONATED: the scatter that writes the window's K/V
+    reuses their buffers instead of copying the whole pool per step."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import paged_attention as _pk
+
+    scale = 1.0 / math.sqrt(head_dim)
+
+    def step(params, kps, vps, tokens, positions, tables, lengths,
+             bids, offs, key):
+        b, tq = tokens.shape
+        embed_dim = num_heads * head_dim
+        h = (params["tok_emb"][tokens % vocab]
+             + params["pos_emb"][positions])               # (B, Tq, E)
+        new_kps, new_vps = [], []
+        for i in range(num_layers):
+            q = (h @ params["wq"][i]).reshape(
+                b, tq, num_heads, head_dim)
+            k = (h @ params["wk"][i]).reshape(
+                b, tq, num_heads, head_dim)
+            v = (h @ params["wv"][i]).reshape(
+                b, tq, num_heads, head_dim)
+            # in-program donated index update — the kv_cache write_*
+            # jit family's scatter, fused into the step program.  Dummy
+            # rows scatter at bids == num_blocks: dropped, never block 0
+            kp = kps[i].at[bids, offs].set(
+                k.astype(kps[i].dtype), mode="drop")
+            vp = vps[i].at[bids, offs].set(
+                v.astype(vps[i].dtype), mode="drop")
+            new_kps.append(kp)
+            new_vps.append(vp)
+            if use_kernel:
+                fn = _pk._kernel_call(
+                    b, tables.shape[1], kp.shape[1], tq, num_heads,
+                    head_dim, "float32", scale, _pk._interpret())
+                attn = fn(tables, lengths, q, kp, vp)
+            else:
+                attn = _pk.window_walk(q, kp, vp, tables, lengths,
+                                       scale)
+            h = jnp.tanh(h + attn.reshape(b, tq, embed_dim)
+                         @ params["wo"][i])
+        logits = h @ params["w_out"]                       # (B, Tq, V)
+        if top_k > 1:
+            # Gumbel-max over the top-k slice: one categorical draw per
+            # (row, window position) without materializing a host RNG
+            vals, idxs = jax.lax.top_k(logits, top_k)
+            g = jax.random.gumbel(key, vals.shape)
+            pick = jnp.argmax(vals + g, axis=-1)
+            toks = jnp.take_along_axis(idxs, pick[..., None],
+                                       axis=-1)[..., 0]
+        else:
+            toks = jnp.argmax(logits, axis=-1)
+        # health only over REAL rows (dummy padding rows carry
+        # lengths == 1; real rows always have prompt + reserved >= 2):
+        # a padded row's finite garbage must not masquerade as the
+        # batch's logit magnitude
+        valid = lengths >= 2
+        health = jnp.max(jnp.where(valid[:, None, None],
+                                   jnp.abs(logits), 0.0))
+        return new_kps, new_vps, toks.astype(jnp.int32), health
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+class JaxTinyLM:
+    """TinyLM's weights as resident jax arrays + the fused step (see
+    module docstring).  Construction imports the host model's matrices
+    once; the per-step host traffic is the integer operand batch in and
+    the sampled tokens out."""
+
+    _IMPORTED = ("tok_emb", "pos_emb", "layers", "w_out", "vocab_size",
+                 "num_layers", "num_heads", "head_dim", "max_positions")
+
+    def __init__(self, model, use_kernel=False):
+        if not self.compatible(model):
+            raise MXNetError(
+                "JaxTinyLM: model does not expose TinyLM's weight "
+                f"surface ({', '.join(self._IMPORTED)}) — the fused "
+                "decode arm only runs models whose forward it can "
+                "reproduce bit-checkably")
+        import jax.numpy as jnp
+
+        self.model = model
+        self.vocab_size = model.vocab_size
+        self.num_layers = model.num_layers
+        self.num_heads = model.num_heads
+        self.head_dim = model.head_dim
+        self.max_positions = model.max_positions
+        self.use_kernel = bool(use_kernel)
+        self.params = {
+            "tok_emb": jnp.asarray(model.tok_emb),
+            "pos_emb": jnp.asarray(model.pos_emb),
+            "wq": jnp.stack([jnp.asarray(l["wq"]) for l in model.layers]),
+            "wk": jnp.stack([jnp.asarray(l["wk"]) for l in model.layers]),
+            "wv": jnp.stack([jnp.asarray(l["wv"]) for l in model.layers]),
+            "wo": jnp.stack([jnp.asarray(l["wo"]) for l in model.layers]),
+            "w_out": jnp.asarray(model.w_out),
+        }
+        # greedy needs no randomness; the key operand still rides along
+        # so top-k sampling shares one trace signature.  Drawn through
+        # the framework stream so resume capsules can replay it.
+        from .. import random as _random
+        self._dummy_key = _random.take_key()
+
+    @staticmethod
+    def compatible(model):
+        """Whether the fused arm can import this model's weights."""
+        return all(hasattr(model, a) for a in JaxTinyLM._IMPORTED)
+
+    def warm(self, cache, max_batch, tq, table_width=4):
+        """Pre-compile the fused step for every pow2 batch bucket up to
+        ``max_batch`` at window width ``tq``.
+
+        The first call at a new operand-shape set pays the XLA compile
+        (~0.6s for even the test model on CPU) — INSIDE the server's
+        watchdog deadline if it happens mid-serving, where it is
+        indistinguishable from a wedged dispatch and can cascade into a
+        spurious engine restart.  Engine construction runs outside the
+        watchdog, so the engine warms the buckets here with all-dummy
+        batches (the module docstring's padding contract: writes
+        dropped, health masked — semantically a no-op).  Restarted
+        engines re-warm for free: the executable cache is keyed on the
+        lru-cached step callable + shapes, both unchanged.  Wider block
+        tables than ``table_width`` still compile lazily — that cost is
+        shared with (and was already carried by) the host arm's jitted
+        attention twin."""
+        nb = cache.allocator.num_blocks
+        b = 1
+        top = max(1, int(max_batch))
+        while True:
+            shape = (b, int(tq))
+            self.decode_step(
+                cache, np.zeros(shape, np.int32),
+                np.zeros(shape, np.int32),
+                np.zeros((b, int(table_width)), np.int32),
+                np.ones((b,), np.int32),
+                np.full(shape, nb, np.int32), np.zeros(shape, np.int32))
+            if b >= top:
+                break
+            b *= 2
+
+    def decode_step(self, cache, tokens, positions, tables, lengths,
+                    bids, offs, top_k=1, key=None):
+        """ONE fused device step for a (padded) decode batch.
+
+        ``tokens``/``positions``/``bids``/``offs``: int ``(B, Tq)``;
+        ``tables``: int32 ``(B, NB)``; ``lengths``: int32 ``(B,)`` —
+        the engine's padded window batch (dummy rows per the module
+        docstring's contract).  Consumes and replaces ``cache``'s pool
+        buffers (donation handoff), returns ``(tokens, health)`` with
+        ``tokens`` a host int32 ``(B, Tq)`` of sampled ids and
+        ``health`` the real rows' max |logit| — the ONLY values that
+        cross back to the host."""
+        positions = np.asarray(positions)
+        if positions.max() >= self.max_positions:
+            # the host model's embed() contract, checked before the
+            # device program bakes the out-of-range gather in
+            raise ValueError(
+                f"position {int(positions.max())} >= max_positions="
+                f"{self.max_positions} — raise max_positions or cap "
+                "prompt+generation length at admission")
+        step = _build_step(self.num_layers, self.vocab_size,
+                           self.num_heads, self.head_dim,
+                           self.use_kernel, int(top_k))
+        kps, vps = cache.pools()
+        new_kps, new_vps, toks, health = step(
+            self.params, kps, vps,
+            np.asarray(tokens, np.int32), positions.astype(np.int32),
+            np.asarray(tables, np.int32), np.asarray(lengths, np.int32),
+            np.asarray(bids, np.int32), np.asarray(offs, np.int32),
+            self._dummy_key if key is None else key)
+        cache.adopt_pools(new_kps, new_vps)
+        # the one sanctioned readback pair: sampled ids + health scalar
+        toks = np.asarray(toks)
+        return toks, float(health)

@@ -891,6 +891,84 @@ class PagedKVCache:
             entry.length = pos + 1
             return pos
 
+    def reserve_window(self, seq_id, k):
+        """Reserve ``k`` consecutive slots in one call — the speculative
+        draft window's append (ISSUE 16).  All-or-nothing like every
+        allocation on this class: on :class:`CacheExhausted` midway the
+        freshly grabbed blocks are released and the length restored, so
+        the caller preempts exactly as it would for a single-slot
+        :meth:`reserve` (a completed copy-on-write of the shared tail is
+        kept — it is semantically invisible: same bits, private copy).
+        Returns the reserved positions ``[length, ..., length+k-1]``."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"reserve_window: k must be >= 1, got {k}")
+        with self._lock:
+            entry = self._entry(seq_id)
+            base_nblocks = len(entry.blocks)
+            base_length = entry.length
+            try:
+                if (entry.length % self.block_size != 0
+                        and self.allocator.refcount(entry.blocks[-1]) > 1):
+                    self._cow_tail(entry)
+                need = (-(-(entry.length + k) // self.block_size)
+                        - len(entry.blocks))
+                if need > 0:
+                    entry.blocks.extend(self._alloc(need,
+                                                    holder=entry.holder))
+            except CacheExhausted:
+                fresh = entry.blocks[base_nblocks:]
+                if fresh:
+                    self.allocator.free(fresh, holder=entry.holder)
+                    del entry.blocks[base_nblocks:]
+                entry.length = base_length
+                raise
+            entry.length = base_length + k
+            return list(range(base_length, base_length + k))
+
+    def truncate(self, seq_id, length):
+        """Shrink ``seq_id`` to ``length`` cached tokens — speculative
+        decode's rejection path: the verify step reserved a whole draft
+        window, the model accepted a prefix of it, and the unaccepted
+        tail slots must stop being part of the sequence (the NEXT window
+        overwrites those pool slots, but the length/table bookkeeping
+        must agree with the accepted stream NOW).  Whole blocks past the
+        new tail drop one reference each (shared blocks survive, as
+        everywhere).  No-op when ``length`` already matches."""
+        length = int(length)
+        if length < 1:
+            raise ValueError(f"truncate: length must be >= 1, got {length}")
+        with self._lock:
+            entry = self._entry(seq_id)
+            if length > entry.length:
+                raise MXNetError(
+                    f"truncate: sequence {seq_id!r} holds {entry.length} "
+                    f"tokens — cannot grow to {length} (use reserve)")
+            keep = self.blocks_for(length)
+            tail = entry.blocks[keep:]
+            if tail:
+                self.allocator.free(tail, holder=entry.holder)
+                del entry.blocks[keep:]
+            entry.length = length
+
+    def window_slots(self, seq_ids, k):
+        """The (block id, in-block offset) address of each sequence's
+        last ``k`` reserved slots, as int32 ``(B, k)`` arrays — the
+        fused decode step's in-program scatter coordinates (the device
+        program writes the draft window's K/V straight into the donated
+        pool at these addresses; no host-side write call happens at
+        all)."""
+        with self._lock:
+            bids = np.empty((len(seq_ids), k), np.int32)
+            offs = np.empty((len(seq_ids), k), np.int32)
+            for i, s in enumerate(seq_ids):
+                entry = self._entry(s)
+                for j in range(k):
+                    pos = entry.length - k + j
+                    bids[i, j] = entry.blocks[pos // self.block_size]
+                    offs[i, j] = pos % self.block_size
+        return bids, offs
+
     def write(self, seq_id, layer, k, v):
         """Write one layer's K/V projection into the newest reserved slot
         (``k``/``v``: ``(num_heads, head_dim)``)."""
@@ -937,6 +1015,33 @@ class PagedKVCache:
                 for i, (bid, off) in enumerate(slots):
                     self.k_blocks[layer, bid, off] = k[i]
                     self.v_blocks[layer, bid, off] = v[i]
+
+    def write_window(self, seq_ids, layer, k, v):
+        """Write one layer's K/V for a whole draft window into each
+        sequence's last ``K`` reserved slots (``k``/``v``: ``(B, K,
+        num_heads, head_dim)``) — the host-resident arm of speculative
+        decode (ISSUE 16).  Device storage pays ONE scatter per pool for
+        the whole ``B*K`` window (flattened rows), exactly like
+        :meth:`write_batch` does for ``K == 1``."""
+        kw = k.shape[1]
+        bids, offs = self.window_slots(seq_ids, kw)
+        with self._lock:
+            if self.storage == "device":
+                _, write_rows, _, _ = _dev_ops()
+                flat = (len(seq_ids) * kw,) + k.shape[2:]
+                self._k_dev[layer] = write_rows(
+                    self._k_dev[layer], bids.ravel(), offs.ravel(),
+                    np.asarray(k).reshape(flat))
+                self._v_dev[layer] = write_rows(
+                    self._v_dev[layer], bids.ravel(), offs.ravel(),
+                    np.asarray(v).reshape(flat))
+            else:
+                for i in range(len(seq_ids)):
+                    for j in range(kw):
+                        self.k_blocks[layer, bids[i, j], offs[i, j]] = \
+                            k[i, j]
+                        self.v_blocks[layer, bids[i, j], offs[i, j]] = \
+                            v[i, j]
 
     def free_sequence(self, seq_id):
         """Evict: drop one reference per block (copy-free — contents
@@ -1008,6 +1113,35 @@ class PagedKVCache:
         if self.storage == "device":
             return self._k_dev[layer], self._v_dev[layer]
         return self.k_blocks[layer], self.v_blocks[layer]
+
+    def pools(self):
+        """EVERY layer's resident K and V pool handles, as two lists —
+        the fused decode step's donated operands (serving/jax_model.py
+        passes them into ONE jitted program that writes the window's
+        K/V and returns the new buffers).  Device storage only: the
+        whole point is that the handles are consumable device arrays."""
+        if self.storage != "device":
+            raise MXNetError(
+                "PagedKVCache.pools: the fused decode step needs "
+                "device-resident pools (storage='device')")
+        return list(self._k_dev), list(self._v_dev)
+
+    def adopt_pools(self, k_pools, v_pools):
+        """Install the pool buffers a fused decode step returned — the
+        other half of the donation handoff: the program CONSUMED the
+        handles :meth:`pools` handed it, and these are their successors.
+        Anything still holding a pre-step handle is stale by contract
+        (module docstring: pool array access is step-thread-owned)."""
+        if self.storage != "device":
+            raise MXNetError(
+                "PagedKVCache.adopt_pools: device storage only")
+        if (len(k_pools) != self.num_layers
+                or len(v_pools) != self.num_layers):
+            raise ValueError(
+                f"adopt_pools: expected {self.num_layers} pool pairs, "
+                f"got {len(k_pools)}/{len(v_pools)}")
+        self._k_dev = list(k_pools)
+        self._v_dev = list(v_pools)
 
     def batch_tables(self, seq_ids):
         """The decode batch's raw block tables: int32 ``(B, NBpad)`` ids
@@ -1088,17 +1222,20 @@ class PagedKVCache:
         kp, vp = self.pool(layer)
         if self.storage == "device":
             # reference arm on a device pool: gather on-device by table,
-            # fetch the (B, Lpad, H, D) result once — the parity tests'
-            # honest dense baseline against the same resident pool
-            import jax.numpy as jnp
-            # tpumx-lint: disable=hot-path-purity -- dense REFERENCE arm
-            # reading a device-resident pool: one index-array commit per
-            # gather is the documented O(context) fallback cost, not the
-            # production paged path (that one walks raw tables in-kernel;
-            # docs/DIVERGENCES.md #27, docs/serving.md "decode arms")
-            idx = jnp.asarray(ids.ravel(), jnp.int32)
-            k = np.asarray(kp[idx]).reshape(shape)
-            v = np.asarray(vp[idx]).reshape(shape)
+            # then commit the (B, Lpad, H, D) result to host once.  The
+            # numpy index array crosses the dispatch boundary on the C++
+            # fast path (no eager jnp.asarray op), and the single host
+            # commit sits behind an isinstance guard — the guarded-
+            # fallback idiom the hot-path-purity pass recognizes, which
+            # retired the justified suppression that used to live here
+            # (ISSUE 16; the O(context) cost itself is the documented
+            # dense-fallback price, docs/DIVERGENCES.md #27)
+            idx = np.asarray(ids.ravel(), np.int32)
+            k, v = kp[idx], vp[idx]
+            if not isinstance(k, np.ndarray):
+                k, v = np.asarray(k), np.asarray(v)
+            k = k.reshape(shape)
+            v = v.reshape(shape)
         else:
             k = kp[ids.ravel()].reshape(shape)
             v = vp[ids.ravel()].reshape(shape)

@@ -116,7 +116,9 @@ class Server:
         self.engine = EngineCore(model, block_size=block_size,
                                  num_blocks=num_blocks, dtype=dtype,
                                  share_prefix=prefix_sharing,
-                                 forensics=blackbox)
+                                 forensics=blackbox,
+                                 warm_batch=getattr(self.scheduler,
+                                                    "max_batch", None))
         self.generation = 0        # engine generation (restart count)
         self.restarts = 0
         self.degraded = False
@@ -230,7 +232,8 @@ class Server:
             self.scheduler.mark_running(req)
             self._commit_token(req, first)
             worked = True
-        # --- decode (one token across the running batch) -------------------
+        # --- decode (one step across the running batch: one token per
+        # sequence, or an accepted speculative window) -----------------------
         batch = self.scheduler.decode_batch()
         if batch:
             if self._t_first_work is None:
@@ -243,11 +246,19 @@ class Server:
                 name=f"serve-decode-step{self._steps}")
             fresh = 0
             for req in batch:
-                token = results.get(req.id)
-                if token is None or req.done:
+                tokens = results.get(req.id)
+                if tokens is None or req.done:
                     continue   # preempted, or a static-padding slot
-                fresh += 1
-                self._commit_token(req, token)
+                # a step yields a LIST (one token, or an accepted
+                # speculative window); commit in stream order and stop
+                # at the first finisher — tokens past an EOS or the
+                # length budget were never part of the stream (the
+                # sequence's cache is evicted with it either way)
+                for token in tokens:
+                    fresh += 1
+                    self._commit_token(req, token)
+                    if req.done:
+                        break
             for req in preempted:
                 # a FINISHED victim was a static-batching padding slot:
                 # its tokens were already delivered, so it is simply
@@ -421,7 +432,9 @@ class Server:
                                  num_blocks=self._num_blocks,
                                  dtype=self._dtype,
                                  share_prefix=self._prefix_sharing,
-                                 forensics=self.blackbox)
+                                 forensics=self.blackbox,
+                                 warm_batch=getattr(self.scheduler,
+                                                    "max_batch", None))
         # the rebuilt engine's pool starts empty: the stale would-fit
         # signal (and the stale pool gauges) refresh on the next step,
         # but the scheduler must not gate admission on the DEAD pool

@@ -173,6 +173,20 @@ KNOWN_METRICS = frozenset({
     # (kind=dense/paged/paged-kernel) and whether the KV block pool is
     # device-resident (1.0) or host numpy (0.0)
     "serve.decode_attention", "serve.pool_device_resident",
+    # whole-step fused decode + speculative windows (ISSUE 16).
+    # fused_steps counts decode steps run as ONE jitted device program
+    # (serving/jax_model.py); host_crossings counts host<->device
+    # boundary crossings the decode step paid (a constant 3 per fused
+    # step vs 4 per LAYER host-resident) and host_crossings_per_token
+    # is that step's crossings amortized over the tokens it emitted —
+    # the O(1)-vs-O(layers) receipt.  spec_drafted / spec_accepted
+    # count proposer-drafted tokens and the verified prefix tokens the
+    # engine accepted; spec_accept_ratio is their lifetime quotient
+    # (serving/speculative.py — correctness never depends on it).
+    "serve.fused_steps", "serve.host_crossings",
+    "serve.host_crossings_per_token",
+    "serve.spec_drafted", "serve.spec_accepted",
+    "serve.spec_accept_ratio",
     # SLO engine (ISSUE 11; tpu_mx/serving/slo.py + timeline.py).
     # phase_seconds{phase=...} is the per-request attribution total for
     # each typed phase (queue_wait/prefill/decode_gap/restart_penalty/

@@ -121,10 +121,13 @@ KNOWN_EVENTS = {
     "serve.restart": {"n": "int", "reason": "str", "requeued": "int"},
     # emitted once per engine construction (so once per generation): the
     # decode-attention arm this engine resolved (dense / paged /
-    # paged-kernel) and where its KV pool lives (host / device) — a
+    # paged-kernel), where its KV pool lives (host / device), whether
+    # the whole step runs as ONE fused device program (ISSUE 16) and
+    # the speculative draft-window width (1 = speculation off) — a
     # restarted engine's black box records which data plane it was on
     "serve.decode_path": {"path": "str", "storage": "str",
-                          "sharing": "bool"},
+                          "sharing": "bool", "fused": "bool",
+                          "spec_window": "int"},
     # shared-prefix index pressure eviction (ISSUE 12): one event per
     # relief pass — `released` index entries freed to satisfy a
     # `need`-block allocation (tpu_mx/serving/kv_cache.py::_alloc)
