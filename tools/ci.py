@@ -61,7 +61,7 @@ TIERS = [
     # is reproducible run-to-run (ISSUE 2; the core tier runs these too,
     # but under whatever seed the environment happens to carry)
     ("chaos", ["tests/test_checkpoint.py", "tests/test_elastic.py",
-               "tests/test_supervisor.py",
+               "tests/test_supervisor.py", "tests/test_fleet.py",
                "-m", "not slow"], {"TPUMX_CHAOS_SEED": "20260804"}),
 ]
 
@@ -564,6 +564,282 @@ print("SOAK OK", flush=True)
 SOAK_REQUIRED = ("supervisor", "resume", "chaos.injections",
                  "checkpoint.corrupt_detected", "train_step.steps",
                  "tracing.blackbox_dumps")
+
+
+# The soak tier's membership-churn leg (ISSUE 17): a two-member fleet in
+# one process (the single-controller convention — member 0 drives the
+# model on the full global batch; member 1 is a logical peer kept alive
+# by a heartbeat thread, exactly what a real worker's beat loop does).
+# The seeded schedule partitions member 1 (chaos `partition_worker`:
+# beats suppressed, process alive) so its lease expires mid-epoch — the
+# supervisor classifies the resulting MembershipChange as `membership`,
+# reshards dp=2 -> dp=1 from the last verified manifest + capsule, and
+# later admits the healed member back at the next epoch (reshard up).
+# A second window SIGTERMs the training rank mid-step (chaos
+# `preempt_worker_at_step`) — classified and survived, not fatal.
+# Hard assertions: the churn run consumes the IDENTICAL global
+# sample-id ledger as the uninterrupted oracle (zero skipped, zero
+# duplicated), losses/weights match to float-reduction tolerance
+# (dp=1 and dp=2 reassociate the batch sum — bitwise equality across
+# the world change is impossible BY MEASUREMENT, ~1e-9), the no-train
+# reshard round-trip dp=2 -> dp=1 -> dp=2 is BIT-exact, and the run
+# ends completed with a verified latest epoch.
+FLEET_SCRIPT = """
+import math
+import os
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+import random
+import signal
+import threading
+import time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tpu_mx as mx
+from tpu_mx import checkpoint as ckpt, elastic, gluon, nd, telemetry
+from tpu_mx import random as trandom
+from tpu_mx import resume as tres
+from tpu_mx.contrib import chaos
+from tpu_mx.gluon import nn
+from tpu_mx.parallel import CompiledTrainStep, make_mesh
+from tpu_mx.parallel.fleet import Fleet
+from tpu_mx.supervisor import Supervisor
+
+assert jax.device_count() >= 2, jax.devices()
+SEED = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
+rng = random.Random(SEED)
+root = os.path.dirname(os.environ["TPUMX_TELEMETRY"])
+prefix = os.path.join(root, "fleet-ck")
+
+R = np.random.RandomState(SEED)
+X = R.rand(64, 4).astype(np.float32)
+Y = (X.sum(1) > 2).astype(np.float32)
+BS, NB, EPOCHS, LEASE = 16, 4, 8, 1.0
+
+# seeded churn schedule: partition early (heal = next epoch), preempt
+# well after the rejoin so the chaos windows never overlap
+PART_EPOCH, PART_STEP = rng.randint(1, 2), rng.randint(1, NB)
+PREEMPT_EPOCH, PREEMPT_STEP = rng.randint(4, 6), rng.randint(1, NB)
+print("FLEET schedule: partition@(%d,%d) preempt@(%d,%d)" %
+      (PART_EPOCH, PART_STEP, PREEMPT_EPOCH, PREEMPT_STEP), flush=True)
+
+
+# chaos preempts with a real SIGTERM; this harness must survive it the
+# way a dying rank's peers do — as a WorkerFailure out of the step
+def _on_term(sig, frame):
+    raise elastic.WorkerFailure("preempted: SIGTERM mid-step")
+
+
+signal.signal(signal.SIGTERM, _on_term)
+
+
+def build_net():
+    trandom.seed(123)
+    n = nn.HybridSequential(prefix="fl_")
+    n.add(nn.Dense(8, in_units=4, activation="relu", prefix="fc1_"))
+    n.add(nn.Dense(2, in_units=8, prefix="fc2_"))
+    n.initialize()
+    n(nd.ones((1, 4)))
+    return n
+
+
+def make_step(world):
+    mesh = make_mesh({"dp": 2}) if world >= 2 else \\
+        make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    n = build_net()
+    s = CompiledTrainStep(n, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.create("sgd", learning_rate=0.05),
+                          mesh=mesh)
+    return n, s
+
+
+def make_iter():
+    return mx.io.NDArrayIter(X, Y, batch_size=BS, shuffle=True,
+                             last_batch_handle="discard", seed=123)
+
+
+def weights(n):
+    return [p.data().asnumpy() for p in n.collect_params().values()]
+
+
+# ---- oracle: the uninterrupted fixed-seed run, dp=2 throughout ----
+o_net, o_step = make_step(2)
+o_it = make_iter()
+o_ledger, o_losses = {}, {}
+for epoch in range(EPOCHS):
+    o_it.reset()
+    for i, batch in enumerate(o_it):
+        o_ledger[(epoch, i + 1)] = tuple(
+            int(v) for v in o_it.global_batch_ids())
+        o_losses[(epoch, i + 1)] = float(
+            o_step.step(batch.data[0], batch.label[0]).asnumpy().mean())
+o_step.sync_to_net()
+o_w = weights(o_net)
+print("FLEET oracle done", flush=True)
+
+# ---- the churn run ----
+f0 = Fleet(os.path.join(root, "fleet"), member=0, controller=True,
+           lease=LEASE)
+f0.advance(world=[0, 1], reason="launch")
+f0.join()
+f1 = Fleet(os.path.join(root, "fleet"), member=1, lease=LEASE)
+f1.join()
+
+stop_beats = threading.Event()
+
+
+def beat_loop():  # member 1's liveness, decoupled from the train loop
+    while not stop_beats.is_set():
+        f1.heartbeat()
+        time.sleep(LEASE / 10.0)
+
+
+threading.Thread(target=beat_loop, daemon=True).start()
+
+H = {}
+H["net"], H["step"] = make_step(2)
+it = make_iter()
+mgr = tres.CapsuleManager(prefix, iters=[it], state=H["step"], interval=1,
+                          fleet=f0)
+
+
+def save_fn(epoch):
+    H["step"].sync_to_net()
+    elastic.save_checkpoint(prefix, epoch, net=H["net"], capsule=mgr)
+
+
+def restore_fn():
+    # the membership branch acks the new epoch BEFORE restoring, so the
+    # adopted world size here is the post-churn one — rebuild the step
+    # on the new mesh and point the capsule at it (load_state_dict then
+    # re-places every leaf: the reshard seam)
+    H["net"], H["step"] = make_step(max(1, f0.acked_world_size))
+    mgr.state = H["step"]
+    e = elastic.auto_resume(prefix, net=H["net"])
+    H["step"].sync_from_net()
+    return e
+
+
+sup = Supervisor(save_fn=save_fn, restore_fn=restore_fn, capsule=mgr,
+                 fleet=f0, deadline=30.0, compile_grace=60.0,
+                 max_restarts=4, backoff=0.05, cooldown=0.0, seed=SEED,
+                 blackbox=prefix)
+
+ledger, losses = {}, {}
+open_ctx, fired = [], set()
+
+
+def epoch_fn(epoch):
+    if epoch == PART_EPOCH + 1 and "part" in fired and "heal" not in fired:
+        fired.add("heal")  # partition heals: member 1's beats resume
+        open_ctx.pop().__exit__(None, None, None)
+        assert f0.wait_member(1, timeout=10), "healed member never beat"
+    if not sup.resume_step(epoch):
+        it.reset()
+    for batch in it:
+        nxt = sup.step_in_epoch + 1
+        if epoch == PART_EPOCH and nxt >= PART_STEP and "part" not in fired:
+            fired.add("part")
+            c = chaos.enable(partition_worker=1, seed=SEED)
+            c.__enter__()
+            open_ctx.append(c)
+            time.sleep(LEASE * 1.5)  # outlive member 1's lease
+        if epoch == PREEMPT_EPOCH and nxt >= PREEMPT_STEP \\
+                and "pre" not in fired:
+            fired.add("pre")
+            c = chaos.enable(preempt_worker_at_step=1, preempt_rank=0,
+                             seed=SEED)
+            c.__enter__()
+            open_ctx.append(c)
+
+        def one(b=batch):
+            v = float(H["step"].step(b.data[0], b.label[0])
+                      .asnumpy().mean())
+            k = (epoch, sup.step_in_epoch + 1)
+            ledger[k] = tuple(int(x) for x in it.global_batch_ids())
+            losses[k] = v
+            return v
+
+        sup.step(one)
+
+
+try:
+    res = sup.run(epoch_fn, begin_epoch=0, num_epoch=EPOCHS)
+finally:
+    stop_beats.set()
+    while open_ctx:
+        open_ctx.pop().__exit__(None, None, None)
+
+print("FLEET result:", res.as_dict(), flush=True)
+assert res.status == "completed", res.as_dict()
+assert fired >= {"part", "heal", "pre"}, fired
+assert res.restarts >= 1, res.as_dict()  # the preempt (not membership)
+
+# exact replay: the churn run consumed the IDENTICAL batch sequence
+assert set(ledger) == set(o_ledger), (len(ledger), len(o_ledger))
+assert ledger == o_ledger, "sample-id ledger diverged from the oracle"
+for epoch in range(EPOCHS):  # zero skipped, zero duplicated
+    ids = sorted(i for (e, s), v in ledger.items() if e == epoch
+                 for i in v)
+    assert ids == list(range(len(X))), (epoch, ids[:8])
+
+# loss-curve/weight parity: gated numerically — dp=1 and dp=2 psums
+# reassociate the batch sum (measured ~1e-9), bitwise across the world
+# change is not a sound gate
+for k in sorted(o_losses):
+    assert math.isclose(losses[k], o_losses[k],
+                        rel_tol=1e-4, abs_tol=1e-6), \\
+        (k, losses[k], o_losses[k])
+H["step"].sync_to_net()
+for a, b in zip(o_w, weights(H["net"])):
+    assert np.allclose(a, b, rtol=1e-5, atol=1e-6), "weights diverged"
+
+# membership accounting: >=2 reshards (down + up), >=1 rejoin, the lost
+# worker counted, and the epoch gauge moved past the launch generation
+assert telemetry.get("fleet.reshards").value >= 2
+assert telemetry.get("fleet.rejoins").value >= 1
+assert telemetry.get("fleet.lost_workers").value >= 1
+assert telemetry.get("fleet.membership_epoch").value >= 3
+
+# completed with a verified latest epoch
+final_epoch, _path = elastic.latest_checkpoint(prefix)
+assert final_epoch == EPOCHS - 1, final_epoch
+assert ckpt.verify_checkpoint(prefix, final_epoch)[0] == "verified"
+
+# the reshard seam itself is lossless: a no-train round trip back onto
+# the original mesh is BIT-exact
+def flat(sd, pre="", out=None):
+    out = {} if out is None else out
+    if isinstance(sd, dict):
+        for k2 in sorted(sd):
+            flat(sd[k2], pre + "/" + str(k2), out)
+    else:
+        try:
+            out[pre] = np.asarray(sd)
+        except Exception:
+            pass
+    return out
+
+
+sd_f = H["step"].state_dict()
+_n1, s1 = make_step(1)
+s1.load_state_dict(sd_f)
+_n2, s2 = make_step(2)
+s2.load_state_dict(s1.state_dict())
+fa, fb = flat(sd_f), flat(s2.state_dict())
+assert set(fa) == set(fb)
+for k in fa:
+    assert np.array_equal(fa[k], fb[k]), k
+print("FLEET reshard round-trip bit-exact OK", flush=True)
+telemetry.flush(final=True)
+print("FLEET OK", flush=True)
+"""
+
+# "fleet" is the telemetry_report require-preset (membership_epoch +
+# reshards + rejoins all nonzero); resume/chaos/train_step gate that the
+# churn actually rode the capsule path under injected faults
+FLEET_REQUIRED = ("fleet", "resume", "chaos.injections",
+                  "train_step.steps")
 
 
 # The serve tier's workload (ISSUE 8): a fixed-seed request storm
@@ -1096,6 +1372,42 @@ def soak_tier():
             return 1
         if val.returncode != 0:
             print(f"  soak: telemetry validation failed "
+                  f"(rc={val.returncode}):\n"
+                  f"{((val.stdout or '') + (val.stderr or ''))[-3000:]}")
+            return val.returncode or 1
+    # membership-churn leg (ISSUE 17): seeded partition -> reshard down,
+    # heal -> rejoin -> reshard up, SIGTERM preempt survived — with the
+    # global sample-id ledger gated against the uninterrupted oracle
+    with tempfile.TemporaryDirectory() as d:
+        jsonl = os.path.join(d, "telemetry.jsonl")
+        env = dict(os.environ, TPUMX_TELEMETRY=jsonl, JAX_PLATFORMS="cpu",
+                   TPUMX_CHAOS_SEED="20260804",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2")
+        env.pop("TPUMX_CHAOS", None)  # the script arms its own schedule
+        env.pop("TPUMX_TRACING", None)
+        try:
+            run = subprocess.run([sys.executable, "-c", FLEET_SCRIPT],
+                                 env=env, cwd=repo, capture_output=True,
+                                 text=True, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: fleet churn run timed out: {e}")
+            return 1
+        if run.returncode != 0 or "FLEET OK" not in (run.stdout or ""):
+            print(f"  soak: fleet churn run failed (rc={run.returncode}):\n"
+                  f"{((run.stdout or '') + (run.stderr or ''))[-4000:]}")
+            return run.returncode or 1
+        try:
+            val = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "telemetry_report.py"),
+                 jsonl, "--validate", "--require",
+                 ",".join(FLEET_REQUIRED)],
+                capture_output=True, text=True, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: fleet telemetry validation timed out: {e}")
+            return 1
+        if val.returncode != 0:
+            print(f"  soak: fleet telemetry validation failed "
                   f"(rc={val.returncode}):\n"
                   f"{((val.stdout or '') + (val.stderr or ''))[-3000:]}")
             return val.returncode or 1

@@ -24,13 +24,36 @@ Env protocol handed to each worker (mirrors DMLC_* in spirit):
 A worker calls `tpu_mx.kvstore.dist_init()` (or jax.distributed.initialize
 directly) to join.  For CPU-simulated multi-worker tests the spawned
 processes default to the CPU backend with JAX_PLATFORMS=cpu.
+
+Elastic fleets (`--supervise`, ISSUE 17): the launcher doubles as the
+fleet CONTROLLER.  It opens membership epoch 1 admitting ranks 0..N-1,
+hands every worker the TPUMX_FLEET_{DIR,MEMBER,LEASE} env protocol
+(tpu_mx.parallel.fleet), and then supervises:
+
+- a worker that exits nonzero (preempted, crashed) is evicted at a fresh
+  membership epoch immediately — the survivors quiesce at their next step
+  boundary and reshard down — and is restarted with jittered exponential
+  backoff while its restart budget (`--max-restarts`) lasts; the restarted
+  process joins and is admitted at the NEXT epoch (rejoin → reshard up);
+- a worker whose heartbeats stop without the process dying (network
+  partition, `partition_worker` chaos) is evicted by lease expiry through
+  the normal `Fleet.reconcile` path;
+- a worker whose budget is exhausted degrades the fleet to the largest
+  healthy world size (`fleet.degrade` + flight-recorder black box); if
+  that drops below `--min-workers` the job is torn down.
+
+    python tools/launch.py --supervise -n 2 --max-restarts 3 \
+        python train.py --kv-store dist_sync
 """
 import argparse
 import os
+import random
 import shlex
 import socket
 import subprocess
 import sys
+import tempfile
+import time
 
 
 def free_port():
@@ -97,6 +120,134 @@ def launch_local(args, coord):
     return procs
 
 
+def _import_fleet():
+    """Import the fleet runtime into the LAUNCHER process.  tools/ is not a
+    package, so put the repo root on sys.path; force the CPU backend before
+    tpu_mx pulls in jax (the launcher must never grab an accelerator the
+    workers need)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tpu_mx.parallel import fleet as fleet_mod
+    from tpu_mx import telemetry, tracing
+    return fleet_mod, telemetry, tracing
+
+
+def restart_backoff(base, attempt, rng=None):
+    """Jittered exponential backoff for worker restart `attempt` (1-based):
+    base * 2^(attempt-1), scaled by a uniform [0.5, 1.5) jitter so a batch
+    of preempted workers doesn't stampede the coordinator (pure —
+    unit-testable)."""
+    rng = random if rng is None else rng
+    return float(base) * (2 ** (max(1, int(attempt)) - 1)) * \
+        (0.5 + rng.random())
+
+
+def supervise(args, coord):
+    """Fleet-supervising local tracker: spawn N workers under the
+    membership-epoch protocol, evict/restart/admit on churn, degrade when
+    a worker's restart budget runs out.  Returns the process exit code."""
+    fleet_mod, _telemetry, _tracing = _import_fleet()
+    fleet_dir = args.fleet_dir or tempfile.mkdtemp(prefix="tpumx_fleet_")
+    fleet = fleet_mod.Fleet(fleet_dir, member=None, controller=True,
+                            lease=args.lease)
+    fleet.advance(world=range(args.num_workers), reason="launch")
+
+    def spawn(rank, *, fresh=False):
+        env = dict(os.environ)
+        env.update(worker_env(coord, args.num_workers, rank, args.env))
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env[fleet_mod.ENV_DIR] = fleet_dir
+        env[fleet_mod.ENV_MEMBER] = str(rank)
+        env[fleet_mod.ENV_LEASE] = str(args.lease)
+        if fresh and not args.keep_chaos:
+            # a chaos knob describes a fault to inject once per JOB, not
+            # once per incarnation: a restarted worker that re-read
+            # preempt_worker_at_step would preempt itself forever
+            env.pop("TPUMX_CHAOS", None)
+        return subprocess.Popen(args.command, env=env)
+
+    procs = {rank: spawn(rank) for rank in range(args.num_workers)}
+    restarts = {rank: 0 for rank in procs}
+    pending = {}       # rank -> monotonic time its backoff expires
+    exit_codes = {}
+    poll = max(0.05, args.lease / 4.0)
+
+    def degrade(rank, why):
+        world = fleet.world()
+        _tracing.emit("fleet.degrade", world_size=len(world), reason=why)
+        _tracing.dump_blackbox(
+            os.path.join(fleet_dir, "fleet"),
+            reason=f"fleet degrade: {why} — continuing at world size "
+                   f"{len(world)} {world}")
+        print(f"launch: {why}; degrading to world size {len(world)}",
+              file=sys.stderr)
+
+    def on_failure(rank, rc):
+        if rank in fleet.world():
+            fleet.evict(rank, reason=f"exit={rc}")
+        if restarts[rank] < args.max_restarts:
+            restarts[rank] += 1
+            backoff = restart_backoff(args.backoff, restarts[rank])
+            _tracing.emit("fleet.restart_worker", member=rank,
+                          n=restarts[rank], backoff_seconds=backoff)
+            _telemetry.counter("fleet.worker_restarts").inc()
+            pending[rank] = time.monotonic() + backoff
+            print(f"launch: worker {rank} exited {rc}; restart "
+                  f"{restarts[rank]}/{args.max_restarts} in "
+                  f"{backoff:.2f}s", file=sys.stderr)
+        else:
+            exit_codes.setdefault(rank, rc)
+            degrade(rank, f"worker {rank} restart budget exhausted "
+                          f"({args.max_restarts}) after exit={rc}")
+
+    try:
+        while procs or pending:
+            for rank, p in list(procs.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del procs[rank]
+                if rc == 0:
+                    exit_codes[rank] = 0
+                else:
+                    on_failure(rank, rc)
+            # lease-expired members (partitioned but process still alive)
+            # are evicted by the protocol path, not the exit-code path
+            fleet.reconcile()
+            for rank, due in list(pending.items()):
+                if time.monotonic() < due:
+                    continue
+                del pending[rank]
+                procs[rank] = spawn(rank, fresh=True)
+                if fleet.wait_member(rank, timeout=args.join_timeout):
+                    # reconcile (not admit): the loop's periodic reconcile
+                    # may already have admitted the joiner — reconcile is
+                    # idempotent where a second admit would burn an epoch
+                    fleet.reconcile(reason="rejoin")
+                else:
+                    p = procs.pop(rank)
+                    rc = p.poll()
+                    if rc is None:
+                        p.terminate()
+                        rc = -1
+                    on_failure(rank, rc)
+            if len(fleet.world()) < args.min_workers and procs:
+                degrade(-1, f"healthy world {fleet.world()} below "
+                            f"--min-workers {args.min_workers}; aborting")
+                raise SystemExit(1)
+            if procs or pending:
+                time.sleep(poll)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+    # signal deaths report negative codes — any nonzero outcome (even a
+    # degraded-but-completed run) must surface as a failed launch
+    return 1 if any(rc != 0 for rc in exit_codes.values()) else 0
+
+
 def launch_ssh(args, coord):
     import random
     hosts = read_hostfile(args.hostfile)
@@ -120,14 +271,39 @@ def main():
                     help="hosts file for --launcher ssh (one per line)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VAL for the workers")
-    ap.add_argument("command", nargs=argparse.REMAINDER)
+    ap.add_argument("--supervise", action="store_true",
+                    help="elastic-fleet mode: run as membership controller, "
+                         "restart preempted workers, admit rejoins at the "
+                         "next epoch (local launcher only)")
+    ap.add_argument("--fleet-dir",
+                    help="membership store directory (default: a tempdir)")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="per-worker restart budget before degrading")
+    ap.add_argument("--min-workers", type=int, default=1,
+                    help="abort when the healthy world drops below this")
+    ap.add_argument("--backoff", type=float, default=0.5,
+                    help="base seconds for jittered exponential restart "
+                         "backoff")
+    ap.add_argument("--lease", type=float, default=10.0,
+                    help="heartbeat lease seconds (liveness horizon)")
+    ap.add_argument("--join-timeout", type=float, default=30.0,
+                    help="seconds to wait for a restarted worker to join")
+    ap.add_argument("--keep-chaos", action="store_true",
+                    help="keep TPUMX_CHAOS in restarted workers' env "
+                         "(default: injected faults fire once per job)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="worker command line")
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
     if args.launcher == "ssh" and not args.hostfile:
         ap.error("--launcher ssh requires -H/--hostfile")
+    if args.supervise and args.launcher != "local":
+        ap.error("--supervise requires --launcher local")
 
     coord = f"127.0.0.1:{free_port()}"
+    if args.supervise:
+        sys.exit(supervise(args, coord))
     procs = launch_local(args, coord) if args.launcher == "local" \
         else launch_ssh(args, coord)
     code = 0

@@ -66,6 +66,12 @@ REQUIRE_PRESETS = {
               "serve.tokens_per_sec", "serve.engine_restarts",
               "serve.phase_seconds", "serve.slo_estimate_seconds",
               "serve.slo_attainment"),
+    # "fleet" gates the membership-churn soak leg (ISSUE 17): the epoch
+    # gauge must have moved past 0, at least one reshard was driven
+    # through the seam, and at least one evicted/late worker was admitted
+    # back (lost_workers/worker_restarts are deliberately absent — a
+    # planned-scale-only churn run legitimately loses nobody).
+    "fleet": ("fleet.membership_epoch", "fleet.reshards", "fleet.rejoins"),
 }
 
 

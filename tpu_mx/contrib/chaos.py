@@ -48,6 +48,19 @@ Injection kinds (all one process, no root, no LD_PRELOAD):
   with reason ``"reject_storm"`` — drives the front-end's backpressure /
   reject-with-reason path and the client resubmit loop without needing a
   genuinely full queue.
+- ``preempt_worker_at_step=N``: SIGTERM this process at the Nth fleet
+  step (counted since arming) IF its member rank matches
+  ``preempt_rank`` (default 0) — a real preemption for the elastic-fleet
+  kill-and-rejoin proof (tpu_mx/parallel/fleet.py calls
+  :func:`maybe_preempt` at every step boundary).  One-shot.  The
+  existing SIGTERM emergency save handles the save; the fleet
+  supervisor (``tools/launch.py --supervise``) handles the restart.
+- ``partition_worker=K``: the fleet member with rank K stops writing
+  heartbeats WITHOUT dying (:func:`partitioned` returns True for it) —
+  a network partition, not a crash: the membership runtime must evict
+  it on lease expiry and the zombie must be refused at the next
+  generation-tagged barrier.  Counted once, on the first suppressed
+  beat.
 - ``match=SUBSTR``: scope file-level faults to paths containing SUBSTR
   (e.g. ``match=.params`` tears the params file but not the manifest).
 
@@ -82,7 +95,7 @@ from .. import tracing as _tracing
 __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
            "wrap_file", "maybe_oserror", "peer_killed", "poison_loss",
            "maybe_hang", "maybe_crash_step", "maybe_slow_decode",
-           "forced_reject"]
+           "forced_reject", "maybe_preempt", "partitioned"]
 
 
 def _count_injection(kind):
@@ -111,13 +124,16 @@ class _Config:
               "transient_oserror", "kill_peer", "nan_after", "nan_streak",
               "hang_step", "hang_seconds", "crash_at_step",
               "slow_decode_step", "slow_decode_seconds", "reject_storm",
+              "preempt_worker_at_step", "preempt_rank", "partition_worker",
               "seed", "hard", "match")
 
     def __init__(self, crash_after_bytes=None, torn_write=None, slow_io=None,
                  transient_oserror=0, kill_peer=False, nan_after=None,
                  nan_streak=1, hang_step=None, hang_seconds=3600.0,
                  crash_at_step=None, slow_decode_step=None,
-                 slow_decode_seconds=3600.0, reject_storm=0, seed=None,
+                 slow_decode_seconds=3600.0, reject_storm=0,
+                 preempt_worker_at_step=None, preempt_rank=0,
+                 partition_worker=None, seed=None,
                  hard=False, match=None):
         if seed is None:
             seed = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
@@ -136,6 +152,11 @@ class _Config:
             else int(slow_decode_step)
         self.slow_decode_seconds = float(slow_decode_seconds)
         self.reject_storm = int(reject_storm)
+        self.preempt_worker_at_step = None if preempt_worker_at_step is None \
+            else int(preempt_worker_at_step)
+        self.preempt_rank = int(preempt_rank)
+        self.partition_worker = None if partition_worker is None \
+            else int(partition_worker)
         self.seed = seed
         self.hard = bool(hard)
         self.match = match
@@ -157,6 +178,9 @@ class _Config:
         self.slow_decodes = 0
         self.rejects_left = self.reject_storm
         self.rejects_forced = 0
+        self.fleet_steps_seen = 0    # fleet steps while preempt armed
+        self.preempts = 0
+        self.partitions = 0          # heartbeats suppressed by partition
 
     def matches(self, path):
         return self.match is None or (path is not None
@@ -450,3 +474,54 @@ def maybe_hang():
         log.warning("chaos: hanging this step for %.0fs (hang_step fired)",
                     secs)
         time.sleep(secs)
+
+
+def maybe_preempt(rank):
+    """SIGTERM this process when ``preempt_worker_at_step`` says the Nth
+    fleet step has arrived and `rank` matches ``preempt_rank`` (the fleet
+    runtime calls this at every step boundary with the worker's member
+    rank).  A REAL signal, not an exception: the existing SIGTERM
+    emergency-save path runs, the process dies, and the fleet supervisor
+    must detect the loss, reshard the survivors, and restart the worker.
+    Counting starts when armed; steps are counted only on the matching
+    rank so ``preempt_worker_at_step=N`` means "rank ``preempt_rank``'s
+    Nth step", whichever processes share the config.  One-shot."""
+    cfg = configure_from_env()  # fleet workers may have no supervisor
+    if cfg is None or cfg.preempt_worker_at_step is None:
+        return
+    with cfg.lock:
+        if cfg.preempt_worker_at_step is None:
+            return
+        if rank is None or int(rank) != cfg.preempt_rank:
+            return
+        cfg.fleet_steps_seen += 1
+        if cfg.fleet_steps_seen < cfg.preempt_worker_at_step:
+            return
+        cfg.preempt_worker_at_step = None  # one-shot: the restart survives
+        cfg.preempts += 1
+        _count_injection("preempt_worker")
+    import signal
+    log.warning("chaos: preempting rank %s at fleet step %d "
+                "(preempt_worker_at_step fired)", rank, cfg.fleet_steps_seen)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def partitioned(rank):
+    """True when the ``partition_worker`` fault says member `rank` is
+    network-partitioned: the fleet runtime suppresses its heartbeat writes
+    (the process stays alive — that is the point: lease expiry, not exit
+    codes, must evict it).  Counted in ``injections{kind=partition_worker}``
+    once, on the first suppressed beat; stays armed until the config is
+    torn down so the zombie keeps missing beats."""
+    cfg = configure_from_env()  # fleet workers may have no supervisor
+    if cfg is None or cfg.partition_worker is None:
+        return False
+    if rank is None or int(rank) != cfg.partition_worker:
+        return False
+    with cfg.lock:
+        if cfg.partition_worker is None:
+            return False
+        cfg.partitions += 1
+        if cfg.partitions == 1:
+            _count_injection("partition_worker")
+    return True

@@ -59,14 +59,24 @@ class WorkerFailure(MXNetError):
     """A peer did not reach the barrier within the timeout (died or hung)."""
 
 
-def barrier(tag="tpumx_elastic", timeout=60.0):
+def barrier(tag="tpumx_elastic", timeout=60.0, generation=None, fleet=None):
     """Synchronize all processes; raise `WorkerFailure` if the group does not
     converge within `timeout` seconds.  Single-process: no-op.
 
     Call between epochs (cheap: one tiny collective) so a dead rank turns
     into a clean, fast failure instead of an indefinite hang in the next
     psum.  The `kill_peer` chaos knob (contrib.chaos) makes this raise
-    deterministically so recovery loops are testable single-process."""
+    deterministically so recovery loops are testable single-process.
+
+    Elastic fleets (docs/robustness.md): pass ``fleet=`` (a
+    ``parallel.fleet.Fleet``) and the rendezvous is tagged with the
+    membership epoch — ``tag@gen`` — so a zombie worker still holding a
+    previous generation can never satisfy, or wedge, the current
+    cohort's barrier: mismatched tags cannot pair, and better, the stale
+    arrival is detected HERE, before the collective, and raises
+    ``WorkerFailure`` loudly instead of waiting out the timeout.
+    ``generation=`` alone (an int) just tags, for callers that manage
+    membership themselves."""
     from .contrib import chaos
     chaos.configure_from_env()
     if chaos.peer_killed():
@@ -74,6 +84,18 @@ def barrier(tag="tpumx_elastic", timeout=60.0):
             f"barrier '{tag}': chaos kill_peer armed — simulating a dead "
             "peer. Restart the job with --resume to continue from the last "
             "checkpoint.")
+    if fleet is not None:
+        current = fleet.generation
+        if generation is None:
+            generation = fleet.acked_generation
+        if int(generation) != int(current):
+            raise WorkerFailure(
+                f"barrier '{tag}': stale fleet generation {generation} "
+                f"(the membership epoch is now {current}) — this worker "
+                "belongs to a previous epoch and must reshard/rejoin "
+                "before it may rendezvous with the current cohort")
+    if generation is not None:
+        tag = f"{tag}@{int(generation)}"
     import jax
     if jax.process_count() <= 1:
         return

@@ -182,11 +182,24 @@ def _as_list_of_pairs(data, default_name):
 
 class NDArrayIter(DataIter):
     """Batches over in-memory arrays with ``pad``/``discard``/``roll_over``
-    last-batch handling and optional shuffling (REF io.py NDArrayIter)."""
+    last-batch handling and optional shuffling (REF io.py NDArrayIter).
+
+    Elastic sharding (``num_workers``/``rank``; docs/robustness.md
+    "Elastic fleets"): ``batch_size`` is always the GLOBAL batch.  The
+    iterator advances a single global cursor through one global
+    permutation and every rank slices its contiguous
+    ``batch_size/num_workers`` piece out of the same global selection —
+    so the global sample sequence is a pure function of (seed, global
+    batch) and IDENTICAL for every world size.  That is the exact-replay
+    invariant a membership change relies on: re-partition the live
+    iterator with :meth:`set_shard` (or restore a v2 state into an
+    iterator built with different ``(rank, num_workers)``) and the world
+    keeps consuming exactly the batches the old world would have."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label", seed=None):
+                 label_name="softmax_label", seed=None,
+                 num_workers=1, rank=0):
         super().__init__(batch_size)
         self.data = _as_list_of_pairs(data, data_name)
         self.label = _as_list_of_pairs(label, label_name)
@@ -201,10 +214,35 @@ class NDArrayIter(DataIter):
               "batch_size larger than dataset")
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
+        self.global_batch_size = int(batch_size)
         self._rng = np.random.RandomState(seed) if seed is not None \
             else np.random
         self._leftover = None  # roll_over: tail carried into the next epoch
+        self._global_sel = None
+        self.num_workers = 1
+        self.rank = 0
+        self.set_shard(rank, num_workers)
         self.reset()
+
+    def set_shard(self, rank, num_workers):
+        """(rank, num_workers) re-partition of the live GLOBAL stream —
+        the data-side half of a membership change.  Only the slice this
+        rank delivers changes; the global cursor, permutation and RNG
+        stream are untouched, so the global sample sequence continues
+        exactly where it was regardless of the world size."""
+        num_workers, rank = int(num_workers), int(rank)
+        check(num_workers >= 1, "set_shard: num_workers must be >= 1")
+        check(0 <= rank < num_workers,
+              f"set_shard: rank {rank} out of range for {num_workers}")
+        check(self.global_batch_size % num_workers == 0,
+              f"set_shard: global batch {self.global_batch_size} not "
+              f"divisible by num_workers {num_workers} — replay boundaries "
+              "would shift")
+        self.num_workers = num_workers
+        self.rank = rank
+        self.batch_size = self.global_batch_size // num_workers
+        self._sel = None
+        self._pad = 0
 
     @property
     def provide_data(self):
@@ -227,51 +265,113 @@ class NDArrayIter(DataIter):
         self.idx = epoch
         self.cursor = 0
         self._sel = None
+        self._global_sel = None
         self._pad = 0
 
     def iter_next(self):
         n = len(self.idx)
+        gbs = self.global_batch_size
         remaining = n - self.cursor
         if remaining <= 0:
             return False
-        if remaining >= self.batch_size:
-            self._sel = self.idx[self.cursor:self.cursor + self.batch_size]
-            self._pad = 0
-            self.cursor += self.batch_size
-            return True
-        # short tail
-        if self.last_batch_handle == "discard":
+        gpad = 0
+        if remaining >= gbs:
+            gsel = self.idx[self.cursor:self.cursor + gbs]
+            self.cursor += gbs
+        else:
+            # short global tail
+            if self.last_batch_handle == "discard":
+                self.cursor = n
+                return False
+            if self.last_batch_handle == "roll_over":
+                self._leftover = self.idx[self.cursor:]
+                self.cursor = n
+                return False
+            # pad: wrap to the epoch head, report the overlap via getpad()
+            gpad = gbs - remaining
+            gsel = np.concatenate([self.idx[self.cursor:], self.idx[:gpad]])
             self.cursor = n
-            return False
-        if self.last_batch_handle == "roll_over":
-            self._leftover = self.idx[self.cursor:]
-            self.cursor = n
-            return False
-        # pad: wrap to the epoch head, report the overlap via getpad()
-        self._pad = self.batch_size - remaining
-        self._sel = np.concatenate(
-            [self.idx[self.cursor:], self.idx[:self._pad]])
-        self.cursor = n
+        self._global_sel = gsel
+        # this rank's contiguous piece of the one global selection
+        lb = self.batch_size
+        lo = self.rank * lb
+        self._sel = gsel[lo:lo + lb]
+        # padded (wrapped) ids occupy the global selection's tail; this
+        # rank's pad is however much of that tail lands in its piece
+        self._pad = max(0, min(lb, lo + lb - (gbs - gpad))) if gpad else 0
         return True
+
+    def global_batch_ids(self):
+        """Sample ids of the last GLOBAL batch — identical for every rank
+        of any world size at the same cursor.  This is the sample-id
+        ledger the elastic-fleet churn proof compares batch-by-batch
+        (docs/robustness.md)."""
+        return (None if self._global_sel is None
+                else np.asarray(self._global_sel).copy())
 
     def state_dict(self):
         """Position + this epoch's permutation + the private RNG stream
-        (the data itself is reconstructed by the constructor)."""
-        return {"iter": type(self).__name__, "version": 1,
-                "cursor": int(self.cursor),
-                "idx": np.asarray(self.idx).copy(),
-                "leftover": (None if self._leftover is None
-                             else np.asarray(self._leftover).copy()),
-                "rng": self._rng.get_state()}
+        (the data itself is reconstructed by the constructor).
+
+        All position fields are in GLOBAL sample space.  Unsharded
+        iterators emit the v1 layout unchanged; sharded ones emit v2,
+        adding the ``shard`` map — v2 states re-partition on load
+        (different ``(rank, num_workers)`` is legal), v1 states do not
+        carry enough to prove they were whole-stream snapshots, so
+        loading one into a sharded iterator refuses loudly (see
+        :meth:`load_state_dict`)."""
+        state = {"iter": type(self).__name__,
+                 "version": 1 if self.num_workers == 1 else 2,
+                 "cursor": int(self.cursor),
+                 "idx": np.asarray(self.idx).copy(),
+                 "leftover": (None if self._leftover is None
+                              else np.asarray(self._leftover).copy()),
+                 "rng": self._rng.get_state()}
+        if self.num_workers != 1:
+            state["shard"] = {"num_workers": self.num_workers,
+                              "rank": self.rank,
+                              "global_batch": self.global_batch_size}
+        return state
 
     def load_state_dict(self, state):
+        """Adopt a captured GLOBAL stream position.  The state's shard
+        placement is NOT adopted — this iterator keeps its own
+        ``(rank, num_workers)`` and reslices the global stream, which is
+        exactly the N→M re-partition path a membership change needs.
+        Constraints, checked loudly instead of guessed:
+
+        - a v2 state must have been captured at the same GLOBAL batch
+          size (otherwise replay boundaries shift);
+        - a v1 state (no shard map) is only accepted by an unsharded
+          iterator — a v1 capture from an old N-world run was a
+          per-worker LOCAL stream and cannot be re-partitioned.  To bless
+          a v1 state you know was whole-stream, load it unsharded, then
+          :meth:`set_shard`.
+        """
         _check_state(state, type(self).__name__)
+        shard = state.get("shard")
+        if shard is not None:
+            captured = int(shard.get("global_batch", -1))
+            if captured != self.global_batch_size:
+                raise MXNetError(
+                    f"load_state_dict: state was captured at global batch "
+                    f"{captured}, this iterator uses "
+                    f"{self.global_batch_size} — replay boundaries would "
+                    "shift; rebuild with the captured global batch")
+        elif self.num_workers != 1:
+            raise MXNetError(
+                "load_state_dict: v1 iterator state has no shard map — it "
+                "may be a per-worker LOCAL stream and cannot be "
+                f"re-partitioned to num_workers={self.num_workers}; load "
+                "it into an unsharded iterator (then set_shard) if it is "
+                "known to be whole-stream")
         self.idx = np.asarray(state["idx"], dtype=np.intp)
         self.cursor = int(state["cursor"])
         lo = state.get("leftover")
         self._leftover = None if lo is None else np.asarray(lo, dtype=np.intp)
         self._rng.set_state(_np_rng_tuple(state["rng"]))
         self._sel = None
+        self._global_sel = None
         self._pad = 0
 
     def _take(self, arrs):

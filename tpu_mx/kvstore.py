@@ -55,6 +55,7 @@ class KVStore:
         self._optimizer = None
         self._compression = None
         self._is_dist = kind.startswith("dist")
+        self._fleet_token = None
         if self._is_dist:
             import jax
             # multi-host boot: jax.distributed.initialize must have been called
@@ -64,16 +65,45 @@ class KVStore:
                 self._num_workers = jax.process_count()
             except Exception:
                 self._rank, self._num_workers = 0, 1
+            from .parallel import fleet as _fleet
+            self._fleet_token = _fleet.generation_token()
         else:
             self._rank, self._num_workers = 0, 1
 
     # -- identity -------------------------------------------------------------
+    def _refresh_world(self):
+        """Invalidate the cached rank/world-size when the fleet membership
+        epoch moved (ISSUE 17 bugfix: these were cached at init and repr/
+        aggregation never re-read them — a resharded run would silently
+        aggregate with the stale world size).  Cheap: a token compare per
+        access; the re-read happens only on a generation bump."""
+        if not self._is_dist:
+            return
+        from .parallel import fleet as _fleet
+        token = _fleet.generation_token()
+        if token == self._fleet_token:
+            return
+        self._fleet_token = token
+        import jax
+        try:
+            self._rank = jax.process_index()
+            self._num_workers = jax.process_count()
+        except Exception:
+            pass
+        # the fleet's membership epoch is the world-size authority while
+        # one is live (jax.process_count is the static launch-time world)
+        live = _fleet.live_world_size()
+        if live:
+            self._num_workers = int(live)
+
     @property
     def rank(self):
+        self._refresh_world()
         return self._rank
 
     @property
     def num_workers(self):
+        self._refresh_world()
         return self._num_workers
 
     # -- core API -------------------------------------------------------------
@@ -112,7 +142,7 @@ class KVStore:
         """Eager cross-process sum: allgather over the process group, reduce
         on host.  Every rank must call push with the same keys in the same
         order (the reference's bulk-synchronous contract)."""
-        if not self._is_dist or self._num_workers <= 1:
+        if not self._is_dist or self.num_workers <= 1:
             return agg
         if get_env("TPUMX_STRICT_KVSTORE", "0") == "1":
             # VERDICT r3 weak#6: reference-habit `kvstore.push/pull` in the

@@ -1,5 +1,6 @@
 """tpu_mx.parallel — mesh/SPMD layer (the reference's KVStore+launcher tier
 re-designed for ICI/DCN collectives; SURVEY §2.3, §5.7, §5.8)."""
+from .fleet import Fleet, MembershipChange, reshard_live
 from .mesh import Mesh, NamedSharding, P, hybrid_mesh, local_mesh, make_mesh
 from .moe import MoEFFN, moe_sharding_rules
 from .pipeline import pipeline_apply, stack_stage_params

@@ -36,11 +36,21 @@ error-feedback (per-device, excluded from checkpoints — DIVERGENCES #13),
 the native C++ image pipeline's internal cursors (use ``use_native=False``
 for deterministic resume), and profiler/telemetry state.
 
-Versioning: capsules carry ``format: tpu_mx-capsule-v1``.  A reader that
-sees an unknown format (or a torn sidecar, or a stale step capsule
-superseded by a newer epoch) logs why and falls back to the next-coarser
-recovery point — epoch capsule, then plain weights-only resume — never
-guessing at state.
+Versioning: this build WRITES ``format: tpu_mx-capsule-v2`` and READS v1
+and v2.  v2 (ISSUE 17, elastic fleets) records the data-stream position
+in GLOBAL sample space — the sharded ``NDArrayIter``'s global cursor +
+permutation plus a ``world`` map (num_workers/rank/fleet generation) —
+so an N-world capsule restores into an M-world run exactly: iterators
+re-partition from the global cursor (``io.NDArrayIter.set_shard``), and
+the batch sequence the M-world run consumes is identical to the one the
+N-world run would have consumed next.  v1 capsules (whole-stream or
+per-worker LOCAL cursors — indistinguishable from the file alone) still
+restore on the same-world unsharded path; restoring one across a
+world-size change is refused with the gap surfaced via
+``resume.resume_step_gap``, never guessed.  A reader that sees an
+unknown format (or a torn sidecar, or a stale step capsule superseded by
+a newer epoch) logs why and falls back to the next-coarser recovery
+point — epoch capsule, then plain weights-only resume.
 
 Telemetry: ``resume.capsules_written{kind}``, ``resume.capsule_restore_seconds``
 and the ``resume.resume_step_gap`` gauge (batches whose consumption cannot
@@ -64,13 +74,18 @@ from . import random as _random
 from . import telemetry as _telemetry
 from . import tracing as _tracing
 
-__all__ = ["CAPSULE_FORMAT", "CapsuleManager", "ModuleState",
+__all__ = ["CAPSULE_FORMAT", "CAPSULE_FORMAT_V1", "CAPSULE_FORMATS",
+           "CapsuleManager", "ModuleState",
            "encode_state", "decode_state", "capsule_path",
            "step_capsule_path", "step_state_path", "read_capsule"]
 
 log = logging.getLogger(__name__)
 
-CAPSULE_FORMAT = "tpu_mx-capsule-v1"
+CAPSULE_FORMAT_V1 = "tpu_mx-capsule-v1"
+#: the format this build WRITES (v2: global-cursor data positions + world map)
+CAPSULE_FORMAT = "tpu_mx-capsule-v2"
+#: the formats this build READS (v1 restores on the same-world path only)
+CAPSULE_FORMATS = (CAPSULE_FORMAT_V1, CAPSULE_FORMAT)
 
 
 # ---------------------------------------------------------------------------
@@ -177,11 +192,11 @@ def read_capsule(path):
         if os.path.exists(path):
             log.warning("capsule %s unreadable (%s) — ignoring", path, e)
         return None
-    if not isinstance(cap, dict) or cap.get("format") != CAPSULE_FORMAT:
+    if not isinstance(cap, dict) or cap.get("format") not in CAPSULE_FORMATS:
         log.warning("capsule %s has unknown format %r (this build reads "
                     "%s) — ignoring", path,
                     cap.get("format") if isinstance(cap, dict) else None,
-                    CAPSULE_FORMAT)
+                    "/".join(CAPSULE_FORMATS))
         return None
     return cap
 
@@ -203,6 +218,10 @@ class CapsuleManager:
     resume and recovery falls back to the epoch boundary.
     ``interval`` — committed steps between step capsules (0 = epoch
     capsules only).
+    ``fleet`` — optional :class:`tpu_mx.parallel.fleet.Fleet`; when set,
+    the capsule's ``world`` map records this worker's (rank, num_workers)
+    and the fleet generation it was captured under (otherwise the map is
+    derived from the registered iterators' shard placement).
 
     Wire it to a supervisor with ``Supervisor(capsule=mgr)`` /
     ``sup.attach_capsule(mgr)`` (or ``module.fit(supervised=Supervise(
@@ -210,12 +229,13 @@ class CapsuleManager:
     calls :meth:`on_step` / :meth:`on_epoch` / :meth:`restore` at the
     right points."""
 
-    def __init__(self, prefix, iters=(), state=None, interval=0):
+    def __init__(self, prefix, iters=(), state=None, interval=0, fleet=None):
         if not prefix:
             raise MXNetError("CapsuleManager needs a checkpoint prefix")
         self.prefix = prefix
         self.iters = list(iters)
         self.state = state
+        self.fleet = fleet
         self.interval = int(interval)
         self.supervisor = None     # back-ref set by Supervisor.attach_capsule
         self._written_epoch = None
@@ -234,11 +254,36 @@ class CapsuleManager:
                     "support on every registered iterator") from e
 
     # -- capture ------------------------------------------------------------
+    def _world(self):
+        """The (rank, num_workers, generation) this capsule is captured
+        under — from the fleet when attached, else from the registered
+        iterators' shard placement (unsharded pipelines record the static
+        1-worker world)."""
+        if self.fleet is not None:
+            rank = 0
+            try:
+                rank = self.fleet.shard()[0]
+            except MXNetError:
+                pass  # controller-only handles have no member slot
+            return {"num_workers": max(1, self.fleet.acked_world_size),
+                    "rank": int(rank),
+                    "generation": int(self.fleet.acked_generation)}
+        n = max([int(getattr(it, "num_workers", 1))
+                 for it in self.iters] or [1])
+        ranks = [int(getattr(it, "rank", 0)) for it in self.iters
+                 if int(getattr(it, "num_workers", 1)) == n]
+        return {"num_workers": n, "rank": ranks[0] if ranks else 0,
+                "generation": 0}
+
+    def _sharded(self):
+        return self._world()["num_workers"] > 1
+
     def _body(self, epoch, step, sup=None):
         sup = sup if sup is not None else self.supervisor
         body = {"format": CAPSULE_FORMAT,
                 "epoch": int(epoch), "step": int(step),
                 "wall_time": time.time(),
+                "world": self._world(),
                 "rng": encode_state(_random.get_state()),
                 "iters": [encode_state(it.state_dict())
                           for it in self.iters]}
@@ -313,10 +358,31 @@ class CapsuleManager:
                 pass
 
     # -- restore ------------------------------------------------------------
+    def _format_usable(self, cap):
+        """Why-not string for a capsule's FORMAT, or None when usable.
+
+        v1 capsules recorded data positions without a world map — a v1
+        file from an old N-world run holds per-worker LOCAL cursors that
+        cannot be re-partitioned, and the file alone cannot prove it was
+        whole-stream.  So v1 restores only on the same-world unsharded
+        path (where its fields mean exactly what they always meant);
+        under a sharded pipeline it is refused and the caller surfaces
+        the gap — never guesses."""
+        if cap.get("format") != CAPSULE_FORMAT_V1:
+            return None
+        if self._sharded():
+            return ("capsule v1 predates the global-cursor format — its "
+                    "cursors cannot be re-partitioned across a world-size "
+                    "change; resuming without it, gap surfaced")
+        return None
+
     def _step_usable(self, cap, resume_from):
         """Why-not string, or None when the step capsule can resume the
-        exact batch (epoch not superseded, sidecar present and
-        hash-verified)."""
+        exact batch (readable format for this world, epoch not
+        superseded, sidecar present and hash-verified)."""
+        why = self._format_usable(cap)
+        if why is not None:
+            return why
         if self.state is None or cap.get("state_file") is None:
             return ("no train-state sidecar — mid-epoch weights "
                     "unavailable, resuming at the epoch boundary")
@@ -407,6 +473,11 @@ class CapsuleManager:
                 epoch_cap = read_capsule(
                     capsule_path(self.prefix, resume_from - 1)) \
                     if resume_from > 0 else None
+                if epoch_cap is not None:
+                    ewhy = self._format_usable(epoch_cap)
+                    if ewhy is not None:
+                        log.warning("epoch capsule unusable: %s", ewhy)
+                        epoch_cap = None
                 if epoch_cap is not None:
                     self._apply(epoch_cap, sup)
                     used = "epoch"

@@ -109,6 +109,12 @@ def classify(exc, transient=TRANSIENT_EXCEPTIONS):
     faults restart from the manifest, numeric divergence rolls back to the
     last verified checkpoint, and everything else — programming errors,
     ``KeyboardInterrupt``/``SystemExit`` — propagates immediately.
+
+    With a fleet attached, :meth:`Supervisor.run` refines one case: a
+    transient ``WorkerFailure`` that coincides with a moved membership
+    epoch is re-classified ``"membership"`` — reshard to the new world
+    size without burning the restart budget (docs/robustness.md
+    "Elastic fleets").
     """
     if isinstance(exc, NumericDivergence):
         return "numeric"
@@ -354,9 +360,17 @@ class Supervisor:
                  skip_limit=2, spike_factor=None, window=32,
                  max_grad_norm=None, cooldown=0.0, backoff=0.5,
                  max_backoff=30.0, jitter=0.5, transient=None, resume=True,
-                 seed=None, on_degraded=None, capsule=None, blackbox=None):
+                 seed=None, on_degraded=None, capsule=None, blackbox=None,
+                 fleet=None):
         self.save_fn = save_fn
         self.restore_fn = restore_fn
+        # elastic fleet membership (parallel/fleet.py, docs/robustness.md
+        # "Elastic fleets"): when attached, every step boundary runs the
+        # fleet duty cycle (heartbeat + membership check) and a
+        # WorkerFailure that coincides with a moved membership epoch is
+        # classified "membership" — reshard via restore_fn, no restart
+        # budget burned
+        self.fleet = fleet
         # flight-recorder black box (docs/observability.md): a checkpoint
         # prefix; every recovery decision and degrade dumps the last-N-
         # steps timeline + telemetry snapshot to <prefix>-blackbox.json
@@ -434,7 +448,13 @@ class Supervisor:
         ``fn``'s return feeds the sentinel: a scalar/array loss (arrays
         reduce via mean), optionally ``(loss, grad_norm)``; None skips the
         numeric check.  Chaos's ``hang_step`` fires inside the watchdog
-        thread (before ``fn``), ``nan_after`` poisons the observed loss."""
+        thread (before ``fn``), ``nan_after`` poisons the observed loss.
+
+        With a fleet attached, the step boundary is ALSO the membership
+        quiesce point: ``fleet.on_step()`` beats the heartbeat, fires a
+        pending chaos preemption, and raises ``MembershipChange`` (a
+        WorkerFailure) when the membership epoch moved — so the reshard
+        always happens between steps, never mid-collective."""
         from .contrib import chaos
 
         # stamp the trace context BEFORE anything can fail: every event
@@ -445,6 +465,8 @@ class Supervisor:
         _tracing.set_context(epoch=self._epoch,
                              step=self._step_in_epoch + 1,
                              generation=self.generation)
+        if self.fleet is not None:
+            self.fleet.on_step()
 
         def call():
             chaos.maybe_hang()
@@ -534,6 +556,16 @@ class Supervisor:
                     self.capsule.on_epoch(epoch, self)
             except BaseException as e:  # noqa: BLE001 — classified below
                 kind = classify(e, self.transient)
+                if (kind == "transient" and self.fleet is not None
+                        and isinstance(e, WorkerFailure)
+                        and self.fleet.poll_changed()):
+                    # a WorkerFailure coinciding with a moved membership
+                    # epoch is a FLEET event, not a fault — whether it
+                    # surfaced as the step-boundary MembershipChange or
+                    # as a dead peer's collective/barrier timeout.  It
+                    # does not burn the restart budget: re-entry requires
+                    # a fresh (monotone) generation, so no loop
+                    kind = "membership"
                 # the classification IS the supervisor's decision: it goes
                 # on the timeline under the FAILING step's trace context
                 # (the context advances only at the next step/epoch top,
@@ -549,7 +581,31 @@ class Supervisor:
                               "propagating (programming errors are not "
                               "retried): %s", type(e).__name__, epoch, e)
                     raise
-                if kind == "numeric":
+                if kind == "membership":
+                    from .parallel.fleet import note_reshard
+                    prev_world = self.fleet.acked_world_size
+                    ep_rec = self.fleet.ack()
+                    log.warning(
+                        "supervisor: membership epoch %d (world size "
+                        "%d -> %d, %s) — quiescing and resharding from "
+                        "the last verified manifest",
+                        ep_rec["generation"], prev_world,
+                        ep_rec["world_size"], ep_rec.get("reason"))
+                    # restore_fn is fleet-aware: it rebuilds the mesh at
+                    # fleet.shard()'s world size and drives the
+                    # load_state_dict reshard seam; the capsule then
+                    # re-partitions the data stream from its GLOBAL
+                    # cursor (resume.py capsule v2)
+                    epoch = self._restore(epoch)
+                    note_reshard(prev_world, ep_rec["world_size"],
+                                 source="manifest",
+                                 generation=ep_rec["generation"])
+                    self._dump_blackbox(
+                        f"membership epoch {ep_rec['generation']}: world "
+                        f"{prev_world} -> {ep_rec['world_size']} "
+                        f"({ep_rec.get('reason')}) — resharded, resuming "
+                        f"epoch {epoch}")
+                elif kind == "numeric":
                     self.rollbacks += 1
                     _telemetry.counter("supervisor.rollbacks").inc()
                     if self.max_rollbacks is not None \

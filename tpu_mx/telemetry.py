@@ -152,6 +152,19 @@ KNOWN_METRICS = frozenset({
     "resume.resume_step_gap",
     # fault injection (tpu_mx/contrib/chaos.py)
     "chaos.injections",
+    # elastic fleet membership (tpu_mx/parallel/fleet.py + tools/launch.py
+    # --supervise; docs/robustness.md "Elastic fleets").  membership_epoch
+    # is the monotone fleet generation this process has adopted (a gauge —
+    # its value IS the current membership epoch); reshards counts
+    # world-size transitions driven through the reshard seam; rejoins
+    # counts members re-admitted at a new membership epoch; lost_workers
+    # counts members evicted (heartbeat-lease expiry or launcher-observed
+    # death); worker_restarts counts fleet-supervisor restarts of
+    # preempted local workers; heartbeats counts liveness beats written
+    # (suppressed beats under the partition_worker fault are NOT counted —
+    # their absence is the observable).
+    "fleet.membership_epoch", "fleet.reshards", "fleet.rejoins",
+    "fleet.lost_workers", "fleet.worker_restarts", "fleet.heartbeats",
     # flight recorder (tpu_mx/tracing.py; event NAMES live in its own
     # KNOWN_EVENTS catalog — blackbox_dumps counts black boxes persisted,
     # events_dropped surfaces tracing.stats()["dropped"] as a gauge

@@ -101,6 +101,29 @@ KNOWN_EVENTS = {
     # fault injection (tpu_mx/contrib/chaos.py): the injection and the
     # recovery it provokes share one timeline
     "chaos.inject": {"kind": "str"},
+    # elastic fleet membership (tpu_mx/parallel/fleet.py + tools/launch.py
+    # --supervise; docs/robustness.md "Elastic fleets").  Every membership
+    # transition is on the timeline: `fleet.epoch` is the authoritative
+    # record of a generation advance (who is in the world and why it
+    # changed); join/leave/lost/rejoin are the per-member lifecycle;
+    # `fleet.reshard` records a world-size transition driven through the
+    # load_state_dict reshard seam (source=manifest for fault recovery,
+    # source=live for planned scale-up from in-memory state);
+    # restart_worker/degrade are the fleet supervisor's restart-budget
+    # decisions.  The fleet generation is a PAYLOAD field here — the
+    # trace-context `generation` field remains the supervisor's restore
+    # generation.
+    "fleet.epoch": {"generation": "int", "world_size": "int",
+                    "reason": "str"},
+    "fleet.join": {"member": "int", "generation": "int"},
+    "fleet.leave": {"member": "int", "generation": "int", "reason": "str"},
+    "fleet.lost": {"member": "int", "age_seconds": "float"},
+    "fleet.rejoin": {"member": "int", "generation": "int"},
+    "fleet.reshard": {"generation": "int", "from_world": "int",
+                      "to_world": "int", "source": "str"},
+    "fleet.restart_worker": {"member": "int", "n": "int",
+                             "backoff_seconds": "float"},
+    "fleet.degrade": {"world_size": "int", "reason": "str"},
     # inference serving runtime (tpu_mx/serving/, docs/serving.md): the
     # request lifecycle.  Per-request events (admit/prefill/evict/reject)
     # are additionally stamped with the request-scoped `request` context
