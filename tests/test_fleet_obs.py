@@ -1,0 +1,374 @@
+"""Fleet observability plane (tpu_mx/parallel/fleet_obs.py, ISSUE 18):
+per-rank snapshot shipping, the cross-worker merge and its exactness
+invariant (fleet counter == sum of per-rank counters), histogram
+bucket-merge accuracy, stale-generation exclusion, missing-rank gap
+reporting, cross-rank straggler attribution, the ``slow_worker`` chaos
+knob, and the jax-less report tools over the fleet black box
+(docs/observability.md "Fleet observability")."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_mx import telemetry, tracing
+from tpu_mx.contrib import chaos
+from tpu_mx.parallel import fleet as fleet_mod
+from tpu_mx.parallel import fleet_obs
+from tpu_mx.parallel.fleet import Fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    telemetry.reset()
+    tracing.reset()
+    yield
+    telemetry.reset()
+    tracing.reset()
+
+
+def _worker(root, rank, lease=5.0):
+    """An admitted worker handle on the store (registry is process-
+    global, so callers reset between 'ranks')."""
+    w = Fleet(root, member=rank, lease=lease)
+    w.join()
+    w.await_admission(timeout=10)
+    return w
+
+
+def _counter_rec(name, value, rank, generation, ts=1000.0, **labels):
+    rec = {"name": name, "type": "counter", "value": value, "ts": ts,
+           "rank": rank, "fleet_generation": generation}
+    if labels:
+        rec["labels"] = labels
+    return rec
+
+
+def _phase_events(rank, generation, steps, slow=0.0):
+    """Synthetic train_step.phase events for one rank (data_wait carries
+    the injected slowness)."""
+    out = []
+    for s in range(steps):
+        for ph, sec in (("data_wait", 0.01 + slow), ("dispatch", 0.005),
+                        ("loss_readback", 0.002)):
+            out.append({"event": "train_step.phase", "ts": 1000.0 + s,
+                        "epoch": 0, "step": s, "generation": 0,
+                        "rank": rank, "fleet_generation": generation,
+                        "data": {"phase": ph, "seconds": sec}})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# identity stamping (fleet.py -> telemetry/tracing)
+# ---------------------------------------------------------------------------
+def test_adopt_stamps_fleet_identity(tmp_path):
+    """Adopting a membership epoch stamps rank + generation onto every
+    subsequent telemetry record and trace event — the fields the merge
+    keys stale exclusion and step correlation on."""
+    root = tmp_path / "fleet"
+    ctl = Fleet(root, member=None, controller=True, lease=5.0)
+    ctl.advance(world=[3], reason="launch")
+    w = _worker(root, 3)
+    assert telemetry.fleet_identity() == (3, 1)
+    ctx = tracing.get_context()
+    assert ctx["rank"] == 3 and ctx["fleet_generation"] == 1
+    telemetry.counter("train_step.steps").inc()
+    (rec,) = [r for r in telemetry.snapshot()
+              if r["name"] == "train_step.steps"]
+    assert rec["rank"] == 3 and rec["fleet_generation"] == 1
+    tracing.emit("train_step.phase", phase="data_wait", seconds=0.1)
+    ev = tracing.snapshot(last=1)[0]
+    assert ev["rank"] == 3 and ev["fleet_generation"] == 1
+    w.leave()
+
+
+# ---------------------------------------------------------------------------
+# the merge core and its exactness invariant
+# ---------------------------------------------------------------------------
+def test_counter_sum_identity_under_concurrent_shipping(tmp_path):
+    """The invariant under fire: a worker ships rolling snapshots while
+    its counters move, a second rank's stream sits on disk, and a
+    concurrent aggregator polls throughout — EVERY poll must see merged
+    counters exactly equal to their per-rank sums, and every shipped
+    line must be schema-clean (atomic whole-file rewrites mean no torn
+    reads)."""
+    root = tmp_path / "fleet"
+    ctl = Fleet(root, member=None, controller=True, lease=5.0)
+    ctl.advance(world=[0, 1], reason="launch")
+    # rank 1's stream: static, written by hand
+    obs = os.path.join(ctl.root, fleet_obs.OBS_DIR)
+    os.makedirs(obs, exist_ok=True)
+    with open(os.path.join(obs, "rank-1.jsonl"), "w") as f:
+        f.write(json.dumps(_counter_rec("train_step.steps", 7, 1, 1)) + "\n")
+        f.write(json.dumps(_counter_rec("chaos.injections", 2, 1, 1,
+                                        kind="slow_worker")) + "\n")
+    w = _worker(root, 0)
+    shipper = fleet_obs.ObsShipper(w, interval=0.0)
+    agg = fleet_obs.FleetAggregator(ctl, interval=0.0)
+    stop = threading.Event()
+    failures = []
+
+    def pound():
+        steps = telemetry.counter("train_step.steps")
+        while not stop.is_set():
+            steps.inc()
+            try:
+                shipper.ship(force=True)
+            except Exception as e:          # noqa: BLE001 — collected
+                failures.append(f"ship: {e!r}")
+
+    t = threading.Thread(target=pound)
+    t.start()
+    try:
+        deadline = time.monotonic() + 2.0
+        polls = 0
+        while time.monotonic() < deadline:
+            res = agg.poll(force=True)
+            if res is None or 0 not in res["info"]["ranks"]:
+                continue
+            polls += 1
+            for rec in res["merged"]:
+                telemetry.validate_record(rec)
+                if rec["type"] != "counter":
+                    continue
+                assert rec["value"] == sum(rec["per_rank"].values()), \
+                    f"identity broken on {rec['name']}: {rec}"
+            steps = [r for r in res["merged"]
+                     if r["name"] == "train_step.steps"]
+            assert steps and steps[0]["per_rank"]["1"] == 7
+    finally:
+        stop.set()
+        t.join()
+    assert not failures, failures
+    assert polls > 0
+    w.leave()
+
+
+def test_histogram_bucket_merge_matches_exact_quantiles():
+    """Bucket-merged quantile estimates on the union must land within
+    one bucket of numpy's exact quantiles over the concatenated
+    samples (cumulative counts are element-wise summable because
+    cumulation is linear)."""
+    rng = np.random.RandomState(7)
+    samples = {0: rng.gamma(2.0, 0.01, 400), 1: rng.gamma(6.0, 0.02, 300)}
+    recs = {}
+    for rank, xs in samples.items():
+        telemetry.reset()
+        h = telemetry.histogram("train_step.seconds")
+        for x in xs:
+            h.observe(float(x))
+        (rec,) = [r for r in telemetry.snapshot()
+                  if r["name"] == "train_step.seconds"]
+        rec["rank"] = rank
+        recs[rank] = [rec]
+    merged, info = fleet_obs.merge_streams(recs)
+    (m,) = merged
+    assert m["value"] == 700 and info["ranks"] == [0, 1]
+    union = np.concatenate(list(samples.values()))
+    bounds, _cum = telemetry._split_record_buckets(m["buckets"])
+
+    def bucket_index(v):
+        return next((i for i, b in enumerate(bounds) if v <= b),
+                    len(bounds))
+
+    for q in (0.5, 0.9, 0.99):
+        est = telemetry.quantile_from_cumulative(
+            m["buckets"], q, vmin=m.get("min"), vmax=m.get("max"))
+        exact = float(np.quantile(union, q))
+        assert abs(bucket_index(est) - bucket_index(exact)) <= 1, \
+            f"q{q}: estimate {est} vs exact {exact} off by > 1 bucket"
+
+
+def test_histogram_merge_refuses_mismatched_buckets():
+    a = {"name": "train_step.seconds", "type": "histogram", "value": 1,
+         "sum": 0.1, "ts": 1.0, "buckets": [[0.1, 1], ["+Inf", 1]]}
+    b = dict(a, buckets=[[0.2, 1], ["+Inf", 1]])
+    with pytest.raises(ValueError, match="bucket edges differ"):
+        fleet_obs.merge_streams({0: [a], 1: [b]})
+
+
+def test_stale_generation_records_excluded():
+    """An evicted rank's snapshot from a previous membership epoch must
+    not pollute the current epoch's rollup: stamped-stale records are
+    dropped (and counted), a fully-stale rank disappears from the
+    reporting set, unstamped records ride along."""
+    streams = {
+        0: [_counter_rec("train_step.steps", 10, 0, 2)],
+        1: [_counter_rec("train_step.steps", 99, 1, 1)],      # stale
+        2: [{"name": "fleet.worker_restarts", "type": "counter",
+             "value": 4, "ts": 1000.0}],                      # unstamped
+    }
+    merged, info = fleet_obs.merge_streams(streams, generation=2)
+    assert info["stale_dropped"] == 1
+    assert info["ranks"] == [0, 2]          # rank 1 fully stale -> gone
+    (steps,) = [r for r in merged if r["name"] == "train_step.steps"]
+    assert steps["value"] == 10 and list(steps["per_rank"]) == ["0"]
+    assert [r for r in merged if r["name"] == "fleet.worker_restarts"]
+
+
+def test_missing_rank_is_a_gap_never_interpolated(tmp_path):
+    """World {0, 1, 2} with only ranks 0 and 2 shipping: the aggregator
+    reports the gap (fleet.ranks_reporting == 2) and no merged record
+    invents a rank-1 contribution."""
+    root = tmp_path / "fleet"
+    ctl = Fleet(root, member=None, controller=True, lease=5.0)
+    ctl.advance(world=[0, 1, 2], reason="launch")
+    obs = os.path.join(ctl.root, fleet_obs.OBS_DIR)
+    os.makedirs(obs, exist_ok=True)
+    for rank in (0, 2):
+        with open(os.path.join(obs, f"rank-{rank}.jsonl"), "w") as f:
+            f.write(json.dumps(
+                _counter_rec("train_step.steps", 5, rank, 1)) + "\n")
+    agg = fleet_obs.FleetAggregator(ctl)
+    res = agg.poll(force=True)
+    assert res["info"]["ranks"] == [0, 2]
+    assert telemetry.get("fleet.ranks_reporting").value == 2
+    for rec in res["merged"]:
+        assert "1" not in rec.get("per_rank", {})
+    (steps,) = [r for r in res["merged"]
+                if r["name"] == "train_step.steps"]
+    assert steps["value"] == 10                  # 5 + 5, nothing imputed
+
+
+# ---------------------------------------------------------------------------
+# straggler attribution
+# ---------------------------------------------------------------------------
+def test_correlate_steps_attributes_slowest_rank_and_phase():
+    events = {0: _phase_events(0, 1, steps=6),
+              1: _phase_events(1, 1, steps=6, slow=0.3)}
+    correlated = fleet_obs.correlate_steps(events, generation=1)
+    assert len(correlated) == 6
+    for c in correlated:
+        assert c["slowest_rank"] == 1
+        assert c["dominant_phase"] == "data_wait"
+        assert c["skew_seconds"] == pytest.approx(0.3)
+    # single-rank steps never correlate — skew needs >= 2 observers
+    assert fleet_obs.correlate_steps({0: _phase_events(0, 1, 4)}) == []
+    # generation alignment: the same (epoch, step) under another
+    # membership epoch is a DIFFERENT step
+    assert fleet_obs.correlate_steps(events, generation=2) == []
+
+
+def test_straggler_detector_flags_persistent_rank_and_flips_back():
+    det = fleet_obs.StragglerDetector(window=8, frac=0.5, min_steps=4)
+    events = {0: _phase_events(0, 1, steps=6),
+              1: _phase_events(1, 1, steps=6, slow=0.2)}
+    sig = det.update(fleet_obs.correlate_steps(events, generation=1))
+    assert sig["straggling"] and sig["rank"] == 1
+    assert sig["dominant_phase"] == "data_wait"
+    assert sig["excess_seconds"] == pytest.approx(0.2)
+    flips = [e for e in tracing.snapshot()
+             if e["event"] == "fleet.straggler"]
+    assert flips and flips[-1]["data"]["rank"] == 1
+    # feeding the SAME correlated steps again must not re-judge them
+    # (shipped event snapshots are rolling and overlap poll to poll)
+    assert det.update(fleet_obs.correlate_steps(events, generation=1)) \
+        == sig
+    # recovery: rank 1 goes fast for a full window -> all-clear flip
+    healed = {0: [], 1: []}
+    for r in (0, 1):
+        evs = _phase_events(r, 1, steps=20, slow=0.2 if r == 0 else 0.0)
+        healed[r] = [e for e in evs if e["step"] >= 6]
+    sig2 = det.update(fleet_obs.correlate_steps(healed, generation=1))
+    assert sig2["rank"] == 0 or not sig2["straggling"]
+    flips = [e for e in tracing.snapshot()
+             if e["event"] == "fleet.straggler"]
+    assert len(flips) >= 2                       # the state flipped again
+
+
+def test_chaos_slow_worker_fires_only_on_matching_rank():
+    with chaos.enable(slow_worker_rank=1, slow_worker_seconds=0.01) as cfg:
+        chaos.maybe_slow_worker(rank=0)
+        assert cfg.slow_worker_fires == 0
+        t0 = time.perf_counter()
+        chaos.maybe_slow_worker(rank=1)
+        assert time.perf_counter() - t0 >= 0.01
+        assert cfg.slow_worker_fires == 1
+    m = telemetry.get("chaos.injections", kind="slow_worker")
+    assert m is not None and m.value == 1
+
+
+# ---------------------------------------------------------------------------
+# the fleet black box + the jax-less tools
+# ---------------------------------------------------------------------------
+def _build_fleet_run(tmp_path):
+    """Ship two ranks (one straggling), aggregate, return (ctl, agg)."""
+    root = tmp_path / "fleet"
+    ctl = Fleet(root, member=None, controller=True, lease=5.0)
+    ctl.advance(world=[0, 1], reason="launch")
+    for rank in (0, 1):
+        telemetry.reset()
+        tracing.reset()
+        w = _worker(root, rank)
+        telemetry.counter("train_step.steps").inc(10 + rank)
+        telemetry.histogram("train_step.seconds").observe(0.01)
+        for ev in _phase_events(rank, 1, steps=6,
+                                slow=0.25 if rank == 1 else 0.0):
+            tracing.set_context(epoch=ev["epoch"], step=ev["step"])
+            tracing.emit("train_step.phase", **ev["data"])
+        fleet_obs.ObsShipper(w).ship(force=True)
+        w.leave()
+    telemetry.reset()
+    tracing.reset()
+    return ctl, fleet_obs.FleetAggregator(ctl)
+
+
+def test_fleet_blackbox_roundtrip_and_report_tools(tmp_path):
+    """ship -> aggregate -> dump -> validate: the black box carries the
+    cross-rank section, the in-module validator re-proves the identity,
+    and both report tools exit 0 on it (fleet_report additionally names
+    the straggling rank and its dominant phase in the rendering)."""
+    ctl, agg = _build_fleet_run(tmp_path)
+    res = agg.poll(force=True)
+    assert res["signal"]["straggling"] and res["signal"]["rank"] == 1
+    path = fleet_obs.dump_fleet_blackbox(ctl.root, reason="test dump",
+                                         aggregator=agg)
+    assert path == fleet_obs.fleet_blackbox_path(ctl.root)
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    fleet_obs.validate_fleet_section(doc, telemetry=telemetry)
+    # tampering with one per-rank value must break the identity check
+    bad = json.loads(json.dumps(doc))
+    for rec in bad["fleet"]["aggregate"]:
+        if rec["type"] == "counter":
+            rec["value"] += 1
+            break
+    with pytest.raises(ValueError, match="identity"):
+        fleet_obs.validate_fleet_section(bad)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_report.py"),
+         path, "--validate"], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "slowest=rank 1" in r.stdout
+    assert "data_wait" in r.stdout
+    assert "aggregation identity holds" in r.stdout
+
+
+def test_telemetry_report_merge_mode(tmp_path):
+    """--merge folds per-rank files through the same merge core and
+    composes with --validate/--require (the fleet_obs preset's
+    obs-shipping counter rides in the worker streams)."""
+    ctl, _agg = _build_fleet_run(tmp_path)
+    obs = os.path.join(ctl.root, fleet_obs.OBS_DIR)
+    files = [os.path.join(obs, f"rank-{r}.jsonl") for r in (0, 1)]
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "telemetry_report.py"), "--merge",
+         *files, "--validate",
+         "--require", "fleet.obs_records,train_step.steps"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "aggregation identity holds" in r.stdout
+    # a required-but-absent metric still fails the merged gate
+    r2 = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "telemetry_report.py"), "--merge",
+         *files, "--require", "serve.requests"],
+        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 1

@@ -842,6 +842,114 @@ FLEET_REQUIRED = ("fleet", "resume", "chaos.injections",
                   "train_step.steps")
 
 
+# The soak tier's STRAGGLER sub-leg (ISSUE 18): a real 2-worker fleet
+# under `tools/launch.py --supervise` with the `slow_worker_rank` chaos
+# knob delaying every rank-1 step inside the measured data_wait window,
+# run through BOTH churn shapes (mid-step SIGTERM preempt -> evict ->
+# restart -> rejoin, and partition -> lease expiry -> heal -> rejoin).
+# Each worker trains a tiny real model through CompiledTrainStep — the
+# phase events the cross-rank attribution correlates come from the
+# production train-step path, not a simulation.  Gates: the controller's
+# fleet.step_skew_seconds gauge moved, the windowed detector names the
+# injected rank with the injected dominant phase in the fleet black box,
+# `fleet_report --validate` passes on that box under POISONED jax (the
+# report tools never boot the accelerator stack), and
+# `telemetry_report --merge --require fleet_obs` holds the aggregation
+# identity across the controller + per-worker registries.
+STRAGGLER_WORKER = """
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.environ["TPUMX_REPO"])
+member = int(os.environ["TPUMX_FLEET_MEMBER"])
+# per-rank telemetry sink: workers inherit the controller's env, and a
+# shared JSONL would interleave two processes' appends
+os.environ["TPUMX_TELEMETRY"] = os.path.join(
+    os.environ["TPUMX_CI_DIR"], "worker-%d.jsonl" % member)
+# the CPU backend cannot run cross-process collectives: drop the
+# coordinator env before the tpu_mx import boots jax.distributed (also
+# keeps XLA's preemption notifier off the chaos SIGTERM)
+for k in ("TPUMX_COORDINATOR", "TPUMX_NUM_PROC", "TPUMX_PROC_ID"):
+    os.environ.pop(k, None)
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tpu_mx as mx
+from tpu_mx import gluon, nd, telemetry, tracing
+from tpu_mx import random as trandom
+from tpu_mx.contrib import chaos
+from tpu_mx.elastic import WorkerFailure
+from tpu_mx.gluon import nn
+from tpu_mx.parallel import CompiledTrainStep
+from tpu_mx.parallel.fleet import Fleet, MembershipChange
+
+LEASE = float(os.environ.get("TPUMX_FLEET_LEASE", "2.0"))
+if os.environ.get("TPUMX_CI_SCENARIO") == "partition" and member == 1:
+    # armed programmatically, NOT via TPUMX_CHAOS: the partition must
+    # HEAL mid-run, which a parse-once env knob cannot express
+    cfg = chaos._Config(partition_worker=1, slow_worker_rank=1,
+                        slow_worker_seconds=0.2)
+    chaos._config = cfg
+
+    def _heal():
+        with cfg.lock:
+            cfg.partition_worker = None
+    # heal just past the lease horizon: ONE eviction cycle (expire ->
+    # evict -> heal -> rejoin), not a churn storm
+    threading.Timer(LEASE * 1.2, _heal).start()
+
+f = Fleet.from_env()
+f.join()
+f.await_admission(timeout=60)
+
+trandom.seed(7)
+net = nn.HybridSequential(prefix="sw_")
+net.add(nn.Dense(4, in_units=4, activation="relu", prefix="fc1_"))
+net.add(nn.Dense(2, in_units=4, prefix="fc2_"))
+net.initialize()
+net(nd.ones((1, 4)))
+step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         mx.optimizer.create("sgd", learning_rate=0.05))
+R = np.random.RandomState(3)
+X = R.rand(8, 4).astype(np.float32)
+Y = (X.sum(1) > 2).astype(np.float32)
+
+STEPS = int(os.environ.get("TPUMX_CI_STEPS", "24"))
+s = 0
+deadline = time.monotonic() + 120
+while s < STEPS and time.monotonic() < deadline:
+    try:
+        f.on_step()
+    except MembershipChange:
+        try:
+            f.ack()
+            f.shard()
+        except WorkerFailure:
+            # evicted (lease expired while partitioned): rejoin at the
+            # next epoch instead of dying
+            f.join()
+            f.await_admission(timeout=60)
+        continue
+    s += 1
+    # both ranks walk the SAME (epoch, step) grid — the cross-rank
+    # correlation joins on these keys (+ the membership generation the
+    # fleet stamps into the trace context).  The baseline pace keeps
+    # the ranks within the same generation window long enough to
+    # correlate: an unpaced fast rank would finish the whole grid
+    # before the chaos-slowed one left step 2, and a step only ONE rank
+    # observed has no skew
+    tracing.set_context(epoch=s // 8, step=s % 8)
+    step.step(nd.array(X), nd.array(Y))
+    time.sleep(0.15)
+telemetry.flush(final=True)
+f.leave()
+print("WORKER DONE", member, flush=True)
+"""
+
+
 # The serve tier's workload (ISSUE 8): a fixed-seed request storm
 # against the serving runtime with every serving chaos knob armed in
 # turn — reject_storm (admission backpressure + client resubmit), a
@@ -1411,6 +1519,125 @@ def soak_tier():
                   f"(rc={val.returncode}):\n"
                   f"{((val.stdout or '') + (val.stderr or ''))[-3000:]}")
             return val.returncode or 1
+    # straggler sub-leg (ISSUE 18): the injected straggler must be
+    # named, with its dominant phase, under BOTH churn shapes
+    for scenario in ("preempt", "partition"):
+        rc = _straggler_leg(repo, scenario)
+        if rc:
+            return rc
+    return 0
+
+
+def _straggler_leg(repo, scenario):
+    """One supervised 2-worker fleet with rank 1 chaos-slowed, churned by
+    ``scenario`` ("preempt": SIGTERM rank 0 mid-step -> evict -> restart
+    -> rejoin; "partition": rank 1's beats suppressed -> lease expiry ->
+    evict -> heal -> rejoin).  Gates the whole observability plane on
+    the resulting artifacts."""
+    with tempfile.TemporaryDirectory() as d:
+        fleet_dir = os.path.join(d, "fleet")
+        ctl_jsonl = os.path.join(d, "controller.jsonl")
+        worker = os.path.join(d, "worker.py")
+        with open(worker, "w") as f:
+            f.write(STRAGGLER_WORKER)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TPUMX_TELEMETRY=ctl_jsonl, TPUMX_REPO=repo,
+                   TPUMX_CI_DIR=d, TPUMX_CI_SCENARIO=scenario,
+                   TPUMX_CI_STEPS="24")
+        env.pop("TPUMX_CHAOS", None)   # scenario wiring below only
+        env.pop("TPUMX_TRACING", None)
+        argv = [sys.executable, os.path.join(repo, "tools", "launch.py"),
+                "--supervise", "-n", "2", "--fleet-dir", fleet_dir,
+                "--max-restarts", "2", "--backoff", "1.0",
+                "--lease", "2.0", "--join-timeout", "60"]
+        if scenario == "preempt":
+            # the env-wired shape: rank 1 straggles all run, rank 0 is
+            # SIGTERMed mid-step and comes back chaos-stripped
+            argv += ["--env", "TPUMX_CHAOS=slow_worker_rank=1,"
+                             "slow_worker_seconds=0.25,"
+                             "preempt_worker_at_step=6,preempt_rank=0"]
+        argv += [sys.executable, worker]
+        try:
+            run = subprocess.run(argv, env=env, cwd=repo,
+                                 capture_output=True, text=True,
+                                 timeout=600)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: straggler/{scenario} run timed out: {e}")
+            return 1
+        if run.returncode != 0:
+            print(f"  soak: straggler/{scenario} supervised run failed "
+                  f"(rc={run.returncode}):\n"
+                  f"{((run.stdout or '') + (run.stderr or ''))[-4000:]}")
+            return run.returncode or 1
+        box = os.path.join(fleet_dir, "fleet-blackbox.json")
+        try:
+            with open(box, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"  soak: straggler/{scenario}: no readable fleet "
+                  f"black box at {box}: {e}")
+            return 1
+        sig = (doc.get("fleet") or {}).get("straggler_signal") or {}
+        if not (sig.get("straggling") and sig.get("rank") == 1
+                and sig.get("dominant_phase") == "data_wait"):
+            print(f"  soak: straggler/{scenario}: detector did not name "
+                  f"the injected rank/phase (signal={sig})")
+            return 1
+        skews = [c.get("skew_seconds", 0.0)
+                 for c in (doc.get("fleet") or {}).get("skew_timeline", [])]
+        if not skews or max(skews) <= 0.0:
+            print(f"  soak: straggler/{scenario}: skew never moved "
+                  f"(timeline={skews[:8]})")
+            return 1
+        # the report tool must work — and name rank 1 + the phase — on a
+        # machine with NO accelerator stack (poisoned jax/tpu_mx)
+        report = os.path.join(repo, "tools", "fleet_report.py")
+        poison = ("import sys, runpy; sys.modules['jax'] = None; "
+                  "sys.modules['tpu_mx'] = None; "
+                  f"sys.argv = ['fleet_report', {box!r}, '--validate']; "
+                  f"runpy.run_path({report!r}, run_name='__main__')")
+        try:
+            rep = subprocess.run([sys.executable, "-c", poison],
+                                 capture_output=True, text=True,
+                                 timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: straggler/{scenario}: fleet_report timed "
+                  f"out: {e}")
+            return 1
+        out = rep.stdout or ""
+        if rep.returncode != 0 or "rank 1" not in out \
+                or "data_wait" not in out:
+            print(f"  soak: straggler/{scenario}: fleet_report "
+                  f"--validate failed (rc={rep.returncode}):\n"
+                  f"{(out + (rep.stderr or ''))[-3000:]}")
+            return rep.returncode or 1
+        # aggregation identity across the controller + worker registries
+        files = [ctl_jsonl] + [os.path.join(d, f"worker-{r}.jsonl")
+                               for r in (0, 1)]
+        missing = [p for p in files if not os.path.exists(p)]
+        if missing:
+            print(f"  soak: straggler/{scenario}: missing telemetry "
+                  f"file(s): {missing}")
+            return 1
+        try:
+            val = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "telemetry_report.py"),
+                 "--merge", *files, "--validate",
+                 "--require", "fleet_obs"],
+                capture_output=True, text=True, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: straggler/{scenario}: merged validation "
+                  f"timed out: {e}")
+            return 1
+        if val.returncode != 0:
+            print(f"  soak: straggler/{scenario}: merged telemetry "
+                  f"validation failed (rc={val.returncode}):\n"
+                  f"{((val.stdout or '') + (val.stderr or ''))[-3000:]}")
+            return val.returncode or 1
+        print(f"  soak: straggler/{scenario}: rank 1/data_wait "
+              f"attributed, max skew {max(skews):.3f}s, merged "
+              "identity holds")
     return 0
 
 

@@ -130,8 +130,9 @@ def _import_fleet():
     if root not in sys.path:
         sys.path.insert(0, root)
     from tpu_mx.parallel import fleet as fleet_mod
+    from tpu_mx.parallel import fleet_obs as fleet_obs_mod
     from tpu_mx import telemetry, tracing
-    return fleet_mod, telemetry, tracing
+    return fleet_mod, fleet_obs_mod, telemetry, tracing
 
 
 def restart_backoff(base, attempt, rng=None):
@@ -148,11 +149,16 @@ def supervise(args, coord):
     """Fleet-supervising local tracker: spawn N workers under the
     membership-epoch protocol, evict/restart/admit on churn, degrade when
     a worker's restart budget runs out.  Returns the process exit code."""
-    fleet_mod, _telemetry, _tracing = _import_fleet()
+    fleet_mod, fleet_obs, _telemetry, _tracing = _import_fleet()
     fleet_dir = args.fleet_dir or tempfile.mkdtemp(prefix="tpumx_fleet_")
     fleet = fleet_mod.Fleet(fleet_dir, member=None, controller=True,
                             lease=args.lease)
     fleet.advance(world=range(args.num_workers), reason="launch")
+    # the controller-side observability plane: merges the workers'
+    # shipped snapshots into fleet.* rollups and watches for persistent
+    # stragglers (tpu_mx/parallel/fleet_obs.py)
+    agg = fleet_obs.FleetAggregator(fleet,
+                                    interval=max(0.5, args.lease / 4.0))
 
     def spawn(rank, *, fresh=False):
         env = dict(os.environ)
@@ -174,18 +180,45 @@ def supervise(args, coord):
     exit_codes = {}
     poll = max(0.05, args.lease / 4.0)
 
+    def straggler_note():
+        """One-line straggler context for evict/degrade decisions (empty
+        when the detector is quiet)."""
+        sig = (agg.last or {}).get("signal") or agg.detector.signal
+        if not sig.get("straggling"):
+            return ""
+        return (f" [straggler: rank {sig['rank']} "
+                f"+{sig['excess_seconds']:.3f}s/step in "
+                f"{sig['dominant_phase'] or '?'} over {sig['steps']} steps]")
+
+    def dump_fleet_box(why):
+        """Collect every live worker's shipped events + telemetry into
+        the cross-rank black box (best-effort: forensics must never take
+        the controller down)."""
+        try:
+            return fleet_obs.dump_fleet_blackbox(fleet_dir, reason=why,
+                                                 aggregator=agg)
+        except OSError:
+            return None
+
     def degrade(rank, why):
         world = fleet.world()
+        why += straggler_note()
         _tracing.emit("fleet.degrade", world_size=len(world), reason=why)
-        _tracing.dump_blackbox(
-            os.path.join(fleet_dir, "fleet"),
-            reason=f"fleet degrade: {why} — continuing at world size "
-                   f"{len(world)} {world}")
+        # the fleet black box replaces the PR 15 single-process dump at
+        # the SAME path (<fleet_dir>/fleet-blackbox.json): the base
+        # document is unchanged, the cross-rank section rides on top
+        dump_fleet_box(f"fleet degrade: {why} — continuing at world size "
+                       f"{len(world)} {world}")
         print(f"launch: {why}; degrading to world size {len(world)}",
               file=sys.stderr)
 
     def on_failure(rank, rc):
         if rank in fleet.world():
+            # snapshot the fleet BEFORE the eviction epoch: the dying
+            # rank's last shipped state is still generation-current here
+            # and would be excluded as stale one epoch later
+            dump_fleet_box(f"worker {rank} exit={rc}{straggler_note()}"
+                           f" — evicting")
             fleet.evict(rank, reason=f"exit={rc}")
         if restarts[rank] < args.max_restarts:
             restarts[rank] += 1
@@ -215,7 +248,13 @@ def supervise(args, coord):
                     on_failure(rank, rc)
             # lease-expired members (partitioned but process still alive)
             # are evicted by the protocol path, not the exit-code path
+            world_before = fleet.world()
             fleet.reconcile()
+            if fleet.world() != world_before:
+                dump_fleet_box(f"membership changed by reconcile: "
+                               f"{world_before} -> {fleet.world()}"
+                               f"{straggler_note()}")
+            agg.poll()
             for rank, due in list(pending.items()):
                 if time.monotonic() < due:
                     continue
@@ -243,6 +282,9 @@ def supervise(args, coord):
         for p in procs.values():
             if p.poll() is None:
                 p.terminate()
+        # final flight record: whatever the workers last shipped, plus
+        # the skew timeline and straggler verdict of the whole run
+        dump_fleet_box(f"supervise exit{straggler_note()}")
     # signal deaths report negative codes — any nonzero outcome (even a
     # degraded-but-completed run) must surface as a failed launch
     return 1 if any(rc != 0 for rc in exit_codes.values()) else 0

@@ -13,6 +13,16 @@ Modes (the ``obs`` tier of tools/ci.py runs the first two):
     python tools/telemetry_report.py metrics.jsonl --validate \
         --require fusion.flushes,checkpoint.save_seconds
     python tools/telemetry_report.py --diff A.jsonl B.jsonl
+    python tools/telemetry_report.py --merge ctl.jsonl obs/rank-*.jsonl
+
+``--merge`` renders the FLEET view over N per-rank snapshot files using
+the cross-worker merge core (tpu_mx/parallel/fleet_obs.py): counters
+sum, histograms bucket-merge, gauges spread to min/mean/max — the same
+code path the supervising launcher aggregates with, so the offline view
+and the live rollup can never disagree.  ``--validate`` additionally
+re-proves the aggregation identity (every merged counter equals its
+per-rank sum) and ``--require`` gates the merged view (the ``fleet_obs``
+preset spans worker + controller registries).
 
 ``--diff`` renders the DELTA between two snapshots (soak runs, bench
 A/Bs): counter values and histogram count/sum are subtracted (B - A),
@@ -72,6 +82,15 @@ REQUIRE_PRESETS = {
     # back (lost_workers/worker_restarts are deliberately absent — a
     # planned-scale-only churn run legitimately loses nobody).
     "fleet": ("fleet.membership_epoch", "fleet.reshards", "fleet.rejoins"),
+    # "fleet_obs" gates the fleet observability plane (ISSUE 18): workers
+    # actually shipped snapshots, the controller's aggregation pass saw
+    # them, and at least one step was observed by >= 2 ranks so cross-
+    # rank skew exists.  Spans worker AND controller registries — meant
+    # for `--merge controller.jsonl <fleet_dir>/obs/rank-*.jsonl`
+    # (straggler_signal is deliberately absent: it is rightly 0 on a
+    # straggler-free run).
+    "fleet_obs": ("fleet.obs_records", "fleet.ranks_reporting",
+                  "fleet.step_skew_seconds"),
 }
 
 
@@ -96,13 +115,24 @@ def load_telemetry():
     return mod
 
 
-def read_series(path, telemetry, validate=False):
-    """Parse the JSONL file into {(name, labels_json): last_record}.
+def load_fleet_obs():
+    """Load the fleet-observability merge core the same standalone way
+    (its merge/correlate functions are stdlib-only by contract; the
+    package bridges degrade to None on a standalone load)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tpu_mx", "parallel", "fleet_obs.py")
+    spec = importlib.util.spec_from_file_location("_tpumx_fleet_obs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
-    Returns (series, n_snapshots, errors).  With validate=True, schema
-    violations and unknown metric names land in `errors` instead of being
-    silently passed through."""
-    series = {}
+
+def read_records(path, telemetry, validate=False):
+    """Parse the JSONL file into (records, stamps, errors) — every
+    record in file order.  With validate=True, schema violations and
+    unknown metric names land in `errors` instead of being silently
+    passed through."""
+    records = []
     stamps = set()
     errors = []
     with open(path, encoding="utf-8") as f:
@@ -128,11 +158,25 @@ def read_series(path, telemetry, validate=False):
                         "(stable names are an API; register new metrics in "
                         "the catalog + docs/observability.md)")
                     continue
-            key = (rec.get("name"),
-                   json.dumps(rec.get("labels", {}), sort_keys=True))
-            series[key] = rec
+            records.append(rec)
             if "ts" in rec:
                 stamps.add(rec["ts"])
+    return records, stamps, errors
+
+
+def read_series(path, telemetry, validate=False):
+    """Parse the JSONL file into {(name, labels_json): last_record}.
+
+    Returns (series, n_snapshots, errors).  With validate=True, schema
+    violations and unknown metric names land in `errors` instead of being
+    silently passed through."""
+    records, stamps, errors = read_records(path, telemetry,
+                                           validate=validate)
+    series = {}
+    for rec in records:
+        key = (rec.get("name"),
+               json.dumps(rec.get("labels", {}), sort_keys=True))
+        series[key] = rec
     return series, len(stamps), errors
 
 
@@ -274,10 +318,68 @@ def check_required(series, required):
     return problems
 
 
+def run_merge(opts, telemetry, ap):
+    """--merge: fold N per-rank JSONL files through the fleet merge core
+    (tpu_mx/parallel/fleet_obs.py — counters sum, histograms bucket-
+    merge, gauges spread) and render/gate the FLEET view.  Each file's
+    rank comes from its records' ``rank`` stamp; unstamped files (a
+    controller's own registry) get distinct negative pseudo-ranks so
+    they can ride along without colliding with a real rank."""
+    if len(opts.file) < 2:
+        ap.error("--merge needs at least two files: a.jsonl b.jsonl ...")
+    fleet_obs = load_fleet_obs()
+    streams = {}
+    errors = []
+    for idx, path in enumerate(opts.file):
+        recs, _stamps, errs = read_records(path, telemetry,
+                                           validate=opts.validate)
+        errors += [f"{os.path.basename(path)}: {e}" for e in errs]
+        rank = next((r["rank"] for r in recs
+                     if isinstance(r.get("rank"), int)
+                     and not isinstance(r.get("rank"), bool)), -1 - idx)
+        streams.setdefault(rank, []).extend(recs)
+    try:
+        merged, info = fleet_obs.merge_streams(streams)
+    except ValueError as e:
+        print(f"VALIDATION FAILED:\n  merge: {e}", file=sys.stderr)
+        return 1
+    series = {(r["name"],
+               json.dumps(r.get("labels", {}), sort_keys=True)): r
+              for r in merged}
+    print(render(series, len(opts.file),
+                 " + ".join(os.path.basename(p) for p in opts.file)))
+    print(f"Merged {len(opts.file)} file(s) as rank(s) "
+          f"{info['ranks']} ({info['records_read']} record(s) read; "
+          "negative ranks are unstamped files)")
+    if opts.validate:
+        # the aggregation exactness invariant, re-checked on the way out
+        for rec in merged:
+            if rec["type"] == "counter":
+                total = sum(rec["per_rank"].values())
+                if total != rec["value"]:
+                    errors.append(
+                        f"aggregation identity violated: {rec['name']} "
+                        f"merged value {rec['value']} != per-rank sum "
+                        f"{total}")
+    errors += check_required(series, expand_required(opts.require))
+    if not series and not errors:
+        errors.append("no file contains telemetry records")
+    if errors:
+        print("VALIDATION FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    if opts.validate:
+        print(f"schema OK: {len(series)} merged series from "
+              f"{len(info['ranks'])} rank(s); aggregation identity holds")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("file", nargs="+",
-                    help="TPUMX_TELEMETRY JSONL file (two with --diff)")
+                    help="TPUMX_TELEMETRY JSONL file (two with --diff, "
+                         "two or more with --merge)")
     ap.add_argument("--validate", action="store_true",
                     help="fail on schema violations or unknown metric names")
     ap.add_argument("--require", default="",
@@ -288,8 +390,17 @@ def main(argv=None):
                     help="delta view between exactly two snapshot files "
                          "(counters/histograms subtracted, gauges side "
                          "by side)")
+    ap.add_argument("--merge", action="store_true",
+                    help="fleet view over N per-rank snapshot files "
+                         "(counters summed, histograms bucket-merged, "
+                         "gauges spread — the fleet_obs merge core); "
+                         "--validate/--require apply to the merged view")
     opts = ap.parse_args(argv)
     telemetry = load_telemetry()
+    if opts.merge:
+        if opts.diff:
+            ap.error("--merge and --diff are mutually exclusive")
+        return run_merge(opts, telemetry, ap)
     if opts.diff:
         if len(opts.file) != 2:
             ap.error("--diff needs exactly two files: A.jsonl B.jsonl")

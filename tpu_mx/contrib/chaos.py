@@ -61,6 +61,14 @@ Injection kinds (all one process, no root, no LD_PRELOAD):
   it on lease expiry and the zombie must be refused at the next
   generation-tagged barrier.  Counted once, on the first suppressed
   beat.
+- ``slow_worker_rank=R`` / ``slow_worker_seconds=S``: the fleet member
+  with rank R sleeps S seconds at EVERY train step (deterministic,
+  NOT one-shot — persistence is exactly what the fleet's windowed
+  straggler detector keys on, tpu_mx/parallel/fleet_obs.py).  The
+  compiled train step calls :func:`maybe_slow_worker` inside its
+  ``data_wait`` phase window, so the injected delay lands in a
+  MEASURED phase and the cross-rank attribution can name it.  Counted
+  per fire.
 - ``match=SUBSTR``: scope file-level faults to paths containing SUBSTR
   (e.g. ``match=.params`` tears the params file but not the manifest).
 
@@ -95,7 +103,8 @@ from .. import tracing as _tracing
 __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
            "wrap_file", "maybe_oserror", "peer_killed", "poison_loss",
            "maybe_hang", "maybe_crash_step", "maybe_slow_decode",
-           "forced_reject", "maybe_preempt", "partitioned"]
+           "forced_reject", "maybe_preempt", "partitioned",
+           "maybe_slow_worker"]
 
 
 def _count_injection(kind):
@@ -125,6 +134,7 @@ class _Config:
               "hang_step", "hang_seconds", "crash_at_step",
               "slow_decode_step", "slow_decode_seconds", "reject_storm",
               "preempt_worker_at_step", "preempt_rank", "partition_worker",
+              "slow_worker_rank", "slow_worker_seconds",
               "seed", "hard", "match")
 
     def __init__(self, crash_after_bytes=None, torn_write=None, slow_io=None,
@@ -133,7 +143,8 @@ class _Config:
                  crash_at_step=None, slow_decode_step=None,
                  slow_decode_seconds=3600.0, reject_storm=0,
                  preempt_worker_at_step=None, preempt_rank=0,
-                 partition_worker=None, seed=None,
+                 partition_worker=None, slow_worker_rank=None,
+                 slow_worker_seconds=1.0, seed=None,
                  hard=False, match=None):
         if seed is None:
             seed = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
@@ -157,6 +168,9 @@ class _Config:
         self.preempt_rank = int(preempt_rank)
         self.partition_worker = None if partition_worker is None \
             else int(partition_worker)
+        self.slow_worker_rank = None if slow_worker_rank is None \
+            else int(slow_worker_rank)
+        self.slow_worker_seconds = float(slow_worker_seconds)
         self.seed = seed
         self.hard = bool(hard)
         self.match = match
@@ -181,6 +195,7 @@ class _Config:
         self.fleet_steps_seen = 0    # fleet steps while preempt armed
         self.preempts = 0
         self.partitions = 0          # heartbeats suppressed by partition
+        self.slow_worker_fires = 0   # per-step straggler delays injected
 
     def matches(self, path):
         return self.match is None or (path is not None
@@ -242,7 +257,8 @@ def configure_from_env():
             continue
         if key == "match":
             kwargs[key] = val
-        elif key in ("slow_io", "hang_seconds", "slow_decode_seconds"):
+        elif key in ("slow_io", "hang_seconds", "slow_decode_seconds",
+                     "slow_worker_seconds"):
             kwargs[key] = float(val)
         elif key in ("kill_peer", "hard"):
             kwargs[key] = val in ("", "1", "true", "yes", "on")
@@ -504,6 +520,32 @@ def maybe_preempt(rank):
     log.warning("chaos: preempting rank %s at fleet step %d "
                 "(preempt_worker_at_step fired)", rank, cfg.fleet_steps_seen)
     os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_slow_worker(rank=None):
+    """Sleep ``slow_worker_seconds`` when ``slow_worker_rank`` says this
+    process is the injected straggler (the compiled train step calls
+    this at the top of every step, INSIDE its ``data_wait`` phase
+    window — the delay lands in a measured phase so cross-rank
+    attribution, tpu_mx/parallel/fleet_obs.py, can name both the rank
+    and the phase).  Deterministic and NOT one-shot: the windowed
+    persistent-straggler detector keys on the delay repeating.  `rank`
+    defaults to the ``TPUMX_FLEET_MEMBER`` env rank — fleet workers
+    know their member slot before any Fleet object exists."""
+    cfg = configure_from_env()  # fleet workers may have no supervisor
+    if cfg is None or cfg.slow_worker_rank is None:
+        return
+    if rank is None:
+        rank = os.environ.get("TPUMX_FLEET_MEMBER")
+    if rank is None or int(rank) != cfg.slow_worker_rank:
+        return
+    with cfg.lock:
+        if cfg.slow_worker_rank is None:
+            return
+        cfg.slow_worker_fires += 1
+        _count_injection("slow_worker")
+        secs = cfg.slow_worker_seconds
+    time.sleep(secs)
 
 
 def partitioned(rank):

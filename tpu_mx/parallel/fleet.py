@@ -179,6 +179,7 @@ class Fleet:
         self._beat = 0
         self._acked_gen = None      # generation this process adopted
         self._acked_world = None    # member list of that generation
+        self._shipper = None        # lazy fleet_obs.ObsShipper (workers)
         os.makedirs(os.path.join(self.root, "members"), exist_ok=True)
 
     @classmethod
@@ -344,6 +345,7 @@ class Fleet:
         call; a clean leaver simply stops renewing its lease."""
         _tracing.emit("fleet.leave", member=self.member,
                       generation=self.generation, reason=str(reason))
+        self._ship_obs(force=True)   # final snapshot before departure
         try:
             os.remove(self._member_path(self.member))
         except OSError:
@@ -353,6 +355,14 @@ class Fleet:
         self._acked_gen = int(ep["generation"])
         self._acked_world = [int(m) for m in ep["world"]]
         _note_generation(self._acked_gen, len(self._acked_world))
+        # stamp the fleet identity onto every telemetry record and trace
+        # event this process emits from here on: the cross-rank merge
+        # (fleet_obs) keys stale-generation exclusion and step
+        # correlation on these two fields
+        _telemetry.set_fleet_identity(rank=self.member,
+                                      generation=self._acked_gen)
+        _tracing.set_context(rank=self.member,
+                             fleet_generation=self._acked_gen)
 
     def ack(self):
         """Adopt the current on-disk epoch (after the reshard that a
@@ -394,9 +404,27 @@ class Fleet:
             from ..contrib import chaos
             chaos.maybe_preempt(self.member)
             self.heartbeat()
+            self._ship_obs()
         if self.controller:
             self.reconcile()
         self.check()
+
+    def _ship_obs(self, force=False):
+        """Export this worker's observability snapshot into the fleet
+        store (rate-limited inside the shipper).  Best-effort: a full
+        disk or torn store must never fail a train step."""
+        if self.member is None:
+            return
+        if self._shipper is None:
+            try:
+                from . import fleet_obs
+            except ImportError:
+                return
+            self._shipper = fleet_obs.ObsShipper(self)
+        try:
+            self._shipper.ship(force=force)
+        except OSError:
+            pass
 
     def shard(self):
         """``(rank, num_workers)`` of this member in its ADOPTED epoch —

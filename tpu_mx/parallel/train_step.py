@@ -636,6 +636,13 @@ class CompiledTrainStep:
             # supersedes them mid-flight
             expect_gen = self._generation
         t_start = time.perf_counter()
+        # chaos straggler injection (ISSUE 18): the slow_worker delay
+        # must land INSIDE the data_wait window below — an injected
+        # straggler whose delay fell outside every measured phase would
+        # be invisible to the cross-rank phase attribution that is the
+        # point of injecting it (tpu_mx/parallel/fleet_obs.py)
+        from ..contrib import chaos as _chaos
+        _chaos.maybe_slow_worker()
         # None batch args pass through (optional model inputs like
         # valid_length); they contribute no leaves to the jitted
         # signature.  Non-NDArray operands stay RAW (numpy/python): the

@@ -61,6 +61,7 @@ from bisect import bisect_left
 
 __all__ = ["counter", "gauge", "histogram", "span", "get", "reset",
            "snapshot", "flush", "exposition", "validate_record",
+           "set_fleet_identity", "fleet_identity",
            "configured_path", "Counter", "Gauge", "Histogram",
            "KNOWN_METRICS", "LATENCY_BUCKETS", "SEGMENT_OPS_BUCKETS",
            "SLO_LATENCY_BUCKETS", "WINDOW_SECONDS", "WINDOW_SUBWINDOWS",
@@ -165,6 +166,24 @@ KNOWN_METRICS = frozenset({
     # their absence is the observable).
     "fleet.membership_epoch", "fleet.reshards", "fleet.rejoins",
     "fleet.lost_workers", "fleet.worker_restarts", "fleet.heartbeats",
+    # fleet observability plane (ISSUE 18; tpu_mx/parallel/fleet_obs.py
+    # + tools/launch.py --supervise; docs/observability.md "Fleet
+    # observability").  obs_records counts telemetry records this worker
+    # shipped to <fleet_dir>/obs/rank-N.jsonl; the rest are the
+    # CONTROLLER'S rollups: step_rate is fleet-wide steps/sec summed
+    # over reporting ranks' windows; ranks_reporting counts ranks whose
+    # shipped snapshot the last aggregation pass actually merged (a
+    # missing rank is a reported gap, never interpolated);
+    # agg_lag_seconds is the age of the OLDEST shipped snapshot the pass
+    # consumed; step_skew_seconds is the max-min cross-rank wall clock
+    # of the latest (epoch, step, generation)-correlated step;
+    # straggler_signal is the windowed persistent-straggler detector's
+    # 0/1 state and straggler_rank the rank it attributes (-1 = none) —
+    # the scheduler.slo_signal/capacity_signal twin the fleet
+    # supervisor surfaces in evict/degrade decisions.
+    "fleet.obs_records", "fleet.step_rate", "fleet.ranks_reporting",
+    "fleet.agg_lag_seconds", "fleet.step_skew_seconds",
+    "fleet.straggler_signal", "fleet.straggler_rank",
     # flight recorder (tpu_mx/tracing.py; event NAMES live in its own
     # KNOWN_EVENTS catalog — blackbox_dumps counts black boxes persisted,
     # events_dropped surfaces tracing.stats()["dropped"] as a gauge
@@ -250,6 +269,35 @@ KNOWN_METRICS = frozenset({
 
 _lock = threading.RLock()
 _metrics: dict = {}          # (name, labels_tuple) -> metric object
+
+# fleet identity (ISSUE 18): once the fleet runtime adopts a membership
+# epoch (tpu_mx/parallel/fleet.py::_adopt), every exported record is
+# stamped with this process's rank and the membership generation the
+# snapshot reflects — the cross-worker aggregator
+# (tpu_mx/parallel/fleet_obs.py) keys stale-record exclusion on the
+# stamp.  Both None (the static-world default) means no stamping at all:
+# records from non-fleet processes are byte-identical to pre-fleet ones.
+_fleet_identity = {"rank": None, "generation": None}
+_UNSET = object()
+
+
+def set_fleet_identity(rank=_UNSET, generation=_UNSET):
+    """Stamp every subsequently exported record with this process's
+    fleet identity.  Omitted fields keep their value; passing None
+    clears one.  The fleet runtime calls this on epoch adoption —
+    instrumented code never needs to."""
+    with _lock:
+        if rank is not _UNSET:
+            _fleet_identity["rank"] = None if rank is None else int(rank)
+        if generation is not _UNSET:
+            _fleet_identity["generation"] = \
+                None if generation is None else int(generation)
+
+
+def fleet_identity():
+    """The live ``(rank, generation)`` stamp, or ``(None, None)``."""
+    with _lock:
+        return _fleet_identity["rank"], _fleet_identity["generation"]
 
 # the window clock.  Monotonic (a wall-clock step must not expire or
 # resurrect subwindows); module-level so tests can substitute a fake
@@ -581,6 +629,10 @@ def _rec(metric, ts, value):
            "ts": ts}
     if metric.labels:
         rec["labels"] = dict(metric.labels)
+    if _fleet_identity["rank"] is not None:
+        rec["rank"] = _fleet_identity["rank"]
+    if _fleet_identity["generation"] is not None:
+        rec["fleet_generation"] = _fleet_identity["generation"]
     return rec
 
 
@@ -795,9 +847,10 @@ def series(name):
 
 
 def reset():
-    """Drop every metric (test hook)."""
+    """Drop every metric and the fleet-identity stamp (test hook)."""
     with _lock:
         _metrics.clear()
+        _fleet_identity.update(rank=None, generation=None)
     _finalized.clear()
 
 
@@ -986,6 +1039,13 @@ def validate_record(rec):
             and all(isinstance(k, str) and isinstance(v, str)
                     for k, v in rec["labels"].items())):
         raise ValueError(f"{name}: labels must be a str->str object")
+    # the fleet-identity stamp (ISSUE 18) is optional — records from
+    # static-world processes simply lack both keys and stay valid
+    for field in ("rank", "fleet_generation"):
+        v = rec.get(field)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool)):
+            raise ValueError(f"{name}: {field!r} must be int, got {v!r}")
     if kind == "histogram":
         if not isinstance(rec.get("sum"), (int, float)):
             raise ValueError(f"{name}: histogram missing numeric 'sum'")

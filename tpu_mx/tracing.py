@@ -124,6 +124,14 @@ KNOWN_EVENTS = {
     "fleet.restart_worker": {"member": "int", "n": "int",
                              "backoff_seconds": "float"},
     "fleet.degrade": {"world_size": "int", "reason": "str"},
+    # fleet observability plane (ISSUE 18; tpu_mx/parallel/fleet_obs.py):
+    # the windowed persistent-straggler detector's state FLIP — `rank`
+    # is the attributed straggler, `excess_seconds` its mean per-step
+    # excess over the fastest rank, `phase` the dominant slow phase
+    # (data_wait/dispatch/loss_readback) and `steps` how many correlated
+    # steps the window judged.  rank=-1 records the all-clear flip.
+    "fleet.straggler": {"rank": "int", "excess_seconds": "float",
+                        "phase": "str", "steps": "int"},
     # inference serving runtime (tpu_mx/serving/, docs/serving.md): the
     # request lifecycle.  Per-request events (admit/prefill/evict/reject)
     # are additionally stamped with the request-scoped `request` context
@@ -228,6 +236,16 @@ _context = {
     # supervisor stamps epoch/step around a train step; batch-scoped
     # decode events leave it None and correlate via step/generation.
     "request": None,
+    # fleet identity (ISSUE 18): this process's fleet rank and the
+    # membership generation it has adopted, stamped by
+    # tpu_mx/parallel/fleet.py on epoch adoption (None outside a
+    # fleet).  `fleet_generation` is the MEMBERSHIP epoch — distinct
+    # from `generation`, which remains the supervisor's restore
+    # generation.  The cross-rank step correlation
+    # (tpu_mx/parallel/fleet_obs.py) keys on (epoch, step,
+    # fleet_generation) across ranks' shipped events.
+    "rank": None,
+    "fleet_generation": None,
 }
 
 
@@ -345,6 +363,14 @@ def validate_event(rec):
     if req is not None and not isinstance(req, str):
         raise ValueError(f"{name}: 'request' must be str or None, "
                          f"got {req!r}")
+    # `rank`/`fleet_generation` joined with the fleet observability
+    # plane (ISSUE 18); same older-builds-lack-the-key rule
+    for field in ("rank", "fleet_generation"):
+        v = rec.get(field)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool)):
+            raise ValueError(f"{name}: {field!r} must be int or None, "
+                             f"got {v!r}")
     data = rec.get("data")
     if not isinstance(data, dict):
         raise ValueError(f"{name}: missing 'data' payload object")
@@ -430,7 +456,8 @@ def reset():
         _ring.clear()
         _emitted = 0
         _dropped = 0
-        _context.update(epoch=None, step=None, generation=0, request=None)
+        _context.update(epoch=None, step=None, generation=0, request=None,
+                        rank=None, fleet_generation=None)
 
 
 # ---------------------------------------------------------------------------
