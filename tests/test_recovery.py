@@ -1,0 +1,475 @@
+"""Zero-regeneration serving recovery (tpu_mx/serving/) — ISSUE 19.
+
+Covers: the committed-token journal (durability discipline, never-guess
+recovery semantics, compaction), prefill-replay restarts (restart-storm
+stream bit-equality across decode modes × sharing × sampling, the
+exactly-one-prefill receipt, sharing-aware replay), cross-process
+kill −9 recovery (a real ``os._exit(137)`` inside a decode step, a new
+process resuming every stream from the journal), graceful drain and hot
+engine handoff (zero client-visible failures, nothing re-yielded), and
+the per-request samplers whose RNG-is-data capsules make non-greedy
+streams replayable."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from tpu_mx import telemetry, tracing
+from tpu_mx.base import MXNetError
+from tpu_mx.contrib import chaos
+from tpu_mx.serving import AdmissionReject, Request, Server, TinyLM
+from tpu_mx.serving import journal as journal_mod
+from tpu_mx.serving.journal import TokenJournal, journal_path
+from tpu_mx.serving.sampling import (GreedySampler, TopKSampler, fold_seed,
+                                     make_sampler, parse_sampling)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Tracing/telemetry state is process-global — isolate every test."""
+    tracing.reset()
+    telemetry.reset()
+    yield
+    tracing.reset()
+    telemetry.reset()
+
+
+def tiny(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("embed_dim", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("seed", 0)
+    return TinyLM(**kw)
+
+
+def counter_value(name, **labels):
+    c = telemetry.get(name, **labels)
+    return 0 if c is None else c.value
+
+
+def clean_reference(prompts, max_new, **server_kw):
+    """The uninterrupted run every recovery path must bit-match."""
+    srv = Server(tiny(), num_blocks=256, **server_kw)
+    reqs = [srv.submit(p, max_new, request_id=f"r{i}")
+            for i, p in enumerate(prompts)]
+    srv.run_until_idle()
+    return [list(r.tokens) for r in reqs]
+
+
+# ---------------------------------------------------------------------------
+# samplers: the RNG-is-data unit of replayability
+# ---------------------------------------------------------------------------
+def test_parse_sampling_specs_and_rejects():
+    assert parse_sampling("greedy") == ("greedy", None)
+    assert parse_sampling("") == ("greedy", None)   # unset -> default
+    assert parse_sampling("top_k:8") == ("top_k", 8)
+    for bad in ("top_k", "top_k:0", "top_k:x", "nucleus:0.9"):
+        with pytest.raises(MXNetError):
+            parse_sampling(bad)
+
+
+def test_fold_seed_is_deterministic_and_id_sensitive():
+    assert fold_seed(7, "r1") == fold_seed(7, "r1")
+    assert fold_seed(7, "r1") != fold_seed(7, "r2")
+    assert fold_seed(7, "r1") != fold_seed(8, "r1")
+
+
+def test_top_k_sampler_state_roundtrip_resumes_mid_roll():
+    logits = np.linspace(-1.0, 1.0, 64)
+    a = TopKSampler(8, seed=123)
+    first = [a.sample(logits) for _ in range(5)]
+    capsule = a.state_dict()
+    rest = [a.sample(logits) for _ in range(5)]
+    # a FRESH sampler loaded from the capsule continues the same roll
+    b = TopKSampler(8, seed=0)
+    b.load_state_dict(capsule)
+    assert [b.sample(logits) for _ in range(5)] == rest
+    # reset() rewinds to the construction-time state
+    a.reset()
+    assert [a.sample(logits) for _ in range(5)] == first
+    # capsule kind/k mismatches refuse loudly
+    with pytest.raises(MXNetError):
+        TopKSampler(4, seed=0).load_state_dict(capsule)
+    assert make_sampler("greedy", None, 0) is None
+
+
+# ---------------------------------------------------------------------------
+# the journal file: durability + never-guess recovery
+# ---------------------------------------------------------------------------
+def _journal_with_traffic(prefix, n_tokens=4):
+    j = TokenJournal(prefix)
+    req = Request([1, 2, 3], 8, request_id="r1")
+    j.begin(req)
+    for t in range(n_tokens):
+        req.tokens.append(10 + t)
+        j.commit_token(req, 10 + t)
+    j.flush()
+    return j, req
+
+
+def test_journal_roundtrip_and_end_retires(tmp_path):
+    j, req = _journal_with_traffic(str(tmp_path / "j"))
+    entries = journal_mod.load(j.path)
+    e = entries["r1"]
+    assert e["prompt"] == [1, 2, 3] and e["max_new"] == 8
+    assert e["tokens"] == [10, 11, 12, 13]
+    assert not e["ended"] and not e["fallback"]
+    j.end(req, "length")
+    j.flush()
+    assert journal_mod.load(j.path)["r1"]["ended"]
+    j.close()
+
+
+def test_journal_compact_drops_retired_keeps_live(tmp_path):
+    j, req = _journal_with_traffic(str(tmp_path / "j"))
+    done = Request([9], 1, request_id="done")
+    j.begin(done)
+    done.tokens.append(5)
+    j.commit_token(done, 5)
+    j.end(done, "length")
+    j.flush()
+    assert j.compact() == 1
+    entries = journal_mod.load(j.path)
+    assert set(entries) == {"r1"}
+    assert entries["r1"]["tokens"] == [10, 11, 12, 13]
+    # the compacted file is a valid journal that accepts appends
+    req.tokens.append(14)
+    j.commit_token(req, 14)
+    j.flush()
+    assert journal_mod.load(j.path)["r1"]["tokens"][-1] == 14
+    j.close()
+
+
+def test_journal_torn_final_line_dropped_loudly(tmp_path):
+    j, _ = _journal_with_traffic(str(tmp_path / "j"))
+    j.close()
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write('{"op":"token","request":"r1","i":4,"tok')  # torn append
+    e = journal_mod.load(j.path)["r1"]
+    # the torn record was never fsync'd complete -> dropped; everything
+    # BEFORE it is trusted (no fallback)
+    assert e["tokens"] == [10, 11, 12, 13] and not e["fallback"]
+
+
+def test_journal_midfile_corruption_degrades_all_unfinished(tmp_path):
+    j, _ = _journal_with_traffic(str(tmp_path / "j"))
+    j.close()
+    lines = open(j.path, encoding="utf-8").read().splitlines()
+    lines[2] = "NOT JSON"   # corrupt a middle record, keep later ones
+    with open(j.path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    e = journal_mod.load(j.path)["r1"]
+    # framing is gone: identity survives, tokens are FORFEITED — prompt
+    # replay, never a guessed resume
+    assert e["fallback"] and e["tokens"] == []
+
+
+def test_journal_token_index_gap_degrades_that_stream(tmp_path):
+    j, _ = _journal_with_traffic(str(tmp_path / "j"))
+    j.close()
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write('{"op":"token","request":"r1","i":9,"token":3,'
+                '"rng":null}\n')
+    e = journal_mod.load(j.path)["r1"]
+    assert e["fallback"] and e["tokens"] == []
+
+
+def test_journal_unknown_format_header_refuses(tmp_path):
+    p = tmp_path / "weird-journal.jsonl"
+    p.write_text('{"format":"somebody-elses-v9"}\n')
+    with pytest.raises(MXNetError):
+        journal_mod.load(str(p))
+
+
+# ---------------------------------------------------------------------------
+# prefill-replay restarts: bit-equality + the one-prefill receipt
+# ---------------------------------------------------------------------------
+PROMPTS = ([1, 2, 3], [1, 2, 4], [7, 8])
+
+
+@pytest.mark.parametrize("paged", ["0", "1"])
+@pytest.mark.parametrize("sharing", ["0", "1"])
+@pytest.mark.parametrize("sampling", ["greedy", "top_k:8"])
+def test_restart_storm_streams_bit_identical(monkeypatch, paged, sharing,
+                                             sampling):
+    """Three back-to-back classified restarts (chaos ``restart_storm``)
+    mid-decode: every stream finishes bit-identical to the uninterrupted
+    run, across decode modes × prefix sharing × sampling modes."""
+    monkeypatch.setenv("TPUMX_PAGED_DECODE", paged)
+    monkeypatch.setenv("TPUMX_PREFIX_SHARING", sharing)
+    kw = dict(sampling=sampling, sampling_seed=11)
+    ref = clean_reference(PROMPTS, 10, **kw)
+    tracing.reset()
+    srv = Server(tiny(), num_blocks=256, max_restarts=5, backoff=0.0, **kw)
+    reqs = [srv.submit(p, 10, request_id=f"r{i}")
+            for i, p in enumerate(PROMPTS)]
+    for _ in range(2):
+        srv.step()   # commit a few tokens before the storm
+    with chaos.enable(restart_storm=3) as cfg:
+        srv.run_until_idle()
+    assert cfg.storms_fired == 3 and srv.restarts == 3
+    assert [list(r.tokens) for r in reqs] == ref
+    # replay kept the ledger: requeues happened, nothing was re-decoded
+    assert all(r.requeues >= 1 for r in reqs)
+    assert counter_value("serve.redecode_tokens") == 0
+
+
+def test_restart_recovery_is_one_prefill_no_redecode():
+    """The acceptance receipt: recovery issues exactly one prefill per
+    in-flight sequence — ``serve.replay_requests`` counts sequences,
+    ``serve.replay_tokens`` counts their committed ledgers, and ZERO
+    tokens are re-decoded."""
+    srv = Server(tiny(), num_blocks=256, max_restarts=3, backoff=0.0)
+    reqs = [srv.submit(p, 12, request_id=f"r{i}")
+            for i, p in enumerate(PROMPTS)]
+    for _ in range(5):
+        srv.step()
+    committed = {r.id: len(r.tokens) for r in reqs}
+    assert all(n >= 4 for n in committed.values())
+    with chaos.enable(restart_storm=1):
+        srv.run_until_idle()
+    assert srv.restarts == 1
+    assert counter_value("serve.replay_requests") == len(reqs)
+    assert counter_value("serve.replay_tokens") == sum(committed.values())
+    assert counter_value("serve.redecode_tokens") == 0
+    # the serve.prefill events receipt the replay per sequence: one
+    # replayed prefill per request, carrying its ledger length
+    replays = [e for e in tracing.snapshot()
+               if e["event"] == "serve.prefill"
+               and e["data"]["replayed"] > 0]
+    assert sorted(e["data"]["replayed"] for e in replays) == \
+        sorted(committed.values())
+
+
+def test_legacy_prompt_replay_arm_redecodes_and_charges_restart_penalty():
+    """``replay=False`` keeps the old arm alive for the A/B: restarts
+    discard the ledger, catch-up re-decodes are counted and charged to
+    ``restart_penalty`` — the cost the replay arm removes."""
+    ref = clean_reference(PROMPTS, 10)
+    tracing.reset()
+    srv = Server(tiny(), num_blocks=256, max_restarts=3, backoff=0.0,
+                 replay=False)
+    reqs = [srv.submit(p, 10, request_id=f"r{i}")
+            for i, p in enumerate(PROMPTS)]
+    for _ in range(5):
+        srv.step()
+    committed = sum(len(r.tokens) for r in reqs)
+    assert committed > 0
+    with chaos.enable(restart_storm=1):
+        srv.run_until_idle()
+    assert [list(r.tokens) for r in reqs] == ref
+    assert counter_value("serve.redecode_tokens") == committed
+    assert counter_value("serve.replay_tokens") == 0
+    for r in reqs:
+        assert r.timeline.phases.get("restart_penalty", 0.0) > 0.0
+
+
+def test_replay_rides_prefix_cache_across_restart(monkeypatch):
+    """Satellite bugfix: with sharing on, N restarted requests carrying
+    one template re-prefill the shared prefix ONCE — the replay path
+    routes through match_prefix like any first-time prefill."""
+    monkeypatch.setenv("TPUMX_PREFIX_SHARING", "1")
+    template = list(range(1, 17))   # a full block of shared prefix
+    prompts = [template + [50 + i] for i in range(3)]
+    srv = Server(tiny(), num_blocks=256, max_restarts=3, backoff=0.0,
+                 prefix_sharing=True)
+    reqs = [srv.submit(p, 8, request_id=f"r{i}")
+            for i, p in enumerate(prompts)]
+    for _ in range(3):
+        srv.step()
+    with chaos.enable(restart_storm=1):
+        srv.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+    st = srv.engine.cache.prefix_stats()
+    # the REBUILT engine's index served replay hits: lookups/hits are
+    # generation-local, so any hit here happened after the restart
+    assert st["hits"] > 0, st
+    assert st["cached_tokens"] > 0
+
+
+# ---------------------------------------------------------------------------
+# in-process journal recovery (the cross-process path minus the kill)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("sampling", ["greedy", "top_k:8"])
+def test_journal_recover_resumes_bit_identical(tmp_path, sampling):
+    kw = dict(sampling=sampling, sampling_seed=7)
+    ref = clean_reference(PROMPTS, 12, **kw)
+    prefix = str(tmp_path / "jr")
+    tracing.reset()
+    srv = Server(tiny(), num_blocks=256, journal=prefix, **kw)
+    reqs = [srv.submit(p, 12, request_id=f"r{i}")
+            for i, p in enumerate(PROMPTS)]
+    for _ in range(6):
+        srv.step()
+    mid = {r.id: list(r.tokens) for r in reqs}
+    assert all(mid.values())
+    # the process "dies" here: a brand-new server on the same journal
+    tracing.reset()
+    srv2 = Server(tiny(), num_blocks=256, journal=prefix, **kw)
+    handles = srv2.recover()
+    assert set(handles) == set(mid)
+    for rid, h in handles.items():
+        assert list(h.tokens) == mid[rid]   # the ledger survived intact
+    srv2.run_until_idle()
+    assert [list(handles[f"r{i}"].tokens)
+            for i in range(len(PROMPTS))] == ref
+    # a finished journal recovers to nothing left to do
+    srv3 = Server(tiny(), num_blocks=256, journal=prefix, **kw)
+    again = srv3.recover()
+    assert all(h.state == "done" for h in again.values()) or not again
+
+
+def test_recover_without_journal_is_loud():
+    with pytest.raises(MXNetError):
+        Server(tiny(), num_blocks=64).recover()
+
+
+def test_recover_from_corrupt_journal_falls_back_to_prompt(tmp_path):
+    """Torn mid-file journal: recovery NEVER guesses — the stream
+    restarts from its prompt (fallback counted) and still completes
+    with the deterministic greedy tokens."""
+    ref = clean_reference([[1, 2, 3]], 8)
+    prefix = str(tmp_path / "jr")
+    srv = Server(tiny(), num_blocks=256, journal=prefix)
+    srv.submit([1, 2, 3], 8, request_id="r0")
+    for _ in range(4):
+        srv.step()
+    path = journal_path(prefix)
+    lines = open(path, encoding="utf-8").read().splitlines()
+    lines[2] = '{"op":'   # corrupt a middle record
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    tracing.reset()
+    srv2 = Server(tiny(), num_blocks=256, journal=prefix)
+    handles = srv2.recover()
+    assert list(handles["r0"].tokens) == []   # forfeited, not guessed
+    assert counter_value("serve.replay_fallbacks") == 1
+    srv2.run_until_idle()
+    assert list(handles["r0"].tokens) == ref[0]
+
+
+# ---------------------------------------------------------------------------
+# cross-process kill −9: the real thing
+# ---------------------------------------------------------------------------
+KILL9_CHILD = textwrap.dedent("""\
+    import json, os, sys
+    os.environ["TPUMX_CHAOS"] = "kill9_at_decode_step=4"
+    from tpu_mx.serving import Server, TinyLM
+    model = TinyLM(vocab_size=64, embed_dim=16, num_heads=2,
+                   num_layers=2, seed=0)
+    srv = Server(model, num_blocks=256, journal=sys.argv[1])
+    prompts = [[1, 2, 3], [1, 2, 4], [7, 8]]
+    for i, p in enumerate(prompts):
+        srv.submit(p, 12, request_id=f"r{i}")
+    srv.run_until_idle()   # dies at decode step 4 with os._exit(137)
+    print("SHOULD NOT REACH HERE")
+""")
+
+
+def test_kill9_cross_process_recovery_zero_lost_tokens(tmp_path):
+    """A REAL ``os._exit(137)`` inside a decode step (chaos
+    ``kill9_at_decode_step``), then a fresh process recovers from the
+    journal: every stream resumes exactly where the dead process's
+    fsync'd ledger left it and finishes bit-identical to the
+    uninterrupted run — zero lost, duplicated, or re-yielded tokens."""
+    prefix = str(tmp_path / "k9")
+    env = {k: v for k, v in os.environ.items() if k != "TPUMX_CHAOS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", KILL9_CHILD, prefix],
+                          capture_output=True, text=True, timeout=120,
+                          env=env, cwd="/root/repo")
+    assert proc.returncode == 137, (proc.returncode, proc.stderr[-2000:])
+    assert "SHOULD NOT REACH HERE" not in proc.stdout
+    entries = journal_mod.load(journal_path(prefix))
+    assert len(entries) == 3
+    survivors = {rid: e["tokens"] for rid, e in entries.items()}
+    assert any(survivors.values())   # the dead process committed work
+    assert not any(e["fallback"] for e in entries.values())
+    ref = clean_reference(PROMPTS, 12)
+    tracing.reset()
+    srv = Server(tiny(), num_blocks=256, journal=prefix)
+    handles = srv.recover()
+    for rid, h in handles.items():
+        assert list(h.tokens) == survivors[rid]
+    srv.run_until_idle()
+    for i in range(3):
+        got = list(handles[f"r{i}"].tokens)
+        assert got == ref[i], (i, got, ref[i])
+        # the committed prefix was NEVER regenerated: it is a prefix of
+        # the final stream, untouched
+        assert got[:len(survivors[f"r{i}"])] == survivors[f"r{i}"]
+    assert counter_value("serve.redecode_tokens") == 0
+
+
+# ---------------------------------------------------------------------------
+# drain & handoff: planned maintenance, zero client-visible failures
+# ---------------------------------------------------------------------------
+def test_drain_quiesces_closes_admission_and_reopens():
+    ref = clean_reference(PROMPTS, 10)
+    tracing.reset()
+    srv = Server(tiny(), num_blocks=256)
+    reqs = [srv.submit(p, 10, request_id=f"r{i}")
+            for i, p in enumerate(PROMPTS)]
+    srv.step()
+    srv.drain()
+    assert [list(r.tokens) for r in reqs] == ref
+    assert all(r.state == "done" for r in reqs)
+    with pytest.raises(AdmissionReject) as e:
+        srv.submit([1], 2)
+    assert e.value.reason == "draining"
+    evs = [ev for ev in tracing.snapshot() if ev["event"] == "serve.drain"]
+    assert evs and evs[0]["data"]["kind"] == "drain"
+    srv.resume_admission()
+    late = srv.submit([1], 2)
+    srv.run_until_idle()
+    assert late.state == "done"
+
+
+@pytest.mark.parametrize("sampling", ["greedy", "top_k:8"])
+def test_handoff_migrates_live_sessions_bit_identical(sampling):
+    """A hot handoff mid-decode: every live session continues on the
+    fresh engine generation with zero failures and an unchanged
+    stream; no restart budget is consumed."""
+    kw = dict(sampling=sampling, sampling_seed=5)
+    ref = clean_reference(PROMPTS, 10, **kw)
+    tracing.reset()
+    srv = Server(tiny(), num_blocks=256, **kw)
+    reqs = [srv.submit(p, 10, request_id=f"r{i}")
+            for i, p in enumerate(PROMPTS)]
+    for _ in range(3):
+        srv.step()
+    before = [list(r.tokens) for r in reqs]
+    assert any(before)
+    gen = srv.generation
+    assert srv.handoff() == len(reqs)
+    assert srv.generation == gen + 1 and srv.restarts == 0
+    # handoff never rewinds a stream (nothing to re-yield)
+    for r, b in zip(reqs, before):
+        assert list(r.tokens)[:len(b)] == b
+    srv.run_until_idle()
+    assert [list(r.tokens) for r in reqs] == ref
+    assert all(r.state == "done" for r in reqs)
+    evs = [ev for ev in tracing.snapshot()
+           if ev["event"] == "serve.drain"]
+    assert evs and evs[-1]["data"]["kind"] == "handoff"
+    assert evs[-1]["data"]["inflight"] == len(reqs)
+
+
+def test_handoff_under_journal_keeps_ledger_durable(tmp_path):
+    """Handoff flushes the journal at the boundary: a kill right after
+    a handoff loses nothing the clients saw."""
+    prefix = str(tmp_path / "ho")
+    srv = Server(tiny(), num_blocks=256, journal=prefix)
+    req = srv.submit([1, 2, 3], 10, request_id="r0")
+    for _ in range(4):
+        srv.step()
+    srv.handoff()
+    on_disk = journal_mod.load(journal_path(prefix))["r0"]["tokens"]
+    assert on_disk == list(req.tokens)
+    srv.run_until_idle()
+    assert req.state == "done"

@@ -687,14 +687,20 @@ def test_nan_logits_classified_restart(tmp_path):
 def test_restart_budget_exhaustion_degrades_loudly():
     srv = Server(tiny(), num_blocks=64, max_restarts=1, backoff=0.0,
                  deadline=0.3)
-    reqs = [srv.submit([1], max_new_tokens=3) for _ in range(2)]
+    # max_new is deliberately > restarts + 1: each generation's replay
+    # prefill legitimately delivers ONE fresh token (the prefill path
+    # is not poisoned), so a short request could finish on prefills
+    # alone — the budget must run out with tokens still owed
+    reqs = [srv.submit([1], max_new_tokens=6) for _ in range(2)]
     with chaos.enable(nan_after=1, nan_streak=100):
+        # every decode poisons -> restarts 1, 2 -> budget exceeded ->
+        # degrade.  The fault is PERSISTENT, so the degraded drain (the
+        # migrated running batch's final generation) faults too and the
+        # remaining streams fail loudly; the now-idle degraded server
+        # then refuses further steps.
         with pytest.raises(MXNetError):
-            # every decode poisons -> restarts 1, 2 -> budget exceeded
             for _ in range(50):
                 srv.step()
-                if srv.degraded:
-                    raise MXNetError("degraded")
     assert srv.degraded
     for r in reqs:
         assert r.state == "failed" and "degraded" in r.finish_reason
@@ -775,22 +781,39 @@ def test_degraded_rejects_are_counted_and_on_the_timeline():
     telemetry.reset()
 
 
-def test_degrade_fails_each_request_once_without_requeue_counts():
+def test_degrade_drains_running_and_fails_only_queued():
+    """Budget exhaustion (ISSUE 19) fails QUEUED work loudly but never
+    abandons mid-stream work: the running batch migrates (one replay
+    prefill each) onto one final engine generation and drains to
+    completion — a transient fault that exhausts the budget costs
+    queued requests, not in-flight streams."""
     telemetry.reset()
     try:
-        srv = Server(tiny(), num_blocks=64, max_restarts=0, backoff=0.0)
+        srv = Server(tiny(), num_blocks=64, max_restarts=0, backoff=0.0,
+                     max_batch=1)
         running = srv.submit([1, 2], max_new_tokens=6)
-        queued = srv.submit([3] * 200, max_new_tokens=6)  # over budget: waits
-        with chaos.enable(nan_after=1, nan_streak=100):
+        queued = srv.submit([3, 4], max_new_tokens=6)  # batch full: waits
+        with chaos.enable(nan_after=1):   # ONE poisoned decode, then clean
             srv.step()   # prefill + first poisoned decode -> degrade
             if not srv.degraded:
                 srv.step()
-        assert srv.degraded
-        assert running.state == "failed" and queued.state == "failed"
-        # failed-at-degrade requests were never RE-ADMITTED: no requeue
-        # counts, no double fail
-        assert running.requeues == 0
-        assert telemetry.get("serve.requests", state="requeued") is None
+            assert srv.degraded
+            # queued work failed loudly AT degrade time — once, never
+            # re-admitted, the client unblocked immediately
+            assert queued.state == "failed"
+            assert "degraded" in queued.finish_reason
+            assert queued.requeues == 0
+            srv.run_until_idle()          # the degraded drain
+        assert running.state == "done" and len(running.tokens) == 6
+        assert running.requeues == 1      # the one migration
+        # the drained stream is bit-identical to an uninterrupted run
+        clean = Server(tiny(), num_blocks=64)
+        ref = clean.submit([1, 2], max_new_tokens=6)
+        clean.run_until_idle()
+        assert running.tokens == ref.tokens
+        # drained-idle degraded server refuses further steps
+        with pytest.raises(MXNetError):
+            srv.step()
     finally:
         telemetry.reset()
 

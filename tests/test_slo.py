@@ -101,11 +101,28 @@ def test_attribution_restart_penalty_on_engine_restart():
         assert r.state == "done"
         assert_attributed(r)
     for r in bounced:
-        # the discarded attempt + rebuild + re-queue wait is attributed,
-        # not smeared into queue_wait
+        # the fault + rebuild + re-queue wait + replay prefill is
+        # attributed, not smeared into queue_wait
         assert r.timeline.phases.get("restart_penalty", 0) > 0
-        # the TTFT breakdown restarted with the generation: it reflects
-        # the FINAL attempt's path to the first token
+        # prefill-replay recovery (ISSUE 19): the committed tokens and
+        # the TTFT already measured STAND — nothing was re-yielded, so
+        # the breakdown still reflects the original path to the first
+        # token, without a restart_penalty component
+        assert "restart_penalty" not in r.timeline.ttft_breakdown
+    # the LEGACY prompt-replay arm discards the generation: TTFT
+    # re-measures to the final attempt's first token, restart penalty
+    # included in its breakdown
+    srv = Server(tiny(), num_blocks=96, block_size=8, max_batch=4,
+                 backoff=0.0, replay=False)
+    with chaos.enable(seed=0, nan_after=4):
+        reqs = [srv.submit([1, 2, 3], max_new_tokens=6) for _ in range(4)]
+        srv.run_until_idle()
+    assert srv.restarts == 1
+    bounced = [r for r in reqs if r.timeline.requeues]
+    assert bounced
+    for r in bounced:
+        assert r.state == "done"
+        assert_attributed(r)
         assert r.timeline.ttft_breakdown.get("restart_penalty", 0) > 0
 
 

@@ -268,7 +268,8 @@ def test_fused_decode_path_event_validates(monkeypatch):
     assert evs[-1]["data"] == {
         "path": "paged-kernel", "storage": "device",
         "sharing": evs[-1]["data"]["sharing"],
-        "fused": True, "spec_window": DEFAULT_WINDOW}
+        "fused": True, "spec_window": DEFAULT_WINDOW,
+        "sampling": "greedy"}
 
 
 # ---------------------------------------------------------------------------

@@ -186,6 +186,56 @@ def render_monitor_gauges(series):
     return lines
 
 
+def render_recovery(series, box):
+    """The restart-recovery section (ISSUE 19): how restarts were paid
+    for — replayed (one prefill re-establishing the committed ledger)
+    vs re-decoded (the legacy prompt-replay arm's catch-up tokens) —
+    plus the journal's durability counters and the per-request
+    ``restart_penalty`` phase totals from the box's timelines.  The
+    replay-vs-redecode split IS the zero-regeneration receipt: a
+    healthy replay-arm run shows restarts > 0 with re-decoded == 0."""
+    def cval(name):
+        rec = series.get((name, "{}"))
+        return 0 if rec is None else rec.get("value", 0)
+
+    lines = ["Restart recovery (zero-regeneration serving):"]
+    restarts = cval("serve.engine_restarts")
+    if not restarts and not cval("serve.journal_requests"):
+        lines.append("  (no engine restarts and no journal in this "
+                     "snapshot — nothing was recovered)")
+        return lines
+    lines.append("  engine restarts        %d" % restarts)
+    lines.append("  replayed sequences     %d  (ONE prefill each — "
+                 "committed ledger kept)" % cval("serve.replay_requests"))
+    lines.append("  replayed tokens        %d  (re-established by "
+                 "prefill, not re-decoded)" % cval("serve.replay_tokens"))
+    lines.append("  re-decoded tokens      %d  (legacy prompt-replay "
+                 "catch-up work)" % cval("serve.redecode_tokens"))
+    if cval("serve.journal_requests"):
+        lines.append("  journal                %d request(s), %d "
+                     "token(s), %d byte(s) fsync'd, %d fallback(s)" % (
+                         cval("serve.journal_requests"),
+                         cval("serve.journal_tokens"),
+                         cval("serve.journal_bytes"),
+                         cval("serve.replay_fallbacks")))
+    if box is not None:
+        pens = [(e["data"].get("request", "?"),
+                 float(e["data"].get("restart_penalty", 0.0)))
+                for e in request_timelines(box)
+                if float(e["data"].get("restart_penalty", 0.0)) > 0]
+        if pens:
+            total = sum(p for _, p in pens)
+            worst = max(pens, key=lambda rp: rp[1])
+            lines.append(
+                "  restart_penalty        %d request(s) paid %.2fms "
+                "total; worst %s at %.2fms" % (
+                    len(pens), total * 1e3, worst[0], worst[1] * 1e3))
+        else:
+            lines.append("  restart_penalty        (no request in the "
+                         "box paid a restart penalty)")
+    return lines
+
+
 def render_tenants(series, telemetry, specs, box, phases):
     """The per-tenant section: each target evaluated against every
     tenant-labeled series' window (quantile estimate, attainment, burn,
@@ -360,6 +410,8 @@ def main(argv=None):
     out.extend(render_slos(series, telemetry, specs))
     out.append("")
     out.extend(render_monitor_gauges(series))
+    out.append("")
+    out.extend(render_recovery(series, box))
     out.append("")
     out.extend(render_tenants(series, telemetry, specs, box,
                               timeline_phases(tracing)))

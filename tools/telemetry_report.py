@@ -71,11 +71,19 @@ REQUIRE_PRESETS = {
     # must have landed, and the live monitor must have published its
     # windowed estimate and attainment gauges (burn_rate/breaching are
     # deliberately absent — both are rightly 0 on a healthy run).
+    # The recovery additions (ISSUE 19): the storm legs arm the token
+    # journal and run on the prefill-replay arm, so restarts must have
+    # been paid for with replay prefills and the journal must have
+    # actually recorded admissions/tokens/fsyncs (redecode_tokens and
+    # replay_fallbacks are deliberately absent — both are rightly 0 on
+    # the replay arm with an intact journal).
     "serve": ("serve.requests", "serve.ttft_seconds", "serve.itl_seconds",
               "serve.generated_tokens", "serve.decode_steps",
               "serve.tokens_per_sec", "serve.engine_restarts",
               "serve.phase_seconds", "serve.slo_estimate_seconds",
-              "serve.slo_attainment"),
+              "serve.slo_attainment", "serve.replay_requests",
+              "serve.replay_tokens", "serve.journal_requests",
+              "serve.journal_tokens", "serve.journal_bytes"),
     # "fleet" gates the membership-churn soak leg (ISSUE 17): the epoch
     # gauge must have moved past 0, at least one reshard was driven
     # through the seam, and at least one evicted/late worker was admitted

@@ -44,6 +44,18 @@ Injection kinds (all one process, no root, no LD_PRELOAD):
   a wedged decode dispatch; the server must convert it into a classified
   engine restart with every queued request surviving
   (tpu_mx/serving/server.py, docs/serving.md).  One-shot.
+- ``kill9_at_decode_step=N``: ``os._exit(137)`` inside the Nth serving
+  decode step since arming — a REAL cross-process death mid-step, no
+  emergency save, no atexit.  The committed-token journal
+  (tpu_mx/serving/journal.py) is the only thing that survives; the
+  recovery run must resume every stream from it with zero lost,
+  duplicated, or re-yielded tokens (docs/robustness.md).  One-shot by
+  construction (the process is gone).
+- ``restart_storm=K``: the next K serving decode steps each raise
+  :class:`ChaosCrash` (classified transient) — K *back-to-back*
+  engine restarts, the compounding-failure shape the prefill-replay
+  recovery path must keep O(1 prefill) per request per restart.
+  Decrementing budget, like ``reject_storm``.
 - ``reject_storm=K``: the next K scheduler admissions are force-rejected
   with reason ``"reject_storm"`` — drives the front-end's backpressure /
   reject-with-reason path and the client resubmit loop without needing a
@@ -103,6 +115,7 @@ from .. import tracing as _tracing
 __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
            "wrap_file", "maybe_oserror", "peer_killed", "poison_loss",
            "maybe_hang", "maybe_crash_step", "maybe_slow_decode",
+           "maybe_kill9_decode", "storm_restart",
            "forced_reject", "maybe_preempt", "partitioned",
            "maybe_slow_worker"]
 
@@ -133,6 +146,7 @@ class _Config:
               "transient_oserror", "kill_peer", "nan_after", "nan_streak",
               "hang_step", "hang_seconds", "crash_at_step",
               "slow_decode_step", "slow_decode_seconds", "reject_storm",
+              "kill9_at_decode_step", "restart_storm",
               "preempt_worker_at_step", "preempt_rank", "partition_worker",
               "slow_worker_rank", "slow_worker_seconds",
               "seed", "hard", "match")
@@ -142,6 +156,7 @@ class _Config:
                  nan_streak=1, hang_step=None, hang_seconds=3600.0,
                  crash_at_step=None, slow_decode_step=None,
                  slow_decode_seconds=3600.0, reject_storm=0,
+                 kill9_at_decode_step=None, restart_storm=0,
                  preempt_worker_at_step=None, preempt_rank=0,
                  partition_worker=None, slow_worker_rank=None,
                  slow_worker_seconds=1.0, seed=None,
@@ -163,6 +178,9 @@ class _Config:
             else int(slow_decode_step)
         self.slow_decode_seconds = float(slow_decode_seconds)
         self.reject_storm = int(reject_storm)
+        self.kill9_at_decode_step = None if kill9_at_decode_step is None \
+            else int(kill9_at_decode_step)
+        self.restart_storm = int(restart_storm)
         self.preempt_worker_at_step = None if preempt_worker_at_step is None \
             else int(preempt_worker_at_step)
         self.preempt_rank = int(preempt_rank)
@@ -190,6 +208,9 @@ class _Config:
         self.step_crashes = 0
         self.decode_steps_seen = 0   # decode steps while slow_decode armed
         self.slow_decodes = 0
+        self.kill9_steps_seen = 0    # decode steps while kill9 armed
+        self.storms_left = self.restart_storm
+        self.storms_fired = 0        # back-to-back restarts provoked
         self.rejects_left = self.reject_storm
         self.rejects_forced = 0
         self.fleet_steps_seen = 0    # fleet steps while preempt armed
@@ -448,6 +469,54 @@ def maybe_slow_decode():
         log.warning("chaos: stalling this decode step for %.0fs "
                     "(slow_decode_step fired)", secs)
         time.sleep(secs)
+
+
+def maybe_kill9_decode():
+    """``os._exit(137)`` when ``kill9_at_decode_step`` says the Nth
+    serving decode step since arming has arrived (the serving engine
+    calls this at the top of every decode step, right after
+    :func:`maybe_slow_decode`).  A TRUE mid-step process death — no
+    exception, no emergency save, no atexit — for the cross-process
+    journal-recovery proof (tpu_mx/serving/journal.py): everything not
+    already fsync'd is gone, exactly like a real kill −9."""
+    cfg = _config
+    if cfg is None or cfg.kill9_at_decode_step is None:
+        return
+    with cfg.lock:
+        if cfg.kill9_at_decode_step is None:
+            return
+        cfg.kill9_steps_seen += 1
+        if cfg.kill9_steps_seen < cfg.kill9_at_decode_step:
+            return
+        cfg.kill9_at_decode_step = None
+        _count_injection("kill9_decode")
+    log.warning("chaos: killing this process inside decode step %d "
+                "(kill9_at_decode_step fired)", cfg.kill9_steps_seen)
+    _telemetry.flush()   # the injection count must outlive the process
+    os._exit(137)  # pragma: no cover - exercised via subprocess
+
+
+def storm_restart():
+    """Raise :class:`ChaosCrash` (classified transient — a guaranteed
+    engine restart) once per serving decode step while the
+    ``restart_storm`` budget lasts: K back-to-back restarts, the
+    compounding shape the prefill-replay recovery path must keep flat.
+    Decrementing budget like ``reject_storm``; the (K+1)th decode step
+    runs clean so the storm drains."""
+    cfg = _config
+    if cfg is None or not cfg.restart_storm:
+        return
+    with cfg.lock:
+        if cfg.storms_left <= 0:
+            return
+        cfg.storms_left -= 1
+        cfg.storms_fired += 1
+        _count_injection("restart_storm")
+        n = cfg.storms_fired
+    raise ChaosCrash(
+        f"chaos: restart_storm fired ({n}/{cfg.restart_storm}) — "
+        f"classified engine restart, every stream must replay in "
+        f"one prefill")
 
 
 def forced_reject():

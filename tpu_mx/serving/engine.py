@@ -76,8 +76,15 @@ class EngineCore:
 
     def __init__(self, model, block_size=16, num_blocks=256,
                  dtype=np.float32, share_prefix=None, forensics=None,
-                 warm_batch=None):
+                 warm_batch=None, greedy=True):
         self.model = model
+        # non-greedy sampling (ISSUE 19) is a HOST sampler whose RNG
+        # state is journaled per token (serving/sampling.py): the fused
+        # arm samples on-device and speculation verifies greedily, so
+        # both are pinned off for the stream to stay replayable — a
+        # knob conflict resolves loudly here, once per generation, and
+        # is recorded on serve.decode_path below
+        self.greedy = bool(greedy)
         # the decode arm is resolved ONCE per engine generation: a knob
         # flip mid-flight cannot leave half a batch on each path, and
         # the serve.decode_path event below is the black box's record of
@@ -90,8 +97,9 @@ class EngineCore:
         if share_prefix is None:
             share_prefix = prefix_sharing_enabled()
         self.share_prefix = bool(share_prefix)
-        self.spec_window = resolve_spec_window()
-        self.fused = resolve_fused(self.decode_kind, model)
+        self.spec_window = resolve_spec_window() if self.greedy else 1
+        self.fused = (resolve_fused(self.decode_kind, model)
+                      if self.greedy else False)
         storage = "device" if self.decode_kind != "dense" else "host"
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
@@ -119,27 +127,43 @@ class EngineCore:
                          else None)
         _tracing.emit("serve.decode_path", path=self.decode_kind,
                       storage=storage, sharing=self.share_prefix,
-                      fused=self.fused, spec_window=self.spec_window)
+                      fused=self.fused, spec_window=self.spec_window,
+                      sampling="greedy" if self.greedy else "sampled")
         # cumulative speculative accounting for the accept-ratio gauge
         self._spec_drafted = 0
         self._spec_accepted = 0
 
     # -- prefill -------------------------------------------------------------
     def prefill(self, req):
-        """Run ``req``'s prompt, fill its cache blocks, return ``(first
-        generated token, cached_tokens)``.
+        """Run ``req``'s prompt — PLUS any committed tokens it already
+        delivered (the prefill-replay recovery path, ISSUE 19) — fill
+        its cache blocks, and return ``(next sampled token,
+        cached_tokens)``.
+
+        A requeued request that kept its tokens is rebuilt in THIS one
+        call: K/V at every position is a pure function of the tokens
+        before it (the PR-12 purity proof), so prefilling
+        ``prompt + committed`` recreates exactly the cache state the
+        interrupted decode had and the returned token is the next one
+        of the same stream — recovery cost is one prefill, flat in how
+        many tokens were already generated.  ``serve.replay_tokens`` /
+        ``serve.replay_requests`` receipt it; the ``serve.prefill``
+        event carries ``replayed``.
 
         With sharing on, the longest indexed full-block prefix of the
         prompt is served from the cache (``cached_tokens`` of them):
         only the suffix's K/V is computed (``model.prefill_suffix``
         attending over the cached prefix) and written — bit-identical
         logits to a full prefill, one prefill's compute shared by every
-        request carrying the template.  :class:`CacheExhausted`
-        propagates with the cache unchanged and no pinned references
-        left behind (the scheduler's backpressure path); NaN/Inf logits
-        raise :class:`NumericDivergence`."""
+        request carrying the template.  Replayed requests ride it too:
+        N restarted requests sharing a template re-prefill the shared
+        prefix once, not N times.  :class:`CacheExhausted` propagates
+        with the cache unchanged and no pinned references left behind
+        (the scheduler's backpressure path); NaN/Inf logits raise
+        :class:`NumericDivergence`."""
         t0 = time.perf_counter()
-        tokens = req.prompt
+        committed = [int(t) for t in getattr(req, "tokens", ())]
+        tokens = req.prompt + committed if committed else req.prompt
         # the capacity ledger's attribution key (ISSUE 14): requests
         # without a tenant (bare tests) fall to the single-tenant default
         tenant = getattr(req, "tenant", None)
@@ -168,9 +192,19 @@ class EngineCore:
             raise NumericDivergence(
                 f"serving: non-finite logits in prefill of {req.id} "
                 f"(health={health}) — restarting the engine")
+        if committed:
+            _telemetry.counter("serve.replay_requests").inc()
+            _telemetry.counter("serve.replay_tokens").inc(len(committed))
         _tracing.emit("serve.prefill", request=req.id,
-                      tokens=len(req.prompt), cached=cached, t0=t0,
+                      tokens=len(req.prompt), cached=cached,
+                      replayed=len(committed), t0=t0,
                       t1=time.perf_counter())
+        # the sample happens AFTER the health gate: a poisoned/faulting
+        # step must not advance a stateful sampler's RNG, or the replay
+        # would re-roll a different stream than the uninterrupted run
+        sampler = getattr(req, "sampler", None)
+        if sampler is not None:
+            return sampler.sample(logits), cached
         return int(np.argmax(logits)), cached
 
     # -- decode --------------------------------------------------------------
@@ -213,6 +247,8 @@ class EngineCore:
         preemption (``items`` arrive in admission order from the
         scheduler)."""
         _chaos.maybe_slow_decode()
+        _chaos.maybe_kill9_decode()   # real os._exit(137), cross-process
+        _chaos.storm_restart()        # K back-to-back classified restarts
         k = self.spec_window
         live, preempted = [], []
         remaining = [(req, int(last)) for req, last in items]
@@ -255,17 +291,27 @@ class EngineCore:
             draft[:, 1:] = self.proposer.draft(draft[:, 0], base_pos,
                                                k - 1)
         positions = base_pos[:, None] + np.arange(k)
+        samplers = [getattr(r, "sampler", None) for r, _ in live]
+        want_logits = any(s is not None for s in samplers)
         if self.fused:
-            out, health, crossings = self._fused_step(seq_ids, draft,
-                                                      positions)
+            out, logits1, health, crossings = self._fused_step(
+                seq_ids, draft, positions)
         else:
-            out, health, crossings = self._host_step(seq_ids, draft,
-                                                     positions)
+            out, logits1, health, crossings = self._host_step(
+                seq_ids, draft, positions, want_logits=want_logits)
         health = _chaos.poison_loss(health)
         if not math.isfinite(health):
             raise NumericDivergence(
                 f"serving: non-finite logits in decode batch of "
                 f"{len(live)} (health={health}) — restarting the engine")
+        if want_logits:
+            # non-greedy rows sample HERE, after the health gate (a
+            # faulting step must not advance the journaled RNG — see
+            # prefill) — a non-greedy engine pins k == 1, so the row's
+            # one token is simply replaced
+            for bi, s in enumerate(samplers):
+                if s is not None:
+                    out[bi, 0] = s.sample(logits1[bi])
         results = {}
         emitted_total = 0
         accepted_total = 0
@@ -301,14 +347,17 @@ class EngineCore:
             crossings / emitted_total)
         return results, preempted
 
-    def _host_step(self, seq_ids, draft, positions):
+    def _host_step(self, seq_ids, draft, positions, want_logits=False):
         """The host-resident forward: numpy embed/QKV/combine
         interleaved with per-layer batched cache writes and decode
         attention.  ``K == 1`` is byte-for-byte the pre-speculative
         decode step; a wider window runs the same layer loop over the
         flattened ``(B*K, E)`` hidden batch with window writes and the
         per-row-causal widened attention.  Returns ``(out tokens
-        (B, K), health, host crossings)``."""
+        (B, K), last-position logits (B, V) when ``want_logits`` else
+        None, health, host crossings)`` — the logits hand-back is the
+        non-greedy sampling seam (the caller samples after the health
+        gate)."""
         b, k = draft.shape
         model = self.model
         # block tables are layer-invariant within a step (the slots were
@@ -326,6 +375,11 @@ class EngineCore:
                 h = model.layer_combine(i, h, attn)
             logits = model.logits(h)
             out = np.argmax(logits, axis=-1)[:, None]
+            if want_logits:
+                crossings = (0 if self.decode_kind == "dense"
+                             else 4 * model.num_layers)
+                return (out, logits, float(np.max(np.abs(logits))),
+                        crossings)
         else:
             h = model.embed(draft.reshape(-1), positions.reshape(-1))
             hd = (model.num_heads, model.head_dim)
@@ -343,7 +397,7 @@ class EngineCore:
             out = np.argmax(logits, axis=-1)
         crossings = (0 if self.decode_kind == "dense"
                      else 4 * model.num_layers)
-        return out, float(np.max(np.abs(logits))), crossings
+        return out, None, float(np.max(np.abs(logits))), crossings
 
     def _fused_step(self, seq_ids, draft, positions):
         """The fused arm: pad the batch to a power of two (dummy rows:
@@ -374,7 +428,7 @@ class EngineCore:
         toks, health = self.jax_model.decode_step(
             self.cache, draft, positions, tables, lengths, bids, offs)
         _telemetry.counter("serve.fused_steps").inc()
-        return toks[:b], health, 3
+        return toks[:b], None, health, 3
 
     def _pick_victim(self, remaining):
         """Index into ``remaining`` of the preemption victim: lowest

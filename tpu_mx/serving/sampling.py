@@ -1,0 +1,149 @@
+"""Samplers whose RNG state is data (the serving half of resume.py).
+
+Greedy decode needs no state: ``argmax`` replays bit-identically from
+the tokens alone, which is why the recovery ladder (docs/robustness.md)
+can rebuild a greedy stream from nothing but the committed-token
+journal.  Non-greedy decode is only replayable if the sampler's RNG
+state is treated exactly like the training RNG in ``tpu_mx/resume.py``:
+captured as an exact capsule (``encode_state`` — base64 of the raw
+MT19937 words, never a repr) next to every committed token, and restored
+before the next sample.  With that discipline a journaled top-k stream
+is bit-identical across an engine restart, a kill −9, or a planned
+handoff — the sampler continues mid-stream instead of re-rolling.
+
+- :class:`GreedySampler` exists only for symmetry in tests; the engine's
+  fast path keeps its batched ``argmax`` and never constructs one.
+- :class:`TopKSampler` draws from the softmax over the ``k`` highest
+  logits with a private ``np.random.RandomState`` (process-global numpy
+  RNG is never touched — the determinism rule every subsystem here
+  follows).  ``state_dict()``/``load_state_dict()`` round-trip the exact
+  generator state; ``reset()`` restores the construction-time state for
+  the legacy prompt-replay arm, which re-rolls the whole stream from the
+  start and therefore must reproduce it from the initial seed.
+
+The engine resolves sampling ONCE per server (like every data-plane
+knob): a non-greedy server pins the fused whole-step arm off and the
+speculative window to 1 — both sample on-device/greedily and would fork
+the stream from the host sampler (recorded on ``serve.decode_path`` so a
+black box says which sampling mode the engine was on).
+"""
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from ..base import MXNetError
+from ..resume import decode_state, encode_state
+
+__all__ = ["GreedySampler", "TopKSampler", "fold_seed", "make_sampler",
+           "parse_sampling"]
+
+
+def parse_sampling(spec):
+    """``"greedy"`` or ``"top_k:K"`` → ``("greedy", None)`` /
+    ``("top_k", K)``.  The one spec parser, used by the server at
+    construction so a typo fails the constructor, not request N."""
+    spec = str(spec or "greedy").strip()
+    if spec == "greedy":
+        return "greedy", None
+    kind, _, arg = spec.partition(":")
+    if kind == "top_k":
+        try:
+            k = int(arg)
+        except ValueError:
+            k = 0
+        if k >= 1:
+            return "top_k", k
+    raise MXNetError(
+        f"serving: unknown sampling spec {spec!r} — expected 'greedy' "
+        f"or 'top_k:K' with K >= 1")
+
+
+def fold_seed(base_seed, request_id):
+    """One deterministic 32-bit seed per request: the server's
+    ``sampling_seed`` folded with the request id, so a recovered process
+    (which re-derives samplers only when the journal carried no state)
+    rolls the same stream the dead process would have."""
+    return (int(base_seed) * 1000003
+            + zlib.crc32(str(request_id).encode("utf-8"))) & 0xFFFFFFFF
+
+
+class GreedySampler:
+    """Stateless argmax — the trivial member of the sampler protocol."""
+
+    kind = "greedy"
+
+    def sample(self, logits):
+        return int(np.argmax(np.asarray(logits).reshape(-1)))
+
+    def state_dict(self):
+        return {"kind": self.kind}
+
+    def load_state_dict(self, state):
+        if state.get("kind") != self.kind:
+            raise MXNetError(f"sampler state kind {state.get('kind')!r} "
+                             f"!= {self.kind!r}")
+
+    def reset(self):
+        pass
+
+
+class TopKSampler:
+    """Softmax over the top ``k`` logits, drawn from a private
+    MT19937 — see module docstring for the RNG-is-data contract."""
+
+    kind = "top_k"
+
+    def __init__(self, k, seed=0):
+        self.k = int(k)
+        if self.k < 1:
+            raise MXNetError(f"TopKSampler: k must be >= 1, got {k}")
+        self._rng = np.random.RandomState(int(seed) & 0xFFFFFFFF)
+        # the construction-time state, kept so reset() (the legacy
+        # prompt-replay arm) re-rolls the stream from the beginning
+        self._initial = self._rng.get_state()
+
+    def sample(self, logits):
+        logits = np.asarray(logits, np.float64).reshape(-1)
+        k = min(self.k, logits.size)
+        idx = np.argpartition(logits, -k)[-k:]
+        # deterministic candidate order whatever argpartition returned:
+        # logit descending, index ascending on ties
+        idx = idx[np.lexsort((idx, -logits[idx]))]
+        z = logits[idx] - logits[idx][0]
+        p = np.exp(z)
+        p /= p.sum()
+        return int(idx[self._rng.choice(k, p=p)])
+
+    def state_dict(self):
+        """Exact JSON-safe capsule of the generator (resume.py's
+        encode_state — the MT19937 key array rides as base64 bytes)."""
+        return {"kind": self.kind, "k": self.k,
+                "state": encode_state(list(self._rng.get_state()))}
+
+    def load_state_dict(self, state):
+        if state.get("kind") != self.kind:
+            raise MXNetError(f"sampler state kind {state.get('kind')!r} "
+                             f"!= {self.kind!r}")
+        if int(state.get("k", self.k)) != self.k:
+            raise MXNetError(
+                f"sampler state k={state.get('k')} != configured "
+                f"k={self.k} — the journaled stream was rolled under a "
+                f"different distribution")
+        st = decode_state(state["state"])
+        self._rng.set_state((str(st[0]), np.asarray(st[1], np.uint32),
+                             int(st[2]), int(st[3]), float(st[4])))
+
+    def reset(self):
+        self._rng.set_state(self._initial)
+
+
+def make_sampler(kind, k, seed):
+    """Instantiate a per-request sampler, or None for greedy (the
+    engine's batched argmax fast path needs no object)."""
+    if kind == "greedy":
+        return None
+    if kind == "top_k":
+        return TopKSampler(k, seed=seed)
+    raise MXNetError(f"serving: unknown sampler kind {kind!r}")

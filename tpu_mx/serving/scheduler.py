@@ -63,7 +63,9 @@ class AdmissionReject(MXNetError):
         # reasons: queue_full / request_too_large / reject_storm /
         # degraded / tenant_quota (ISSUE 12 — the submitting tenant is
         # over its max_inflight or token_quota; resubmit after its own
-        # in-flight work drains, other tenants are unaffected)
+        # in-flight work drains, other tenants are unaffected) /
+        # draining (ISSUE 19 — the server is quiescing for a drain or
+        # handoff; resubmit once admission reopens)
 
 
 class Request:
@@ -72,11 +74,16 @@ class Request:
 
     States: ``queued`` → ``running`` → ``done`` (or ``failed``).  A
     requeued request (engine restart, cache preemption) goes back to
-    ``queued`` with its generated tokens DISCARDED — re-run-from-prompt
-    is the restart contract (docs/serving.md); ``requeues`` counts how
-    often that happened.  Latency bookkeeping (``submitted_at``,
-    ``first_token_at``, ``token_times``) feeds the TTFT/ITL telemetry
-    and the bench percentiles."""
+    ``queued``; on the prefill-replay arm (ISSUE 19, the default) its
+    committed tokens SURVIVE — they are the in-memory token ledger the
+    recovery prefill replays in one call — while the legacy
+    prompt-replay arm discards them and re-runs from the prompt
+    (docs/serving.md, docs/robustness.md).  ``requeues`` counts how
+    often either happened.  ``sampler`` (serving/sampling.py) is the
+    per-request host sampler for non-greedy modes, or None for the
+    engine's batched-argmax fast path.  Latency bookkeeping
+    (``submitted_at``, ``first_token_at``, ``token_times``) feeds the
+    TTFT/ITL telemetry and the bench percentiles."""
 
     def __init__(self, prompt, max_new_tokens, request_id=None,
                  tenant=None):
@@ -95,6 +102,7 @@ class Request:
         # the table.
         self.tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         self.tenant_weight = 1.0
+        self.sampler = None
         self.state = "queued"
         self.tokens = []
         self.finish_reason = None
@@ -143,14 +151,29 @@ class Request:
         self.tokens.append(int(token))
         self.timeline.mark_token(now)
 
-    def reset_generation(self):
-        """Discard generated state for a re-run (restart/preemption)."""
+    def reset_generation(self, keep_tokens=False):
+        """Put the request back in ``queued`` for a re-run
+        (restart/preemption).  ``keep_tokens=True`` is the
+        prefill-replay arm: committed tokens, delivery times, and the
+        measured TTFT all stand — the recovery prefill replays them
+        without re-yielding.  ``keep_tokens=False`` is the legacy
+        prompt-replay arm: generated state is discarded, and a stateful
+        sampler rewinds to its initial capsule so the re-rolled stream
+        reproduces the discarded one bit-for-bit."""
+        if keep_tokens:
+            self.requeues += 1
+            self.state = "queued"
+            self.timeline.mark_replay_requeue()
+            return
+        committed = len(self.tokens)
         self.tokens = []
         self.token_times = []
         self.first_token_at = None
         self.requeues += 1
         self.state = "queued"
-        self.timeline.mark_requeue()
+        if self.sampler is not None:
+            self.sampler.reset()
+        self.timeline.mark_requeue(committed=committed)
 
     def _observe_ttft(self):
         # one serve.ttft_seconds sample per REQUEST, stamped at terminal
@@ -529,18 +552,21 @@ class ContinuousBatchingScheduler:
                 self._running.remove(req)
         return [req]
 
-    def requeue(self, req, front=True):
+    def requeue(self, req, front=True, replay=False):
         """Bounce a running request back to pending for a re-run
-        (engine restart, cache preemption).  Its generated tokens are
-        discarded; ``front=True`` preserves arrival order fairness.
-        The vtime charge is NOT refunded: a requeued request consumed
-        real service (its destroyed attempt) — unlike a deferral."""
+        (engine restart, cache preemption).  ``replay=True`` (the
+        server's prefill-replay arm, ISSUE 19) keeps its committed
+        tokens — the recovery prefill replays them in one call;
+        ``replay=False`` discards them (legacy prompt replay).
+        ``front=True`` preserves arrival order fairness.  The vtime
+        charge is NOT refunded: a requeued request consumed real
+        service (its interrupted attempt) — unlike a deferral."""
         with self._lock:
             if req in self._running:
                 self._running.remove(req)
             self._admitting.discard(req)
             self._vtime_charges.pop(req, None)
-            req.reset_generation()
+            req.reset_generation(keep_tokens=replay)
             if front:
                 self._pending.insert(0, req)
             else:
@@ -566,13 +592,14 @@ class ContinuousBatchingScheduler:
             self._pending[0:0] = list(reqs)
         _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
 
-    def requeue_all_running(self):
-        """Engine restart: every in-flight sequence survives by going
-        back to pending (newest first so fronted order stays FIFO)."""
+    def requeue_all_running(self, replay=False):
+        """Engine restart / handoff: every in-flight sequence survives
+        by going back to pending (newest first so fronted order stays
+        FIFO).  ``replay`` as in :meth:`requeue`."""
         with self._lock:
             running = list(self._running)
         for req in reversed(running):
-            self.requeue(req, front=True)
+            self.requeue(req, front=True, replay=replay)
         return running
 
     def drain_running(self):
@@ -654,12 +681,12 @@ class StaticBatchingScheduler(ContinuousBatchingScheduler):
             self._finished = []
         return drained
 
-    def requeue_all_running(self):
+    def requeue_all_running(self, replay=False):
         with self._lock:
             # padding members' cache is freed by the server on restart
             # like everyone else's; only unfinished ones re-run
             self._finished = []
-        return super().requeue_all_running()
+        return super().requeue_all_running(replay=replay)
 
     def drain_running(self):
         with self._lock:

@@ -22,14 +22,32 @@ catchable ``WatchdogTimeout``); non-finite logits raise
 ``NumericDivergence`` exactly like the training sentinel; both are
 sorted by ``supervisor.classify`` and anything transient/numeric
 triggers a **classified engine restart**: the engine (cache included) is
-rebuilt from scratch, every in-flight request is requeued and re-runs
-from its prompt, a black box is dumped (``blackbox=`` prefix, same
-flight-recorder format the training supervisor writes), and a bounded
-restart budget degrades gracefully — queued requests are failed with a
-reason, never silently lost.  Abandoned watchdog threads only ever touch
-the DISCARDED engine's private cache (the zombie-step discipline:
-scheduler and request handles are mutated exclusively by the caller's
-step thread).
+rebuilt from scratch, every in-flight request is requeued, a black box
+is dumped (``blackbox=`` prefix, same flight-recorder format the
+training supervisor writes), and a bounded restart budget degrades
+gracefully.  Abandoned watchdog threads only ever touch the DISCARDED
+engine's private cache (the zombie-step discipline: scheduler and
+request handles are mutated exclusively by the caller's step thread).
+
+**Zero-regeneration recovery** (ISSUE 19, docs/robustness.md "Serving
+recovery ladder"): a requeued request keeps its committed tokens — the
+in-memory token ledger — and the rebuilt engine re-establishes it with
+ONE ``prefill(prompt + committed)`` call instead of re-decoding token by
+token, so recovery cost is flat in generation length and greedy (or
+journaled-RNG sampled) streams are bit-identical to the uninterrupted
+run, re-yielding nothing.  ``TPUMX_PREFILL_REPLAY=0`` (or ``replay=
+False``) selects the legacy prompt-replay arm for A/B.  ``journal=``
+arms the durable half: every admission and committed token is fsync'd
+to an append-only JSONL journal (tpu_mx/serving/journal.py) — once per
+step, BEFORE tokens become client-visible — so a new process can
+``recover()`` every stream after a kill −9 with zero lost, duplicated,
+or re-yielded tokens.  ``drain()`` / ``handoff()`` are the planned
+twins: stop admission and quiesce, or migrate every live session to a
+fresh engine generation at a step boundary — zero client-visible
+failures, no restart budget spent.  The degrade path reuses the same
+machinery: budget exhaustion fails QUEUED work loudly but migrates the
+running batch onto one final generation and drains it — mid-stream work
+fails only if the fault strikes again during that drain.
 
 Trace context: each step stamps ``step``/``generation`` (engine
 generation = restart count) and per-request work stamps ``request`` —
@@ -40,6 +58,7 @@ black box reconstructs its admit → prefill → decode → evict timeline
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import numpy as np
@@ -49,7 +68,9 @@ from .. import telemetry as _telemetry
 from .. import tracing as _tracing
 from ..supervisor import classify, run_with_deadline
 from .engine import EngineCore
+from .journal import TokenJournal, load as _journal_load
 from .kv_cache import CacheExhausted
+from .sampling import fold_seed, make_sampler, parse_sampling
 from .scheduler import ContinuousBatchingScheduler, Request
 from .slo import SLOMonitor
 from .tenancy import label_for
@@ -68,13 +89,25 @@ class Server:
     ``block_size``/``num_blocks`` size the paged cache; ``deadline``
     arms the hung-step watchdog (seconds, None = off); ``max_restarts``
     bounds the self-healing budget; ``blackbox`` (a path prefix) arms
-    the crash black box; ``eos_id`` optionally ends generation early."""
+    the crash black box; ``eos_id`` optionally ends generation early.
+
+    Recovery knobs (ISSUE 19): ``journal=`` (a path prefix) arms the
+    durable committed-token journal — ``recover()`` in a NEW process
+    resumes every unfinished stream from it; ``sampling=`` picks the
+    decode mode (``"greedy"`` default, or ``"top_k:K"`` — non-greedy
+    pins the fused/speculative arms off, since both sample greedily);
+    ``sampling_seed=`` is the base seed each request's private RNG is
+    folded from; ``replay=`` overrides the ``TPUMX_PREFILL_REPLAY``
+    resolution (True = prefill replay on restarts, False = the legacy
+    prompt-replay arm)."""
 
     def __init__(self, model, *, scheduler=None, max_pending=64,
                  max_batch=8, max_tokens=8192, block_size=16,
                  num_blocks=256, deadline=None, max_restarts=3,
                  backoff=0.05, blackbox=None, eos_id=None, slo=None,
-                 tenants=None, prefix_sharing=None, dtype=np.float32):
+                 tenants=None, prefix_sharing=None, dtype=np.float32,
+                 journal=None, sampling="greedy", sampling_seed=0,
+                 replay=None):
         self.model = model
         # the live SLO monitor (tpu_mx/serving/slo.py): True arms the
         # default targets, a list/tuple of spec strings builds a monitor
@@ -113,12 +146,18 @@ class Server:
         self.backoff = float(backoff)
         self.blackbox = blackbox
         self.eos_id = eos_id
-        self.engine = EngineCore(model, block_size=block_size,
-                                 num_blocks=num_blocks, dtype=dtype,
-                                 share_prefix=prefix_sharing,
-                                 forensics=blackbox,
-                                 warm_batch=getattr(self.scheduler,
-                                                    "max_batch", None))
+        # recovery plane (ISSUE 19): sampling mode is a SERVER property
+        # (one decode path per server, resolved once like the engine
+        # arms), the replay arm resolves env-default-on, and the journal
+        # opens (and fsyncs its header) before any request is admitted
+        self._sampling_kind, self._sampling_k = parse_sampling(sampling)
+        self._sampling_seed = int(sampling_seed)
+        if replay is None:
+            replay = os.environ.get("TPUMX_PREFILL_REPLAY", "1") != "0"
+        self.replay = bool(replay)
+        self.journal = TokenJournal(journal) if journal else None
+        self._draining = False
+        self.engine = self._new_engine()
         self.generation = 0        # engine generation (restart count)
         self.restarts = 0
         self.degraded = False
@@ -135,6 +174,28 @@ class Server:
         # mirroring the SLO monitor's own rate limit
         self._cap_published = None
 
+    def _new_engine(self):
+        """Build one engine generation (construction, restart, handoff,
+        the degraded drain): the rebuilt engine keeps every data-plane
+        contract it degraded under — sharing knob, forensics, warm
+        batch, and the greedy/sampled pin (non-greedy sampling forces
+        the fused and speculative arms off)."""
+        return EngineCore(self.model, block_size=self._block_size,
+                          num_blocks=self._num_blocks, dtype=self._dtype,
+                          share_prefix=self._prefix_sharing,
+                          forensics=self.blackbox,
+                          warm_batch=getattr(self.scheduler,
+                                             "max_batch", None),
+                          greedy=self._sampling_kind == "greedy")
+
+    def _sampler_for(self, req):
+        """The request's private sampler (None for greedy): seeded by
+        folding the request id into the server's base seed, so a
+        recovered process rebuilds the SAME sampler for the same id
+        before loading its journaled state."""
+        return make_sampler(self._sampling_kind, self._sampling_k,
+                            fold_seed(self._sampling_seed, req.id))
+
     # -- admission (any thread) ----------------------------------------------
     def submit(self, prompt, max_new_tokens=16, request_id=None,
                tenant=None):
@@ -143,17 +204,25 @@ class Server:
         later; ``tenant_quota`` means THIS tenant is over its caps).
         ``tenant`` names the submitting tenant (fairness/quota identity
         + bounded telemetry label; None = the default tenant).  A
-        degraded server rejects everything."""
+        degraded server rejects everything; a draining one rejects with
+        ``"draining"`` until :meth:`resume_admission`."""
         req = Request(prompt, max_new_tokens, request_id=request_id,
                       tenant=tenant)
         req.tenant_weight = self.scheduler.tenants.get(req.tenant).weight
-        # both server-side gates route through the scheduler's ONE
-        # reject implementation, so a degraded-window or oversized
-        # submit is counted and lands on the timeline like any other
+        req.sampler = self._sampler_for(req)
+        # all server-side gates route through the scheduler's ONE
+        # reject implementation, so a degraded-window, draining, or
+        # oversized submit is counted and lands on the timeline like
+        # any other
         if self.degraded:
             self.scheduler.reject(req, "degraded",
                                   "restart budget exhausted; server is "
                                   "in degraded shutdown")
+        if self._draining:
+            self.scheduler.reject(req, "draining",
+                                  "server is quiescing for drain/"
+                                  "handoff; resubmit after admission "
+                                  "reopens")
         # a request whose WORST CASE can never fit the block pool would
         # preempt-loop forever — reject it at the door with the reason
         need = self.engine.cache.blocks_for(req.budget_tokens)
@@ -162,14 +231,21 @@ class Server:
                 req, "request_too_large",
                 f"prompt+max_new needs {need} cache blocks > pool of "
                 f"{self._num_blocks}")
-        return self.scheduler.submit(req)
+        handle = self.scheduler.submit(req)
+        if self.journal is not None:
+            # fsync'd at admission: a crash between here and the first
+            # token still recovers the stream (prompt-only replay)
+            self.journal.begin(req)
+        return handle
 
     # -- the engine loop (one driver thread) ---------------------------------
     def step(self):
         """One engine iteration (admit → prefill → decode → evict).
         Returns True when any work was done.  Transient/numeric faults
-        restart the engine in place; fatal ones propagate."""
-        if self.degraded:
+        restart the engine in place; fatal ones propagate.  A degraded
+        server still steps while its migrated running batch drains —
+        only an IDLE degraded server refuses to step."""
+        if self.degraded and self.scheduler.idle():
             raise MXNetError("serving: server is degraded — no further "
                              "steps will run")
         self._steps += 1
@@ -180,6 +256,14 @@ class Server:
             kind = classify(e)
             if kind == "fatal":
                 raise
+            if self.degraded:
+                # a SECOND fault during the degraded drain: the budget
+                # is spent and there is no next generation — fail the
+                # remaining in-flight work loudly instead of looping
+                self._fail_inflight(
+                    f"degraded: fault during degraded drain "
+                    f"({type(e).__name__}: {e})"[:300])
+                return True
             self._restart(e)
             return True
 
@@ -224,7 +308,8 @@ class Server:
                 # restart_penalty); the ones behind it never started
                 # and keep accruing queue wait.
                 self.scheduler.defer(admits[i + 1:])
-                self.scheduler.requeue(req, front=True)
+                self.scheduler.requeue(req, front=True,
+                                       replay=self.replay)
                 raise
             finally:
                 _tracing.set_context(request=None)
@@ -274,11 +359,18 @@ class Server:
                 if done_padding:
                     self.scheduler.discard(req)
                 else:
-                    self.scheduler.requeue(req, front=True)
+                    self.scheduler.requeue(req, front=True,
+                                           replay=self.replay)
             _telemetry.counter("serve.decode_steps").inc()
             _tracing.emit("serve.decode", batch=len(items), tokens=fresh,
                           t0=t0, t1=time.perf_counter())
             worked = True
+        if self.journal is not None:
+            # the once-per-step durability point: every token committed
+            # this step hits disk BEFORE step() returns — and stream()
+            # only yields after step() returns, so every client-visible
+            # token is journaled
+            self.journal.flush()
         self._update_gauges()
         return worked
 
@@ -287,10 +379,16 @@ class Server:
         req.record_token(token)
         self._tokens_generated += 1
         _telemetry.counter("serve.generated_tokens").inc()
+        if self.journal is not None:
+            # buffered, not fsync'd: the step-end flush() is the
+            # durability point (one fsync per step, not per token)
+            self.journal.commit_token(req, token)
         done_len = len(req.tokens) >= req.max_new_tokens
         done_eos = self.eos_id is not None and int(token) == self.eos_id
         if done_len or done_eos:
             reason = "eos" if done_eos else "length"
+            if self.journal is not None:
+                self.journal.end(req, reason)
             for ev in self.scheduler.finish(req, reason):
                 self._evict(ev)
 
@@ -402,11 +500,25 @@ class Server:
         return self.scheduler.capacity_signal
 
     # -- self-healing --------------------------------------------------------
+    def _swap_engine(self):
+        """Advance to a fresh engine generation (restart, handoff, the
+        degraded drain).  The old engine — and any watchdog thread
+        still wedged inside it — is garbage from here: threads touching
+        its private cache mutate nothing the new generation reads.  The
+        rebuilt pool starts empty, so the stale would-fit signal (and
+        stale pool gauges) must not gate admission on the DEAD pool."""
+        self.generation += 1
+        _tracing.set_context(generation=self.generation)
+        self.engine = self._new_engine()
+        self.scheduler.capacity_signal = None
+        self._cap_published = None
+
     def _restart(self, err):
         """Classified engine restart: fresh engine + cache, every
-        in-flight request requeued (re-runs from its prompt), black box
-        dumped; budget exhaustion degrades — queued requests are failed
-        loudly, never silently lost."""
+        in-flight request requeued (ONE replay prefill re-establishes
+        its committed tokens — or a full prompt re-run on the legacy
+        arm), black box dumped; budget exhaustion degrades — queued
+        requests are failed loudly, never silently lost."""
         self.restarts += 1
         reason = f"{type(err).__name__}: {err}"[:300]
         log.warning("serving: engine fault (%s) — restart %d/%d",
@@ -414,7 +526,13 @@ class Server:
         if self.restarts > self.max_restarts:
             self._degrade(err)
             return
-        requeued = self.scheduler.requeue_all_running()
+        requeued = self.scheduler.requeue_all_running(replay=self.replay)
+        if self.journal is not None:
+            # tokens the faulted step committed before the fault are
+            # real (record_token ran; stream() may yield them) — make
+            # them durable with the restart instead of waiting for the
+            # next clean step boundary
+            self.journal.flush()
         _telemetry.counter("serve.engine_restarts").inc()
         # serve.restart lands under the FAILING step's (step, generation)
         # context — the injection->decision correlation the serve CI tier
@@ -423,23 +541,7 @@ class Server:
         # stamped with the generation it will actually run as
         _tracing.emit("serve.restart", n=self.restarts, reason=reason,
                       requeued=len(requeued))
-        self.generation += 1
-        _tracing.set_context(generation=self.generation)
-        # the old engine (and any watchdog thread still wedged inside
-        # it) is garbage from here: threads touching its private cache
-        # mutate nothing the new generation reads
-        self.engine = EngineCore(self.model, block_size=self._block_size,
-                                 num_blocks=self._num_blocks,
-                                 dtype=self._dtype,
-                                 share_prefix=self._prefix_sharing,
-                                 forensics=self.blackbox,
-                                 warm_batch=getattr(self.scheduler,
-                                                    "max_batch", None))
-        # the rebuilt engine's pool starts empty: the stale would-fit
-        # signal (and the stale pool gauges) refresh on the next step,
-        # but the scheduler must not gate admission on the DEAD pool
-        self.scheduler.capacity_signal = None
-        self._cap_published = None
+        self._swap_engine()
         self._dump_blackbox(f"serving engine restart "
                             f"{self.restarts}/{self.max_restarts}: "
                             f"{reason}")
@@ -448,19 +550,48 @@ class Server:
             time.sleep(min(30.0, self.backoff * 2 ** (self.restarts - 1)))
 
     def _degrade(self, err):
-        """Restart budget exhausted: fail every queued + running request
-        with a reason (the client sees it; nothing hangs forever)."""
+        """Restart budget exhausted: admission closes and QUEUED
+        requests fail loudly — but the running batch is not abandoned.
+        It migrates (the same replay path a restart uses) onto one
+        final engine generation and drains to completion under
+        ``step()``'s degraded-drain mode, so budget exhaustion fails
+        only queued, never mid-stream, work.  A further fault during
+        that drain fails the remainder (``_fail_inflight``)."""
         self.degraded = True
         reason = (f"degraded: restart budget exhausted "
                   f"({type(err).__name__}: {err})")[:300]
         log.error("serving: %s", reason)
-        # drain, don't requeue: these requests are being FAILED, so a
-        # requeue would both double-count them as "requeued" and leave
-        # each one processed twice
+        # drain the QUEUE, don't requeue it: these requests are being
+        # FAILED, so a requeue would both double-count them as
+        # "requeued" and leave each one processed twice
+        failed = self.scheduler.drain_pending()
+        for req in failed:
+            req.fail(reason)
+            if self.journal is not None:
+                self.journal.end(req, "degraded")
+        requeued = self.scheduler.requeue_all_running(replay=self.replay)
+        _tracing.emit("serve.drain", kind="degrade",
+                      inflight=len(requeued), pending=len(failed))
+        if self.journal is not None:
+            self.journal.flush()
+        if requeued:
+            self._swap_engine()
+        self._dump_blackbox(reason)
+        _telemetry.flush()
+
+    def _fail_inflight(self, reason):
+        """Terminal: fail everything still queued or running (a second
+        fault inside the degraded drain — no generation left to
+        migrate to)."""
+        log.error("serving: %s", reason)
         failed = self.scheduler.drain_running()
         failed.extend(self.scheduler.drain_pending())
         for req in failed:
             req.fail(reason)
+            if self.journal is not None:
+                self.journal.end(req, "failed")
+        if self.journal is not None:
+            self.journal.flush()
         self._dump_blackbox(reason)
         _telemetry.flush()
 
@@ -482,6 +613,102 @@ class Server:
         except Exception as dump_err:  # noqa: BLE001 — best effort
             log.warning("serving: black-box dump failed: %s", dump_err)
             return None
+
+    # -- planned maintenance: drain / handoff / recover (ISSUE 19) -----------
+    def drain(self, max_steps=1_000_000):
+        """Graceful drain: admission closes (new submits reject with
+        reason ``"draining"``) and the loop runs until every admitted
+        request completes — quiescing at decode-step boundaries with
+        zero client-visible failures.  Admission stays closed
+        afterwards (:meth:`resume_admission` reopens it); returns the
+        number of steps the drain took.  :meth:`handoff` is the
+        live-migration sibling that never stops serving."""
+        self._draining = True
+        _tracing.emit("serve.drain", kind="drain",
+                      inflight=self.scheduler.running_count(),
+                      pending=self.scheduler.queue_depth())
+        return self.run_until_idle(max_steps)
+
+    def resume_admission(self):
+        """Reopen admission after :meth:`drain`."""
+        self._draining = False
+
+    def handoff(self):
+        """Hot engine handoff: quiesce at the current decode-step
+        boundary (the single driver thread owns it — call between
+        ``step()``s) and migrate every live session onto a fresh engine
+        generation via ONE replay prefill each.  A planned upgrade: no
+        restart budget spent, no backoff, no black box, nothing
+        re-yielded — greedy/journaled streams continue bit-identically.
+        Returns the number of migrated sessions."""
+        requeued = self.scheduler.requeue_all_running(replay=self.replay)
+        if self.journal is not None:
+            self.journal.flush()
+        _tracing.emit("serve.drain", kind="handoff",
+                      inflight=len(requeued),
+                      pending=self.scheduler.queue_depth())
+        self._swap_engine()
+        log.info("serving: handoff to generation %d (%d live sessions "
+                 "migrated)", self.generation, len(requeued))
+        return len(requeued)
+
+    def recover(self):
+        """Resume every unfinished stream from the journal — the
+        cross-process half of zero-regeneration recovery (a kill −9'd
+        server's successor calls this once before stepping).  Each live
+        journal entry becomes a Request with its committed tokens
+        pre-loaded as the in-memory ledger and its sampler restored
+        from the last per-token RNG capsule; the next step re-
+        establishes it with ONE ``prefill(prompt + committed)`` and the
+        stream continues exactly where the dead process left it.  A
+        torn/corrupt entry degrades LOUDLY to prompt replay (tokens
+        dropped, ``serve.replay_fallbacks`` counted) — never guesses.
+        Returns ``{request_id: Request}``."""
+        if self.journal is None:
+            raise MXNetError("serving: recover() needs Server("
+                             "journal=...) — there is no journal to "
+                             "recover from")
+        out = {}
+        for rid, entry in _journal_load(self.journal.path).items():
+            if entry["ended"]:
+                continue
+            req = Request(entry["prompt"], entry["max_new"],
+                          request_id=rid, tenant=entry["tenant"])
+            req.tenant_weight = \
+                self.scheduler.tenants.get(req.tenant).weight
+            req.sampler = self._sampler_for(req)
+            if entry["fallback"]:
+                _telemetry.counter("serve.replay_fallbacks").inc()
+                log.error("serving: journal entry for %s was torn/"
+                          "corrupt — recovering from the prompt "
+                          "(committed tokens dropped, stream restarts "
+                          "from scratch)", rid)
+            if req.sampler is not None:
+                # the RNG capsule after the LAST committed token (or
+                # the admission-time state when none committed yet)
+                state = (entry["rngs"][-1] if entry["rngs"]
+                         else entry["sampler"])
+                if state is not None:
+                    req.sampler.load_state_dict(state)
+            if entry["tokens"]:
+                req.tokens = [int(t) for t in entry["tokens"]]
+            if len(req.tokens) >= req.max_new_tokens:
+                # the stream finished but its end record died with the
+                # process: retire it here — re-admitting would decode
+                # past the length budget
+                self.journal.end(req, "length")
+                req.finish("length")
+                out[rid] = req
+                continue
+            # direct scheduler admission: server.submit would journal a
+            # fresh begin and rebuild a fresh sampler — this request
+            # CONTINUES its existing journal entry (token indices stay
+            # contiguous with what is already on disk)
+            self.scheduler.submit(req)
+            out[rid] = req
+        if self.journal is not None:
+            self.journal.flush()
+        return out
 
     # -- drivers -------------------------------------------------------------
     def run_until_idle(self, max_steps=1_000_000):
@@ -507,10 +734,13 @@ class Server:
         seen = 0
         guard = 0
         while True:
-            # an engine restart resets req.tokens and re-runs from the
-            # prompt; greedy decode is deterministic, so the regenerated
-            # prefix matches what was already yielded — wait for the
-            # length to catch back up to `seen` instead of re-yielding
+            # on the prefill-replay arm an engine restart KEEPS
+            # req.tokens (the ledger survives; nothing to re-yield).
+            # On the legacy arm a restart resets req.tokens and re-runs
+            # from the prompt; greedy decode is deterministic, so the
+            # regenerated prefix matches what was already yielded —
+            # wait for the length to catch back up to `seen` instead of
+            # re-yielding.  Either way the cursor only moves forward.
             while seen < len(req.tokens):
                 yield req.tokens[seen]
                 seen += 1

@@ -12,8 +12,14 @@ scheduler/engine/server seams:
 - ``decode_gap``   — per-token: from the previous committed token (or
   prefill end) to this commit — scheduler share and decode compute both
 - ``restart_penalty`` — everything an engine restart / cache preemption
-  cost this request: the in-flight interval at fault time, plus the
-  rebuild/backoff/queue wait until its re-run's prefill starts
+  cost this request: the in-flight interval at fault time, the
+  rebuild/backoff/queue wait until its recovery starts, and the
+  recovery work itself — the replay prefill on the prefill-replay arm
+  (ISSUE 19), or, on the legacy prompt-replay arm, the re-run prefill
+  PLUS every re-decoded catch-up token (tokens the client already had
+  deliver nothing; charging them to ``decode_gap`` would hide exactly
+  the O(n) cost the replay arm removes — the CI A/B gate compares the
+  two arms on this phase)
 - ``defer_stall``  — cache-backpressure deferrals: the wait after a
   prefill admission bounced on ``CacheExhausted``
 - ``reject``       — the (tiny) interval a rejected admission consumed
@@ -65,7 +71,7 @@ class RequestTimeline:
     __slots__ = ("t0", "_mark", "_wait_kind", "_in_flight", "phases",
                  "defers", "requeues", "tokens", "ttft_breakdown",
                  "_first_token_pending", "ended_at", "outcome",
-                 "cached_tokens")
+                 "cached_tokens", "_replay_pending", "_catchup")
 
     def __init__(self, t0=None):
         self.t0 = time.perf_counter() if t0 is None else float(t0)
@@ -84,6 +90,8 @@ class RequestTimeline:
         self._first_token_pending = True
         self.ended_at = None
         self.outcome = None
+        self._replay_pending = False     # next prefill is a restart replay
+        self._catchup = 0                # legacy re-decodes still owed
 
     # -- the one accounting primitive ----------------------------------------
     def _close(self, phase, now=None):
@@ -107,8 +115,13 @@ class RequestTimeline:
         """``cached_tokens``: how many leading prompt tokens this
         attempt served from the shared-prefix cache — recorded so a
         suspiciously fast ``prefill`` phase reads as a cache hit, not a
-        measurement bug (ISSUE 12)."""
-        self._close("prefill")
+        measurement bug (ISSUE 12).  A restart-replay prefill (ISSUE 19)
+        is recovery work, not first-time prompt work: it closes into
+        ``restart_penalty``, keeping ``prefill`` comparable across
+        restarted and clean requests."""
+        self._close("restart_penalty" if self._replay_pending
+                    else "prefill")
+        self._replay_pending = False
         self._in_flight = True
         self.cached_tokens = int(cached_tokens)
 
@@ -132,27 +145,53 @@ class RequestTimeline:
     def mark_token(self, now=None):
         """A token committed: the gap since the previous commit (or the
         prefill end) is decode_gap.  The first token of an attempt
-        snapshots the cumulative phase sums — the TTFT breakdown."""
-        self._close("decode_gap", now)
+        snapshots the cumulative phase sums — the TTFT breakdown.  On
+        the legacy prompt-replay arm, the first ``committed`` tokens
+        after a requeue are CATCH-UP re-decodes — the client already
+        had them, so their gaps are restart penalty, not decode_gap
+        (and each one counts ``serve.redecode_tokens``)."""
+        if self._catchup > 0:
+            self._catchup -= 1
+            self._close("restart_penalty", now)
+            _telemetry.counter("serve.redecode_tokens").inc()
+        else:
+            self._close("decode_gap", now)
         self.tokens += 1
         if self._first_token_pending:
             self._first_token_pending = False
             self.ttft_breakdown = dict(self.phases)
 
-    def mark_requeue(self):
-        """An engine restart / cache preemption discarded this request's
-        generation: the in-flight interval, and everything until the
-        re-run's prefill starts, is restart penalty.  The first-token
-        snapshot resets with the generation (TTFT is measured to the
-        final attempt's first token)."""
+    def mark_requeue(self, committed=0):
+        """An engine restart / cache preemption DISCARDED this request's
+        generation (the legacy prompt-replay arm): the in-flight
+        interval, and everything until the re-run's prefill starts, is
+        restart penalty.  ``committed`` is how many tokens the discarded
+        attempt had delivered — the re-run's first ``committed`` decodes
+        are catch-up and stay in restart_penalty (:meth:`mark_token`).
+        The first-token snapshot resets with the generation (TTFT is
+        measured to the final attempt's first token)."""
         self._close("restart_penalty")
         self._wait_kind = "restart_penalty"
         self._in_flight = False
         self.requeues += 1
         self.tokens = 0
         self.cached_tokens = 0   # the re-run re-resolves its own hit
+        self._catchup += int(committed)
         self._first_token_pending = True
         self.ttft_breakdown = None
+
+    def mark_replay_requeue(self):
+        """The prefill-replay arm's requeue (ISSUE 19): the generation
+        SURVIVES — committed tokens, their delivery times, and the TTFT
+        already measured all stand, because the recovery re-establishes
+        the stream without re-yielding anything.  Everything from the
+        fault to the end of the ONE replay prefill is restart penalty
+        (the wait here, the prefill via ``_replay_pending``)."""
+        self._close("restart_penalty")
+        self._wait_kind = "restart_penalty"
+        self._in_flight = False
+        self.requeues += 1
+        self._replay_pending = True
 
     # -- terminal ------------------------------------------------------------
     def finalize(self, request_id, outcome, ttft=None, now=None,

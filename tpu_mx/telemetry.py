@@ -256,6 +256,21 @@ KNOWN_METRICS = frozenset({
     "serve.pool_bytes", "serve.pool_used_bytes",
     "serve.pool_fragmentation", "serve.pool_high_watermark_bytes",
     "serve.prefix_index_bytes", "serve.pool_pinned_blocks",
+    # zero-regeneration recovery (ISSUE 19; tpu_mx/serving/journal.py +
+    # the prefill-replay restart path).  journal_requests/tokens/bytes
+    # count durable admissions, committed-token records, and bytes
+    # fsync'd to the append-only journal.  replay_requests/replay_tokens
+    # count restart recoveries that re-established a stream with ONE
+    # prefill and the already-committed tokens that prefill replayed
+    # (vs serve.decode_steps — the "zero re-decoded steps" receipt);
+    # redecode_tokens counts tokens the LEGACY prompt-replay arm
+    # regenerated one decode step at a time (the A/B cost the CI gate
+    # compares); replay_fallbacks counts streams a torn/corrupt journal
+    # loudly degraded to prompt replay.
+    "serve.journal_requests", "serve.journal_tokens",
+    "serve.journal_bytes",
+    "serve.replay_requests", "serve.replay_tokens",
+    "serve.replay_fallbacks", "serve.redecode_tokens",
     # training-side capacity twins (ISSUE 14): jit builds per batch
     # shape-signature and their wall-clock (first-call XLA compile
     # included), the newest checkpoint's manifest bytes-on-disk, and

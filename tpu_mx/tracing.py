@@ -144,9 +144,13 @@ KNOWN_EVENTS = {
     "serve.reject": {"request": "str", "reason": "str"},
     # `cached` (ISSUE 12): how many leading prompt tokens were served
     # from the shared-prefix index instead of computed — a prefill that
-    # rode the cache attributes its speed honestly
+    # rode the cache attributes its speed honestly.  `replayed`
+    # (ISSUE 19): how many already-committed GENERATED tokens this
+    # prefill replayed in the same call — nonzero means this was a
+    # restart/handoff recovery that rebuilt the stream in O(1 prefill)
+    # instead of re-decoding
     "serve.prefill": {"request": "str", "tokens": "int", "seconds": "float",
-                     "cached": "int"},
+                     "cached": "int", "replayed": "int"},
     "serve.decode": {"batch": "int", "tokens": "int", "seconds": "float"},
     "serve.evict": {"request": "str", "reason": "str", "generated": "int"},
     "serve.restart": {"n": "int", "reason": "str", "requeued": "int"},
@@ -156,9 +160,19 @@ KNOWN_EVENTS = {
     # the whole step runs as ONE fused device program (ISSUE 16) and
     # the speculative draft-window width (1 = speculation off) — a
     # restarted engine's black box records which data plane it was on
+    # `sampling` (ISSUE 19): greedy or the host sampler spec — a
+    # non-greedy engine pins fused off and the spec window to 1 (both
+    # sample greedily/on-device and would fork the journaled stream)
     "serve.decode_path": {"path": "str", "storage": "str",
                           "sharing": "bool", "fused": "bool",
-                          "spec_window": "int"},
+                          "spec_window": "int", "sampling": "str"},
+    # graceful drain / hot handoff / degraded drain (ISSUE 19): one
+    # event per admission-stopping transition — kind=drain (quiesce to
+    # idle, admission closed), kind=handoff (live sessions migrated to
+    # a fresh engine generation via prefill replay, no restart budget
+    # spent), kind=degrade (budget exhausted: queued work failed, the
+    # running batch migrated to one final generation and drained)
+    "serve.drain": {"kind": "str", "inflight": "int", "pending": "int"},
     # shared-prefix index pressure eviction (ISSUE 12): one event per
     # relief pass — `released` index entries freed to satisfy a
     # `need`-block allocation (tpu_mx/serving/kv_cache.py::_alloc)
