@@ -14,6 +14,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import threading
 
 import numpy as np
 import pytest
@@ -322,6 +323,107 @@ def test_journal_recover_resumes_bit_identical(tmp_path, sampling):
     srv3 = Server(tiny(), num_blocks=256, journal=prefix, **kw)
     again = srv3.recover()
     assert all(h.state == "done" for h in again.values()) or not again
+
+
+def test_recover_bypasses_admission_gates(tmp_path):
+    """A server killed at full load journals more unfinished streams
+    than its successor's ``max_pending`` — recovery must bypass the
+    admission gates (``scheduler.restore``) instead of queue_full-
+    rejecting the overflow and aborting the rest: zero lost streams."""
+    prefix = str(tmp_path / "full")
+    prompts = [[1, 2, 3 + i] for i in range(6)]
+    ref = clean_reference(prompts, 8)
+    srv = Server(tiny(), num_blocks=256, journal=prefix)
+    reqs = [srv.submit(p, 8, request_id=f"r{i}")
+            for i, p in enumerate(prompts)]
+    for _ in range(3):
+        srv.step()
+    assert all(r.tokens for r in reqs)
+    # the process "dies"; the successor is provisioned SMALLER than the
+    # journaled load (max_pending=2 < 6 unfinished streams)
+    srv2 = Server(tiny(), num_blocks=256, journal=prefix, max_pending=2)
+    handles = srv2.recover()
+    assert len(handles) == 6   # nothing rejected, nothing lost
+    srv2.run_until_idle()
+    assert [list(handles[f"r{i}"].tokens) for i in range(6)] == ref
+    assert all(h.state == "done" for h in handles.values())
+
+
+def test_sampling_runs_on_driver_thread_only():
+    """Zombie-step discipline for sampler RNG: with the watchdog armed,
+    engine prefill/decode run on abandoned-able daemon threads — the
+    journaled RNG must only ever advance on the driver thread (the
+    engine hands logits back; the server samples after the join)."""
+    kw = dict(sampling="top_k:8", sampling_seed=3)
+    ref = clean_reference(PROMPTS, 8, **kw)
+    srv = Server(tiny(), num_blocks=256, deadline=30.0, **kw)
+    reqs = [srv.submit(p, 8, request_id=f"r{i}")
+            for i, p in enumerate(PROMPTS)]
+    sample_threads = set()
+    for r in reqs:
+        orig = r.sampler.sample
+
+        def spy(logits, _orig=orig):
+            sample_threads.add(threading.current_thread())
+            return _orig(logits)
+
+        r.sampler.sample = spy
+    srv.run_until_idle()
+    assert sample_threads == {threading.main_thread()}
+    assert [list(r.tokens) for r in reqs] == ref
+
+
+def test_rejected_submit_journal_entry_is_retired(tmp_path):
+    """``begin`` lands before the request is schedulable, so a rejected
+    admission must retire its entry — a recovering successor must never
+    resurrect (and generate) a request whose client saw the reject."""
+    prefix = str(tmp_path / "rej")
+    srv = Server(tiny(), num_blocks=256, journal=prefix, max_pending=1)
+    srv.submit([1, 2, 3], 8, request_id="kept")
+    with pytest.raises(AdmissionReject) as e:
+        srv.submit([4, 5, 6], 8, request_id="bounced")
+    assert e.value.reason == "queue_full"
+    entries = journal_mod.load(journal_path(prefix))
+    assert entries["bounced"]["ended"]
+    assert not entries["kept"]["ended"]
+    srv2 = Server(tiny(), num_blocks=256, journal=prefix, max_pending=1)
+    handles = srv2.recover()
+    assert set(handles) == {"kept"}   # the reject stayed rejected
+    srv2.run_until_idle()
+    assert handles["kept"].state == "done"
+
+
+@pytest.mark.parametrize("sampling", ["greedy", "top_k:8"])
+def test_legacy_arm_requeue_keeps_journal_indices_consistent(
+        tmp_path, sampling):
+    """Journal armed on the legacy arm (``replay=False``): a restart
+    discards the ledger and the re-rolled stream journals from i=0
+    again — the requeue must re-begin the entry (last-incarnation-wins)
+    or load()'s index-gap check degrades every stream to prompt replay."""
+    prefix = str(tmp_path / "legacy")
+    kw = dict(sampling=sampling, sampling_seed=9)
+    srv = Server(tiny(), num_blocks=256, journal=prefix, replay=False,
+                 max_restarts=3, backoff=0.0, **kw)
+    reqs = [srv.submit(p, 10, request_id=f"r{i}")
+            for i, p in enumerate(PROMPTS)]
+    for _ in range(4):
+        srv.step()
+    assert all(r.tokens for r in reqs)
+    with chaos.enable(restart_storm=1):
+        srv.step()   # classified restart: ledgers discarded, re-begin
+    for _ in range(3):
+        srv.step()   # the re-rolled streams journal from i=0 again
+    entries = journal_mod.load(journal_path(prefix))
+    for i, r in enumerate(reqs):
+        e = entries[f"r{i}"]
+        # no index-gap degrade, no duplicate-index confusion: the file
+        # reads back as the LAST incarnation's consistent stream
+        assert not e["fallback"]
+        assert e["tokens"] == list(r.tokens)[:len(e["tokens"])]
+    srv.run_until_idle()
+    entries = journal_mod.load(journal_path(prefix))
+    assert all(e["ended"] and not e["fallback"]
+               for e in entries.values())
 
 
 def test_recover_without_journal_is_loud():

@@ -137,8 +137,11 @@ class EngineCore:
     def prefill(self, req):
         """Run ``req``'s prompt — PLUS any committed tokens it already
         delivered (the prefill-replay recovery path, ISSUE 19) — fill
-        its cache blocks, and return ``(next sampled token,
-        cached_tokens)``.
+        its cache blocks, and return ``(next, cached_tokens)``.  For a
+        greedy request ``next`` is the argmax token; for a request
+        carrying a sampler it is the final-position LOGITS vector — the
+        caller samples on the driver thread after the watchdog join, so
+        a zombie deadline thread can never touch the journaled RNG.
 
         A requeued request that kept its tokens is rebuilt in THIS one
         call: K/V at every position is a pure function of the tokens
@@ -199,12 +202,15 @@ class EngineCore:
                       tokens=len(req.prompt), cached=cached,
                       replayed=len(committed), t0=t0,
                       t1=time.perf_counter())
-        # the sample happens AFTER the health gate: a poisoned/faulting
-        # step must not advance a stateful sampler's RNG, or the replay
-        # would re-roll a different stream than the uninterrupted run
-        sampler = getattr(req, "sampler", None)
-        if sampler is not None:
-            return sampler.sample(logits), cached
+        # non-greedy requests get the LOGITS back, not a token: the
+        # caller samples on its own (driver) thread once the watchdog
+        # join returns, so an abandoned deadline thread parked in here
+        # can never advance a journaled RNG — the zombie-step discipline
+        # covers sampler state, not just the discarded engine's cache.
+        # The health gate above still guards the RNG: a poisoned or
+        # faulting step raises before any logits are handed back.
+        if getattr(req, "sampler", None) is not None:
+            return np.asarray(logits).reshape(-1), cached
         return int(np.argmax(logits)), cached
 
     # -- decode --------------------------------------------------------------
@@ -215,9 +221,12 @@ class EngineCore:
         Returns ``(results, preempted)``: ``results`` maps request id →
         the LIST of tokens this step produced, in stream order, for
         every sequence that decoded (always at least one; up to
-        ``spec_window`` when speculation accepts drafted tokens);
-        ``preempted`` lists the requests evicted to make room — the
-        scheduler requeues them (re-run), the rest of the batch
+        ``spec_window`` when speculation accepts drafted tokens) — for
+        a request carrying a sampler the value is instead its
+        final-position LOGITS vector (ndarray): the caller samples the
+        one token on the driver thread, never this (possibly watchdog)
+        thread.  ``preempted`` lists the requests evicted to make room
+        — the scheduler requeues them (re-run), the rest of the batch
         proceeds.  Raises :class:`NumericDivergence` on non-finite
         logits (real or chaos-poisoned).
 
@@ -304,14 +313,6 @@ class EngineCore:
             raise NumericDivergence(
                 f"serving: non-finite logits in decode batch of "
                 f"{len(live)} (health={health}) — restarting the engine")
-        if want_logits:
-            # non-greedy rows sample HERE, after the health gate (a
-            # faulting step must not advance the journaled RNG — see
-            # prefill) — a non-greedy engine pins k == 1, so the row's
-            # one token is simply replaced
-            for bi, s in enumerate(samplers):
-                if s is not None:
-                    out[bi, 0] = s.sample(logits1[bi])
         results = {}
         emitted_total = 0
         accepted_total = 0
@@ -323,7 +324,15 @@ class EngineCore:
                 # pool slots either way)
                 self.cache.truncate(req.id,
                                     int(lengths_now[bi]) - (k - 1 - a))
-            results[req.id] = [int(t) for t in out[bi, :a + 1]]
+            if samplers[bi] is not None and logits1 is not None:
+                # non-greedy row (k pinned to 1): hand the last-position
+                # logits back — the CALLER samples on the driver thread
+                # after the watchdog join, so an abandoned zombie step
+                # can never advance the journaled RNG (the health gate
+                # above already ran; see prefill)
+                results[req.id] = np.asarray(logits1[bi]).reshape(-1)
+            else:
+                results[req.id] = [int(t) for t in out[bi, :a + 1]]
             accepted_total += a
             emitted_total += a + 1
         if k > 1:

@@ -106,8 +106,13 @@ class TokenJournal:
         self._f.flush()
         os.fsync(self._f.fileno())
 
-    def begin(self, req):
-        """Journal an admission — durable before the handle returns."""
+    def begin(self, req, sync=True):
+        """Journal an admission — durable before the handle returns.
+        ``sync=False`` (the legacy-arm re-begin: a ``replay=False``
+        requeue re-rolls the stream, so its token indices restart at 0
+        and the entry must restart with them — last incarnation wins)
+        buffers the record for the next step-boundary flush instead:
+        every requeue path flushes before the stream can advance."""
         sampler = getattr(req, "sampler", None)
         with self._lock:
             self._append({"op": "begin", "request": req.id,
@@ -116,8 +121,12 @@ class TokenJournal:
                           "max_new": req.max_new_tokens,
                           "sampler": (sampler.state_dict()
                                       if sampler is not None else None)})
-            self._fsync()
-        _telemetry.counter("serve.journal_requests").inc()
+            if sync:
+                self._fsync()
+        if sync:
+            # re-begins are incarnations of an already-counted stream —
+            # journal_requests stays "streams journaled at admission"
+            _telemetry.counter("serve.journal_requests").inc()
 
     def commit_token(self, req, token):
         """Buffer one committed token (``req.tokens`` already holds it —

@@ -359,6 +359,27 @@ class ContinuousBatchingScheduler:
                       tenant=req.tenant)
         return req
 
+    def restore(self, req):
+        """Re-admit a RECOVERED request (``server.recover`` — ISSUE 19)
+        with every admission gate bypassed: the dead process already
+        admitted it, and its journaled ``begin`` IS the admission
+        receipt.  A server killed at full load journals up to
+        ``max_pending + max_batch`` unfinished streams (pending plus
+        the running batch), so routing recovery through :meth:`submit`
+        would ``queue_full``-reject the overflow and break the
+        zero-lost-streams guarantee — this is the same deliberate cap
+        bypass :meth:`requeue`/:meth:`defer` use for in-flight work.
+        Appended (not fronted) so journal order is preserved."""
+        with self._lock:
+            self._pending.append(req)
+        _telemetry.counter("serve.requests", state="admitted").inc()
+        _telemetry.gauge("serve.queue_depth").set(self.queue_depth())
+        _tracing.emit("serve.admit", request=req.id,
+                      prompt_tokens=len(req.prompt),
+                      max_new_tokens=req.max_new_tokens,
+                      tenant=req.tenant, recovered=True)
+        return req
+
     def reject(self, req, reason, detail=""):
         """Refuse ``req`` with full bookkeeping — fail the handle, count
         it, put it on the timeline — then raise :class:`AdmissionReject`.

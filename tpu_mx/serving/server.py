@@ -26,8 +26,11 @@ rebuilt from scratch, every in-flight request is requeued, a black box
 is dumped (``blackbox=`` prefix, same flight-recorder format the
 training supervisor writes), and a bounded restart budget degrades
 gracefully.  Abandoned watchdog threads only ever touch the DISCARDED
-engine's private cache (the zombie-step discipline: scheduler and
-request handles are mutated exclusively by the caller's step thread).
+engine's private cache (the zombie-step discipline: scheduler, request
+handles, and sampler RNG state are mutated exclusively by the caller's
+step thread — non-greedy engine steps hand LOGITS back and the sample
+runs here, after the watchdog join, so a zombie step can never advance
+a journaled RNG and fork a requeued stream).
 
 **Zero-regeneration recovery** (ISSUE 19, docs/robustness.md "Serving
 recovery ladder"): a requeued request keeps its committed tokens — the
@@ -231,12 +234,26 @@ class Server:
                 req, "request_too_large",
                 f"prompt+max_new needs {need} cache blocks > pool of "
                 f"{self._num_blocks}")
-        handle = self.scheduler.submit(req)
         if self.journal is not None:
-            # fsync'd at admission: a crash between here and the first
-            # token still recovers the stream (prompt-only replay)
+            # fsync'd BEFORE the request becomes schedulable: the
+            # any-thread-submit model lets a concurrently-stepping
+            # driver prefill and buffer token records the moment
+            # scheduler.submit returns, and load() treats a token
+            # without its begin as a lost stream — so the begin must
+            # already be on disk.  A crash between here and the first
+            # token still recovers the stream (prompt-only replay).
             self.journal.begin(req)
-        return handle
+        try:
+            return self.scheduler.submit(req)
+        except BaseException:
+            if self.journal is not None:
+                # the entry was journaled but admission refused it:
+                # retire it durably so a recovering successor never
+                # resurrects (and generates) a request whose client was
+                # told it was rejected
+                self.journal.end(req, "rejected")
+                self.journal.flush()
+            raise
 
     # -- the engine loop (one driver thread) ---------------------------------
     def step(self):
@@ -310,11 +327,18 @@ class Server:
                 self.scheduler.defer(admits[i + 1:])
                 self.scheduler.requeue(req, front=True,
                                        replay=self.replay)
+                self._journal_requeue([req])
                 raise
             finally:
                 _tracing.set_context(request=None)
             req.timeline.mark_prefill_end(cached_tokens=cached)
             self.scheduler.mark_running(req)
+            if req.sampler is not None:
+                # the engine hands LOGITS back for sampled requests:
+                # the sample runs HERE, on the driver thread, after the
+                # watchdog join — an abandoned zombie prefill can never
+                # advance the journaled RNG
+                first = req.sampler.sample(first)
             self._commit_token(req, first)
             worked = True
         # --- decode (one step across the running batch: one token per
@@ -334,6 +358,12 @@ class Server:
                 tokens = results.get(req.id)
                 if tokens is None or req.done:
                     continue   # preempted, or a static-padding slot
+                if isinstance(tokens, np.ndarray):
+                    # a sampled row came back as LOGITS: the sample runs
+                    # here on the driver thread, after the watchdog
+                    # join, so a zombie decode step can never advance
+                    # the journaled RNG (zombie-step discipline)
+                    tokens = [req.sampler.sample(tokens)]
                 # a step yields a LIST (one token, or an accepted
                 # speculative window); commit in stream order and stop
                 # at the first finisher — tokens past an EOS or the
@@ -361,6 +391,7 @@ class Server:
                 else:
                     self.scheduler.requeue(req, front=True,
                                            replay=self.replay)
+                    self._journal_requeue([req])
             _telemetry.counter("serve.decode_steps").inc()
             _tracing.emit("serve.decode", batch=len(items), tokens=fresh,
                           t0=t0, t1=time.perf_counter())
@@ -373,6 +404,22 @@ class Server:
             self.journal.flush()
         self._update_gauges()
         return worked
+
+    def _journal_requeue(self, reqs):
+        """Legacy-arm journal consistency: a ``replay=False`` requeue
+        discards the ledger (``reset_generation``), so the re-rolled
+        stream journals token records from ``i=0`` again while the file
+        already holds higher indices for the request — which load()'s
+        index-gap check would misread as corruption and degrade to
+        prompt replay.  Re-begin each entry (last-incarnation-wins),
+        capturing the sampler's post-reset capsule — exactly the state
+        the re-roll consumes.  Buffered, not fsync'd: every requeue
+        path flushes before the stream can advance.  No-op on the
+        replay arm, where the ledger (and its indices) survive."""
+        if self.journal is None or self.replay:
+            return
+        for req in reqs:
+            self.journal.begin(req, sync=False)
 
     def _commit_token(self, req, token):
         """Record one generated token and finish/evict when done."""
@@ -527,6 +574,7 @@ class Server:
             self._degrade(err)
             return
         requeued = self.scheduler.requeue_all_running(replay=self.replay)
+        self._journal_requeue(requeued)
         if self.journal is not None:
             # tokens the faulted step committed before the fault are
             # real (record_token ran; stream() may yield them) — make
@@ -570,6 +618,7 @@ class Server:
             if self.journal is not None:
                 self.journal.end(req, "degraded")
         requeued = self.scheduler.requeue_all_running(replay=self.replay)
+        self._journal_requeue(requeued)
         _tracing.emit("serve.drain", kind="degrade",
                       inflight=len(requeued), pending=len(failed))
         if self.journal is not None:
@@ -642,6 +691,7 @@ class Server:
         re-yielded — greedy/journaled streams continue bit-identically.
         Returns the number of migrated sessions."""
         requeued = self.scheduler.requeue_all_running(replay=self.replay)
+        self._journal_requeue(requeued)
         if self.journal is not None:
             self.journal.flush()
         _tracing.emit("serve.drain", kind="handoff",
@@ -700,11 +750,19 @@ class Server:
                 req.finish("length")
                 out[rid] = req
                 continue
-            # direct scheduler admission: server.submit would journal a
-            # fresh begin and rebuild a fresh sampler — this request
-            # CONTINUES its existing journal entry (token indices stay
-            # contiguous with what is already on disk)
-            self.scheduler.submit(req)
+            # gate-bypassing re-admission (scheduler.restore, the same
+            # cap bypass requeue/defer use): the dead process already
+            # admitted this request — its journaled begin is the
+            # admission receipt — and a server killed at full load
+            # journals up to max_pending + max_batch unfinished
+            # streams, so routing recovery back through submit() would
+            # queue_full-reject the overflow, abort the remaining
+            # streams, and break the zero-lost-streams guarantee.
+            # server.submit would also journal a fresh begin and
+            # rebuild a fresh sampler — this request CONTINUES its
+            # existing journal entry (token indices stay contiguous
+            # with what is already on disk).
+            self.scheduler.restore(req)
             out[rid] = req
         if self.journal is not None:
             self.journal.flush()

@@ -139,8 +139,11 @@ KNOWN_EVENTS = {
     # training loop's step context), so a slow request's black box is
     # reconstructible; decode is batch-scoped and rides the engine-step
     # `step`/`generation` context like a train step.
+    # `recovered` (ISSUE 19): True when the admission is a journal
+    # recovery (scheduler.restore — gates bypassed), absent otherwise
     "serve.admit": {"request": "str", "prompt_tokens": "int",
-                    "max_new_tokens": "int", "tenant": "str"},
+                    "max_new_tokens": "int", "tenant": "str",
+                    "recovered": "bool"},
     "serve.reject": {"request": "str", "reason": "str"},
     # `cached` (ISSUE 12): how many leading prompt tokens were served
     # from the shared-prefix index instead of computed — a prefill that
