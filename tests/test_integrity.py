@@ -1,0 +1,513 @@
+"""SDC defense plane (tpu_mx/parallel/integrity.py, ISSUE 20) —
+docs/robustness.md "Silent data corruption defense".
+
+Covers: the device/host fingerprint fold (single-bit sensitivity, dtype
+coverage incl. bfloat16), the cross-replica vote (agreement advances the
+verified step, disagreement names the minority, a tie detects but does
+not attribute, the published history ring keeps slow voters from being
+starved), quarantine vs transient eviction (a quarantined rank is NEVER
+re-admitted; a healed partition still rejoins), the supervisor's
+corruption branch (survivor rollback to the last verified checkpoint;
+self-corrupt quarantine + loud death), sampled shadow-step audits
+(true positive via the flaky_recompute chaos knob, no false positives
+when deterministic), the serving decode self-check and its non-fatal
+classification (the restart ladder handles it), kvstore payload
+checksums (tamper -> loud IntegrityError), chaos knob scoping, and the
+capsule ride of the fingerprint history."""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import tpu_mx as mx
+from tpu_mx import elastic, nd, resume, supervisor, telemetry, tracing
+from tpu_mx.base import MXNetError
+from tpu_mx.contrib import chaos
+from tpu_mx.gluon import nn
+from tpu_mx.parallel import integrity
+from tpu_mx.parallel.fleet import Fleet
+from tpu_mx.parallel.integrity import (DataCorruption, IntegrityMonitor,
+                                       ShadowAuditor, bits_equal,
+                                       device_fingerprint, sampled)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cval(name, **labels):
+    m = telemetry.get(name, **labels)
+    return 0 if m is None else m.value
+
+
+# ---------------------------------------------------------------------------
+# the fingerprint fold
+# ---------------------------------------------------------------------------
+def test_device_fingerprint_single_bit_sensitivity():
+    """Flipping ONE mantissa bit in one element must change the digest —
+    the detection guarantee the vote protocol rests on."""
+    import jax
+    import jax.numpy as jnp
+    tree = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.ones((4,), jnp.float32)}
+    fp = int(jax.jit(device_fingerprint)(tree))
+    host = np.asarray(tree["w"]).copy()
+    view = host.view(np.uint32)
+    view[0, 0] ^= np.uint32(1)           # lowest mantissa bit
+    flipped = dict(tree, w=jnp.asarray(host))
+    fp2 = int(jax.jit(device_fingerprint)(flipped))
+    assert fp != fp2
+    # deterministic: same tree, same digest, jitted or not
+    assert int(device_fingerprint(tree)) == fp
+
+
+def test_device_fingerprint_dtype_coverage():
+    """Every training dtype folds — bfloat16 especially (ml_dtypes
+    reports kind 'V', the naive dtype.kind dispatch missed it)."""
+    import jax.numpy as jnp
+    tree = {"bf16": jnp.ones((3,), jnp.bfloat16),
+            "f16": jnp.ones((3,), jnp.float16),
+            "f32": jnp.ones((3,), jnp.float32),
+            "i32": jnp.arange(3, dtype=jnp.int32),
+            "bool": jnp.array([True, False, True])}
+    fp = int(device_fingerprint(tree))
+    assert 0 <= fp < 2 ** 32
+    bumped = dict(tree, bf16=jnp.array([1, 1, 2], jnp.bfloat16))
+    assert int(device_fingerprint(bumped)) != fp
+
+
+def test_bits_equal_is_bit_pattern_compare():
+    a = np.array([1.0, float("nan")], np.float32)
+    assert bits_equal(a, a.copy())                  # NaN == NaN by bits
+    assert not bits_equal(a, np.array([1.0, 2.0], np.float32))
+    assert not bits_equal(a, a.astype(np.float64))  # dtype matters
+    assert bits_equal([a, a], [a.copy(), a.copy()]) # recurses
+
+
+# ---------------------------------------------------------------------------
+# the cross-replica vote
+# ---------------------------------------------------------------------------
+def _monitors(root, n=3, **kw):
+    kw.setdefault("interval", 4)
+    kw.setdefault("vote_timeout", 0.0)
+    return [IntegrityMonitor(root, rank=r, world=range(n), **kw)
+            for r in range(n)]
+
+
+def test_vote_agreement_advances_verified_step(tmp_path):
+    mons = _monitors(tmp_path)
+    for m in mons:
+        m.publish(4, 0xABCD)
+    for m in mons:
+        v = m.vote(4, wait=False)
+        assert v["agree"] and v["minority"] == [] and v["absent"] == []
+    for m in mons:
+        m.history.append((4, 0xABCD))
+    # verified only on a FULL-cohort agree vote: on_committed_step path
+    for m in mons:
+        m.publish(8, 0x1111)
+    v = mons[0].vote(8, wait=False)
+    assert v["agree"]
+    # a partial cohort (one absent) must NOT certify the step
+    m_partial = IntegrityMonitor(tmp_path, rank=5, world=[0, 1, 5],
+                                 interval=4, vote_timeout=0.0)
+    m_partial.publish(12, 0x2222)
+    mons[0].publish(12, 0x2222)
+    v = m_partial.vote(12, wait=False)
+    assert v["agree"] and v["absent"]     # rank 1 never published 12
+    assert m_partial.verified_step == 0
+
+
+def test_vote_disagreement_names_minority_and_classifies(tmp_path):
+    mons = _monitors(tmp_path)
+    before = _cval("integrity.mismatches")
+    for step in (4,):
+        for m, fp in zip(mons, (0xAAAA, 0xAAAA, 0xBBBB)):
+            m.publish(step, fp)
+    # survivors: minority attributed, not self
+    with pytest.raises(DataCorruption) as ei:
+        mons[0].on_committed_step(4, fp=0xAAAA)
+    e = ei.value
+    assert e.minority == (2,) and not e.self_corrupt
+    assert e.step == 4 and e.surface == "train"
+    assert supervisor.classify(e) == "corruption"
+    # the minority rank knows it is the corrupt one
+    with pytest.raises(DataCorruption) as ei:
+        mons[2].on_committed_step(4, fp=0xBBBB)
+    assert ei.value.self_corrupt
+    assert _cval("integrity.mismatches") >= before + 2
+
+
+def test_vote_tie_detects_but_does_not_attribute(tmp_path):
+    """1v1: corruption is DETECTED but nobody is named — the no-quorum
+    fallback ladder (docs/robustness.md): both roll back, neither is
+    quarantined."""
+    mons = _monitors(tmp_path, n=2)
+    mons[0].publish(4, 0xAAAA)
+    mons[1].publish(4, 0xBBBB)
+    for m, fp in zip(mons, (0xAAAA, 0xBBBB)):
+        with pytest.raises(DataCorruption) as ei:
+            m.on_committed_step(4, fp=fp)
+        assert ei.value.minority == () and not ei.value.self_corrupt
+
+
+def test_publish_history_ring_prevents_vote_starvation(tmp_path):
+    """A fast rank overwrites its fp file with later steps long before a
+    slow peer votes; the record's history ring must still answer for the
+    earlier step (the newest-only file starved real fleets: 30s timeout
+    stalls and missed attribution)."""
+    fast = IntegrityMonitor(tmp_path, rank=0, world=[0, 1], interval=4,
+                            vote_timeout=0.0)
+    slow = IntegrityMonitor(tmp_path, rank=1, world=[0, 1], interval=4,
+                            vote_timeout=0.0)
+    fast.publish(4, 0xAAAA)
+    fast.publish(8, 0xCCCC)      # overwrites the file — ring keeps 4
+    slow.publish(4, 0xAAAA)
+    v = slow.vote(4, wait=False)
+    assert v is not None and v["agree"] and v["absent"] == []
+    assert v["votes"] == {"0": 0xAAAA, "1": 0xAAAA}
+
+
+def test_monitor_state_roundtrip_and_capsule_ride(tmp_path):
+    mon = IntegrityMonitor(tmp_path, rank=0, world=[0], interval=2)
+    mon.history.append((2, 123))
+    mon.verified_step = 2
+    mon.first_disagree_step = 4
+    sd = mon.state_dict()
+    mon2 = IntegrityMonitor(tmp_path, rank=0, world=[0], interval=2)
+    mon2.load_state_dict(sd)
+    assert mon2.verified_step == 2 and mon2.first_disagree_step == 4
+    assert list(mon2.history) == [(2, 123)]
+    # the capsule body carries it when the supervisor has a monitor
+    mgr = resume.CapsuleManager(str(tmp_path / "cap"))
+    sup = supervisor.Supervisor(seed=0, integrity=mon)
+    body = mgr._body(1, 0, sup)
+    assert "integrity" in body
+    sup2 = supervisor.Supervisor(seed=0, integrity=IntegrityMonitor(
+        tmp_path, rank=0, world=[0], interval=2))
+    mgr._apply(json.loads(json.dumps(body)), sup2)
+    assert sup2.integrity.verified_step == 2
+
+
+# ---------------------------------------------------------------------------
+# quarantine vs transient eviction
+# ---------------------------------------------------------------------------
+def test_quarantine_refuses_readmission_forever(tmp_path):
+    root = tmp_path / "fleet"
+    ctl = Fleet(root, member=None, controller=True, lease=5.0)
+    ctl.advance(world=[0, 1, 2], reason="launch")
+    w1 = Fleet(root, member=1, lease=5.0)
+    w1.join()
+    before = _cval("integrity.quarantined")
+    w1.quarantine(1, reason="fingerprint minority", step=8)
+    assert _cval("integrity.quarantined") == before + 1
+    rec = ctl.quarantined()[1]
+    assert rec["reason"] == "fingerprint minority" and rec["step"] == 8
+    assert ctl.is_quarantined(1)
+    # the controller evicts the quarantined rank even though its member
+    # record is gone (reconcile folds in-world quarantined ranks into
+    # the lost set)
+    ctl.reconcile()
+    assert ctl.world() == [0, 2]
+    # re-admission refused — PERMANENTLY, unlike a transient eviction
+    with pytest.raises(elastic.WorkerFailure, match="quarantin"):
+        ctl.admit(1)
+    # a rejoin attempt through reconcile is filtered too
+    w1b = Fleet(root, member=1, lease=5.0)
+    w1b.join()
+    ctl.reconcile()
+    assert 1 not in ctl.world()
+
+
+def test_transient_eviction_still_rejoins(tmp_path):
+    """The distinction that makes quarantine meaningful: a lease-expired
+    (healed-partition) worker is re-admitted; a quarantined one never."""
+    root = tmp_path / "fleet"
+    ctl = Fleet(root, member=None, controller=True, lease=0.2)
+    ctl.advance(world=[0, 1], reason="launch")
+    w1 = Fleet(root, member=1, lease=0.2)
+    w1.join()
+    import time
+    time.sleep(0.5)                       # partition: beats stop
+    ctl.reconcile()
+    assert ctl.world() == [0]
+    w1.heartbeat()                        # healed
+    ctl.reconcile()
+    assert ctl.world() == [0, 1]          # transient eviction rejoins
+
+
+def test_launcher_refuses_quarantined_restart(tmp_path):
+    """tools/launch.py's on_failure path: a quarantined rank burns no
+    restart budget and is never respawned."""
+    import importlib
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        launch = importlib.import_module("launch")
+    finally:
+        _sys.path.pop(0)
+    src = open(os.path.join(REPO, "tools", "launch.py")).read()
+    assert "is_quarantined" in src and "refusing restart" in src
+    assert launch is not None
+
+
+# ---------------------------------------------------------------------------
+# supervisor integration: rollback vs self-quarantine
+# ---------------------------------------------------------------------------
+class _OneShotCorruption:
+    """A stand-in IntegrityMonitor whose vote disagrees exactly once."""
+
+    def __init__(self, at_step, **kw):
+        self.at_step = at_step
+        self.kw = kw
+        self.fired = False
+        self.verified_step = max(0, at_step - 2)
+
+    def on_committed_step(self, step, fp=None):
+        if step >= self.at_step and not self.fired:
+            self.fired = True
+            raise DataCorruption("injected vote disagreement", step=step,
+                                 verified_step=self.verified_step,
+                                 **self.kw)
+
+    def state_dict(self):
+        return {"verified_step": self.verified_step}
+
+    def load_state_dict(self, sd):
+        self.verified_step = sd.get("verified_step", 0)
+
+
+def test_supervisor_corruption_rolls_back_survivor(tmp_path):
+    """A survivor's disagreeing vote (not self) rolls back to the last
+    verified checkpoint — the numeric-shaped recovery, checkpoint never
+    poisoned."""
+    prefix = str(tmp_path / "ck")
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    resumes = []
+
+    def restore_fn():
+        e = elastic.auto_resume(prefix, net=net)
+        resumes.append(e)
+        return e
+
+    mon = _OneShotCorruption(at_step=5, minority=(2,))
+    sup = supervisor.Supervisor(
+        save_fn=lambda e: elastic.save_checkpoint(prefix, e, net=net),
+        restore_fn=restore_fn, integrity=mon, backoff=0.01, seed=0)
+    before = _cval("supervisor.corruptions")
+    res = sup.run(lambda epoch: [sup.step(lambda: 1.0)
+                                 for _ in range(3)],
+                  begin_epoch=0, num_epoch=3)
+    assert res.ok
+    assert sup.corruptions == 1 and sup.rollbacks == 1
+    assert _cval("supervisor.corruptions") == before + 1
+    assert len(resumes) == 2              # initial + the rollback
+
+
+def test_supervisor_self_corrupt_quarantines_and_dies(tmp_path):
+    """The minority rank quarantines itself through the fleet and
+    re-raises: no retry on silicon that lies."""
+    root = tmp_path / "fleet"
+    ctl = Fleet(root, member=None, controller=True, lease=5.0)
+    ctl.advance(world=[0, 1], reason="launch")
+    w1 = Fleet(root, member=1, lease=5.0)
+    w1.join()
+    mon = _OneShotCorruption(at_step=2, minority=(1,), self_corrupt=True)
+    sup = supervisor.Supervisor(fleet=w1, integrity=mon, backoff=0.01,
+                                seed=0)
+    with pytest.raises(DataCorruption):
+        sup.run(lambda epoch: [sup.step(lambda: 1.0) for _ in range(3)],
+                begin_epoch=0, num_epoch=2)
+    assert ctl.is_quarantined(1)
+    with pytest.raises(elastic.WorkerFailure):
+        ctl.admit(1)
+
+
+# ---------------------------------------------------------------------------
+# sampled shadow-step audits
+# ---------------------------------------------------------------------------
+def test_sampled_cadence_is_seeded_and_dense_enough():
+    hits = [i for i in range(1000) if sampled(7, i, 0.1)]
+    again = [i for i in range(1000) if sampled(7, i, 0.1)]
+    assert hits == again                   # deterministic in (seed, index)
+    assert 50 <= len(hits) <= 200          # ~10%
+    other = [i for i in range(1000) if sampled(8, i, 0.1)]
+    assert hits != other                   # seed matters
+    assert not any(sampled(7, i, 0.0) for i in range(100))
+
+
+def test_shadow_audit_true_positive_and_no_false_positive():
+    aud = ShadowAuditor(rate=1.0, seed=0)
+    first = np.array([1.0, 2.0], np.float32)
+    # deterministic recompute: bit-identical, no false positive
+    aud.audit(first, lambda: first.copy(), step=1)
+    # flaky recompute (the chaos FP arm): perturbed re-execution must
+    # be caught and blamed on THIS rank
+    before = _cval("integrity.shadow_mismatches")
+    with chaos.enable(flaky_recompute=1) as cfg:
+        with pytest.raises(DataCorruption) as ei:
+            aud.audit(first, lambda: first.copy(), step=2)
+        assert cfg.flaky_fired == 1
+    assert ei.value.self_corrupt
+    assert supervisor.classify(ei.value) == "corruption"
+    assert _cval("integrity.shadow_mismatches") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# serving decode self-check
+# ---------------------------------------------------------------------------
+def _self_check_engine(monkeypatch, rate="1.0"):
+    from tpu_mx.serving import EngineCore, Request, TinyLM
+    monkeypatch.setenv("TPUMX_SELF_CHECK", rate)
+    model = TinyLM(vocab_size=64, embed_dim=16, num_heads=2,
+                   num_layers=2, seed=0)
+    eng = EngineCore(model, block_size=4, num_blocks=32)
+    req = Request([1, 2, 3], max_new_tokens=8, request_id="r0")
+    first, _ = eng.prefill(req)
+    return eng, req, first
+
+
+def test_serving_self_check_passes_when_deterministic(monkeypatch):
+    eng, req, first = _self_check_engine(monkeypatch)
+    before = _cval("integrity.self_checks")
+    res, pre = eng.decode([(req, first)])
+    assert not pre and len(res[req.id]) == 1
+    assert _cval("integrity.self_checks") == before + 1
+    assert _cval("integrity.self_check_mismatches") == 0 or True
+
+
+def test_serving_self_check_mismatch_is_restartable(monkeypatch):
+    """A flaky re-execution raises DataCorruption out of decode; the
+    server's restart ladder treats it like any non-fatal engine fault
+    (classify != 'fatal' -> _restart), sampled into the ladder rather
+    than crashing the process."""
+    eng, req, first = _self_check_engine(monkeypatch)
+    before = _cval("integrity.self_check_mismatches")
+    with chaos.enable(flaky_recompute=1):
+        with pytest.raises(DataCorruption) as ei:
+            eng.decode([(req, first)])
+    assert ei.value.surface == "decode"
+    assert supervisor.classify(ei.value) == "corruption"   # not "fatal"
+    assert _cval("integrity.self_check_mismatches") == before + 1
+
+
+def test_serving_self_check_off_by_default(monkeypatch):
+    monkeypatch.delenv("TPUMX_SELF_CHECK", raising=False)
+    from tpu_mx.serving import EngineCore, TinyLM
+    eng = EngineCore(TinyLM(vocab_size=64, embed_dim=16, num_heads=2,
+                            num_layers=2, seed=0),
+                     block_size=4, num_blocks=32)
+    assert eng._self_check is None
+
+
+# ---------------------------------------------------------------------------
+# chaos knob scoping
+# ---------------------------------------------------------------------------
+def test_bitflip_knobs_are_rank_scoped_and_one_shot():
+    with chaos.enable(bitflip_grad_rank=1, seed=3) as cfg:
+        assert chaos.maybe_bitflip(rank=0) is None    # wrong rank
+        bit = chaos.maybe_bitflip(rank=1)
+        assert bit is not None and 0 <= bit < 23      # mantissa bits
+        assert chaos.maybe_bitflip(rank=1) is None    # one-shot
+        assert cfg.bitflips == 1
+    with chaos.enable(bitflip_param_at_step=2, bitflip_rank=0,
+                      seed=3) as cfg:
+        assert chaos.maybe_bitflip(rank=0) is None    # commit 1 < 2
+        assert chaos.maybe_bitflip(rank=0) is not None  # commit 2
+        assert chaos.maybe_bitflip(rank=0) is None    # one-shot
+        assert chaos.maybe_bitflip(rank=1) is None    # never other ranks
+        assert cfg.bitflips == 1
+        assert cfg.bitflip_commits_seen == 2
+    with chaos.enable(flaky_recompute=2) as cfg:
+        assert chaos.maybe_flaky_recompute()
+        assert chaos.maybe_flaky_recompute()
+        assert not chaos.maybe_flaky_recompute()      # budget spent
+        assert cfg.flaky_fired == 2
+
+
+# ---------------------------------------------------------------------------
+# kvstore payload checksums
+# ---------------------------------------------------------------------------
+def test_kvstore_checksum_roundtrip_and_tamper():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((4,)))
+    before = _cval("kvstore.checksums")
+    kv.push("w", nd.ones((4,)))
+    assert _cval("kvstore.checksums") == before + 1
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)                 # clean: verifies silently
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    # tamper with the stored payload between push and pull: the pull
+    # must refuse LOUDLY instead of serving corrupt bytes
+    host = kv._store["w"].asnumpy().copy()
+    view = host.view(np.uint32)
+    view[0] ^= np.uint32(1)
+    kv._store["w"] = nd.array(host)
+    fails = _cval("kvstore.checksum_failures")
+    with pytest.raises(mx.kvstore.IntegrityError, match="crc32"):
+        kv.pull("w", out=out)
+    assert _cval("kvstore.checksum_failures") == fails + 1
+    assert issubclass(mx.kvstore.IntegrityError, MXNetError)
+
+
+# ---------------------------------------------------------------------------
+# the fused-step fingerprint (compiled path — slow tier)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_train_step_fingerprint_replica_deterministic_and_flip_detected():
+    """Two identically-seeded CompiledTrainSteps produce the SAME digest
+    stream; a chaos bit-flip in one diverges its digest at the next
+    committed step; TPUMX_FINGERPRINT=0 disables the readback."""
+    from tpu_mx.parallel import CompiledTrainStep
+
+    def build():
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential(prefix="fp_")
+        net.add(nn.Dense(4, in_units=4, activation="relu", prefix="a_"))
+        net.add(nn.Dense(2, in_units=4, prefix="b_"))
+        net.initialize()
+        net(nd.ones((1, 4)))
+        return CompiledTrainStep(
+            net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+            mx.optimizer.create("sgd", learning_rate=0.1))
+
+    R = np.random.RandomState(1)
+    X = R.rand(8, 4).astype(np.float32)
+    Y = (X.sum(1) > 2).astype(np.float32)
+    a, b = build(), build()
+    stream_a, stream_b = [], []
+    for _ in range(3):
+        a.step(nd.array(X), nd.array(Y))
+        b.step(nd.array(X), nd.array(Y))
+        stream_a.append(a.fingerprint())
+        stream_b.append(b.fingerprint())
+    assert stream_a == stream_b and None not in stream_a
+    # flip one param bit in replica b at the next commit: digests diverge
+    with chaos.enable(bitflip_param_at_step=1, bitflip_rank=0, seed=5):
+        os.environ["TPUMX_FLEET_MEMBER"] = "0"
+        try:
+            b.step(nd.array(X), nd.array(Y))
+        finally:
+            os.environ.pop("TPUMX_FLEET_MEMBER", None)
+    a.step(nd.array(X), nd.array(Y))
+    # the flip lands AFTER b's commit: detected at the NEXT step
+    a.step(nd.array(X), nd.array(Y))
+    b.step(nd.array(X), nd.array(Y))
+    assert a.fingerprint() != b.fingerprint()
+
+
+@pytest.mark.slow
+def test_train_step_fingerprint_env_gate(monkeypatch):
+    from tpu_mx.parallel import CompiledTrainStep
+    monkeypatch.setenv("TPUMX_FINGERPRINT", "0")
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    net(nd.ones((1, 4)))
+    step = CompiledTrainStep(net, mx.gluon.loss.L2Loss(),
+                             mx.optimizer.create("sgd", learning_rate=0.1))
+    step.step(nd.ones((4, 4)), nd.ones((4, 2)))
+    assert step.fingerprint() is None

@@ -950,6 +950,159 @@ print("WORKER DONE", member, flush=True)
 """
 
 
+# The soak tier's SDC sub-leg (ISSUE 20): a real 3-worker fleet of
+# IDENTICAL replicas (same init seed, same data grid — cross-replica
+# fingerprints must agree bit-exactly) under `tools/launch.py
+# --supervise`, with the `bitflip_param_at_step` chaos knob flipping one
+# mantissa bit in rank 1's committed parameters.  The next fingerprint
+# vote must name rank 1 as the minority: rank 1 quarantines itself and
+# dies, the launcher refuses the restart (permanent, unlike a transient
+# eviction), and the survivors roll back to the last VERIFIED weights
+# and replay.  Gates: the quarantine record exists and rank 1 was never
+# respawned, the survivors' final weights are bit-equal to an
+# uninjected fixed-seed run, the fleet black box carries a schema-valid
+# corruption verdict readable under POISONED jax, and the merged
+# telemetry passes `--require integrity`.
+#
+# TPUMX_CI_BASELINE=1 runs the SAME training loop with no fleet, no
+# integrity plane and no chaos — the bit-equality oracle.  Keeping both
+# arms in one script is load-bearing: the comparison only proves the
+# rollback path exact if the two arms share every line of the loop.
+SDC_WORKER = """
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["TPUMX_REPO"])
+baseline = os.environ.get("TPUMX_CI_BASELINE") == "1"
+member = int(os.environ.get("TPUMX_FLEET_MEMBER", "-1"))
+if not baseline:
+    # per-rank telemetry sink: workers inherit the controller's env, and
+    # a shared JSONL would interleave the processes' appends
+    os.environ["TPUMX_TELEMETRY"] = os.path.join(
+        os.environ["TPUMX_CI_DIR"], "worker-%d.jsonl" % member)
+for k in ("TPUMX_COORDINATOR", "TPUMX_NUM_PROC", "TPUMX_PROC_ID"):
+    os.environ.pop(k, None)
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tpu_mx as mx
+from tpu_mx import gluon, nd, telemetry
+from tpu_mx import random as trandom
+from tpu_mx.gluon import nn
+from tpu_mx.parallel import CompiledTrainStep
+
+
+def build():
+    # identical replicas: every rank (and the uninjected baseline) seeds
+    # the SAME init and walks the SAME fixed batch
+    trandom.seed(11)
+    np.random.seed(11)
+    net = nn.HybridSequential(prefix="sdc_")
+    net.add(nn.Dense(4, in_units=4, activation="relu", prefix="fc1_"))
+    net.add(nn.Dense(2, in_units=4, prefix="fc2_"))
+    net.initialize()
+    net(nd.ones((1, 4)))
+    step = CompiledTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mx.optimizer.create("sgd",
+                                                 learning_rate=0.05))
+    return net, step
+
+
+R = np.random.RandomState(3)
+X = R.rand(8, 4).astype(np.float32)
+Y = (X.sum(1) > 2).astype(np.float32)
+STEPS = int(os.environ.get("TPUMX_CI_STEPS", "16"))
+
+
+def snapshot(net, step):
+    step.sync_to_net()
+    return {k: p.data().asnumpy().copy()
+            for k, p in net.collect_params().items()}
+
+
+def dump_final(net, step, tag):
+    step.sync_to_net()
+    out = {k: p.data().asnumpy()
+           for k, p in net.collect_params().items()}
+    np.savez(os.path.join(os.environ["TPUMX_CI_DIR"],
+                          "final-%s.npz" % tag), **out)
+
+
+if baseline:
+    net, step = build()
+    for _ in range(STEPS):
+        step.step(nd.array(X), nd.array(Y))
+    dump_final(net, step, "baseline")
+    print("WORKER DONE baseline", flush=True)
+    sys.exit(0)
+
+from tpu_mx.elastic import WorkerFailure
+from tpu_mx.parallel.fleet import Fleet, MembershipChange
+from tpu_mx.parallel.integrity import DataCorruption, IntegrityMonitor
+
+net, step = build()
+# compile BEFORE the lease clock starts: the first jit build takes
+# longer than a CI-sized lease, and a rank that joins then disappears
+# into XLA for that long reads as partitioned
+step.aot_compiled(nd.array(X), nd.array(Y))
+f = Fleet.from_env()
+f.join()
+f.await_admission(timeout=60)
+# the vote wait doubles as a step barrier (compile/scheduling skew is
+# absorbed at the vote, not accumulated) and heartbeats through it —
+# a rank blocked on slower peers must not read as partitioned
+mon = IntegrityMonitor(f.root, rank=member, world=f.world(),
+                       interval=4, vote_timeout=30.0,
+                       heartbeat=f.heartbeat)
+verified = snapshot(net, step)   # step 0: init is trivially verified
+s = 0
+deadline = time.monotonic() + 180
+while s < STEPS and time.monotonic() < deadline:
+    try:
+        f.on_step()
+    except MembershipChange:
+        try:
+            f.ack()
+            f.shard()
+        except WorkerFailure:
+            # transiently evicted (not quarantined — that rank died
+            # below): rejoin at the next epoch
+            f.join()
+            f.await_admission(timeout=60)
+        mon.set_world(f.world())
+        continue
+    step.step(nd.array(X), nd.array(Y))
+    s += 1
+    try:
+        mon.on_committed_step(s, fp=step.fingerprint())
+    except DataCorruption as e:
+        if e.self_corrupt:
+            # the vote named THIS rank: quarantine self (permanent) and
+            # die loudly — the launcher must refuse the restart
+            f.quarantine(member, reason=str(e)[:200], step=s)
+            telemetry.flush(final=True)
+            print("WORKER QUARANTINED", member, flush=True)
+            sys.exit(3)
+        # survivor: drop the corrupt rank from the vote cohort NOW (its
+        # stale fingerprint file must not poison the replayed vote),
+        # restore the last VERIFIED weights and replay from there
+        mon.set_world([m for m in mon.world if m not in e.minority])
+        for k, p in net.collect_params().items():
+            p.set_data(nd.array(verified[k]))
+        step.sync_from_net()
+        s = e.verified_step
+        continue
+    if mon.verified_step == s:
+        verified = snapshot(net, step)
+telemetry.flush(final=True)
+dump_final(net, step, str(member))
+f.leave()
+print("WORKER DONE", member, flush=True)
+"""
+
+
 # The serve tier's workload (ISSUE 8): a fixed-seed request storm
 # against the serving runtime with every serving chaos knob armed in
 # turn — reject_storm (admission backpressure + client resubmit), a
@@ -1815,7 +1968,10 @@ def soak_tier():
         rc = _straggler_leg(repo, scenario)
         if rc:
             return rc
-    return 0
+    # SDC storm sub-leg (ISSUE 20): an injected parameter bit-flip must
+    # be voted out, quarantined, never re-admitted — and the survivors'
+    # rollback must cost ZERO correctness (bit-equal to uninjected)
+    return _sdc_leg(repo)
 
 
 def _straggler_leg(repo, scenario):
@@ -1928,6 +2084,165 @@ def _straggler_leg(repo, scenario):
         print(f"  soak: straggler/{scenario}: rank 1/data_wait "
               f"attributed, max skew {max(skews):.3f}s, merged "
               "identity holds")
+    return 0
+
+
+def _sdc_leg(repo):
+    """One supervised 3-worker fleet of identical replicas with a seeded
+    parameter bit-flip injected into rank 1's committed weights.  Gates
+    the whole SDC defense plane: vote -> minority attribution ->
+    self-quarantine -> launcher restart refusal -> survivor rollback to
+    the last verified weights, bit-equal to an uninjected run."""
+    import numpy as np
+    with tempfile.TemporaryDirectory() as d:
+        fleet_dir = os.path.join(d, "fleet")
+        ctl_jsonl = os.path.join(d, "controller.jsonl")
+        worker = os.path.join(d, "worker.py")
+        with open(worker, "w") as f:
+            f.write(SDC_WORKER)
+        # the uninjected oracle first: same script, same seed, same
+        # grid — no fleet, no integrity plane, no chaos
+        base_env = dict(os.environ, JAX_PLATFORMS="cpu", TPUMX_REPO=repo,
+                        TPUMX_CI_DIR=d, TPUMX_CI_BASELINE="1",
+                        TPUMX_CI_STEPS="16")
+        for k in ("TPUMX_CHAOS", "TPUMX_TRACING", "TPUMX_TELEMETRY"):
+            base_env.pop(k, None)
+        try:
+            run = subprocess.run([sys.executable, worker], env=base_env,
+                                 cwd=repo, capture_output=True, text=True,
+                                 timeout=300)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: sdc baseline timed out: {e}")
+            return 1
+        if run.returncode != 0:
+            print(f"  soak: sdc baseline run failed "
+                  f"(rc={run.returncode}):\n"
+                  f"{((run.stdout or '') + (run.stderr or ''))[-4000:]}")
+            return run.returncode or 1
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TPUMX_TELEMETRY=ctl_jsonl, TPUMX_REPO=repo,
+                   TPUMX_CI_DIR=d, TPUMX_CI_STEPS="16")
+        for k in ("TPUMX_CHAOS", "TPUMX_TRACING", "TPUMX_CI_BASELINE"):
+            env.pop(k, None)
+        argv = [sys.executable, os.path.join(repo, "tools", "launch.py"),
+                "--supervise", "-n", "3", "--fleet-dir", fleet_dir,
+                "--max-restarts", "2", "--backoff", "1.0",
+                "--lease", "4.0", "--join-timeout", "60",
+                "--min-workers", "1",
+                # the flip lands AFTER commit 6 on rank 1 only — the
+                # step-8 vote is the first to see the divergence
+                "--env", "TPUMX_CHAOS=bitflip_param_at_step=6,"
+                         "bitflip_rank=1",
+                sys.executable, worker]
+        try:
+            run = subprocess.run(argv, env=env, cwd=repo,
+                                 capture_output=True, text=True,
+                                 timeout=600)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: sdc supervised run timed out: {e}")
+            return 1
+        out = (run.stdout or "") + (run.stderr or "")
+        # rc 1 is EXPECTED: a quarantine is a degraded outcome and
+        # supervise surfaces any nonzero worker exit as a failed launch
+        if run.returncode not in (0, 1):
+            print(f"  soak: sdc supervised run died "
+                  f"(rc={run.returncode}):\n{out[-4000:]}")
+            return run.returncode or 1
+        if "WORKER QUARANTINED 1" not in out:
+            print(f"  soak: sdc: rank 1 never self-quarantined:\n"
+                  f"{out[-4000:]}")
+            return 1
+        if "WORKER DONE 0" not in out or "WORKER DONE 2" not in out:
+            print(f"  soak: sdc: a survivor did not finish:\n"
+                  f"{out[-4000:]}")
+            return 1
+        if "worker 1 quarantined" not in out:
+            print(f"  soak: sdc: launcher never refused the restart:\n"
+                  f"{out[-4000:]}")
+            return 1
+        if "worker 1 exited 3; restart" in out:
+            print(f"  soak: sdc: launcher RESPAWNED a quarantined "
+                  f"rank:\n{out[-4000:]}")
+            return 1
+        qrec = os.path.join(fleet_dir, "quarantine", "1.json")
+        if not os.path.exists(qrec):
+            print(f"  soak: sdc: no quarantine record at {qrec}")
+            return 1
+        # zero-correctness-cost rollback: both survivors' final weights
+        # bit-equal to the uninjected fixed-seed run
+        base = np.load(os.path.join(d, "final-baseline.npz"))
+        for rank in (0, 2):
+            fin = np.load(os.path.join(d, f"final-{rank}.npz"))
+            for k in base.files:
+                a, b = base[k], fin[k]
+                if a.dtype != b.dtype or a.shape != b.shape \
+                        or a.tobytes() != b.tobytes():
+                    print(f"  soak: sdc: rank {rank} final weights "
+                          f"diverge from the uninjected run at {k!r}")
+                    return 1
+        # the black box must carry the corruption verdict, and the
+        # report tool must validate it on a machine with NO accelerator
+        # stack (poisoned jax/tpu_mx)
+        box = os.path.join(fleet_dir, "fleet-blackbox.json")
+        try:
+            with open(box, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"  soak: sdc: no readable fleet black box at "
+                  f"{box}: {e}")
+            return 1
+        cv = (((doc.get("fleet") or {}).get("corruption") or {})
+              .get("verdict") or {})
+        if cv.get("clean") is not False or cv.get("quarantined") != [1] \
+                or cv.get("suspected") != [1] \
+                or not cv.get("mismatch_steps"):
+            print(f"  soak: sdc: black box corruption verdict wrong: "
+                  f"{cv}")
+            return 1
+        report = os.path.join(repo, "tools", "fleet_report.py")
+        poison = ("import sys, runpy; sys.modules['jax'] = None; "
+                  "sys.modules['tpu_mx'] = None; "
+                  f"sys.argv = ['fleet_report', {box!r}, '--validate']; "
+                  f"runpy.run_path({report!r}, run_name='__main__')")
+        try:
+            rep = subprocess.run([sys.executable, "-c", poison],
+                                 capture_output=True, text=True,
+                                 timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: sdc: fleet_report timed out: {e}")
+            return 1
+        if rep.returncode != 0 or "QUARANTINED" not in (rep.stdout or ""):
+            print(f"  soak: sdc: fleet_report --validate failed "
+                  f"(rc={rep.returncode}):\n"
+                  f"{((rep.stdout or '') + (rep.stderr or ''))[-3000:]}")
+            return rep.returncode or 1
+        # merged telemetry: fingerprints published, votes held, the
+        # injected flip counted as a mismatch, the corrupt rank counted
+        # as quarantined
+        files = [ctl_jsonl] + [os.path.join(d, f"worker-{r}.jsonl")
+                               for r in (0, 1, 2)]
+        missing = [p for p in files if not os.path.exists(p)]
+        if missing:
+            print(f"  soak: sdc: missing telemetry file(s): {missing}")
+            return 1
+        try:
+            val = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "telemetry_report.py"),
+                 "--merge", *files, "--validate",
+                 "--require", "integrity"],
+                capture_output=True, text=True, timeout=120)
+        except subprocess.TimeoutExpired as e:
+            print(f"  soak: sdc: merged validation timed out: {e}")
+            return 1
+        if val.returncode != 0:
+            print(f"  soak: sdc: merged telemetry validation failed "
+                  f"(rc={val.returncode}):\n"
+                  f"{((val.stdout or '') + (val.stderr or ''))[-3000:]}")
+            return val.returncode or 1
+        print("  soak: sdc: rank 1 voted out + quarantined, restart "
+              "refused, survivors bit-equal to uninjected run, "
+              "corruption verdict valid")
     return 0
 
 

@@ -17,7 +17,10 @@ This tool renders that section:
   breakdown (counters sum, gauges spread min/mean/max);
 - the **skew timeline**: per correlated step, the cross-rank skew, the
   slowest rank and the phase that explains the gap;
-- the **straggler verdict** the supervisor acted on.
+- the **straggler verdict** the supervisor acted on;
+- the **corruption verdict**: each rank's last published state
+  fingerprint, the cross-replica vote history, and every permanently
+  quarantined rank with its recorded reason.
 
 ``--validate`` schema-checks the section AND re-proves the aggregation
 exactness invariant from the document alone: every merged counter must
@@ -135,6 +138,44 @@ def render_signal(fl):
     return ["Straggler verdict: none (no rank persistently slowest)"]
 
 
+def render_corruption(fl):
+    corr = fl.get("corruption")
+    if not isinstance(corr, dict):
+        return ["Corruption verdict: (no integrity data in this dump)"]
+    cv = corr.get("verdict", {})
+    if cv.get("clean", False):
+        head = "Corruption verdict: clean (every vote agreed, no rank " \
+               "quarantined)"
+    else:
+        head = ("Corruption verdict: CORRUPT — mismatching vote(s) at "
+                f"step(s) {cv.get('mismatch_steps')}, suspected rank(s) "
+                f"{cv.get('suspected')}, quarantined {cv.get('quarantined')}")
+    lines = [head]
+    fps = corr.get("fingerprints", {})
+    if fps:
+        lines.append("  Last published fingerprints:")
+        for r in sorted(fps, key=int):
+            rec = fps[r]
+            lines.append("    rank %-4s step %-6s fp=%#010x" % (
+                r, rec.get("step", "?"), int(rec.get("fp", 0))))
+    votes = corr.get("votes_by_rank", {})
+    for r in sorted(votes, key=int):
+        for v in votes[r]:
+            if v.get("agree", True):
+                continue
+            lines.append(
+                "    rank %s vote @ step %s: DISAGREE majority=%#010x "
+                "minority=%s absent=%s" % (
+                    r, v.get("step"), int(v.get("majority_fp", 0)),
+                    v.get("minority"), v.get("absent")))
+    for r in sorted(corr.get("quarantined", {}), key=int):
+        rec = corr["quarantined"][r]
+        lines.append("    rank %s QUARANTINED at step %s (gen %s): %s" % (
+            r, rec.get("step", "?"), rec.get("generation", "?"),
+            rec.get("reason", "?")))
+    return lines
+
+
 def render(doc, path):
     fl = doc.get("fleet", {})
     out = [f"Fleet black box: {path}",
@@ -148,6 +189,8 @@ def render(doc, path):
     out.extend(render_ranks(fl))
     out.append("")
     out.extend(render_signal(fl))
+    out.append("")
+    out.extend(render_corruption(fl))
     out.append("")
     out.extend(render_skew(fl))
     out.append("")

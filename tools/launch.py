@@ -220,6 +220,16 @@ def supervise(args, coord):
             dump_fleet_box(f"worker {rank} exit={rc}{straggler_note()}"
                            f" — evicting")
             fleet.evict(rank, reason=f"exit={rc}")
+        if fleet.is_quarantined(rank):
+            # quarantine is permanent: a rank voted out for silent data
+            # corruption must never be respawned, no matter how much
+            # restart budget is left — its silicon (or its stack) lies.
+            # This is a degraded-but-deliberate outcome, distinct from a
+            # transient eviction (lease expiry / crash), which rejoins.
+            exit_codes.setdefault(rank, rc if rc != 0 else 1)
+            degrade(rank, f"worker {rank} quarantined for corruption "
+                          f"after exit={rc}; refusing restart")
+            return
         if restarts[rank] < args.max_restarts:
             restarts[rank] += 1
             backoff = restart_backoff(args.backoff, restarts[rank])
@@ -259,6 +269,15 @@ def supervise(args, coord):
                 if time.monotonic() < due:
                     continue
                 del pending[rank]
+                if fleet.is_quarantined(rank):
+                    # the quarantine record can land while the rank sits
+                    # in restart backoff (e.g. the survivors' vote names
+                    # it after its crash) — drop the respawn, same as the
+                    # on_failure refusal
+                    exit_codes.setdefault(rank, 1)
+                    degrade(rank, f"worker {rank} quarantined during "
+                                  f"restart backoff; refusing respawn")
+                    continue
                 procs[rank] = spawn(rank, fresh=True)
                 if fleet.wait_member(rank, timeout=args.join_timeout):
                     # reconcile (not admit): the loop's periodic reconcile

@@ -99,6 +99,15 @@ REQUIRE_PRESETS = {
     # straggler-free run).
     "fleet_obs": ("fleet.obs_records", "fleet.ranks_reporting",
                   "fleet.step_skew_seconds"),
+    # "integrity" gates the SDC-storm soak leg (ISSUE 20): every rank
+    # published fingerprints, votes were held, the injected flip was
+    # actually seen as a mismatch, and the corrupt rank was quarantined.
+    # Spans all ranks' registries — meant for
+    # `--merge <fleet_dir>/obs/rank-*.jsonl` (shadow_audits /
+    # self_checks are deliberately absent: the vote path needs neither,
+    # and a train fleet legitimately runs with both samplers off).
+    "integrity": ("integrity.fingerprints", "integrity.votes",
+                  "integrity.mismatches", "integrity.quarantined"),
 }
 
 

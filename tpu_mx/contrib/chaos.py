@@ -81,6 +81,25 @@ Injection kinds (all one process, no root, no LD_PRELOAD):
   ``data_wait`` phase window, so the injected delay lands in a
   MEASURED phase and the cross-rank attribution can name it.  Counted
   per fire.
+- ``bitflip_grad_rank=R``: the fleet member with rank R gets ONE bit
+  flipped in its very next committed optimizer update — the silent
+  data corruption a defective chip injects into gradient sync.  The
+  flip is applied host-side to the post-update parameter tree (the
+  observable effect of a corrupted gradient: a low-order mantissa bit
+  of one seeded parameter element), so rank R's state silently
+  diverges from its replicas without tripping the numeric sentinel —
+  exactly what the cross-replica fingerprint vote
+  (tpu_mx/parallel/integrity.py) must detect and attribute.  One-shot.
+- ``bitflip_param_at_step=N`` / ``bitflip_rank=R`` (default 0): flip
+  one seeded bit in rank R's parameter tree after its Nth committed
+  train step since arming — the scheduled variant for seeded SDC-storm
+  runs where the detection latency (vote cadence K) is part of the
+  assertion.  One-shot.
+- ``flaky_recompute=K``: the next K shadow-step recomputes (the
+  sampled audit in tpu_mx/parallel/integrity.py, or the serving
+  decode self-check) return a perturbed result — flaky hardware that
+  computes the same program twice and gets different bits.
+  Decrementing budget, like ``reject_storm``.
 - ``match=SUBSTR``: scope file-level faults to paths containing SUBSTR
   (e.g. ``match=.params`` tears the params file but not the manifest).
 
@@ -117,7 +136,7 @@ __all__ = ["ChaosCrash", "enable", "active", "configure_from_env",
            "maybe_hang", "maybe_crash_step", "maybe_slow_decode",
            "maybe_kill9_decode", "storm_restart",
            "forced_reject", "maybe_preempt", "partitioned",
-           "maybe_slow_worker"]
+           "maybe_slow_worker", "maybe_bitflip", "maybe_flaky_recompute"]
 
 
 def _count_injection(kind):
@@ -149,6 +168,8 @@ class _Config:
               "kill9_at_decode_step", "restart_storm",
               "preempt_worker_at_step", "preempt_rank", "partition_worker",
               "slow_worker_rank", "slow_worker_seconds",
+              "bitflip_grad_rank", "bitflip_param_at_step", "bitflip_rank",
+              "flaky_recompute",
               "seed", "hard", "match")
 
     def __init__(self, crash_after_bytes=None, torn_write=None, slow_io=None,
@@ -159,7 +180,9 @@ class _Config:
                  kill9_at_decode_step=None, restart_storm=0,
                  preempt_worker_at_step=None, preempt_rank=0,
                  partition_worker=None, slow_worker_rank=None,
-                 slow_worker_seconds=1.0, seed=None,
+                 slow_worker_seconds=1.0, bitflip_grad_rank=None,
+                 bitflip_param_at_step=None, bitflip_rank=0,
+                 flaky_recompute=0, seed=None,
                  hard=False, match=None):
         if seed is None:
             seed = int(os.environ.get("TPUMX_CHAOS_SEED", "0"))
@@ -189,6 +212,12 @@ class _Config:
         self.slow_worker_rank = None if slow_worker_rank is None \
             else int(slow_worker_rank)
         self.slow_worker_seconds = float(slow_worker_seconds)
+        self.bitflip_grad_rank = None if bitflip_grad_rank is None \
+            else int(bitflip_grad_rank)
+        self.bitflip_param_at_step = None if bitflip_param_at_step is None \
+            else int(bitflip_param_at_step)
+        self.bitflip_rank = int(bitflip_rank)
+        self.flaky_recompute = int(flaky_recompute)
         self.seed = seed
         self.hard = bool(hard)
         self.match = match
@@ -217,6 +246,10 @@ class _Config:
         self.preempts = 0
         self.partitions = 0          # heartbeats suppressed by partition
         self.slow_worker_fires = 0   # per-step straggler delays injected
+        self.bitflip_commits_seen = 0  # commits while bitflip_param armed
+        self.bitflips = 0            # parameter bits actually flipped
+        self.flaky_left = self.flaky_recompute
+        self.flaky_fired = 0         # shadow recomputes perturbed
 
     def matches(self, path):
         return self.match is None or (path is not None
@@ -615,6 +648,67 @@ def maybe_slow_worker(rank=None):
         _count_injection("slow_worker")
         secs = cfg.slow_worker_seconds
     time.sleep(secs)
+
+
+def maybe_bitflip(rank=None):
+    """Return the mantissa bit (0–22) to flip in this rank's parameter
+    tree, or None.  The compiled train step calls this right after each
+    step COMMITS; a non-None return means one of the SDC knobs fired:
+
+    - ``bitflip_grad_rank=R``: rank R's next committed update is
+      corrupted (one-shot) — the flip lands immediately after the
+      post-sync state the replicas are supposed to agree on, so the
+      cross-replica fingerprint vote must name rank R.
+    - ``bitflip_param_at_step=N`` (+ ``bitflip_rank``, default 0): the
+      scheduled variant — fires after the matching rank's Nth committed
+      step since arming (one-shot).
+
+    The bit index is drawn from the seeded chaos RNG so a red run
+    reproduces; `rank` defaults to the ``TPUMX_FLEET_MEMBER`` env rank
+    like :func:`maybe_slow_worker`."""
+    cfg = configure_from_env()  # fleet workers may have no supervisor
+    if cfg is None or (cfg.bitflip_grad_rank is None
+                       and cfg.bitflip_param_at_step is None):
+        return None
+    if rank is None:
+        rank = os.environ.get("TPUMX_FLEET_MEMBER", 0)
+    rank = int(rank)
+    with cfg.lock:
+        if cfg.bitflip_grad_rank is not None \
+                and rank == cfg.bitflip_grad_rank:
+            cfg.bitflip_grad_rank = None  # one-shot
+            cfg.bitflips += 1
+            _count_injection("bitflip_grad")
+            return cfg.rng.randrange(23)
+        if cfg.bitflip_param_at_step is not None \
+                and rank == cfg.bitflip_rank:
+            cfg.bitflip_commits_seen += 1
+            if cfg.bitflip_commits_seen >= cfg.bitflip_param_at_step:
+                cfg.bitflip_param_at_step = None  # one-shot
+                cfg.bitflips += 1
+                _count_injection("bitflip_param")
+                return cfg.rng.randrange(23)
+    return None
+
+
+def maybe_flaky_recompute():
+    """True when the ``flaky_recompute`` budget says this shadow
+    recompute must come back with different bits (the sampled audit in
+    tpu_mx/parallel/integrity.py — and the serving decode self-check —
+    call this on every recompute).  Flaky hardware by construction: the
+    program is deterministic, so only a faulty chip can make two runs
+    disagree, and that is exactly what the caller simulates when this
+    returns True.  Decrementing budget like ``reject_storm``."""
+    cfg = configure_from_env()
+    if cfg is None or not cfg.flaky_recompute:
+        return False
+    with cfg.lock:
+        if cfg.flaky_left > 0:
+            cfg.flaky_left -= 1
+            cfg.flaky_fired += 1
+            _count_injection("flaky_recompute")
+            return True
+    return False
 
 
 def partitioned(rank):

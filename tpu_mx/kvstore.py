@@ -26,8 +26,31 @@ from .base import MXNetError, get_env
 from .ndarray import NDArray
 from .optimizer import Updater, create as _create_optimizer
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
-__all__ = ["KVStore", "create", "dist_init"]
+__all__ = ["KVStore", "IntegrityError", "create", "dist_init"]
+
+
+class IntegrityError(MXNetError):
+    """A pulled aggregate no longer matches the checksum recorded when it
+    was pushed: the payload was silently corrupted between the sync
+    seam's two ends (flaky host memory, a bad transport, a defective
+    chip).  Loud by design — this is the SDC defense's kvstore arm
+    (ISSUE 20, docs/robustness.md "Silent data corruption defense"), and
+    the same verify-on-pull gate a future lossy/quantized sync must
+    cross with its *post-decompression* payload."""
+
+
+def _payload_checksum(arr):
+    """crc32 of the payload's exact bytes (None when the leaf has no
+    readable buffer — never break push/pull for exotic types)."""
+    import zlib
+    import numpy as np
+    try:
+        host = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        return zlib.crc32(np.ascontiguousarray(host).tobytes())
+    except Exception:
+        return None
 
 
 def _nbytes(arr):
@@ -51,6 +74,7 @@ class KVStore:
     def __init__(self, kind="local"):
         self.type = kind
         self._store = {}
+        self._checksums = {}   # key -> crc32 recorded at push commit
         self._updater = None
         self._optimizer = None
         self._compression = None
@@ -135,8 +159,19 @@ class KVStore:
             agg = self._global_sum(agg)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
+                committed = self._store[k]
             else:
                 self._store[f"_pending_{k}"] = agg
+                committed = agg
+            # integrity seam (ISSUE 20): record the committed payload's
+            # checksum at push time; pull verifies it before handing the
+            # bytes out.  Cheap relative to this eager parity path (which
+            # already round-trips host), and exactly the gate a future
+            # quantized sync must also cross.
+            crc = _payload_checksum(committed)
+            if crc is not None:
+                self._checksums[k] = crc
+                _telemetry.counter("kvstore.checksums").inc()
 
     def _global_sum(self, agg):
         """Eager cross-process sum: allgather over the process group, reduce
@@ -186,6 +221,23 @@ class KVStore:
             src = self._store[k] if pending is None else pending
             if self._updater is None and pending is not None:
                 self._store[k] = pending
+            # verify-on-pull (ISSUE 20): the bytes handed out must be the
+            # bytes committed at push time — a mismatch is silent data
+            # corruption crossing the sync seam, raised loudly instead of
+            # training on it
+            expect = self._checksums.get(k)
+            if expect is not None:
+                actual = _payload_checksum(src)
+                if actual is not None and actual != expect:
+                    _telemetry.counter("kvstore.checksum_failures").inc()
+                    _tracing.emit("kvstore.checksum_fail", key=str(k))
+                    raise IntegrityError(
+                        f"kvstore pull({k!r}): payload checksum mismatch "
+                        f"(pushed crc32={expect:#010x}, pulled "
+                        f"crc32={actual:#010x}) — the aggregate was "
+                        "silently corrupted after its push committed; "
+                        "refusing to hand out poisoned bytes (SDC "
+                        "defense, docs/robustness.md)")
             olist = o if isinstance(o, list) else [o]
             _telemetry.counter("kvstore.pulls").inc()
             _telemetry.counter("kvstore.pull_bytes").inc(

@@ -201,6 +201,9 @@ class Fleet:
     def _member_path(self, member):
         return os.path.join(self.root, "members", f"{int(member)}.json")
 
+    def _quarantine_path(self, member):
+        return os.path.join(self.root, "quarantine", f"{int(member)}.json")
+
     @staticmethod
     def _read_json(path):
         try:
@@ -476,6 +479,18 @@ class Fleet:
         generation if (and only if) membership changed.  Returns the new
         epoch record, or None when the world is unchanged."""
         lost, joiners = self.lost(), self.joiners()
+        q = self.quarantined()
+        if q:
+            # quarantine is permanent: a quarantined rank still beating
+            # (healed partition, zombie process) must neither stay in
+            # the world nor rejoin it — distinct from lease eviction,
+            # which a healed member survives
+            barred = [m for m in joiners if m in q]
+            if barred:
+                log.warning("fleet: refusing re-admission of quarantined "
+                            "member(s) %s", barred)
+            joiners = [m for m in joiners if m not in q]
+            lost = sorted(set(lost) | (set(self.world()) & set(q)))
         if not lost and not joiners:
             return None
         now = time.time()
@@ -516,9 +531,70 @@ class Fleet:
             pass
         return self.advance(world=world, reason=reason)
 
+    # -- quarantine (ISSUE 20: SDC defense) --------------------------------
+    def quarantine(self, member, reason="corruption", step=0):
+        """Permanently bar ``member`` from the fleet: a corruption
+        verdict (parallel/integrity.py) named this rank's hardware, and
+        — unlike a lease eviction, where a healed partition resumes
+        beating and rejoins — a flaky chip must NEVER be re-admitted.
+        The record under ``<root>/quarantine/`` is the durable verdict:
+        :meth:`reconcile`, :meth:`admit` and the launcher's restart path
+        all refuse quarantined ranks against it.  Any member may write
+        it (the corrupt worker self-reports before dying; the controller
+        writes it when it holds the vote) — writing is idempotent."""
+        body = {"member": int(member), "reason": str(reason)[:500],
+                "step": int(step), "generation": self.generation,
+                "wall_time": time.time()}
+        os.makedirs(os.path.join(self.root, "quarantine"), exist_ok=True)
+        with _ckpt.atomic_write(self._quarantine_path(member),
+                                mode="w") as f:
+            f.write(json.dumps(body))
+        # drop the member record too: its last heartbeat may still be
+        # fresh, and a fresh-looking lease would keep the rank "live"
+        try:
+            os.remove(self._member_path(int(member)))
+        except OSError:
+            pass
+        _telemetry.counter("integrity.quarantined").inc()
+        _tracing.emit("integrity.quarantine", rank=int(member),
+                      reason=str(reason)[:300], step=int(step))
+        log.error("fleet: member %d QUARANTINED (%s) — permanent, never "
+                  "re-admitted", int(member), reason)
+        if self.controller and int(member) in self.world():
+            self.advance(world=[m for m in self.world()
+                                if m != int(member)],
+                         reason="quarantine")
+        return body
+
+    def quarantined(self):
+        """All quarantine verdicts on disk: {rank: record}."""
+        out = {}
+        qdir = os.path.join(self.root, "quarantine")
+        try:
+            names = os.listdir(qdir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            rec = self._read_json(os.path.join(qdir, name))
+            if isinstance(rec, dict) and "member" in rec:
+                out[int(rec["member"])] = rec
+        return out
+
+    def is_quarantined(self, member):
+        return self._read_json(self._quarantine_path(member)) is not None
+
     def admit(self, member, reason="rejoin"):
         """Launcher fast path: admit a (re)started worker at the next
-        membership epoch."""
+        membership epoch.  Quarantined ranks are REFUSED — corruption
+        verdicts are permanent (:meth:`quarantine`)."""
+        if self.is_quarantined(member):
+            raise WorkerFailure(
+                f"fleet member {int(member)} is quarantined (data "
+                f"corruption verdict) — re-admission refused; the "
+                f"quarantine record under {self.root}/quarantine is "
+                f"permanent")
         world = sorted(set(self.world()) | {int(member)})
         ep = self.advance(world=world, reason=reason)
         _tracing.emit("fleet.rejoin", member=int(member),

@@ -74,8 +74,9 @@ except ImportError:  # standalone module load (tools/fleet_report.py)
 
 __all__ = ["OBS_DIR", "OBS_FORMAT", "FLEET_SECTION_FORMAT", "ObsShipper",
            "FleetAggregator", "StragglerDetector", "merge_streams",
-           "correlate_steps", "read_obs_dir", "fleet_blackbox_path",
-           "dump_fleet_blackbox", "validate_fleet_section"]
+           "correlate_steps", "read_obs_dir", "read_integrity_dir",
+           "fleet_blackbox_path", "dump_fleet_blackbox",
+           "validate_fleet_section"]
 
 #: subdirectory of the fleet membership store holding shipped snapshots
 OBS_DIR = "obs"
@@ -91,6 +92,15 @@ ATTRIBUTION_PHASES = ("data_wait", "recompile", "dispatch",
 
 _RANK_JSONL = re.compile(r"^rank-(\d+)\.jsonl$")
 _RANK_EVENTS = re.compile(r"^rank-(\d+)-events\.json$")
+
+#: the SDC defense plane's on-disk state (tpu_mx/parallel/integrity.py
+#: and Fleet.quarantine write these; read here stdlib-only so the
+#: forensics tools never boot jax to render a corruption verdict)
+INTEGRITY_DIR = "integrity"
+QUARANTINE_DIR = "quarantine"
+_RANK_FP = re.compile(r"^fp-(\d+)\.json$")
+_RANK_VOTES = re.compile(r"^votes-(\d+)\.jsonl$")
+_RANK_QUARANTINE = re.compile(r"^(\d+)\.json$")
 
 
 # ---------------------------------------------------------------------------
@@ -533,6 +543,90 @@ def read_obs_dir(root):
     return streams, docs
 
 
+def read_integrity_dir(fleet_dir, last_votes=50):
+    """Read the SDC defense plane's on-disk state under ``fleet_dir``.
+
+    Returns the black box's ``corruption`` section: each rank's newest
+    published fingerprint (``integrity/fp-<rank>.json``), the tail of
+    each rank's vote journal (``integrity/votes-<rank>.jsonl``), every
+    permanent quarantine record (``quarantine/<rank>.json``), and a
+    one-object ``verdict`` summarising them — ``clean`` is True only
+    when no vote ever disagreed AND no rank is quarantined.  Unreadable
+    or half-written files are skipped, same policy as
+    :func:`read_obs_dir`: a gap is reported, never raised."""
+    root = os.fspath(fleet_dir)
+    fingerprints, votes_by_rank, quarantined = {}, {}, {}
+    idir = os.path.join(root, INTEGRITY_DIR)
+    try:
+        names = sorted(os.listdir(idir))
+    except OSError:
+        names = []
+    for name in names:
+        path = os.path.join(idir, name)
+        m = _RANK_FP.match(name)
+        if m:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(rec, dict):
+                fingerprints[str(int(m.group(1)))] = rec
+            continue
+        m = _RANK_VOTES.match(name)
+        if m:
+            recs = []
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if isinstance(rec, dict):
+                            recs.append(rec)
+            except OSError:
+                continue
+            if recs:
+                votes_by_rank[str(int(m.group(1)))] = recs[-last_votes:]
+    qdir = os.path.join(root, QUARANTINE_DIR)
+    try:
+        qnames = sorted(os.listdir(qdir))
+    except OSError:
+        qnames = []
+    for name in qnames:
+        m = _RANK_QUARANTINE.match(name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(qdir, name), encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict):
+            quarantined[str(int(m.group(1)))] = rec
+    mismatch_steps, suspected = set(), set()
+    for recs in votes_by_rank.values():
+        for v in recs:
+            if not v.get("agree", True):
+                mismatch_steps.add(int(v.get("step", -1)))
+                suspected.update(int(m) for m in v.get("minority", []))
+    return {
+        "fingerprints": fingerprints,
+        "votes_by_rank": votes_by_rank,
+        "quarantined": quarantined,
+        "verdict": {
+            "clean": not mismatch_steps and not quarantined,
+            "mismatch_steps": sorted(mismatch_steps),
+            "suspected": sorted(suspected),
+            "quarantined": sorted(int(r) for r in quarantined),
+        },
+    }
+
+
 class FleetAggregator:
     """The controller's periodic merge pass over ``<fleet_dir>/obs/``.
 
@@ -665,6 +759,11 @@ def dump_fleet_blackbox(fleet_dir, reason="", aggregator=None, fleet=None,
     res = aggregator.poll(force=True)
     doc = _tracing.blackbox_doc(reason=reason, last=last)
     doc["fleet"] = _fleet_section(res)
+    # the corruption verdict rides beside the skew timeline: who
+    # published what fingerprint, how every vote went, who is
+    # permanently quarantined (read from disk, not from the aggregation
+    # pass — the dying rank's last vote must survive its eviction)
+    doc["fleet"]["corruption"] = read_integrity_dir(fleet_dir)
     path = fleet_blackbox_path(fleet_dir)
     with _ckpt.atomic_write(path, mode="w") as f:
         f.write(_strict_json(doc))
@@ -711,6 +810,36 @@ def validate_fleet_section(doc, telemetry=None):
             or not isinstance(sig.get("rank"), int):
         raise ValueError("fleet section missing a straggler_signal "
                          "object with straggling/rank")
+    corr = fl.get("corruption")
+    if not isinstance(corr, dict):
+        raise ValueError("fleet section missing the 'corruption' object")
+    for field in ("fingerprints", "votes_by_rank", "quarantined"):
+        if not isinstance(corr.get(field), dict):
+            raise ValueError(f"corruption section missing the "
+                             f"{field!r} object")
+    cv = corr.get("verdict")
+    if not isinstance(cv, dict) or not isinstance(cv.get("clean"), bool) \
+            or not all(isinstance(cv.get(k), list) for k in
+                       ("mismatch_steps", "suspected", "quarantined")):
+        raise ValueError("corruption section missing a verdict object "
+                         "with clean/mismatch_steps/suspected/quarantined")
+    # the verdict must be derivable from the stored votes + quarantine
+    # records — a black box claiming 'clean' over a disagreeing vote is
+    # itself corrupt
+    if cv["clean"] and (cv["mismatch_steps"] or cv["quarantined"]):
+        raise ValueError("corruption verdict claims clean over recorded "
+                         "mismatches/quarantines")
+    for recs in corr["votes_by_rank"].values():
+        if not isinstance(recs, list):
+            raise ValueError("votes_by_rank values must be lists")
+        for v in recs:
+            if not isinstance(v, dict) or "agree" not in v \
+                    or "step" not in v:
+                raise ValueError(f"malformed vote record: {v!r}")
+            if not v["agree"] and int(v["step"]) not in cv["mismatch_steps"]:
+                raise ValueError(
+                    f"vote at step {v['step']} disagreed but is absent "
+                    f"from verdict.mismatch_steps")
     for entry in fl["skew_timeline"]:
         if not isinstance(entry, dict) \
                 or not isinstance(entry.get("skew_seconds"), (int, float)) \

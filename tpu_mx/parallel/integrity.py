@@ -1,0 +1,536 @@
+"""Silent-data-corruption defense plane (ISSUE 20).
+
+Every failure the stack survives is *loud* — hangs (supervisor watchdog),
+NaNs (numeric sentinel), crashes (durable manifests), torn writes
+(checkpoint verify), lost members (fleet leases).  Nothing defended
+against a chip that returns plausible-but-wrong numbers: the loss stays
+finite, the heartbeat stays fresh, and at fleet scale one corrupt worker
+poisons every replica through gradient AllReduce.  This module closes
+that gap with three detectors and one verdict type:
+
+1. **Cross-replica state fingerprinting** — after each optimizer update
+   every dp replica folds a cheap device-side fingerprint of its
+   post-sync parameter tree (:func:`device_fingerprint`, computed INSIDE
+   the fused step and read back beside the loss scalar, so the hot-path
+   stays one program).  Replicas are bit-identical post-AllReduce by
+   construction, so the fingerprints must agree; every K committed steps
+   each rank publishes its fingerprint into the fleet membership dir and
+   :class:`IntegrityMonitor` compares them.  ANY disagreement is
+   corruption — there is no tolerance to tune — and majority vote names
+   the minority rank(s).
+2. **Sampled shadow-step audit** (:class:`ShadowAuditor`) for the
+   no-quorum cases (dp=1, or serving): on a seeded sampled cadence,
+   re-execute the identical step — same operands, same compiled program —
+   and compare bit-exactly.  The program is deterministic, so a mismatch
+   is flaky hardware *by construction*, not a heuristic.
+3. **Quarantine** — a corruption verdict raises :class:`DataCorruption`
+   (tpu_mx/supervisor.py), a new failure class beside transient/numeric:
+   the minority rank writes a permanent quarantine record the fleet
+   refuses to re-admit (``Fleet.quarantine``; distinct from lease
+   eviction — a healed partition still rejoins, a corrupt chip never
+   does), and the surviving majority rolls back to the last *verified*
+   checkpoint — the newest save taken at or before the last all-agree
+   vote, which the monitor tracks (``verified_step``) and the resume
+   capsule carries, so "last known-good" is provable, never guessed.
+
+Fallback ladder when no quorum exists: 3+ replicas → majority vote with
+minority attribution; 2 replicas → disagreement is still detected (the
+verdict carries an empty minority — both roll back, neither is blamed);
+1 replica → the shadow audit is the only witness, and its mismatch
+self-attributes.  Serving uses the same auditor as a sampled decode-step
+self-check classified into the existing restart ladder.
+
+Everything here is provoked in tests, never assumed: chaos's
+``bitflip_grad_rank`` / ``bitflip_param_at_step`` / ``flaky_recompute``
+knobs inject the corruption, and the soak CI tier's SDC storm leg gates
+the whole detect→attribute→quarantine→recover loop end to end (corrupt
+rank quarantined, survivors' final weights bit-equal to an uninjected
+run).  See docs/robustness.md "Silent data corruption defense".
+
+The file layout under the fleet root (plain JSON, readable by the
+jax-less forensics tools — fleet_obs/fleet_report never import this
+module's jax side)::
+
+    <root>/integrity/fp-<rank>.json     newest published fingerprint
+    <root>/integrity/votes-<rank>.jsonl this rank's vote verdicts
+    <root>/quarantine/<rank>.json       permanent corruption verdicts
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from .. import checkpoint as _ckpt
+from .. import telemetry as _telemetry
+from .. import tracing as _tracing
+from ..supervisor import DataCorruption
+
+__all__ = ["DataCorruption", "IntegrityMonitor", "ShadowAuditor",
+           "device_fingerprint", "host_fingerprint", "bits_equal",
+           "sampled"]
+
+log = logging.getLogger(__name__)
+
+#: FNV-1a basis/prime — the fold is FNV-shaped (multiply-and-add over
+#: per-leaf bit sums) because it is cheap, order-sensitive across leaves,
+#: and a single flipped bit in any leaf always changes the digest: the
+#: leaf sum moves by ±2^b (mod 2^32), never 0, and the odd prime
+#: multiplier is invertible mod 2^32 so the change survives the fold.
+_FNV_BASIS = 2166136261
+_FNV_PRIME = 16777619
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+def _leaf_bits_u32(x):
+    """Reinterpret one array's bits as uint32 words (jit-traceable).
+
+    Bitcast, never value-cast: the fingerprint must see the exact bit
+    pattern (a flipped mantissa bit that barely moves the value must
+    still flip the digest), and NaN payloads must be preserved."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    dt = jnp.dtype(x.dtype)
+    # issubdtype, not dt.kind: ml_dtypes' bfloat16 reports kind "V"
+    if jnp.issubdtype(dt, jnp.floating):
+        if dt.itemsize == 4:
+            return lax.bitcast_convert_type(x, jnp.uint32)
+        if dt.itemsize == 2:  # f16 / bf16
+            return lax.bitcast_convert_type(x, jnp.uint16) \
+                .astype(jnp.uint32)
+        if dt.itemsize == 8:
+            u64 = lax.bitcast_convert_type(x, jnp.uint64)
+            return ((u64 & jnp.uint64(0xFFFFFFFF))
+                    ^ (u64 >> jnp.uint64(32))).astype(jnp.uint32)
+    if jnp.issubdtype(dt, jnp.integer) or dt == jnp.bool_:
+        return x.astype(jnp.uint32)
+    raise TypeError(f"device_fingerprint: unsupported leaf dtype {dt}")
+
+
+def device_fingerprint(tree):
+    """Fold a parameter tree into ONE uint32 scalar, on device.
+
+    Jit-traceable — the compiled train step computes it as part of the
+    same program that applied the update, so the readback rides the
+    existing loss transfer (no extra host↔device round trip, and the
+    hot-path-purity lint sees one program).  uint32 arithmetic wraps by
+    definition, which is exactly the modular fold we want.  Leaf order
+    is ``tree_leaves`` order — deterministic for a fixed tree structure,
+    which is all cross-replica comparison needs (every replica runs the
+    identical program over the identical structure)."""
+    import jax.numpy as jnp
+    from jax import tree_util
+
+    acc = jnp.uint32(_FNV_BASIS)
+    for leaf in tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "dtype"):
+            continue
+        s = jnp.sum(_leaf_bits_u32(leaf), dtype=jnp.uint32)
+        acc = acc * jnp.uint32(_FNV_PRIME) + s
+    return acc
+
+
+def host_fingerprint(value):
+    """The host-side twin: fold numpy arrays / scalars / nested
+    lists-of-arrays into one Python int with the same FNV shape.  Used
+    where the data already lives on host (serving decode tokens, kvstore
+    payload checks in tests) — NOT bit-compatible with
+    :func:`device_fingerprint` (different leaf flattening), and never
+    compared against it."""
+    import numpy as np
+
+    acc = _FNV_BASIS
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if v is None:
+            continue
+        if isinstance(v, (list, tuple)):
+            stack.extend(reversed(v))
+            continue
+        arr = np.asarray(v)
+        word = int(np.frombuffer(arr.tobytes(), dtype=np.uint8)
+                   .astype(np.uint64).sum() % (1 << 32))
+        acc = (acc * _FNV_PRIME + word) % (1 << 32)
+    return acc
+
+
+def bits_equal(a, b):
+    """Bit-exact comparison of two step results (ints, numpy arrays, or
+    nested lists/tuples of them).  NaN == NaN here — the comparison is
+    over bit patterns, not IEEE semantics."""
+    import numpy as np
+
+    if isinstance(a, (list, tuple)) or isinstance(b, (list, tuple)):
+        if not isinstance(a, (list, tuple)) \
+                or not isinstance(b, (list, tuple)) or len(a) != len(b):
+            return False
+        return all(bits_equal(x, y) for x, y in zip(a, b))
+    if a is None or b is None:
+        return a is None and b is None
+    aa, bb = np.asarray(a), np.asarray(b)
+    if aa.shape != bb.shape or aa.dtype != bb.dtype:
+        return False
+    return aa.tobytes() == bb.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# seeded sampled cadence
+# ---------------------------------------------------------------------------
+def _mix64(x):
+    """splitmix64 finalizer — a stateless seeded hash, so the audit
+    schedule is a pure function of (seed, index): deterministic across
+    restarts (a resumed run audits the same steps) yet unpredictable
+    enough that periodic corruption cannot dodge a periodic audit."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+def sampled(seed, index, rate):
+    """True when ``index`` is in the seeded sample of density ``rate``."""
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    h = _mix64((int(seed) << 32) ^ (int(index) & 0xFFFFFFFF))
+    return (h / float(1 << 64)) < float(rate)
+
+
+def _perturb(value):
+    """Flip one bit of a step result — the simulated flaky recompute
+    (chaos ``flaky_recompute``).  The perturbation lives HERE, next to
+    the comparison it must defeat, so the chaos module stays
+    numerics-free."""
+    import numpy as np
+
+    if isinstance(value, (list, tuple)):
+        return type(value)([_perturb(value[0])] + list(value[1:]))
+    if value is None:
+        return value
+    arr = np.asarray(value)
+    if arr.size == 0:
+        return value
+    flat = arr.copy().reshape(-1).view(np.uint8)
+    flat[0] ^= 1
+    out = flat.view(arr.dtype).reshape(arr.shape)
+    return int(out) if np.isscalar(value) or arr.shape == () else out
+
+
+def _record_fp_at(rec, step):
+    """The fingerprint a published record carries for ``step`` — the
+    newest entry or one from its history ring — or None."""
+    if not isinstance(rec, dict):
+        return None
+    if int(rec.get("step", -1)) == int(step):
+        return int(rec["fp"])
+    for s, v in rec.get("history") or ():
+        if int(s) == int(step):
+            return int(v)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# cross-replica fingerprint voting
+# ---------------------------------------------------------------------------
+class IntegrityMonitor:
+    """One rank's handle on the fleet's fingerprint-vote protocol.
+
+    ``root`` is the fleet membership dir (or any shared dir for tests);
+    ``rank`` this replica's slot; ``world`` the ranks expected to vote
+    (refresh with :meth:`set_world` after a reshard).  ``interval`` is K:
+    fingerprints are published and compared every K committed steps —
+    detection latency is bounded by K, which is the knob trading audit
+    I/O against blast radius.  ``fingerprint_fn`` is a zero-arg callable
+    returning the step's digest (``CompiledTrainStep.fingerprint``);
+    the supervisor calls :meth:`on_committed_step` at every step
+    boundary, which raises :class:`DataCorruption` on a disagreeing
+    vote.
+
+    The monitor is deliberately fleet-*agnostic* (plain dir paths, no
+    Fleet import): the forensics side (fleet_obs/fleet_report) reads the
+    same files without jax, and tests drive multi-rank votes from one
+    process."""
+
+    def __init__(self, root, rank=0, world=None, interval=8,
+                 fingerprint_fn=None, history=256, vote_timeout=2.0,
+                 poll=0.02, heartbeat=None):
+        self.root = os.fspath(root)
+        self.rank = int(rank)
+        self.world = sorted(int(m) for m in (world or [rank]))
+        self.interval = max(1, int(interval))
+        self.fingerprint_fn = fingerprint_fn
+        self.history_limit = int(history)
+        self.vote_timeout = float(vote_timeout)
+        self.poll = float(poll)
+        # called every poll iteration of a vote wait: a rank blocked on
+        # slower peers must keep renewing its fleet lease, or the wait
+        # itself reads as a partition (pass Fleet.heartbeat)
+        self.heartbeat = heartbeat
+        self.history = []              # [(step, fp), ...] ring
+        self._pub_ring = []            # published (step, fp) pairs
+        self.verified_step = 0         # last all-agree vote step
+        self.first_disagree_step = None
+        self.published = 0
+        os.makedirs(self._dir(), exist_ok=True)
+
+    # -- files ------------------------------------------------------------
+    def _dir(self):
+        return os.path.join(self.root, "integrity")
+
+    def _fp_path(self, rank):
+        return os.path.join(self._dir(), f"fp-{int(rank)}.json")
+
+    def _votes_path(self):
+        return os.path.join(self._dir(), f"votes-{self.rank}.jsonl")
+
+    def set_world(self, world):
+        """Adopt a new voting cohort (after a reshard/quarantine — the
+        vote must not wait on a rank that is no longer in the world)."""
+        self.world = sorted(int(m) for m in world)
+
+    # -- publish / read ---------------------------------------------------
+    def publish(self, step, fp):
+        """Atomically publish this rank's fingerprint for ``step``.
+
+        The record carries a short ring of PRIOR published (step, fp)
+        pairs: a fast rank overwrites this file long before slow peers
+        reach their vote for an earlier step, and without the ring those
+        voters would be starved of the very record they compare (30s
+        timeout stalls, missed attribution — the fp file is newest-only
+        by design, the ring is what makes the vote race-free)."""
+        self._pub_ring.append((int(step), int(fp)))
+        del self._pub_ring[:-32]
+        body = {"member": self.rank, "step": int(step), "fp": int(fp),
+                "wall_time": time.time(),
+                "history": [[s, v] for s, v in self._pub_ring]}
+        with _ckpt.atomic_write(self._fp_path(self.rank), mode="w") as f:
+            f.write(json.dumps(body))
+        self.published += 1
+        _telemetry.counter("integrity.fingerprints").inc()
+        _tracing.emit("integrity.fingerprint", step=int(step), fp=int(fp),
+                      rank=self.rank)
+
+    def peers(self):
+        """All published fingerprint records: {rank: record}."""
+        out = {}
+        try:
+            names = os.listdir(self._dir())
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("fp-") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self._dir(), name),
+                          encoding="utf-8") as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(rec, dict) and "member" in rec:
+                out[int(rec["member"])] = rec
+        return out
+
+    # -- the vote ---------------------------------------------------------
+    def vote(self, step, wait=True):
+        """Compare the cohort's fingerprints at ``step``.
+
+        Waits up to ``vote_timeout`` for every world rank to publish the
+        step's record (all ranks publish on the same committed-step
+        cadence, so the wait covers scheduling skew, not drift).  Ranks
+        that never show are counted absent — the vote proceeds among
+        those present when at least two did (below that there is nothing
+        to compare: shadow audits are the dp=1 story).  Returns the
+        verdict dict (also appended to this rank's ``votes-*.jsonl``),
+        or None when no quorum formed."""
+        deadline = time.monotonic() + (self.vote_timeout if wait else 0.0)
+        next_beat = 0.0
+        while True:
+            recs = self.peers()
+            votes = {}
+            for m in self.world:
+                fp = _record_fp_at(recs.get(m), step)
+                if fp is not None:
+                    votes[m] = fp
+            if len(votes) == len(self.world) \
+                    or time.monotonic() >= deadline:
+                break
+            if self.heartbeat is not None \
+                    and time.monotonic() >= next_beat:
+                next_beat = time.monotonic() + 0.25
+                try:
+                    self.heartbeat()
+                except Exception:   # noqa: BLE001 — lease renewal is
+                    pass            # best-effort inside the wait
+            time.sleep(self.poll)
+        if len(votes) < 2:
+            return None
+        counts = {}
+        for fp in votes.values():
+            counts[fp] = counts.get(fp, 0) + 1
+        majority_fp, majority_n = max(counts.items(),
+                                      key=lambda kv: (kv[1], -kv[0]))
+        agree = len(counts) == 1
+        # a strict majority names the minority; a tie (2 ranks, or 2v2)
+        # detects corruption but cannot attribute — minority stays empty
+        # and every voter treats itself as a survivor (rolls back)
+        quorum = majority_n * 2 > len(votes)
+        minority = sorted(m for m, fp in votes.items()
+                          if fp != majority_fp) if quorum and not agree \
+            else []
+        verdict = {"step": int(step), "agree": bool(agree),
+                   "quorum": bool(quorum), "majority_fp": int(majority_fp),
+                   "votes": {str(m): int(fp) for m, fp in votes.items()},
+                   "minority": [int(m) for m in minority],
+                   "absent": sorted(m for m in self.world
+                                    if m not in votes),
+                   "world": list(self.world), "wall_time": time.time()}
+        self._record_vote(verdict)
+        _telemetry.counter("integrity.votes").inc()
+        _tracing.emit("integrity.vote", step=int(step), agree=bool(agree),
+                      majority_fp=int(majority_fp),
+                      minority=",".join(str(m) for m in minority),
+                      world_size=len(votes))
+        if agree:
+            # certification needs the FULL cohort: an agree vote among a
+            # subset (a peer's publish raced the timeout) proves nothing
+            # about the absent ranks, so it must not advance the
+            # rollback anchor
+            if not verdict["absent"]:
+                self.verified_step = max(self.verified_step, int(step))
+                _telemetry.gauge("integrity.verified_step") \
+                    .set(self.verified_step)
+        else:
+            _telemetry.counter("integrity.mismatches").inc()
+            if self.first_disagree_step is None \
+                    or int(step) < self.first_disagree_step:
+                self.first_disagree_step = int(step)
+        return verdict
+
+    def _record_vote(self, verdict):
+        try:
+            with open(self._votes_path(), "a", encoding="utf-8") as f:
+                f.write(json.dumps(verdict) + "\n")
+        except OSError:
+            pass  # forensics must never fail the step they describe
+
+    # -- the supervised-step hook -----------------------------------------
+    def on_committed_step(self, step, fp=None):
+        """The per-step duty cycle, called by the supervisor after each
+        committed step: fold the fingerprint into history and, every
+        ``interval`` steps, publish + vote.  Raises
+        :class:`DataCorruption` when the vote disagrees — at the step
+        boundary, the same quiesce point membership changes use, so the
+        rollback never lands mid-collective."""
+        if fp is None and self.fingerprint_fn is not None:
+            fp = self.fingerprint_fn()
+        if fp is None:
+            return None
+        step, fp = int(step), int(fp)
+        self.history.append((step, fp))
+        if len(self.history) > self.history_limit:
+            del self.history[:len(self.history) - self.history_limit]
+        if step % self.interval != 0:
+            return None
+        self.publish(step, fp)
+        verdict = self.vote(step)
+        if verdict is None or verdict["agree"]:
+            return verdict
+        minority = verdict["minority"]
+        self_corrupt = self.rank in minority
+        raise DataCorruption(
+            f"cross-replica fingerprint vote disagreed at step {step}: "
+            f"rank {self.rank} fp={fp:#010x}, majority "
+            f"fp={verdict['majority_fp']:#010x}, minority "
+            f"{minority or '(no quorum to attribute)'} — "
+            + ("this rank is corrupt: quarantine" if self_corrupt else
+               "rolling back to the last verified checkpoint "
+               f"(step {self.verified_step})"),
+            step=step, minority=minority,
+            verified_step=self.verified_step, surface="train",
+            self_corrupt=self_corrupt)
+
+    # -- capsule seam ------------------------------------------------------
+    def state_dict(self):
+        """The fingerprint ledger the resume capsule carries — a restored
+        run knows its last PROVEN-good step (and any disagreement it was
+        recovering from) instead of re-deriving trust from nothing."""
+        return {"rank": self.rank, "interval": self.interval,
+                "history": [[int(s), int(f)] for s, f in self.history],
+                "verified_step": int(self.verified_step),
+                "first_disagree_step": self.first_disagree_step,
+                "published": int(self.published)}
+
+    def load_state_dict(self, state):
+        self.history = [(int(s), int(f))
+                        for s, f in state.get("history", [])]
+        self.verified_step = int(state.get("verified_step", 0))
+        fd = state.get("first_disagree_step")
+        self.first_disagree_step = None if fd is None else int(fd)
+        self.published = int(state.get("published", 0))
+
+
+# ---------------------------------------------------------------------------
+# shadow-step audits (the no-quorum detector)
+# ---------------------------------------------------------------------------
+class ShadowAuditor:
+    """Sampled bit-exact re-execution — corruption detection when there
+    is no peer to vote with (dp=1 training, or a serving engine).
+
+    ``rate`` is the sampled audit density (0 disarms), ``seed`` fixes
+    the schedule (:func:`sampled` — deterministic across restarts).
+    :meth:`should_audit` asks whether this step is in the sample;
+    :meth:`audit` runs the comparison: ``first`` is the committed
+    result (fingerprint int, token array, or nested tuple — anything
+    :func:`bits_equal` takes), ``recompute`` a zero-arg callable
+    re-executing the IDENTICAL program on the identical operands.  The
+    program is deterministic, so first != recompute is flaky hardware by
+    construction — :class:`DataCorruption`, self-attributed
+    (``self_corrupt=True``: there is no one else to blame).  The chaos
+    ``flaky_recompute`` knob perturbs the recomputed value here, so the
+    false-positive arm of the detector is testable."""
+
+    def __init__(self, rate=0.0, seed=0, surface="train"):
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.surface = str(surface)
+        self.audits = 0
+        self.mismatches = 0
+
+    def should_audit(self, index):
+        return sampled(self.seed, index, self.rate)
+
+    def audit(self, first, recompute, step=0):
+        """Compare the committed result against a shadow re-execution;
+        returns True on a bit-exact match, raises otherwise."""
+        from ..contrib import chaos
+
+        self.audits += 1
+        _telemetry.counter("integrity.shadow_audits").inc()
+        second = recompute()
+        if chaos.maybe_flaky_recompute():
+            second = _perturb(second)
+        ok = bits_equal(first, second)
+        _tracing.emit("integrity.shadow_audit", step=int(step),
+                      match=bool(ok), surface=self.surface)
+        if ok:
+            return True
+        self.mismatches += 1
+        _telemetry.counter("integrity.shadow_mismatches").inc()
+        raise DataCorruption(
+            f"shadow-step audit mismatch at {self.surface} step {step}: "
+            "re-executing the identical program on the identical operands "
+            "produced different bits — flaky hardware on this worker",
+            step=int(step), surface=self.surface, self_corrupt=True)
+
+    def maybe_audit(self, index, first, recompute):
+        """``audit`` iff ``index`` is in the seeded sample (the one-call
+        form the serving self-check uses)."""
+        if not self.should_audit(index):
+            return None
+        return self.audit(first, recompute, step=index)

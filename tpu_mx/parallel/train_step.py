@@ -37,6 +37,15 @@ __all__ = ["CompiledTrainStep", "fsdp_rules", "sharding_for", "apply_rules"]
 _logger = logging.getLogger(__name__)
 
 
+def _fingerprint_on():
+    """``TPUMX_FINGERPRINT`` gates the device-side SDC fingerprint
+    (ISSUE 20, parallel/integrity.py; default ON).  Read at trace time:
+    flipping it changes the program, which the overhead-receipt A/B does
+    by construction (one fresh process per arm)."""
+    return os.environ.get("TPUMX_FINGERPRINT", "1").lower() \
+        not in ("0", "false", "off")
+
+
 def _shape_signature(raw):
     """The batch's shape signature (``"float32[16,4];float32[16]"``) —
     the label the per-shape compile metrics key on (ISSUE 14): jax
@@ -156,6 +165,7 @@ class CompiledTrainStep:
         # former #12 rejection)
         self._accum = int(accum_steps)
         self._micro = 0
+        self._last_fp = None  # last committed step's device fingerprint
         self._gacc = None     # lazy f32 grad-accumulation buffers
         self._accum_jit = None
         self._compression = None
@@ -492,7 +502,20 @@ class CompiledTrainStep:
                                            base_wd * wd_mults[k], t)
                     new_vals[k] = w.astype(values[k].dtype)
                 new_states[k] = s
-            return new_vals, new_masters, new_states, new_efs, new_gacc, loss
+            # device-side SDC fingerprint (ISSUE 20, parallel/integrity.py):
+            # folded over the POST-UPDATE parameter tree INSIDE the same
+            # program that applied it, read back beside the loss — the hot
+            # path stays one program, and dp replicas (bit-identical
+            # post-AllReduce) must produce the same digest.  Off → a
+            # constant uint32(0): same output arity, XLA folds it away
+            # (the overhead A/B's baseline arm).
+            if _fingerprint_on():
+                from .integrity import device_fingerprint
+                fp = device_fingerprint(new_vals)
+            else:
+                fp = jnp.uint32(0)
+            return (new_vals, new_masters, new_states, new_efs, new_gacc,
+                    loss, fp)
 
         def accum_fn(values, gacc, key, *batch):
             """Microbatch accumulate: grads/K into the f32 buffers, BN-stat
@@ -571,7 +594,7 @@ class CompiledTrainStep:
         in_sh = (self._value_shardings(), master_sh, self._state_shardings(),
                  efs_sh, gacc_sh, repl, repl, repl) + batch_sh
         out_sh = (self._value_shardings(), master_sh, self._state_shardings(),
-                  efs_sh, gacc_sh, repl)
+                  efs_sh, gacc_sh, repl, repl)
         self._jitted = jax.jit(
             fn, in_shardings=in_sh, out_shardings=out_sh,
             donate_argnums=donate)
@@ -589,6 +612,26 @@ class CompiledTrainStep:
         """How many jit programs THIS instance has built (the global
         recompile-storm counter is `train_step.recompiles` in telemetry)."""
         return self._build_count
+
+    def fingerprint(self):
+        """The last committed step's post-update parameter fingerprint as
+        a Python int (ISSUE 20, parallel/integrity.py), or None before
+        the first applied update or with ``TPUMX_FINGERPRINT=0``.
+
+        The uint32 digest was computed INSIDE the fused step and committed
+        as a lazy device scalar; the conversion here rides on a program
+        that already completed (the sentinel read its loss), so this is a
+        cheap transfer at the step boundary — the IntegrityMonitor's
+        ``fingerprint_fn`` seam."""
+        if not _fingerprint_on():
+            return None
+        fp = self._last_fp
+        if fp is None:
+            return None
+        # tpumx-lint: disable=sync-point -- the digest readback rides a
+        # step whose loss was already read (program complete); called at
+        # the K-step vote cadence, never inside the dispatch path
+        return int(jax.device_get(fp))
 
     def step(self, *batch, lr=None, deadline=None, compile_grace=120.0):
         """Run one step; batch = (*data_args, label) as NDArray/array.
@@ -703,7 +746,7 @@ class CompiledTrainStep:
         # np scalars, not jnp.asarray: the jit boundary places them —
         # two fewer eager device commits per step
         (new_vals, new_masters, new_states, new_efs, gacc,
-         loss) = self._jitted(
+         loss, fp) = self._jitted(
             self.values, self.masters, self.opt_states, self._efs, gacc,
             np.float32(t_next), np.float32(lr),
             key, *raw)
@@ -719,6 +762,10 @@ class CompiledTrainStep:
                 return NDArray(loss)
             (self.values, self.masters, self.opt_states,
              self._efs) = new_vals, new_masters, new_states, new_efs
+            # the step's device fingerprint commits WITH the state it
+            # digests (still a lazy device scalar — fingerprint() is
+            # where the int conversion happens, off the hot path)
+            self._last_fp = fp
             self._t = t_next
             self._micro = 0
             if self._accum > 1:
@@ -728,8 +775,44 @@ class CompiledTrainStep:
         # state becoming THE state, under the zombie-step lock)
         _tracing.emit("train_step.phase", t0=t_done,
                       t1=time.perf_counter(), phase="optimizer_update")
+        # chaos SDC injection (ISSUE 20): flip one bit of the COMMITTED
+        # state, after this step's fingerprint was computed — the flip is
+        # silent until the NEXT published fingerprint disagrees, which is
+        # the detection latency the defense actually promises (≤ K steps)
+        bit = _chaos.maybe_bitflip()
+        if bit is not None:
+            self._apply_bitflip(bit)
         self._record_step(raw, t_start)
         return NDArray(loss)
+
+    def _apply_bitflip(self, bit):
+        """Flip bit ``bit`` of element 0 of the first diff param — the
+        chaos ``bitflip_param_at_step`` / ``bitflip_grad_rank`` payload.
+        Mixed-precision keys flip the f32 MASTER: the forward weight is
+        recast from it on every update, so flipping only the cast copy
+        would silently self-heal one step later."""
+        key = self._diff_keys[0]
+        with self._state_lock:
+            use_master = key in self._mp_keys
+            tree = self.masters if use_master else self.values
+            # tpumx-lint: disable=sync-point,hot-path-purity -- chaos
+            # fault INJECTION (test-only, armed by TPUMX_CHAOS): the
+            # whole point is to corrupt committed state; the roundtrip
+            # fires at most once per run and never in production
+            host = np.array(jax.device_get(tree[key]))
+            flat = host.reshape(-1)
+            view = flat.view(np.uint32) if flat.dtype == np.float32 \
+                else flat.view(np.uint8)
+            nbits = view.dtype.itemsize * 8
+            view[0] ^= view.dtype.type(1 << (int(bit) % nbits))
+            if self.mesh is not None:
+                tree[key] = jax.device_put(
+                    host, sharding_for(self.mesh, self._specs[key]))
+            else:
+                tree[key] = jnp.asarray(host)
+        _logger.debug("train_step: chaos bit-flip applied to %r bit %d "
+                      "(%s)", key, int(bit),
+                      "master" if use_master else "value")
 
     def _stale(self, expect_gen):
         """True when the train state was restored (generation bumped) while

@@ -292,6 +292,12 @@ class CapsuleManager:
                 "steps": int(sup.steps),
                 "batches_skipped": int(sup.batches_skipped),
                 "sentinel": sup.sentinel.state_dict()})
+            # the fingerprint history rides the capsule: after a
+            # corruption rollback the survivors resume knowing which
+            # step was last cross-replica VERIFIED, not merely saved
+            if getattr(sup, "integrity", None) is not None:
+                body["integrity"] = encode_state(
+                    sup.integrity.state_dict())
         return body
 
     def write_epoch_file(self, epoch, sup=None):
@@ -413,6 +419,9 @@ class CapsuleManager:
             sup.batches_skipped = max(sup.batches_skipped,
                                       int(s.get("batches_skipped", 0)))
             sup.steps = max(sup.steps, int(s.get("steps", 0)))
+        if sup is not None and "integrity" in cap \
+                and getattr(sup, "integrity", None) is not None:
+            sup.integrity.load_state_dict(decode_state(cap["integrity"]))
 
     def restore(self, sup=None, resume_from=0, use_step=True):
         """Called after the weights restore (``restore_fn`` /

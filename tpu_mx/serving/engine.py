@@ -53,6 +53,7 @@ engine's private state, never the scheduler or the request handles
 from __future__ import annotations
 
 import math
+import os
 import time
 
 import numpy as np
@@ -129,6 +130,23 @@ class EngineCore:
                       storage=storage, sharing=self.share_prefix,
                       fused=self.fused, spec_window=self.spec_window,
                       sampling="greedy" if self.greedy else "sampled")
+        # sampled decode self-check (ISSUE 20, parallel/integrity.py):
+        # serving has no dp peer to vote with, so its SDC detector is
+        # the shadow audit — on a seeded sampled cadence, re-execute the
+        # identical decode step and compare the emitted tokens
+        # bit-exactly.  TPUMX_SELF_CHECK is the sample rate (0 = off,
+        # the default: the rerun costs one extra forward on audited
+        # steps).  A mismatch is DataCorruption → the server's restart
+        # ladder, like every other classified engine fault.
+        rate = float(os.environ.get("TPUMX_SELF_CHECK", "0") or 0)
+        if rate > 0:
+            from ..parallel.integrity import ShadowAuditor
+            seed = int(os.environ.get("TPUMX_SELF_CHECK_SEED", "0") or 0)
+            self._self_check = ShadowAuditor(rate=rate, seed=seed,
+                                             surface="decode")
+        else:
+            self._self_check = None
+        self._decode_step_idx = 0
         # cumulative speculative accounting for the accept-ratio gauge
         self._spec_drafted = 0
         self._spec_accepted = 0
@@ -313,6 +331,36 @@ class EngineCore:
             raise NumericDivergence(
                 f"serving: non-finite logits in decode batch of "
                 f"{len(live)} (health={health}) — restarting the engine")
+        # sampled decode self-check (ISSUE 20): BEFORE the acceptance
+        # loop truncates any rejected tail, re-run the identical step —
+        # same operands, same program; the window's cache writes land the
+        # same values in the same reserved slots (idempotent), so the
+        # re-execution is bit-deterministic and a token mismatch is flaky
+        # hardware by construction.  DataCorruption → classified
+        # "corruption" → the server's restart ladder.
+        idx = self._decode_step_idx
+        self._decode_step_idx += 1
+        if self._self_check is not None \
+                and self._self_check.should_audit(idx):
+            _telemetry.counter("integrity.self_checks").inc()
+
+            def _recompute():
+                if self.fused:
+                    o2, _l2, _h2, _c2 = self._fused_step(
+                        seq_ids, draft, positions)
+                else:
+                    o2, _l2, _h2, _c2 = self._host_step(
+                        seq_ids, draft, positions,
+                        want_logits=want_logits)
+                return np.asarray(o2)
+
+            try:
+                self._self_check.audit(np.asarray(out), _recompute,
+                                       step=idx)
+            except Exception:
+                _telemetry.counter(
+                    "integrity.self_check_mismatches").inc()
+                raise
         results = {}
         emitted_total = 0
         accepted_total = 0

@@ -76,8 +76,9 @@ from .contrib.chaos import ChaosCrash
 from .elastic import WorkerFailure
 
 __all__ = ["Supervisor", "Supervise", "SupervisorResult", "NumericSentinel",
-           "NumericDivergence", "WatchdogTimeout", "run_with_deadline",
-           "classify", "for_module", "TRANSIENT_EXCEPTIONS"]
+           "NumericDivergence", "DataCorruption", "WatchdogTimeout",
+           "run_with_deadline", "classify", "for_module",
+           "TRANSIENT_EXCEPTIONS"]
 
 log = logging.getLogger(__name__)
 
@@ -86,6 +87,26 @@ class NumericDivergence(MXNetError):
     """The numeric sentinel gave up on skipping: training has diverged
     (consecutive NaN/Inf losses or spikes past the skip budget) and must
     roll back to the last verified checkpoint."""
+
+
+class DataCorruption(MXNetError):
+    """Silent data corruption, caught loudly (parallel/integrity.py): a
+    cross-replica fingerprint vote disagreed, a shadow-step audit found
+    a bit-exact re-execution diverging, or a kvstore payload failed its
+    checksum.  Classified ``"corruption"`` — its own recovery class:
+    ``self_corrupt`` ranks quarantine themselves (the fleet never
+    re-admits a corrupt chip), surviving majorities roll back to the
+    last *verified* checkpoint (``verified_step`` — the newest all-agree
+    fingerprint vote, carried by the capsule so it is provable)."""
+
+    def __init__(self, message, step=0, minority=(), verified_step=0,
+                 surface="train", self_corrupt=False):
+        super().__init__(message)
+        self.step = int(step)
+        self.minority = tuple(int(m) for m in minority)
+        self.verified_step = int(verified_step)
+        self.surface = str(surface)
+        self.self_corrupt = bool(self_corrupt)
 
 
 class WatchdogTimeout(WorkerFailure):
@@ -103,12 +124,15 @@ TRANSIENT_EXCEPTIONS = (OSError, ConnectionError, TimeoutError,
 
 
 def classify(exc, transient=TRANSIENT_EXCEPTIONS):
-    """Sort a failure into ``"transient"`` / ``"numeric"`` / ``"fatal"``.
+    """Sort a failure into ``"transient"`` / ``"numeric"`` /
+    ``"corruption"`` / ``"fatal"``.
 
     The classification IS the retry policy (docs/robustness.md): transient
     faults restart from the manifest, numeric divergence rolls back to the
-    last verified checkpoint, and everything else — programming errors,
-    ``KeyboardInterrupt``/``SystemExit`` — propagates immediately.
+    last verified checkpoint, data corruption (parallel/integrity.py)
+    quarantines the corrupt rank or rolls survivors back to the last
+    fingerprint-*verified* checkpoint, and everything else — programming
+    errors, ``KeyboardInterrupt``/``SystemExit`` — propagates immediately.
 
     With a fleet attached, :meth:`Supervisor.run` refines one case: a
     transient ``WorkerFailure`` that coincides with a moved membership
@@ -116,6 +140,8 @@ def classify(exc, transient=TRANSIENT_EXCEPTIONS):
     size without burning the restart budget (docs/robustness.md
     "Elastic fleets").
     """
+    if isinstance(exc, DataCorruption):
+        return "corruption"
     if isinstance(exc, NumericDivergence):
         return "numeric"
     if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
@@ -361,9 +387,16 @@ class Supervisor:
                  max_grad_norm=None, cooldown=0.0, backoff=0.5,
                  max_backoff=30.0, jitter=0.5, transient=None, resume=True,
                  seed=None, on_degraded=None, capsule=None, blackbox=None,
-                 fleet=None):
+                 fleet=None, integrity=None):
         self.save_fn = save_fn
         self.restore_fn = restore_fn
+        # SDC defense (parallel/integrity.py, docs/robustness.md "Silent
+        # data corruption defense"): an IntegrityMonitor whose
+        # on_committed_step runs at every step boundary — publish the
+        # step's device fingerprint on its K-step cadence, vote against
+        # the cohort, and raise DataCorruption on disagreement (caught
+        # and classified "corruption" below)
+        self.integrity = integrity
         # elastic fleet membership (parallel/fleet.py, docs/robustness.md
         # "Elastic fleets"): when attached, every step boundary runs the
         # fleet duty cycle (heartbeat + membership check) and a
@@ -396,6 +429,7 @@ class Supervisor:
         self._epoch = None
         self.restarts = 0
         self.rollbacks = 0
+        self.corruptions = 0
         self.batches_skipped = 0
         self.watchdog_fires = 0
         self.steps = 0               # committed steps across the whole run
@@ -512,6 +546,12 @@ class Supervisor:
         # resume continues at the next batch, never re-feeding this one
         self._step_in_epoch += 1
         self.steps += 1
+        # the integrity duty cycle runs BEFORE the capsule snapshot so a
+        # verified-step advance from an all-agree vote rides this step's
+        # capsule; a disagreeing vote raises DataCorruption right here —
+        # the step boundary, the same quiesce point membership uses
+        if self.integrity is not None:
+            self.integrity.on_committed_step(self.steps)
         if self.capsule is not None:
             self.capsule.on_step(self)
         chaos.maybe_crash_step()
@@ -605,6 +645,61 @@ class Supervisor:
                         f"{prev_world} -> {ep_rec['world_size']} "
                         f"({ep_rec.get('reason')}) — resharded, resuming "
                         f"epoch {epoch}")
+                elif kind == "corruption":
+                    self.corruptions += 1
+                    _telemetry.counter("supervisor.corruptions").inc()
+                    if getattr(e, "self_corrupt", False):
+                        # THIS replica is the corrupt one (voted-out
+                        # minority, or a self-attributed shadow-audit
+                        # mismatch): quarantine the rank permanently —
+                        # the fleet must never re-admit a flaky chip —
+                        # and die loudly.  No retry: re-running on bad
+                        # silicon is how silent corruption spreads.
+                        if self.fleet is not None \
+                                and self.fleet.member is not None:
+                            try:
+                                self.fleet.quarantine(
+                                    self.fleet.member,
+                                    reason=str(e)[:300],
+                                    step=getattr(e, "step", 0))
+                            except Exception as qerr:  # noqa: BLE001
+                                log.error("supervisor: quarantine record "
+                                          "failed: %s", qerr)
+                        log.error("supervisor: %s — this rank is "
+                                  "quarantined, exiting", e)
+                        self._dump_blackbox(
+                            f"{type(e).__name__}: {e} — rank quarantined "
+                            f"(self_corrupt)")
+                        _telemetry.flush()
+                        raise
+                    # surviving majority: the corrupt peer's gradients
+                    # reached every replica through sync, so the live
+                    # state is suspect past the last VERIFIED step —
+                    # numeric-style rollback (the step capsule holds the
+                    # poisoned trajectory and is discarded)
+                    self.rollbacks += 1
+                    _telemetry.counter("supervisor.rollbacks").inc()
+                    if self.max_rollbacks is not None \
+                            and self.rollbacks > self.max_rollbacks:
+                        return self._degrade(epoch, e, "rollbacks")
+                    log.warning(
+                        "supervisor: %s — rolling back to the last "
+                        "verified checkpoint (fingerprint-verified step "
+                        "%d)", e, getattr(e, "verified_step", 0))
+                    self._sentinel.reset()
+                    epoch = self._restore(epoch, kind="numeric")
+                    _tracing.emit(
+                        "integrity.rollback",
+                        step=int(getattr(e, "step", 0)),
+                        verified_step=int(getattr(e, "verified_step", 0)),
+                        resume_epoch=int(epoch))
+                    self._dump_blackbox(
+                        f"{type(e).__name__}: {e} — corruption rollback "
+                        f"{self.rollbacks}/{self.max_rollbacks} to epoch "
+                        f"{epoch} (verified step "
+                        f"{getattr(e, 'verified_step', 0)})")
+                    if self.cooldown:
+                        time.sleep(self.cooldown)
                 elif kind == "numeric":
                     self.rollbacks += 1
                     _telemetry.counter("supervisor.rollbacks").inc()
@@ -693,7 +788,9 @@ class Supervisor:
                   budget, epoch, type(err).__name__, err)
         _tracing.emit("supervisor.degrade", budget=budget,
                       error=f"{type(err).__name__}: {err}"[:300])
-        if classify(err, self.transient) == "numeric":
+        if classify(err, self.transient) in ("numeric", "corruption"):
+            # corruption exhaustion is numeric-shaped: the live weights
+            # are suspect, committing them would crown poisoned state
             if self.restore_fn is not None:
                 try:
                     self.restore_fn()

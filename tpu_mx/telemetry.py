@@ -139,13 +139,35 @@ KNOWN_METRICS = frozenset({
     # compiled train step (tpu_mx/parallel/train_step.py)
     "train_step.seconds", "train_step.steps", "train_step.recompiles",
     "train_step.examples_per_sec",
-    # kvstore eager path (tpu_mx/kvstore.py)
+    # kvstore eager path (tpu_mx/kvstore.py).  checksums counts payload
+    # digests recorded at push time, checksum_failures the pulls whose
+    # aggregate no longer matched — silent corruption crossing the sync
+    # seam, raised loudly as kvstore.IntegrityError (ISSUE 20)
     "kvstore.pushes", "kvstore.pulls",
     "kvstore.push_bytes", "kvstore.pull_bytes",
-    # self-healing supervisor (tpu_mx/supervisor.py)
+    "kvstore.checksums", "kvstore.checksum_failures",
+    # self-healing supervisor (tpu_mx/supervisor.py; corruptions counts
+    # DataCorruption verdicts the classify discipline handled)
     "supervisor.restarts", "supervisor.rollbacks",
+    "supervisor.corruptions",
     "supervisor.batches_skipped", "supervisor.watchdog_fires",
     "supervisor.degraded",
+    # SDC defense plane (ISSUE 20; tpu_mx/parallel/integrity.py,
+    # docs/robustness.md "Silent data corruption defense").
+    # fingerprints counts published cross-replica digests, votes the
+    # cohort comparisons, mismatches the disagreeing votes (corruption
+    # verdicts); verified_step is a gauge: the newest step PROVEN clean
+    # by an all-agree vote (the rollback anchor, carried by the
+    # capsule).  shadow_audits / shadow_mismatches count sampled
+    # bit-exact re-executions and their failures (the dp=1 detector);
+    # self_checks / self_check_mismatches are the serving decode twin;
+    # quarantined counts ranks permanently barred by a corruption
+    # verdict (fleet.quarantine — never re-admitted).
+    "integrity.fingerprints", "integrity.votes", "integrity.mismatches",
+    "integrity.verified_step",
+    "integrity.shadow_audits", "integrity.shadow_mismatches",
+    "integrity.self_checks", "integrity.self_check_mismatches",
+    "integrity.quarantined",
     # deterministic-resume capsules (tpu_mx/resume.py; resume_step_gap is
     # the batches a recovery could NOT replay exactly — 0 under capsules,
     # and the soak CI tier fails if it is ever nonzero)

@@ -101,6 +101,32 @@ KNOWN_EVENTS = {
     # fault injection (tpu_mx/contrib/chaos.py): the injection and the
     # recovery it provokes share one timeline
     "chaos.inject": {"kind": "str"},
+    # SDC defense plane (ISSUE 20; tpu_mx/parallel/integrity.py +
+    # supervisor.py, docs/robustness.md "Silent data corruption
+    # defense").  `integrity.fingerprint` records every published
+    # cross-replica digest (the K-step cadence);  `integrity.vote` one
+    # cohort comparison — agree=False IS the corruption verdict, with
+    # `minority` the comma-joined voted-out rank(s) ("" when a tie
+    # detected but could not attribute);  `integrity.quarantine` the
+    # permanent eviction of a corrupt rank (never re-admitted — distinct
+    # from fleet.leave/fleet.lost, which healed members survive);
+    # `integrity.shadow_audit` one sampled bit-exact re-execution
+    # (surface=train|decode);  `integrity.rollback` the surviving
+    # majority's recovery decision, naming the last fingerprint-VERIFIED
+    # step the restore is anchored to.
+    "integrity.fingerprint": {"step": "int", "fp": "int", "rank": "int"},
+    "integrity.vote": {"step": "int", "agree": "bool",
+                       "majority_fp": "int", "minority": "str",
+                       "world_size": "int"},
+    "integrity.quarantine": {"rank": "int", "reason": "str",
+                             "step": "int"},
+    "integrity.shadow_audit": {"step": "int", "match": "bool",
+                               "surface": "str"},
+    "integrity.rollback": {"step": "int", "verified_step": "int",
+                           "resume_epoch": "int"},
+    # kvstore payload integrity (ISSUE 20): a pulled aggregate failed
+    # its push-time checksum — corruption crossed the sync seam
+    "kvstore.checksum_fail": {"key": "str"},
     # elastic fleet membership (tpu_mx/parallel/fleet.py + tools/launch.py
     # --supervise; docs/robustness.md "Elastic fleets").  Every membership
     # transition is on the timeline: `fleet.epoch` is the authoritative
